@@ -1,0 +1,43 @@
+"""ceph_tpu — a TPU-native distributed object-storage framework.
+
+A brand-new system with the capabilities of Ceph/RADOS, built TPU-first:
+the two data-parallel hot paths (CRUSH placement mapping and GF(2^8)
+erasure coding) run as JAX/XLA kernels batched over millions of PGs /
+thousands of stripes, while the surrounding system (cluster maps, monitors,
+OSD daemons, messenger, object stores, client library) is rebuilt
+idiomatically in Python + C++.
+
+Layer map (mirrors the reference's architecture, see SURVEY.md §1):
+
+  utils/     L0 substrate: config, logging, perf counters, admin socket
+  ops/       L1 compute kernels: CRUSH (host + JAX), GF(2^8) EC (host + JAX)
+  ec/        L1 erasure-code plugin framework + plugins
+  models/    cluster map models: CrushMap, OSDMap, pools
+  parallel/  device-mesh bulk mapping, sharding helpers, striper math
+  store/     L2 ObjectStore: Transaction, MemStore, KStore
+  msg/       L3 async messenger (framed DCN transport)
+  mon/       L4 control plane: paxos-replicated map store, elections
+  osd/       L5 data plane: PGs, replicated/EC backends, peering, recovery
+  client/    L6 librados-style client: Objecter, striper
+  cli/       L8 tools: crushtool/osdmaptool/rados analogs, vstart
+
+Bit-exactness: CRUSH mapping is bit-identical to the reference semantics
+(verified against golden vectors generated from the reference's freestanding
+C core); straw2 needs 64-bit signed fixed-point, so x64 mode is enabled at
+import, before any JAX computation runs.
+"""
+
+import os as _os
+
+# straw2 draws are s64 fixed-point (2^44-scaled log2 divided by 16.16
+# weights); JAX must run with x64 enabled before the backend initialises.
+_os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+try:  # keep the non-JAX layers importable even where jax is absent
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+except ImportError:  # pragma: no cover
+    _jax = None
+
+__version__ = "0.1.0"

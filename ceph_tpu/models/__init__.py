@@ -1,0 +1,1 @@
+"""models subpackage — see ceph_tpu/__init__.py for the layer map."""

@@ -1,0 +1,228 @@
+"""Typed, layered configuration system.
+
+Reference analog: Ceph's option framework — options declared with
+type/level/default/min/max/enum/see_also in YAML
+(src/common/options/*.yaml.in), merged from layered sources
+(compiled defaults < conf file < centralized mon store < env < CLI <
+runtime overrides) with change observers (md_config_obs_t).
+
+This is a fresh design: options are declared in Python as `Option`
+objects grouped into schemas; a `Config` instance resolves values through
+an explicit source-priority stack and notifies observers on change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+OPT_STR = "str"
+OPT_INT = "int"
+OPT_FLOAT = "float"
+OPT_BOOL = "bool"
+
+_CASTS: dict[str, Callable[[Any], Any]] = {
+    OPT_STR: str,
+    OPT_INT: int,
+    OPT_FLOAT: float,
+    OPT_BOOL: lambda v: (
+        v
+        if isinstance(v, bool)
+        else str(v).strip().lower() in ("1", "true", "yes", "on")
+    ),
+}
+
+# Source priority, low to high.  Mirrors the reference's merge order:
+# defaults < conf file < mon central store < env < cli < runtime.
+SOURCES = ("default", "file", "mon", "env", "cli", "runtime")
+_SOURCE_RANK = {s: i for i, s in enumerate(SOURCES)}
+
+
+@dataclass(frozen=True)
+class Option:
+    """One declared configuration option."""
+
+    name: str
+    type: str = OPT_STR
+    default: Any = None
+    desc: str = ""
+    level: str = "advanced"  # basic | advanced | dev
+    min: Any = None
+    max: Any = None
+    enum_allowed: tuple = ()
+    see_also: tuple = ()
+
+    def cast(self, value: Any) -> Any:
+        v = _CASTS[self.type](value)
+        if self.min is not None and v < self.min:
+            raise ValueError(f"{self.name}: {v} < min {self.min}")
+        if self.max is not None and v > self.max:
+            raise ValueError(f"{self.name}: {v} > max {self.max}")
+        if self.enum_allowed and v not in self.enum_allowed:
+            raise ValueError(f"{self.name}: {v!r} not in {self.enum_allowed}")
+        return v
+
+
+class Config:
+    """Layered config resolver with observers.
+
+    Values are stored per (option, source); lookup returns the value from
+    the highest-priority source that has one, else the declared default.
+    """
+
+    def __init__(self, schema: Iterable[Option] = (), env_prefix: str = "CEPH_TPU_"):
+        self._lock = threading.RLock()
+        self._schema: dict[str, Option] = {}
+        self._defaults: dict[str, Any] = {}  # pre-cast declared defaults
+        self._values: dict[str, dict[str, Any]] = {}  # name -> source -> value
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+        self._env_prefix = env_prefix
+        self.register(DEFAULT_SCHEMA)
+        self.register(schema)
+        self._load_env()
+
+    # -- schema ----------------------------------------------------------
+    def register(self, options: Iterable[Option]) -> None:
+        with self._lock:
+            for opt in options:
+                self._schema[opt.name] = opt
+                if opt.default is not None:
+                    self._defaults[opt.name] = opt.cast(opt.default)
+
+    def option(self, name: str) -> Option:
+        return self._schema[name]
+
+    def schema(self) -> list[Option]:
+        return sorted(self._schema.values(), key=lambda o: o.name)
+
+    # -- sources ---------------------------------------------------------
+    def load_file(self, path: str) -> None:
+        """Load a JSON conf file ({option: value} or {section: {option: value}})."""
+        with open(path) as f:
+            data = json.load(f)
+        flat: dict[str, Any] = {}
+        for k, v in data.items():
+            if isinstance(v, dict):
+                flat.update(v)
+            else:
+                flat[k] = v
+        # validate everything before committing anything, so a bad key or
+        # value cannot leave the config half-applied
+        casted = {}
+        for k, v in flat.items():
+            opt = self._schema.get(k)
+            if opt is None:
+                raise KeyError(f"unknown option {k!r} in {path}")
+            casted[k] = opt.cast(v)
+        for k, v in casted.items():
+            self.set(k, v, source="file")
+
+    def _load_env(self) -> None:
+        for key, raw in os.environ.items():
+            if key.startswith(self._env_prefix):
+                name = key[len(self._env_prefix):].lower()
+                if name in self._schema:
+                    try:
+                        self.set(name, raw, source="env")
+                    except ValueError as e:
+                        # a bad env var must not make the process
+                        # unconstructable; warn and fall through
+                        import sys
+
+                        print(f"ceph-tpu: ignoring {key}: {e}", file=sys.stderr)
+
+    def apply_mon_values(self, values: dict[str, Any]) -> None:
+        """Apply centralized values pushed by the monitor config service."""
+        for k, v in values.items():
+            self.set(k, v, source="mon")
+
+    # -- get/set ---------------------------------------------------------
+    def set(self, name: str, value: Any, source: str = "runtime") -> None:
+        if source not in _SOURCE_RANK:
+            raise ValueError(f"unknown config source {source!r}")
+        opt = self._schema.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        value = opt.cast(value)
+        with self._lock:
+            old = self.get(name)
+            self._values.setdefault(name, {})[source] = value
+            new = self.get(name)
+            observers = list(self._observers.get(name, ()))
+        if new != old:
+            for fn in observers:
+                fn(name, new)
+
+    def rm(self, name: str, source: str = "runtime") -> None:
+        with self._lock:
+            old = self.get(name)
+            self._values.get(name, {}).pop(source, None)
+            new = self.get(name)
+            observers = list(self._observers.get(name, ()))
+        if new != old:
+            for fn in observers:
+                fn(name, new)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        opt = self._schema.get(name)
+        with self._lock:
+            per_source = self._values.get(name)
+            if per_source:
+                for source in reversed(SOURCES):
+                    if source in per_source:
+                        return per_source[source]
+        if name in self._defaults:
+            return self._defaults[name]
+        return default
+
+    def __getitem__(self, name: str) -> Any:
+        if name not in self._schema:
+            raise KeyError(name)
+        return self.get(name)
+
+    # -- observers -------------------------------------------------------
+    def add_observer(self, name: str, fn: Callable[[str, Any], None]) -> None:
+        with self._lock:
+            self._observers.setdefault(name, []).append(fn)
+
+    # -- introspection ---------------------------------------------------
+    def dump(self) -> dict[str, Any]:
+        return {o.name: self.get(o.name) for o in self.schema()}
+
+    def diff(self) -> dict[str, dict[str, Any]]:
+        """Non-default values per source (admin `config diff` analog)."""
+        with self._lock:
+            return {n: dict(per) for n, per in self._values.items() if per}
+
+
+DEFAULT_SCHEMA: list[Option] = [
+    Option("log_level", OPT_INT, 1, "global log level (0-20)", min=0, max=20),
+    Option("log_ring_size", OPT_INT, 10000, "crash-dump ring buffer entries"),
+    Option("admin_socket", OPT_STR, "", "path for admin socket, empty=disabled"),
+    Option("mon_addrs", OPT_STR, "", "comma-separated monitor host:port list"),
+    Option("public_addr", OPT_STR, "", "daemon bind address"),
+    Option("heartbeat_interval", OPT_FLOAT, 1.0, "osd peer heartbeat period (s)"),
+    Option("heartbeat_grace", OPT_FLOAT, 6.0, "failure grace before reporting (s)"),
+    Option("mon_osd_down_out_interval", OPT_FLOAT, 30.0,
+           "seconds before a down osd is auto-marked out"),
+    Option("mon_osd_min_down_reporters", OPT_INT, 1,
+           "distinct reporters required to mark an osd down"),
+    Option("mon_lease", OPT_FLOAT, 5.0, "paxos lease duration (s)"),
+    Option("osd_pool_default_size", OPT_INT, 3, "default replica count"),
+    Option("osd_pool_default_min_size", OPT_INT, 2, "min replicas to serve IO"),
+    Option("osd_pool_default_pg_num", OPT_INT, 32, "default pg count"),
+    Option("osd_op_num_shards", OPT_INT, 4, "op queue shards per osd"),
+    Option("osd_recovery_max_active", OPT_INT, 8,
+           "max concurrent recovery ops per osd"),
+    Option("ec_batch_max_stripes", OPT_INT, 4096,
+           "max stripes aggregated into one device EC dispatch"),
+    Option("ec_batch_flush_us", OPT_INT, 200,
+           "deadline before a partial EC batch is flushed (µs)"),
+    Option("crush_backend", OPT_STR, "auto", "crush mapping backend",
+           enum_allowed=("auto", "host", "jax", "native")),
+    Option("ec_backend", OPT_STR, "auto", "erasure-code compute backend",
+           enum_allowed=("auto", "host", "jax", "native")),
+]

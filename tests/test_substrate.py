@@ -1,0 +1,170 @@
+"""L0 substrate tests: config layering, logging ring, perf counters, admin socket."""
+
+import json
+import os
+import tempfile
+import threading
+
+import pytest
+
+from ceph_tpu.utils import Config, Context, Option, PerfCounters
+from ceph_tpu.utils.admin import admin_command
+from ceph_tpu.utils.log import Logger, LogRing
+
+
+class TestConfig:
+    def test_defaults_and_cast(self):
+        c = Config()
+        assert c["osd_pool_default_size"] == 3
+        assert isinstance(c["heartbeat_interval"], float)
+
+    def test_source_priority(self):
+        c = Config()
+        c.set("log_level", 5, source="file")
+        assert c["log_level"] == 5
+        c.set("log_level", 10, source="cli")
+        assert c["log_level"] == 10
+        # lower-priority source cannot shadow a higher one
+        c.set("log_level", 2, source="mon")
+        assert c["log_level"] == 10
+        c.rm("log_level", source="cli")
+        assert c["log_level"] == 2
+
+    def test_bounds_and_enum(self):
+        c = Config()
+        with pytest.raises(ValueError):
+            c.set("log_level", 99)
+        with pytest.raises(ValueError):
+            c.set("crush_backend", "gpu")
+        c.set("crush_backend", "jax")
+        assert c["crush_backend"] == "jax"
+
+    def test_unknown_option(self):
+        c = Config()
+        with pytest.raises(KeyError):
+            c.set("no_such_option", 1)
+
+    def test_file_source(self, tmp_path):
+        p = tmp_path / "conf.json"
+        p.write_text(json.dumps({"global": {"log_level": 7}}))
+        c = Config()
+        c.load_file(str(p))
+        assert c["log_level"] == 7
+
+    def test_observers(self):
+        c = Config()
+        seen = []
+        c.add_observer("heartbeat_grace", lambda k, v: seen.append((k, v)))
+        c.set("heartbeat_grace", 12.5)
+        assert seen == [("heartbeat_grace", 12.5)]
+        c.set("heartbeat_grace", 12.5)  # no change -> no callback
+        assert len(seen) == 1
+
+    def test_custom_schema(self):
+        c = Config([Option("my_opt", "int", 42, min=0)])
+        assert c["my_opt"] == 42
+
+    def test_rm_notifies_observers(self):
+        c = Config()
+        seen = []
+        c.add_observer("log_level", lambda k, v: seen.append(v))
+        c.set("log_level", 10, source="cli")
+        c.rm("log_level", source="cli")
+        assert seen == [10, 1]  # back to default
+
+    def test_file_source_atomic(self, tmp_path):
+        p = tmp_path / "conf.json"
+        p.write_text(json.dumps({"log_level": 7, "log_levle": 3}))
+        c = Config()
+        with pytest.raises(KeyError):
+            c.load_file(str(p))
+        assert c["log_level"] == 1  # typo'd key aborted before any commit
+
+    def test_bad_env_var_does_not_crash(self, monkeypatch, capsys):
+        monkeypatch.setenv("CEPH_TPU_LOG_LEVEL", "verbose")
+        c = Config()
+        assert c["log_level"] == 1
+        assert "ignoring CEPH_TPU_LOG_LEVEL" in capsys.readouterr().err
+
+
+class TestLog:
+    def test_ring_gathers_above_output_level(self):
+        ring = LogRing(16)
+        log = Logger("t", ring=ring, sink=open(os.devnull, "w"))
+        log.set_level("osd", output=1, gather=10)
+        log.debug("osd", "deep detail", level=7)   # gathered, not emitted
+        log.debug("osd", "too deep", level=15)     # dropped entirely
+        assert len(ring._ring) == 1
+
+    def test_global_level_applies_to_real_subsystems(self):
+        import io
+
+        sink = io.StringIO()
+        log = Logger("t", sink=sink)
+        log.set_global_level(10)
+        log.debug("osd", "visible now", level=5)
+        assert "visible now" in sink.getvalue()
+
+    def test_ring_bounded(self):
+        ring = LogRing(8)
+        log = Logger("t", ring=ring, sink=open(os.devnull, "w"))
+        for i in range(100):
+            log.info("osd", f"m{i}")
+        assert len(ring._ring) == 8
+
+
+class TestPerf:
+    def test_kinds(self):
+        pc = PerfCounters("osd")
+        pc.add_u64("ops")
+        pc.add_avg("op_bytes")
+        pc.add_time("op_lat")
+        pc.add_hist("op_hist")
+        pc.inc("ops", 3)
+        pc.avg_add("op_bytes", 4096)
+        pc.avg_add("op_bytes", 8192)
+        pc.tinc("op_lat", 0.5)
+        pc.hist_sample("op_hist", 0.001)  # 1000 us -> bucket 9
+        d = pc.dump()
+        assert d["ops"] == 3
+        assert d["op_bytes"]["avg"] == 6144
+        assert d["op_lat"]["sum"] == 0.5
+        assert d["op_hist"]["buckets_us_pow2"][9] == 1
+
+    def test_timed_context(self):
+        pc = PerfCounters("x")
+        pc.add_time("t")
+        with pc.timed("t"):
+            pass
+        assert pc.dump()["t"]["count"] == 1
+
+    def test_threaded_inc(self):
+        pc = PerfCounters("x")
+        pc.add_u64("n")
+        threads = [
+            threading.Thread(target=lambda: [pc.inc("n") for _ in range(1000)])
+            for _ in range(8)
+        ]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert pc.dump()["n"] == 8000
+
+
+class TestAdminSocket:
+    def test_round_trip(self):
+        path = os.path.join(tempfile.mkdtemp(), "asok")
+        ctx = Context("test-daemon", conf_overrides={"admin_socket": path})
+        try:
+            pc = ctx.perf.create("osd")
+            pc.add_u64("ops")
+            pc.inc("ops", 7)
+            assert admin_command(path, "perf dump")["osd"]["ops"] == 7
+            admin_command(path, "config set", key="log_level", value=4)
+            assert admin_command(path, "config get", key="log_level") == {
+                "log_level": 4
+            }
+            assert "perf dump" in admin_command(path, "help")
+            with pytest.raises(RuntimeError):
+                admin_command(path, "bogus command")
+        finally:
+            ctx.shutdown()
