@@ -81,9 +81,10 @@ class AdminSocket:
             pass
 
     def _serve(self) -> None:
+        sock = self._sock  # local ref: stop() may null the attribute
         while not self._stop.is_set():
             try:
-                conn, _ = self._sock.accept()
+                conn, _ = sock.accept()
             except socket.timeout:
                 continue
             except OSError:
@@ -103,7 +104,7 @@ class AdminSocket:
             if not b:
                 break
             chunks.append(b)
-            if b.rstrip().endswith((b"}", b"\n")) and _is_complete(b"".join(chunks)):
+            if _is_complete(b"".join(chunks)):
                 break
         try:
             req = json.loads(b"".join(chunks) or b"{}")

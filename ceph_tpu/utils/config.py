@@ -91,6 +91,9 @@ class Config:
                 self._schema[opt.name] = opt
                 if opt.default is not None:
                     self._defaults[opt.name] = opt.cast(opt.default)
+        # late-registered options may have env overrides waiting
+        if hasattr(self, "_env_prefix"):
+            self._load_env()
 
     def option(self, name: str) -> Option:
         return self._schema[name]
@@ -167,7 +170,6 @@ class Config:
                 fn(name, new)
 
     def get(self, name: str, default: Any = None) -> Any:
-        opt = self._schema.get(name)
         with self._lock:
             per_source = self._values.get(name)
             if per_source:

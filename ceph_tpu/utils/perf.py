@@ -45,18 +45,22 @@ class PerfCounters:
 
     # -- declaration -----------------------------------------------------
     def add_u64(self, name: str, desc: str = "") -> None:
-        self._counters[name] = _Counter("u64", desc)
+        with self._lock:
+            self._counters[name] = _Counter("u64", desc)
 
     def add_time(self, name: str, desc: str = "") -> None:
-        self._counters[name] = _Counter("time", desc)
+        with self._lock:
+            self._counters[name] = _Counter("time", desc)
 
     def add_avg(self, name: str, desc: str = "") -> None:
-        self._counters[name] = _Counter("avg", desc)
+        with self._lock:
+            self._counters[name] = _Counter("avg", desc)
 
     def add_hist(self, name: str, desc: str = "") -> None:
         c = _Counter("hist", desc)
         c.buckets = [0] * self.HIST_BUCKETS
-        self._counters[name] = c
+        with self._lock:
+            self._counters[name] = c
 
     # -- mutation --------------------------------------------------------
     def inc(self, name: str, by: int = 1) -> None:

@@ -1,0 +1,436 @@
+#!/usr/bin/env python3
+"""Generate CRUSH golden vectors from the reference C implementation.
+
+Builds a small oracle binary in /tmp that links the reference's
+freestanding CRUSH core (crush.c/mapper.c/builder.c/hash.c — kernel-
+compatible C with no other dependencies), feeds it map specs generated
+from ceph_tpu's own CrushMap model, and records the resulting mappings
+as JSON golden files committed under tests/golden/.
+
+The oracle binary and the reference sources stay outside the repo; only
+the generated *data* is committed.  Tests then verify ceph_tpu's host
+and JAX mapping engines reproduce these vectors bit-exactly.
+
+Usage: python tests/golden/gen_crush_golden.py [reference_root]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from ceph_tpu.models.crushmap import (  # noqa: E402
+    CHOOSE_FIRSTN,
+    CHOOSE_INDEP,
+    CHOOSELEAF_FIRSTN,
+    CHOOSELEAF_INDEP,
+    EMIT,
+    LIST,
+    SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    SET_CHOOSE_LOCAL_TRIES,
+    SET_CHOOSELEAF_STABLE,
+    SET_CHOOSELEAF_TRIES,
+    SET_CHOOSELEAF_VARY_R,
+    SET_CHOOSE_TRIES,
+    STRAW,
+    STRAW2,
+    TAKE,
+    TREE,
+    UNIFORM,
+    CrushMap,
+    Tunables,
+    WeightSet,
+)
+
+GOLDEN_DIR = os.path.dirname(os.path.abspath(__file__))
+
+DRIVER_C = r"""
+/* CRUSH oracle driver: builds maps from a line protocol, runs queries,
+ * prints results.  Written for ceph_tpu golden-vector generation; links
+ * against the reference's freestanding CRUSH core. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "crush.h"
+#include "builder.h"
+#include "mapper.h"
+#include "hash.h"
+
+extern __u64 crush_ln_oracle(unsigned int xin);
+
+int main(void) {
+    struct crush_map *map = crush_create();
+    struct crush_bucket *buckets[4096];
+    struct crush_choose_arg *cargs = NULL;
+    __u32 weights[65536];
+    int n_weights = 0;
+    char line[1 << 20];
+
+    while (fgets(line, sizeof(line), stdin)) {
+        char *tok = strtok(line, " \n");
+        if (!tok) continue;
+        if (!strcmp(tok, "tunables")) {
+            map->choose_local_tries = atoi(strtok(NULL, " \n"));
+            map->choose_local_fallback_tries = atoi(strtok(NULL, " \n"));
+            map->choose_total_tries = atoi(strtok(NULL, " \n"));
+            map->chooseleaf_descend_once = atoi(strtok(NULL, " \n"));
+            map->chooseleaf_vary_r = atoi(strtok(NULL, " \n"));
+            map->chooseleaf_stable = atoi(strtok(NULL, " \n"));
+            map->straw_calc_version = atoi(strtok(NULL, " \n"));
+        } else if (!strcmp(tok, "bucket")) {
+            int id = atoi(strtok(NULL, " \n"));
+            int alg = atoi(strtok(NULL, " \n"));
+            int hash = atoi(strtok(NULL, " \n"));
+            int type = atoi(strtok(NULL, " \n"));
+            int size = atoi(strtok(NULL, " \n"));
+            int *items = malloc(sizeof(int) * size);
+            int *iw = malloc(sizeof(int) * size);
+            for (int i = 0; i < size; i++) {
+                items[i] = atoi(strtok(NULL, ", \n"));
+                iw[i] = atoi(strtok(NULL, ", \n"));
+            }
+            struct crush_bucket *b =
+                crush_make_bucket(map, alg, hash, type, size, items, iw);
+            if (!b) { printf("error: make_bucket\n"); return 1; }
+            int idout;
+            crush_add_bucket(map, id, b, &idout);
+            if (idout != id) { printf("error: bucket id %d != %d\n", idout, id); return 1; }
+            buckets[-1 - id] = b;
+            free(items); free(iw);
+        } else if (!strcmp(tok, "rule")) {
+            int id = atoi(strtok(NULL, " \n"));
+            int nsteps = atoi(strtok(NULL, " \n"));
+            struct crush_rule *r = crush_make_rule(nsteps, 0);
+            for (int i = 0; i < nsteps; i++) {
+                int op = atoi(strtok(NULL, ", \n"));
+                int a1 = atoi(strtok(NULL, ", \n"));
+                int a2 = atoi(strtok(NULL, ", \n"));
+                crush_rule_set_step(r, i, op, a1, a2);
+            }
+            crush_add_rule(map, r, id);
+        } else if (!strcmp(tok, "weights")) {
+            n_weights = atoi(strtok(NULL, " \n"));
+            for (int i = 0; i < n_weights; i++)
+                weights[i] = (__u32)strtoul(strtok(NULL, " \n"), NULL, 10);
+        } else if (!strcmp(tok, "choose_arg")) {
+            /* choose_arg <bucket_id> <npos> <size> w... (npos*size) [ids: i...] */
+            if (!cargs) {
+                cargs = calloc(map->max_buckets, sizeof(*cargs));
+            }
+            int id = atoi(strtok(NULL, " \n"));
+            int npos = atoi(strtok(NULL, " \n"));
+            int size = atoi(strtok(NULL, " \n"));
+            struct crush_choose_arg *a = &cargs[-1 - id];
+            a->weight_set_positions = npos;
+            a->weight_set = calloc(npos, sizeof(struct crush_weight_set));
+            for (int p = 0; p < npos; p++) {
+                a->weight_set[p].size = size;
+                a->weight_set[p].weights = calloc(size, sizeof(__u32));
+                for (int i = 0; i < size; i++)
+                    a->weight_set[p].weights[i] =
+                        (__u32)strtoul(strtok(NULL, " \n"), NULL, 10);
+            }
+            char *idstok = strtok(NULL, " \n");
+            if (idstok && !strcmp(idstok, "ids:")) {
+                a->ids_size = size;
+                a->ids = calloc(size, sizeof(__s32));
+                for (int i = 0; i < size; i++)
+                    a->ids[i] = atoi(strtok(NULL, " \n"));
+            }
+        } else if (!strcmp(tok, "finalize")) {
+            crush_finalize(map);
+        } else if (!strcmp(tok, "query")) {
+            int ruleno = atoi(strtok(NULL, " \n"));
+            int x = atoi(strtok(NULL, " \n"));
+            int result_max = atoi(strtok(NULL, " \n"));
+            int result[1024];
+            void *cwin = malloc(map->working_size + 3 * result_max * sizeof(int));
+            crush_init_workspace(map, cwin);
+            int n = crush_do_rule(map, ruleno, x, result, result_max,
+                                  weights, n_weights, cwin, cargs);
+            free(cwin);
+            printf("result %d %d %d", ruleno, x, n);
+            for (int i = 0; i < n; i++) printf(" %d", result[i]);
+            printf("\n");
+        } else if (!strcmp(tok, "hash2")) {
+            __u32 a = (__u32)strtoul(strtok(NULL, " \n"), NULL, 10);
+            __u32 b = (__u32)strtoul(strtok(NULL, " \n"), NULL, 10);
+            printf("hash2 %u\n", crush_hash32_2(0, a, b));
+        } else if (!strcmp(tok, "hash3")) {
+            __u32 a = (__u32)strtoul(strtok(NULL, " \n"), NULL, 10);
+            __u32 b = (__u32)strtoul(strtok(NULL, " \n"), NULL, 10);
+            __u32 c = (__u32)strtoul(strtok(NULL, " \n"), NULL, 10);
+            printf("hash3 %u\n", crush_hash32_3(0, a, b, c));
+        } else if (!strcmp(tok, "ln")) {
+            unsigned u = (unsigned)strtoul(strtok(NULL, " \n"), NULL, 10);
+            printf("ln %llu\n", (unsigned long long)crush_ln_oracle(u));
+        }
+    }
+    fflush(stdout);
+    return 0;
+}
+"""
+
+# crush_ln is static in mapper.c; re-expose it by including mapper.c in a
+# wrapper TU under a shim (the oracle build lives entirely in /tmp).
+LN_SHIM_C = r"""
+#define dprintk(args...)
+#include "mapper.c"
+__u64 crush_ln_oracle(unsigned int xin) { return crush_ln(xin); }
+"""
+
+
+def build_oracle(reference_root: str) -> str:
+    src = os.path.join(reference_root, "src", "crush")
+    workdir = "/tmp/crush_oracle"
+    os.makedirs(workdir, exist_ok=True)
+    with open(os.path.join(workdir, "driver.c"), "w") as f:
+        f.write(DRIVER_C)
+    with open(os.path.join(workdir, "ln_shim.c"), "w") as f:
+        f.write(LN_SHIM_C)
+    # cmake-generated config header: an empty stub suffices for the
+    # freestanding CRUSH core
+    with open(os.path.join(workdir, "acconfig.h"), "w") as f:
+        f.write("/* stub for oracle build */\n")
+    exe = os.path.join(workdir, "oracle")
+    cmd = [
+        "gcc", "-O2", "-I", workdir, "-I", src,
+        "-I", os.path.join(reference_root, "src"),
+        os.path.join(workdir, "driver.c"),
+        os.path.join(workdir, "ln_shim.c"),
+        os.path.join(src, "crush.c"),
+        os.path.join(src, "builder.c"),
+        os.path.join(src, "hash.c"),
+        "-lm", "-o", exe,
+    ]
+    subprocess.run(cmd, check=True)
+    return exe
+
+
+def map_to_spec(m: CrushMap, weights: list[int],
+                queries: list[tuple[int, int, int]],
+                choose_args: dict[int, WeightSet] | None = None) -> str:
+    t = m.tunables
+    lines = [
+        f"tunables {t.choose_local_tries} {t.choose_local_fallback_tries} "
+        f"{t.choose_total_tries} {t.chooseleaf_descend_once} "
+        f"{t.chooseleaf_vary_r} {t.chooseleaf_stable} {t.straw_calc_version}"
+    ]
+    # deepest-first so child buckets exist before parents reference them
+    for b in sorted(m.buckets.values(), key=lambda b: -b.id):
+        if b.alg == UNIFORM:
+            ws = [b.item_weight] * b.size
+        elif b.alg == TREE:
+            ws = [b.node_weights[((i + 1) << 1) - 1] for i in range(b.size)]
+        else:
+            ws = b.item_weights
+        pairs = " ".join(f"{it},{w}" for it, w in zip(b.items, ws))
+        lines.append(f"bucket {b.id} {b.alg} {b.hash} {b.type} {b.size} {pairs}")
+    for r in m.rules.values():
+        steps = " ".join(f"{op},{a1},{a2}" for op, a1, a2 in r.steps)
+        lines.append(f"rule {r.id} {len(r.steps)} {steps}")
+    lines.append("finalize")
+    if choose_args:
+        for ws in choose_args.values():
+            npos = len(ws.weight_sets)
+            size = len(ws.weight_sets[0])
+            flat = " ".join(str(w) for pos in ws.weight_sets for w in pos)
+            line = f"choose_arg {ws.bucket_id} {npos} {size} {flat}"
+            if ws.ids is not None:
+                line += " ids: " + " ".join(str(i) for i in ws.ids)
+            lines.append(line)
+    lines.append(f"weights {len(weights)} " + " ".join(str(w) for w in weights))
+    for ruleno, x, result_max in queries:
+        lines.append(f"query {ruleno} {x} {result_max}")
+    return "\n".join(lines) + "\n"
+
+
+def run_oracle(exe: str, spec: str) -> list[list[int]]:
+    out = subprocess.run([exe], input=spec, capture_output=True, text=True,
+                         check=True)
+    results = []
+    for line in out.stdout.splitlines():
+        parts = line.split()
+        if parts[0] == "result":
+            n = int(parts[3])
+            results.append([int(v) for v in parts[4:4 + n]])
+    return results
+
+
+# -- scenario construction ------------------------------------------------
+
+def rule_replicated(root_id: int, numrep: int = 0,
+                    leaf_type: int = 0) -> list[tuple[int, int, int]]:
+    if leaf_type:
+        return [(TAKE, root_id, 0), (CHOOSELEAF_FIRSTN, numrep, leaf_type),
+                (EMIT, 0, 0)]
+    return [(TAKE, root_id, 0), (CHOOSE_FIRSTN, numrep, 0), (EMIT, 0, 0)]
+
+
+def rule_ec(root_id: int, numrep: int = 0,
+            leaf_type: int = 0) -> list[tuple[int, int, int]]:
+    if leaf_type:
+        return [(TAKE, root_id, 0), (CHOOSELEAF_INDEP, numrep, leaf_type),
+                (EMIT, 0, 0)]
+    return [(TAKE, root_id, 0), (CHOOSE_INDEP, numrep, 0), (EMIT, 0, 0)]
+
+
+def scenario_flat(alg: int, n: int, rng: random.Random,
+                  tunables: Tunables | None = None,
+                  weird_weights: bool = False) -> dict:
+    m = CrushMap(tunables)
+    if weird_weights:
+        ws = [rng.choice([0x4000, 0x8000, 0x10000, 0x20000, 0x30000, 0])
+              for _ in range(n)]
+        if not any(ws):
+            ws[0] = 0x10000
+    elif alg == UNIFORM:
+        ws = [0x10000] * n
+    else:
+        ws = [rng.randrange(0x8000, 0x40000) for _ in range(n)]
+    m.add_bucket(alg, 1, list(range(n)), ws, id=-1)
+    m.add_rule(rule_replicated(-1), id=0)
+    m.add_rule(rule_ec(-1), id=1)
+    return {"map": m, "reweights": [0x10000] * n}
+
+
+def scenario_hierarchy(rng: random.Random, n_hosts: int, osds_per_host: int,
+                       alg: int = STRAW2,
+                       tunables: Tunables | None = None) -> dict:
+    """root -> host buckets -> osds, with chooseleaf rules."""
+    m = CrushMap(tunables)
+    m.types = {0: "osd", 1: "host", 2: "root"}
+    host_ids = []
+    host_weights = []
+    osd = 0
+    for h in range(n_hosts):
+        items = list(range(osd, osd + osds_per_host))
+        ws = [rng.randrange(0x8000, 0x30000) for _ in items]
+        hb = m.add_bucket(alg, 1, items, ws, id=-(h + 2))
+        host_ids.append(hb.id)
+        host_weights.append(hb.weight)
+        osd += osds_per_host
+    m.add_bucket(alg, 2, host_ids, host_weights, id=-1)
+    m.add_rule(rule_replicated(-1, leaf_type=1), id=0)
+    m.add_rule(rule_ec(-1, leaf_type=1), id=1)
+    # also a two-step choose: pick hosts, then osds
+    m.add_rule([(TAKE, -1, 0), (CHOOSE_FIRSTN, 0, 1), (CHOOSE_FIRSTN, 1, 0),
+                (EMIT, 0, 0)], id=2)
+    reweights = [0x10000] * osd
+    # mark some devices out / partially reweighted
+    for i in range(0, osd, 7):
+        reweights[i] = rng.choice([0, 0x8000, 0xC000])
+    return {"map": m, "reweights": reweights}
+
+
+def main(reference_root: str = "/root/reference") -> None:
+    exe = build_oracle(reference_root)
+    rng = random.Random(0xCEF)
+
+    # 1. primitive vectors: hashes + crush_ln
+    prim_spec = []
+    hash2_in, hash3_in, ln_in = [], [], []
+    for _ in range(200):
+        a, b, c = (rng.randrange(0, 1 << 32) for _ in range(3))
+        hash2_in.append([a, b])
+        hash3_in.append([a, b, c])
+        prim_spec.append(f"hash2 {a} {b}")
+        prim_spec.append(f"hash3 {a} {b} {c}")
+    for u in list(range(0, 256)) + [rng.randrange(0, 0x10000) for _ in range(512)]:
+        ln_in.append(u)
+        prim_spec.append(f"ln {u}")
+    out = subprocess.run([exe], input="\n".join(prim_spec) + "\n",
+                         capture_output=True, text=True, check=True)
+    hash2_out, hash3_out, ln_out = [], [], []
+    for line in out.stdout.splitlines():
+        k, v = line.split()
+        {"hash2": hash2_out, "hash3": hash3_out, "ln": ln_out}[k].append(int(v))
+    with open(os.path.join(GOLDEN_DIR, "crush_primitives.json"), "w") as f:
+        json.dump({"hash2_in": hash2_in, "hash2_out": hash2_out,
+                   "hash3_in": hash3_in, "hash3_out": hash3_out,
+                   "ln_in": ln_in, "ln_out": ln_out}, f)
+    print(f"crush_primitives.json: {len(hash2_in)}+{len(hash3_in)} hashes, "
+          f"{len(ln_in)} ln values")
+
+    # 2. mapping scenarios
+    scenarios: dict[str, dict] = {}
+    scenarios["flat_straw2_10"] = scenario_flat(STRAW2, 10, rng)
+    scenarios["flat_straw2_100_weird"] = scenario_flat(
+        STRAW2, 100, rng, weird_weights=True)
+    scenarios["flat_uniform_8"] = scenario_flat(UNIFORM, 8, rng)
+    scenarios["flat_list_9"] = scenario_flat(LIST, 9, rng)
+    scenarios["flat_tree_12"] = scenario_flat(TREE, 12, rng)
+    scenarios["flat_straw_11"] = scenario_flat(STRAW, 11, rng)
+    scenarios["hier_straw2_4x4"] = scenario_hierarchy(rng, 4, 4)
+    scenarios["hier_straw2_8x3"] = scenario_hierarchy(rng, 8, 3)
+    scenarios["hier_legacy_5x4"] = scenario_hierarchy(
+        rng, 5, 4, tunables=Tunables.legacy())
+    scenarios["hier_straw_4x3_legacy"] = scenario_hierarchy(
+        rng, 4, 3, alg=STRAW, tunables=Tunables.legacy())
+    scenarios["flat_straw2_legacy"] = scenario_flat(
+        STRAW2, 20, rng, tunables=Tunables.legacy())
+
+    # tunable-override rule variants on a hierarchy
+    sc = scenario_hierarchy(rng, 6, 4)
+    m = sc["map"]
+    m.add_rule([(TAKE, -1, 0), (SET_CHOOSELEAF_TRIES, 5, 0),
+                (SET_CHOOSE_TRIES, 100, 0),
+                (CHOOSELEAF_FIRSTN, 0, 1), (EMIT, 0, 0)], id=3)
+    m.add_rule([(TAKE, -1, 0), (SET_CHOOSELEAF_VARY_R, 0, 0),
+                (SET_CHOOSELEAF_STABLE, 0, 0),
+                (CHOOSELEAF_INDEP, 0, 1), (EMIT, 0, 0)], id=4)
+    m.add_rule([(TAKE, -1, 0), (SET_CHOOSE_LOCAL_TRIES, 2, 0),
+                (SET_CHOOSE_LOCAL_FALLBACK_TRIES, 3, 0),
+                (CHOOSE_FIRSTN, 3, 1), (CHOOSE_FIRSTN, 1, 0),
+                (EMIT, 0, 0)], id=5)
+    scenarios["hier_tunable_overrides"] = sc
+
+    # choose_args (weight-set) scenario
+    sc = scenario_hierarchy(rng, 4, 4)
+    m = sc["map"]
+    cargs: dict[int, WeightSet] = {}
+    for bid, b in m.buckets.items():
+        npos = 3
+        wsets = [[max(0, w + rng.randrange(-0x3000, 0x3000))
+                  for w in (b.item_weights or [0x10000] * b.size)]
+                 for _ in range(npos)]
+        cargs[bid] = WeightSet(bucket_id=bid, weight_sets=wsets)
+    m.choose_args["balancer"] = cargs
+    sc["choose_args"] = cargs
+    scenarios["hier_choose_args"] = sc
+
+    golden = {}
+    for name, sc in scenarios.items():
+        m = sc["map"]
+        reweights = sc["reweights"]
+        queries = []
+        for ruleno in sorted(m.rules):
+            for x in range(0, 64):
+                queries.append((ruleno, x, 5))
+            for x in (1 << 31) - 1, 0xFFFFFFF, 12345678:
+                queries.append((ruleno, x, 8))
+        spec = map_to_spec(m, reweights, queries, sc.get("choose_args"))
+        results = run_oracle(exe, spec)
+        assert len(results) == len(queries), (name, len(results), len(queries))
+        golden[name] = {
+            "map": m.to_dict(),
+            "reweights": reweights,
+            "queries": [list(q) for q in queries],
+            "results": results,
+            "choose_args_name": "balancer" if "choose_args" in sc else None,
+        }
+        print(f"{name}: {len(queries)} queries")
+
+    with open(os.path.join(GOLDEN_DIR, "crush_mappings.json"), "w") as f:
+        json.dump(golden, f)
+    size = os.path.getsize(os.path.join(GOLDEN_DIR, "crush_mappings.json"))
+    print(f"crush_mappings.json: {len(golden)} scenarios, {size//1024} KiB")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
