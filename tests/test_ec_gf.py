@@ -1,0 +1,174 @@
+"""GF(2^w) algebra and coding-matrix invariants."""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf, matrices
+
+
+class TestGF:
+    @pytest.mark.parametrize("w", [8, 16])
+    def test_field_axioms_sampled(self, w):
+        rng = random.Random(w)
+        n = (1 << w) - 1
+        for _ in range(200):
+            a = rng.randrange(1, n + 1)
+            b = rng.randrange(1, n + 1)
+            c = rng.randrange(1, n + 1)
+            assert gf.gf_mul(a, b, w) == gf.gf_mul(b, a, w)
+            assert gf.gf_mul(a, gf.gf_mul(b, c, w), w) == \
+                gf.gf_mul(gf.gf_mul(a, b, w), c, w)
+            # distributive over xor (field addition)
+            assert gf.gf_mul(a, b ^ c, w) == \
+                gf.gf_mul(a, b, w) ^ gf.gf_mul(a, c, w)
+            assert gf.gf_mul(a, gf.gf_inv(a, w), w) == 1
+
+    def test_w8_known_values(self):
+        # 0x11d field: classic AES-unrelated checks from gf-complete docs
+        assert gf.gf_mul(2, 128, 8) == 0x1D
+        assert gf.gf_mul(0x53, 0xCA, 8) == gf.mul_slow(0x53, 0xCA, 8)
+        assert gf.gf_pow(2, 255, 8) == 1  # generator order divides 255
+        # 2 is a primitive element of the 0x11d field
+        seen = set()
+        x = 1
+        for _ in range(255):
+            seen.add(x)
+            x = gf.gf_mul(x, 2, 8)
+        assert len(seen) == 255
+
+    def test_w32_mul_inverse(self):
+        rng = random.Random(3)
+        for _ in range(20):
+            a = rng.randrange(1, 1 << 32)
+            assert gf.gf_mul(a, gf.gf_inv(a, 32), 32) == 1
+
+    def test_mul_table_matches_scalar(self):
+        t = gf.mul_table_u8()
+        rng = random.Random(1)
+        for _ in range(500):
+            a, b = rng.randrange(256), rng.randrange(256)
+            assert int(t[a, b]) == gf.gf_mul(a, b, 8)
+
+    def test_nibble_tables_recompose(self):
+        lo, hi = gf.nibble_tables_u8()
+        rng = random.Random(2)
+        for _ in range(500):
+            c, b = rng.randrange(256), rng.randrange(256)
+            assert int(lo[c, b & 0xF]) ^ int(hi[c, b >> 4]) == gf.gf_mul(c, b, 8)
+
+    def test_region_matmul_roundtrip(self):
+        rng = np.random.default_rng(0)
+        k, m, n = 4, 2, 64
+        coding = matrices.reed_sol_vandermonde_coding_matrix(k, m, 8)
+        mat = np.array(coding, dtype=np.uint8)
+        data = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+        parity = gf.matmul_u8(mat, data)
+        # erase two data chunks, decode via inverse
+        surviving = [2, 3, 4, 5]
+        inv, chosen = matrices.decoding_matrix(k, 8, coding, [0, 1], surviving)
+        rows = np.stack([data[2], data[3], parity[0], parity[1]])
+        rec = gf.matmul_u8(np.array(inv, dtype=np.uint8), rows)
+        np.testing.assert_array_equal(rec, data)
+
+    def test_w16_region_matmul(self):
+        rng = np.random.default_rng(1)
+        k, m = 3, 2
+        coding = matrices.reed_sol_vandermonde_coding_matrix(k, m, 16)
+        data = rng.integers(0, 1 << 16, size=(k, 32), dtype=np.uint16)
+        parity = gf.matmul_words(np.array(coding, dtype=np.uint32), data, 16)
+        inv, chosen = matrices.decoding_matrix(
+            k, 16, coding, [0, 2], [1, 3, 4])
+        rows = np.stack([data[1], parity[0].astype(np.uint16),
+                         parity[1].astype(np.uint16)])
+        rec = gf.matmul_words(np.array(inv, dtype=np.uint32), rows, 16)
+        np.testing.assert_array_equal(rec, data)
+
+
+def _is_mds(coding, k, m, w):
+    """Every k x k submatrix of [I; C] must be invertible."""
+    total = k + m
+    full = [[1 if j == i else 0 for j in range(k)] for i in range(k)]
+    full += [row[:] for row in coding]
+    for rows in itertools.combinations(range(total), k):
+        sub = [full[r] for r in rows]
+        try:
+            gf.matrix_invert(sub, w)
+        except ValueError:
+            return False
+    return True
+
+
+class TestMatrices:
+    @pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (4, 2), (6, 3), (8, 3)])
+    def test_reed_sol_van_mds_w8(self, k, m):
+        c = matrices.reed_sol_vandermonde_coding_matrix(k, m, 8)
+        assert c[0] == [1] * k  # jerasure guarantees an all-ones first row
+        assert _is_mds(c, k, m, 8)
+
+    def test_reed_sol_van_systematic_top(self):
+        k, m, w = 5, 3, 8
+        dist = matrices.big_vandermonde_distribution_matrix(k + m, k, w)
+        for i in range(k):
+            assert dist[i] == [1 if j == i else 0 for j in range(k)]
+
+    def test_raid6_matrix(self):
+        c = matrices.reed_sol_r6_coding_matrix(6, 8)
+        assert c[0] == [1] * 6
+        assert c[1] == [1, 2, 4, 8, 16, 32]
+        assert _is_mds(c, 6, 2, 8)
+
+    @pytest.mark.parametrize("k,m,w", [(4, 2, 8), (6, 3, 8), (5, 2, 4)])
+    def test_cauchy_orig_mds(self, k, m, w):
+        c = matrices.cauchy_original_coding_matrix(k, m, w)
+        assert _is_mds(c, k, m, w)
+
+    @pytest.mark.parametrize("k,m,w", [(4, 2, 8), (6, 3, 8), (4, 3, 8)])
+    def test_cauchy_good_mds_and_cheaper(self, k, m, w):
+        orig = matrices.cauchy_original_coding_matrix(k, m, w)
+        good = matrices.cauchy_good_general_coding_matrix(k, m, w)
+        assert _is_mds(good, k, m, w)
+        cost = lambda mat: sum(matrices.n_ones(x, w) for row in mat for x in row)
+        assert cost(good) <= cost(orig)
+        assert good[0] == [1] * k
+
+    @pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 3), (10, 4)])
+    def test_isa_cauchy_mds(self, k, m):
+        c = matrices.isa_cauchy_matrix(k, m)
+        assert _is_mds(c, k, m, 8)
+
+    @pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 3), (10, 4)])
+    def test_isa_vandermonde_shape(self, k, m):
+        c = matrices.isa_rs_vandermonde_matrix(k, m)
+        assert c[0] == [1] * k
+        # single-erasure decode must always work for these profiles
+        for lost in range(k):
+            surviving = [i for i in range(k + m) if i != lost]
+            matrices.decoding_matrix(k, 8, c, [lost], surviving)
+
+    def test_bitmatrix_equivalence(self):
+        """Bit-sliced XOR encode per the bitmatrix equals GF matmul."""
+        k, m, w = 3, 2, 4
+        mat = matrices.cauchy_original_coding_matrix(k, m, w)
+        bits = matrices.matrix_to_bitmatrix(k, m, w, mat)
+        rng = random.Random(9)
+        data = [rng.randrange(1 << w) for _ in range(k)]
+        # expected via field arithmetic
+        expected = [0] * m
+        for i in range(m):
+            for j in range(k):
+                expected[i] ^= gf.gf_mul(mat[i][j], data[j], w)
+        # via bitmatrix: bit l of coding word i = parity over set positions
+        for i in range(m):
+            word = 0
+            for l in range(w):
+                row = bits[i * w + l]
+                bit = 0
+                for j in range(k):
+                    for x in range(w):
+                        if row[j * w + x]:
+                            bit ^= (data[j] >> x) & 1
+                word |= bit << l
+            assert word == expected[i]
