@@ -1,1 +1,26 @@
-"""ec subpackage — see ceph_tpu/__init__.py for the layer map."""
+"""Erasure coding: GF math, codec plugins, TPU kernels.
+
+Public surface:
+    new_codec(profile)            — build a codec from a profile dict
+    ErasureCodePluginRegistry     — the plugin registry singleton
+    ErasureCodeInterface          — codec contract
+"""
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+from .plugin import ErasureCodePluginRegistry, register_plugin
+
+
+def new_codec(profile: ErasureCodeProfile) -> ErasureCodeInterface:
+    """Instantiate a codec: profile must carry plugin=<name> (default
+    jerasure) plus plugin-specific keys (k, m, technique, ...)."""
+    plugin = profile.get("plugin", "jerasure")
+    return ErasureCodePluginRegistry.instance().factory(plugin, profile)
+
+
+__all__ = [
+    "ErasureCodeInterface",
+    "ErasureCodeProfile",
+    "ErasureCodePluginRegistry",
+    "register_plugin",
+    "new_codec",
+]

@@ -1,0 +1,182 @@
+"""Shared erasure-code behavior: padding, chunk mapping, read planning.
+
+Re-derivation of the reference base class (src/erasure-code/
+ErasureCode.cc): encode_prepare zero-pads the object tail so every data
+chunk is exactly get_chunk_size(len) bytes (:150-185), encode trims to
+want_to_encode (:187-203), _decode passes surviving chunks through and
+fills the rest via decode_chunks (:205-241), minimum_to_decode returns
+want_to_read when fully available else the first k available (:102-119),
+and the "mapping" profile string (D=data) permutes chunk positions
+(:260-279).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+
+
+class ErasureCode(ErasureCodeInterface):
+    """Base class: subclasses set self.k / self.m in init() and implement
+    encode_chunks / decode_chunks and get_chunk_size."""
+
+    def __init__(self):
+        self.k = 0
+        self.m = 0
+        self.chunk_mapping: list[int] = []
+        self._profile: ErasureCodeProfile = {}
+
+    # -- profile helpers ---------------------------------------------------
+
+    @staticmethod
+    def _to_int(profile: dict, name: str, default: int) -> int:
+        v = profile.get(name)
+        if v is None or v == "":
+            profile[name] = str(default)
+            return default
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            raise ValueError("profile %s=%r is not an integer" % (name, v))
+
+    @staticmethod
+    def _to_bool(profile: dict, name: str, default: str) -> bool:
+        v = profile.get(name)
+        if v is None or v == "":
+            profile[name] = default
+            v = default
+        return str(v) in ("yes", "true", "True", "1")
+
+    def _parse_mapping(self, profile: dict) -> None:
+        mapping = profile.get("mapping")
+        if not mapping:
+            return
+        data_pos = [i for i, c in enumerate(mapping) if c == "D"]
+        coding_pos = [i for i, c in enumerate(mapping) if c != "D"]
+        self.chunk_mapping = data_pos + coding_pos
+
+    def sanity_check_k_m(self) -> None:
+        if self.k < 2:
+            raise ValueError("k=%d must be >= 2" % self.k)
+        if self.m < 1:
+            raise ValueError("m=%d must be >= 1" % self.m)
+
+    # -- interface basics --------------------------------------------------
+
+    def get_profile(self) -> ErasureCodeProfile:
+        return self._profile
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_chunk_mapping(self) -> Sequence[int]:
+        return self.chunk_mapping
+
+    def chunk_index(self, i: int) -> int:
+        return self.chunk_mapping[i] if i < len(self.chunk_mapping) else i
+
+    def _to_logical(self, chunks: Mapping[int, bytes]) -> dict[int, bytes]:
+        """Translate physical chunk ids back to generator-row (logical)
+        ids so codec math is mapping-transparent."""
+        if not self.chunk_mapping:
+            return dict(chunks)
+        inv = {p: l for l, p in enumerate(self.chunk_mapping)}
+        return {inv.get(i, i): v for i, v in chunks.items()}
+
+    def _from_logical(self, chunks: dict[int, bytes]) -> dict[int, bytes]:
+        if not self.chunk_mapping:
+            return chunks
+        return {self.chunk_index(i): v for i, v in chunks.items()}
+
+    def _logical_ids(self, ids) -> set[int]:
+        if not self.chunk_mapping:
+            return set(ids)
+        inv = {p: l for l, p in enumerate(self.chunk_mapping)}
+        return {inv.get(i, i) for i in ids}
+
+    # -- object-level encode/decode ---------------------------------------
+
+    def encode_prepare(self, data: bytes) -> dict[int, bytes]:
+        """Split into k chunks of get_chunk_size(len), zero-padding the
+        tail chunks."""
+        k = self.get_data_chunk_count()
+        blocksize = self.get_chunk_size(len(data))
+        if blocksize == 0:  # zero-length object: k+m empty chunks
+            return {self.chunk_index(i): b"" for i in range(k)}
+        chunks: dict[int, bytes] = {}
+        full = len(data) // blocksize
+        for i in range(full):
+            chunks[self.chunk_index(i)] = data[i * blocksize:(i + 1) * blocksize]
+        if full < k:
+            rest = data[full * blocksize:]
+            chunks[self.chunk_index(full)] = rest.ljust(blocksize, b"\0")
+            zero = bytes(blocksize)
+            for i in range(full + 1, k):
+                chunks[self.chunk_index(i)] = zero
+        return chunks
+
+    def encode(self, want_to_encode: set[int], data: bytes) -> dict[int, bytes]:
+        if len(data) == 0:
+            return {i: b"" for i in want_to_encode}
+        prepared = self.encode_prepare(data)
+        encoded = self.encode_chunks(prepared)
+        return {i: encoded[i] for i in want_to_encode}
+
+    def _decode(
+        self, want_to_read: set[int], chunks: Mapping[int, bytes],
+    ) -> dict[int, bytes]:
+        if want_to_read <= set(chunks):
+            return {i: bytes(chunks[i]) for i in want_to_read}
+        if len(chunks) < self.get_data_chunk_count():
+            raise IOError(
+                "cannot decode: %d chunks available, %d needed"
+                % (len(chunks), self.get_data_chunk_count()))
+        lengths = {len(c) for c in chunks.values()}
+        if len(lengths) != 1:
+            raise ValueError("surviving chunks have differing sizes %s" % lengths)
+        decoded = self.decode_chunks(want_to_read, chunks)
+        out = {}
+        for i in want_to_read:
+            out[i] = bytes(chunks[i]) if i in chunks else decoded[i]
+        return out
+
+    def decode(
+        self, want_to_read: set[int], chunks: Mapping[int, bytes],
+        chunk_size: int = 0,
+    ) -> dict[int, bytes]:
+        return self._decode(want_to_read, chunks)
+
+    def decode_concat(self, chunks: Mapping[int, bytes]) -> bytes:
+        k = self.get_data_chunk_count()
+        want = {self.chunk_index(i) for i in range(k)}
+        decoded = self._decode(want, chunks)
+        return b"".join(decoded[self.chunk_index(i)] for i in range(k))
+
+    # -- read planning -----------------------------------------------------
+
+    def _minimum_to_decode(
+        self, want_to_read: set[int], available: set[int],
+    ) -> set[int]:
+        if want_to_read <= available:
+            return set(want_to_read)
+        k = self.get_data_chunk_count()
+        if len(available) < k:
+            raise IOError("cannot decode: only %d of %d chunks available"
+                          % (len(available), k))
+        return set(sorted(available)[:k])
+
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int],
+    ) -> dict[int, list[tuple[int, int]]]:
+        ids = self._minimum_to_decode(want_to_read, available)
+        whole = [(0, self.get_sub_chunk_count())]
+        return {i: list(whole) for i in ids}
+
+    def minimum_to_decode_with_cost(
+        self, want_to_read: set[int], available: Mapping[int, int],
+    ) -> set[int]:
+        return self._minimum_to_decode(want_to_read, set(available))

@@ -1,0 +1,267 @@
+"""Codec-level tests, modeled on the reference suites
+(src/test/erasure-code/TestErasureCode*.cc): roundtrips for every
+plugin/technique, all erasure patterns up to m, padding behavior,
+chunk-size math, mapping, minimum_to_decode, plugin registry failures."""
+
+import itertools
+import os
+import random
+
+import pytest
+
+from ceph_tpu.ec import ErasureCodePluginRegistry, new_codec, register_plugin
+
+
+def _payload(n, seed=7):
+    rng = random.Random(seed)
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+JERASURE_PROFILES = [
+    {"plugin": "jerasure", "technique": "reed_sol_van", "k": "2", "m": "1"},
+    {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2"},
+    {"plugin": "jerasure", "technique": "reed_sol_van", "k": "8", "m": "3"},
+    {"plugin": "jerasure", "technique": "reed_sol_van", "k": "3", "m": "2",
+     "w": "16"},
+    {"plugin": "jerasure", "technique": "reed_sol_van", "k": "3", "m": "2",
+     "w": "32"},
+    {"plugin": "jerasure", "technique": "reed_sol_r6_op", "k": "4", "m": "2"},
+    {"plugin": "jerasure", "technique": "cauchy_orig", "k": "3", "m": "2",
+     "w": "4", "packetsize": "8"},
+    {"plugin": "jerasure", "technique": "cauchy_good", "k": "6", "m": "3",
+     "w": "8", "packetsize": "32"},
+    {"plugin": "jerasure", "technique": "liberation", "k": "2", "m": "2",
+     "w": "7", "packetsize": "8"},
+    {"plugin": "jerasure", "technique": "blaum_roth", "k": "4", "m": "2",
+     "w": "6", "packetsize": "8"},
+    {"plugin": "jerasure", "technique": "liber8tion", "k": "2", "m": "2",
+     "w": "8", "packetsize": "8"},
+]
+
+ISA_PROFILES = [
+    {"plugin": "isa", "technique": "reed_sol_van", "k": "7", "m": "3"},
+    {"plugin": "isa", "technique": "reed_sol_van", "k": "8", "m": "3"},
+    {"plugin": "isa", "technique": "reed_sol_van", "k": "10", "m": "4"},
+    {"plugin": "isa", "technique": "cauchy", "k": "10", "m": "4"},
+    {"plugin": "isa", "technique": "cauchy", "k": "4", "m": "1"},
+]
+
+ALL_PROFILES = JERASURE_PROFILES + ISA_PROFILES
+
+
+def _ids(profiles):
+    return ["%s-%s-k%s-m%s" % (p["plugin"], p.get("technique", "?"),
+                               p["k"], p["m"]) for p in profiles]
+
+
+@pytest.mark.parametrize("profile", ALL_PROFILES, ids=_ids(ALL_PROFILES))
+class TestRoundtrip:
+    def test_encode_decode_all_erasures(self, profile):
+        codec = new_codec(dict(profile))
+        k, m = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        payload = _payload(k * 977 + 13)  # deliberately unaligned
+        want = set(range(k + m))
+        encoded = codec.encode(want, payload)
+        assert set(encoded) == want
+        sizes = {len(c) for c in encoded.values()}
+        assert len(sizes) == 1
+        assert sizes.pop() == codec.get_chunk_size(len(payload))
+
+        # losing any subset of up to m chunks must be recoverable
+        max_patterns = 40
+        patterns = []
+        for r in range(1, m + 1):
+            patterns.extend(itertools.combinations(range(k + m), r))
+        rng = random.Random(0)
+        if len(patterns) > max_patterns:
+            patterns = rng.sample(patterns, max_patterns)
+        for lost in patterns:
+            chunks = {i: c for i, c in encoded.items() if i not in lost}
+            decoded = codec.decode(set(lost), chunks)
+            for i in lost:
+                assert decoded[i] == encoded[i], \
+                    "chunk %d mismatch after losing %s" % (i, lost)
+
+    def test_decode_concat_restores_payload(self, profile):
+        codec = new_codec(dict(profile))
+        k, m = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        payload = _payload(k * 501 + 29, seed=11)
+        encoded = codec.encode(set(range(k + m)), payload)
+        # drop the first min(m, k) data chunks, rebuild from the rest
+        lost = list(range(min(m, k)))
+        chunks = {i: c for i, c in encoded.items() if i not in lost}
+        assert codec.decode_concat(chunks)[:len(payload)] == payload
+
+    def test_minimum_to_decode(self, profile):
+        codec = new_codec(dict(profile))
+        k, m = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        everything = set(range(k + m))
+        # all available -> exactly what was asked
+        got = codec.minimum_to_decode({0, 1}, everything)
+        assert set(got) == {0, 1}
+        assert all(v == [(0, codec.get_sub_chunk_count())]
+                   for v in got.values())
+        # chunk 0 missing -> k chunks needed
+        got = codec.minimum_to_decode({0}, everything - {0})
+        assert len(got) == k
+        assert 0 not in got
+        # too few -> error (want a chunk outside the undersized available set)
+        with pytest.raises(IOError):
+            codec.minimum_to_decode({k + m - 1}, set(range(k - 1)))
+
+
+class TestPadding:
+    @pytest.mark.parametrize("size", [1, 31, 32, 4096, 4097, 8191])
+    def test_small_and_unaligned_objects(self, size):
+        codec = new_codec({"plugin": "isa", "k": "4", "m": "2"})
+        payload = _payload(size, seed=size)
+        encoded = codec.encode(set(range(6)), payload)
+        assert codec.decode_concat(
+            {i: encoded[i] for i in (1, 2, 4, 5)})[:size] == payload
+
+    def test_chunk_size_alignment_isa(self):
+        codec = new_codec({"plugin": "isa", "k": "7", "m": "3"})
+        for size in (1, 100, 4096, 1 << 20):
+            cs = codec.get_chunk_size(size)
+            assert cs % 32 == 0
+            assert cs * 7 >= size
+
+    def test_chunk_size_alignment_jerasure(self):
+        codec = new_codec({"plugin": "jerasure", "technique": "reed_sol_van",
+                           "k": "4", "m": "2", "w": "8"})
+        # alignment is k*w*sizeof(int); padded length divides evenly by k
+        for size in (1, 1000, 4096):
+            cs = codec.get_chunk_size(size)
+            assert (cs * 4) % (4 * 8 * 4) == 0
+
+
+class TestMapping:
+    def test_mapping_permutes_chunk_positions(self):
+        profile = {"plugin": "jerasure", "technique": "reed_sol_van",
+                   "k": "2", "m": "1", "mapping": "_DD"}
+        codec = new_codec(profile)
+        assert list(codec.get_chunk_mapping()) == [1, 2, 0]
+        payload = _payload(1024)
+        encoded = codec.encode({0, 1, 2}, payload)
+        # data lives at positions 1,2; parity at 0
+        import numpy as np
+        p = np.frombuffer(encoded[1], dtype=np.uint8) ^ \
+            np.frombuffer(encoded[2], dtype=np.uint8)
+        # k=2,m=1 reed_sol parity row is all ones -> parity is the XOR
+        assert p.tobytes() == encoded[0]
+
+    @pytest.mark.parametrize("plugin_profile", [
+        {"plugin": "jerasure", "technique": "reed_sol_van",
+         "k": "3", "m": "2", "mapping": "_DD_D"},
+        {"plugin": "isa", "k": "3", "m": "2", "mapping": "_DD_D"},
+        {"plugin": "jerasure", "technique": "cauchy_good", "k": "3", "m": "2",
+         "w": "4", "packetsize": "8", "mapping": "_DD_D"},
+    ], ids=["jerasure", "isa", "bitmatrix"])
+    def test_decode_honors_mapping(self, plugin_profile):
+        codec = new_codec(dict(plugin_profile))
+        payload = _payload(3 * 700 + 5)
+        encoded = codec.encode({0, 1, 2, 3, 4}, payload)
+        for lost in itertools.combinations(range(5), 2):
+            chunks = {i: c for i, c in encoded.items() if i not in lost}
+            decoded = codec.decode(set(lost), chunks)
+            for i in lost:
+                assert decoded[i] == encoded[i], \
+                    "mapping-aware decode failed losing %s" % (lost,)
+
+    def test_zero_length_object(self):
+        codec = new_codec({"plugin": "isa", "k": "4", "m": "2"})
+        encoded = codec.encode(set(range(6)), b"")
+        assert all(c == b"" for c in encoded.values())
+
+    def test_blaum_roth_legacy_w7_decodable(self):
+        codec = new_codec({"plugin": "jerasure", "technique": "blaum_roth",
+                           "k": "4", "m": "2", "w": "7", "packetsize": "8"})
+        payload = _payload(2048)
+        encoded = codec.encode(set(range(6)), payload)
+        for lost in itertools.combinations(range(6), 2):
+            chunks = {i: c for i, c in encoded.items() if i not in lost}
+            decoded = codec.decode(set(lost), chunks)
+            assert all(decoded[i] == encoded[i] for i in lost)
+
+    def test_cauchy_per_chunk_alignment(self):
+        codec = new_codec({"plugin": "jerasure", "technique": "cauchy_orig",
+                           "k": "3", "m": "2", "w": "7", "packetsize": "8",
+                           "jerasure-per-chunk-alignment": "true"})
+        payload = _payload(300)
+        cs = codec.get_chunk_size(len(payload))
+        assert cs % (7 * 8) == 0 and cs % 16 == 0
+        encoded = codec.encode(set(range(5)), payload)
+        chunks = {i: c for i, c in encoded.items() if i not in (0, 1)}
+        decoded = codec.decode({0, 1}, chunks)
+        assert decoded[0] == encoded[0] and decoded[1] == encoded[1]
+
+    def test_bad_mapping_length_rejected(self):
+        with pytest.raises(ValueError):
+            new_codec({"plugin": "jerasure", "technique": "reed_sol_van",
+                       "k": "2", "m": "1", "mapping": "_DDDD"})
+
+
+class TestProfiles:
+    def test_defaults(self):
+        codec = new_codec({"plugin": "jerasure"})
+        assert codec.get_data_chunk_count() == 7  # reed_sol_van default
+        assert codec.get_coding_chunk_count() == 3
+        codec = new_codec({"plugin": "isa"})
+        assert (codec.get_data_chunk_count(),
+                codec.get_coding_chunk_count()) == (7, 3)
+
+    def test_k1_rejected(self):
+        with pytest.raises(ValueError):
+            new_codec({"plugin": "jerasure", "k": "1", "m": "1"})
+
+    def test_isa_vandermonde_envelope(self):
+        with pytest.raises(ValueError):
+            new_codec({"plugin": "isa", "k": "22", "m": "4"})
+        with pytest.raises(ValueError):
+            new_codec({"plugin": "isa", "k": "4", "m": "5"})
+        new_codec({"plugin": "isa", "technique": "cauchy", "k": "12",
+                   "m": "5"})  # cauchy has no such envelope
+
+    def test_raid6_m_must_be_2(self):
+        with pytest.raises(ValueError):
+            new_codec({"plugin": "jerasure", "technique": "reed_sol_r6_op",
+                       "k": "4", "m": "3"})
+
+    def test_liberation_w_must_be_prime(self):
+        with pytest.raises(ValueError):
+            new_codec({"plugin": "jerasure", "technique": "liberation",
+                       "k": "2", "m": "2", "w": "8", "packetsize": "8"})
+
+
+class TestRegistry:
+    """Fault fixtures per src/test/erasure-code/ErasureCodePlugin*.cc."""
+
+    def test_unknown_plugin(self):
+        with pytest.raises(IOError):
+            new_codec({"plugin": "does_not_exist"})
+
+    def test_module_without_registration(self, tmp_path, monkeypatch):
+        reg = ErasureCodePluginRegistry.instance()
+        with pytest.raises(IOError, match="did not register"):
+            reg.load("noreg", module_path="os.path")  # imports, no register
+
+    def test_version_mismatch(self):
+        reg = ErasureCodePluginRegistry.instance()
+        register_plugin("badver_test", lambda p: None, version=99)
+        with pytest.raises(IOError, match="API version"):
+            reg.load("badver_test")
+
+    def test_double_registration_rejected(self):
+        register_plugin("dup_test", lambda p: None)
+        with pytest.raises(KeyError):
+            register_plugin("dup_test", lambda p: None)
+
+    def test_factory_failure_propagates(self):
+        def bomb(profile):
+            raise RuntimeError("FailToInitialize")
+        register_plugin("bomb_test", bomb)
+        with pytest.raises(RuntimeError):
+            ErasureCodePluginRegistry.instance().factory("bomb_test", {})
+
+    def test_preload(self):
+        ErasureCodePluginRegistry.instance().preload(["jerasure", "isa"])
