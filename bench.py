@@ -1,0 +1,93 @@
+"""Headline benchmark: EC encode throughput on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload mirrors BASELINE.json #2 (Reed-Solomon k=8,m=3, 4 KiB stripes —
+the ceph_erasure_code_benchmark encode config,
+src/test/erasure-code/ceph_erasure_code_benchmark.cc:193), batched
+across many in-flight stripes.  The kernel is the framework's native
+XOR-schedule Pallas path on the bit-sliced planes8 chunk layout (the
+same packetized layout jerasure's schedule encode writes for its
+bitmatrix codes); value is payload GiB/s.
+
+Timing: the device tunnel reorders/elides independent repeated
+dispatches, so iterations are *chained* — each step folds a slice of
+the previous parity into the next input, forcing serial execution —
+and throughput is taken from the slope between a short and a long run
+(single final readback), which cancels fixed tunnel latency.
+
+vs_baseline divides by 100 GiB/s — a deliberately generous stand-in
+for the reference's ISA-L encode on a 64-core host (~1.5-6 GiB/s/core
+published by intel, memory-bandwidth-bound in aggregate), since
+BASELINE.json carries no published figure.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_GIBPS = 100.0  # ISA-L k=8,m=3 on 64-core host (documented proxy)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec import kernels, matrices
+
+    k, m = 8, 3
+    matrix = matrices.isa_rs_vandermonde_matrix(k, m)
+    rng = np.random.default_rng(0)
+
+    gibps = 0.0
+    # tile bounded by VMEM: (512+192)*tile*2 (double-buffered) < 16 MiB
+    for tile in (2048, 8192):
+        P = tile * (1048576 // tile)  # 512 MiB payload resident in HBM
+        payload = k * 64 * P
+        enc = kernels.PlanesEncoder(matrix, tile=tile)
+        host = rng.integers(0, 256, size=(k * 64, P), dtype=np.uint8)
+        d0 = jax.device_put(jnp.asarray(host))   # uploaded once per tile
+        clone = jax.jit(lambda d: d + jnp.uint8(0))
+
+        def step_fn(d):
+            parity = enc(d)
+            # serialization: next input depends on this step's parity;
+            # donation makes the update in-place (no full-buffer copy)
+            return jax.lax.dynamic_update_slice(
+                d, parity[0:8, 0:128] ^ d[0:8, 0:128], (0, 0))
+
+        step = jax.jit(step_fn, donate_argnums=0)
+
+        def run_chained(iters: int) -> float:
+            d = clone(d0)                        # device-side copy
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                d = step(d)
+            np.asarray(d[0:1, 0:1])  # single final sync
+            return time.perf_counter() - t0
+
+        run_chained(2)    # compile + warm
+        n1, n2 = 4, 100
+        estimates = []
+        for _ in range(3):
+            t1 = run_chained(n1)
+            t2 = run_chained(n2)
+            if t2 > t1:
+                estimates.append((t2 - t1) / (n2 - n1))
+        if not estimates:
+            continue
+        per_iter = sorted(estimates)[len(estimates) // 2]
+        gibps = max(gibps, payload / per_iter / (1 << 30))
+
+    result = {
+        "metric": "ec_encode_k8m3_4k_stripes_payload_throughput",
+        "value": round(gibps, 1),
+        "unit": "GiB/s",
+        "vs_baseline": round(gibps / BASELINE_GIBPS, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -229,17 +229,8 @@ class _BitmatrixTechnique(ErasureCodeJerasure):
         chunks = self._to_logical(chunks)
         erased = [i for i in range(k + m) if i not in chunks]
         have = sorted(chunks)[:k]
-        # bit-level rows of [I; B] for surviving chunks
-        rows = []
-        for cid in have:
-            for l in range(w):
-                if cid < k:
-                    row = [0] * (k * w)
-                    row[cid * w + l] = 1
-                else:
-                    row = list(self.bitmatrix[(cid - k) * w + l])
-                rows.append(row)
-        inv = _gf2_invert(rows)
+        rows = matrices.survivor_bitrows(k, w, self.bitmatrix, have)
+        inv = matrices.gf2_invert(rows)
         data_flat = np.stack([self._packets(chunks[c]) for c in have])
         nw, ps = data_flat.shape[1], data_flat.shape[3]
         flat = data_flat.transpose(0, 2, 1, 3).reshape(k * w, nw * ps)
@@ -267,25 +258,6 @@ class _BitmatrixTechnique(ErasureCodeJerasure):
                     out[i] = np.ascontiguousarray(
                         cpk.reshape(w, nw, ps).transpose(1, 0, 2)).tobytes()
         return self._from_logical(out)
-
-
-def _gf2_invert(rows: list[list[int]]) -> list[list[int]]:
-    """Invert a square 0/1 matrix over GF(2)."""
-    n = len(rows)
-    a = [list(r) for r in rows]
-    inv = [[1 if i == j else 0 for j in range(n)] for i in range(n)]
-    for col in range(n):
-        piv = next((r for r in range(col, n) if a[r][col]), None)
-        if piv is None:
-            raise ValueError("singular GF(2) matrix")
-        if piv != col:
-            a[col], a[piv] = a[piv], a[col]
-            inv[col], inv[piv] = inv[piv], inv[col]
-        for r in range(n):
-            if r != col and a[r][col]:
-                a[r] = [x ^ y for x, y in zip(a[r], a[col])]
-                inv[r] = [x ^ y for x, y in zip(inv[r], inv[col])]
-    return inv
 
 
 class CauchyOrig(_BitmatrixTechnique):

@@ -193,6 +193,40 @@ def matrix_to_bitmatrix(k: int, m: int, w: int, matrix: Matrix) -> list[list[int
     return bits
 
 
+def gf2_invert(rows: list[list[int]]) -> list[list[int]]:
+    """Invert a square 0/1 matrix over GF(2)."""
+    n = len(rows)
+    a = [list(r) for r in rows]
+    inv = [[1 if i == j else 0 for j in range(n)] for i in range(n)]
+    for col in range(n):
+        piv = next((r for r in range(col, n) if a[r][col]), None)
+        if piv is None:
+            raise ValueError("singular GF(2) matrix")
+        if piv != col:
+            a[col], a[piv] = a[piv], a[col]
+            inv[col], inv[piv] = inv[piv], inv[col]
+        for r in range(n):
+            if r != col and a[r][col]:
+                a[r] = [x ^ y for x, y in zip(a[r], a[col])]
+                inv[r] = [x ^ y for x, y in zip(inv[r], inv[col])]
+    return inv
+
+
+def survivor_bitrows(k: int, w: int, bitmatrix, survivors) -> list[list[int]]:
+    """Bit-level rows of the generator [I; B] for the first k surviving
+    chunks — the system a bitmatrix decode inverts."""
+    rows = []
+    for cid in survivors[:k]:
+        for l in range(w):
+            if cid < k:
+                row = [0] * (k * w)
+                row[cid * w + l] = 1
+            else:
+                row = [int(v) for v in bitmatrix[(cid - k) * w + l]]
+            rows.append(row)
+    return rows
+
+
 # ---------------------------------------------------------------------------
 # ISA-L: ec_base.c generators
 # ---------------------------------------------------------------------------
