@@ -1,0 +1,594 @@
+"""Vectorized CRUSH mapping on device: one jitted program maps millions
+of PGs at once.
+
+This is the TPU replacement for the reference's threaded bulk mapper
+(src/osd/OSDMapMapping.h:18-120 ParallelPGMapper) and the inner loops it
+shards (crush_do_rule / crush_choose_firstn / crush_choose_indep,
+src/crush/mapper.c:438-821): the PG axis becomes the vector lane axis,
+retries become masked lax.while_loop iterations, and the straw2
+exponential draw (mapper.c:316-345) runs as int64 fixed-point math that
+is bit-identical to the host engine (ceph_tpu.ops.crush.host) and the
+reference golden vectors.
+
+Device scope (the modern "optimal" tunables profile): straw2 buckets at
+every level, choose_local_tries == choose_local_fallback_tries == 0,
+rules of shape TAKE -> one CHOOSE/CHOOSELEAF step -> EMIT.  Anything
+else falls back to the host interpreter, which remains the general spec.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...models.crushmap import (
+    CHOOSE_FIRSTN,
+    CHOOSE_INDEP,
+    CHOOSELEAF_FIRSTN,
+    CHOOSELEAF_INDEP,
+    EMIT,
+    ITEM_NONE,
+    ITEM_UNDEF,
+    SET_CHOOSE_TRIES,
+    SET_CHOOSELEAF_TRIES,
+    SET_CHOOSELEAF_STABLE,
+    SET_CHOOSELEAF_VARY_R,
+    STRAW2,
+    TAKE,
+    CrushMap,
+)
+from ._ln_tables import LL_TBL, RH_LH_TBL
+
+S64_MIN = -(1 << 63)
+LN_ONE = 1 << 48  # 2^48: crush_ln scale at u=0xFFFF+1
+
+HASH_SEED = 1315423911
+
+
+# ---------------------------------------------------------------------------
+# jnp primitives (bit-for-bit mirrors of hashes.py / host.crush_ln)
+# ---------------------------------------------------------------------------
+
+def _u32(v):
+    return jnp.asarray(v, jnp.uint32)
+
+
+def _mix(a, b, c):
+    a = a - b; a = a - c; a = a ^ (c >> _u32(13))
+    b = b - c; b = b - a; b = b ^ (a << _u32(8))
+    c = c - a; c = c - b; c = c ^ (b >> _u32(13))
+    a = a - b; a = a - c; a = a ^ (c >> _u32(12))
+    b = b - c; b = b - a; b = b ^ (a << _u32(16))
+    c = c - a; c = c - b; c = c ^ (b >> _u32(5))
+    a = a - b; a = a - c; a = a ^ (c >> _u32(3))
+    b = b - c; b = b - a; b = b ^ (a << _u32(10))
+    c = c - a; c = c - b; c = c ^ (b >> _u32(15))
+    return a, b, c
+
+
+def hash32_3_j(a, b, c):
+    a, b, c = _u32(a), _u32(b), _u32(c)
+    h = _u32(HASH_SEED) ^ a ^ b ^ c
+    x, y = _u32(231232), _u32(1232)
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+def hash32_2_j(a, b):
+    a, b = _u32(a), _u32(b)
+    h = _u32(HASH_SEED) ^ a ^ b
+    x, y = _u32(231232), _u32(1232)
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+_RH_LH = jnp.asarray(np.array(RH_LH_TBL, dtype=np.int64))
+_LL = jnp.asarray(np.array(LL_TBL, dtype=np.int64))
+
+
+def crush_ln_j(xin):
+    """Vector crush_ln: 2^44 * log2(xin+1) fixed point (mapper.c:226-268).
+    xin int64 in [0, 0xFFFF]."""
+    x = xin.astype(jnp.int64) + 1            # [1, 0x10000]
+    bl = jnp.ones_like(x)                    # exact bit_length via compares
+    for kbit in range(1, 17):
+        bl = bl + (x >= (1 << kbit)).astype(jnp.int64)
+    need_norm = (x & 0x18000) == 0
+    bits = jnp.maximum(16 - bl, 0)
+    x2 = jnp.where(need_norm, x << bits, x)
+    iexpon = jnp.where(need_norm, 15 - bits, 15)
+    index1 = (x2 >> 8) << 1
+    rh = _RH_LH[index1 - 256]
+    lh = _RH_LH[index1 + 1 - 256]
+    xl64 = (x2 * rh) >> 48
+    index2 = xl64 & 0xFF
+    lh2 = (lh + _LL[index2]) >> 4
+    return (iexpon << 44) + lh2
+
+
+U64_MAX = (1 << 64) - 1
+
+
+def _neg_ln_table() -> np.ndarray:
+    """neg[u] = 2^48 - crush_ln(u) for every 16-bit u (the full domain of
+    the straw2 hash draw)."""
+    from .host import crush_ln
+
+    return np.array([(1 << 48) - crush_ln(u) for u in range(1 << 16)],
+                    dtype=np.int64)
+
+
+_NEG_LN_NP: np.ndarray | None = None
+
+
+def _neg_ln() -> jnp.ndarray:
+    """Must be materialised OUTSIDE any jit trace (see FlatMap.__init__);
+    inside a trace it would leak a tracer through the module global."""
+    global _NEG_LN_NP
+    if _NEG_LN_NP is None:
+        _NEG_LN_NP = _neg_ln_table()
+    return jnp.asarray(_NEG_LN_NP)
+
+
+def magic_for_divisor(d: int) -> tuple[int, int]:
+    """(M, k) such that a*M >> k == a // d exactly for all a <= 2^48.
+
+    Granlund-Montgomery: M = ceil(2^k / d) with k = 48 + bits(d); then
+    e = M*d - 2^k < 2^bits(d), so the error term a*e/(d*2^k) stays below
+    1/d for a <= 2^48 and the floor is exact.  M < 2^50 always fits."""
+    if d <= 0:
+        return 0, 0
+    k = 48 + d.bit_length()
+    M = -(-(1 << k) // d)
+    return M, k
+
+
+def _magic_divide(a, m_arr, k_arr):
+    """Exact a // d via the per-item magic (a int64 <= 2^48, arrays of
+    uint64 M and int32 k).  128-bit product by 32-bit limbs; TPU int64
+    multiply is cheap, only division is emulated slowly."""
+    a = a.astype(jnp.uint64)
+    m = m_arr
+    a0 = a & jnp.uint64(0xFFFFFFFF)
+    a1 = a >> jnp.uint64(32)
+    m0 = m & jnp.uint64(0xFFFFFFFF)
+    m1 = m >> jnp.uint64(32)
+    lo_lo = a0 * m0
+    c1 = a0 * m1
+    c2 = a1 * m0
+    hi_hi = a1 * m1
+    mid = (lo_lo >> jnp.uint64(32)) + (c1 & jnp.uint64(0xFFFFFFFF)) + \
+        (c2 & jnp.uint64(0xFFFFFFFF))
+    lo = (lo_lo & jnp.uint64(0xFFFFFFFF)) | (mid << jnp.uint64(32))
+    hi = hi_hi + (c1 >> jnp.uint64(32)) + (c2 >> jnp.uint64(32)) + \
+        (mid >> jnp.uint64(32))
+    k = k_arr.astype(jnp.uint64)
+    klo = jnp.minimum(k, jnp.uint64(63))
+    km64 = jnp.where(k > 64, k - jnp.uint64(64), jnp.uint64(0))
+    sh_up = jnp.where(k < 64, jnp.uint64(64) - k, jnp.uint64(0))
+    q_low = (hi << sh_up) | (lo >> klo)
+    q_high = hi >> km64
+    return jnp.where(k < 64, q_low, q_high).astype(jnp.int64)
+
+
+def _straw2_draw_q(x, ids, r, m_arr, k_arr):
+    """Quotient of the exponential draw (mapper.c:312-345): the reference
+    maximises trunc((ln-2^48)/w); we minimise q = (2^48-ln)//w, which is
+    the same winner with the same first-index tie-break.  Zero-weight
+    items (k==0) get q = S64_MAX."""
+    u = (hash32_3_j(x, ids, r) & _u32(0xFFFF)).astype(jnp.int64)
+    neg = _neg_ln()[u]
+    q = _magic_divide(neg, m_arr, k_arr)
+    return jnp.where(k_arr > 0, q, jnp.int64((1 << 63) - 1))
+
+
+# ---------------------------------------------------------------------------
+# flattened map
+# ---------------------------------------------------------------------------
+
+
+class FlatMap:
+    """CrushMap flattened to dense arrays. Bucket index bid = -1 - id."""
+
+    def __init__(self, m: CrushMap, choose_args_name: str | None = None):
+        for b in m.buckets.values():
+            if b.alg != STRAW2:
+                raise ValueError(
+                    "device mapper requires straw2 buckets (bucket %d has "
+                    "alg %d)" % (b.id, b.alg))
+        t = m.tunables
+        if t.choose_local_tries or t.choose_local_fallback_tries:
+            raise ValueError("device mapper requires local tries == 0")
+        B = m.max_buckets or 1
+        S = max((b.size for b in m.buckets.values()), default=1) or 1
+        self.B, self.S = B, S
+        self.max_devices = m.max_devices
+        self.tunables = t
+        size = np.zeros(B, np.int32)
+        btype = np.zeros(B, np.int32)
+        items = np.zeros((B, S), np.int32)
+        ids = np.zeros((B, S), np.int32)
+        cargs = (m.choose_args.get(choose_args_name)
+                 if choose_args_name else None)
+        n_pos = 1
+        if cargs:
+            n_pos = max((len(ws.weight_sets) for ws in cargs.values()
+                         if ws.weight_sets), default=1) or 1
+        pos_w = np.zeros((n_pos, B, S), np.int32)
+        for b in m.buckets.values():
+            bid = -1 - b.id
+            size[bid] = b.size
+            btype[bid] = b.type
+            items[bid, :b.size] = b.items
+            ids[bid, :b.size] = b.items
+            for p in range(n_pos):
+                pos_w[p, bid, :b.size] = b.item_weights
+            if cargs and b.id in cargs:
+                ws = cargs[b.id]
+                if ws.ids is not None:
+                    ids[bid, :b.size] = ws.ids
+                if ws.weight_sets:
+                    for p in range(n_pos):
+                        src = ws.weight_sets[min(p, len(ws.weight_sets) - 1)]
+                        pos_w[p, bid, :b.size] = src
+        depth: dict[int, int] = {}
+
+        def _depth(bid_id: int) -> int:
+            if bid_id in depth:
+                return depth[bid_id]
+            b = m.buckets[bid_id]
+            d = 1 + max((_depth(i) for i in b.items if i < 0), default=0)
+            depth[bid_id] = d
+            return d
+
+        self.max_depth = max((_depth(i) for i in m.buckets), default=1)
+        # magic-division constants per (pos, bucket, item) weight — the
+        # divisors are map constants, so the slow emulated int64 divide
+        # becomes a 128-bit multiply-shift on device
+        magic_m = np.zeros((n_pos, B, S), np.uint64)
+        magic_k = np.zeros((n_pos, B, S), np.int32)
+        for p in range(n_pos):
+            for bi in range(B):
+                for si in range(S):
+                    M, k = magic_for_divisor(int(pos_w[p, bi, si]))
+                    magic_m[p, bi, si] = M
+                    magic_k[p, bi, si] = k
+        self.size = jnp.asarray(size)
+        self.btype = jnp.asarray(btype)
+        self.items = jnp.asarray(items)
+        self.ids = jnp.asarray(ids)
+        self.magic_m = jnp.asarray(magic_m)
+        self.magic_k = jnp.asarray(magic_k)
+        self.neg_ln = _neg_ln()              # materialise outside jit
+        self.n_pos = n_pos
+        self.rules = dict(m.rules)
+
+
+# ---------------------------------------------------------------------------
+# vector choose primitives
+# ---------------------------------------------------------------------------
+
+
+def _straw2_choose(fm: FlatMap, bid, x, r, pos):
+    """Winning item per lane. bid [L] bucket indices; pos [L] output
+    positions (selects the choose_args weight-set, CrushWrapper.h:1500)."""
+    idv = fm.ids[bid]                        # [L, S]
+    if fm.n_pos == 1:
+        m_arr = fm.magic_m[0][bid]
+        k_arr = fm.magic_k[0][bid]
+    else:
+        p = jnp.minimum(pos, fm.n_pos - 1)
+        m_arr = fm.magic_m[p, bid]
+        k_arr = fm.magic_k[p, bid]
+    q = _straw2_draw_q(x[:, None], idv, r[:, None], m_arr, k_arr)
+    valid = jnp.arange(fm.S)[None, :] < fm.size[bid][:, None]
+    q = jnp.where(valid, q, jnp.int64((1 << 63) - 1))
+    win = jnp.argmin(q, axis=1)
+    return fm.items[bid, win].astype(jnp.int32)
+
+
+def _descend(fm: FlatMap, take_bid, x, r, want_type: int, pos):
+    """Walk bucket->bucket until an item of want_type.
+
+    Returns (item, ok, perm_fail): ok = reached an item of the wanted
+    type; perm_fail = hit a wrong-type device (host skips the replica
+    permanently, mapper.c:516-520); neither = retryable (empty bucket).
+    """
+    L = x.shape[0]
+    cur = take_bid
+    item = jnp.full((L,), ITEM_NONE, jnp.int32)
+    ok = jnp.zeros((L,), bool)
+    perm = jnp.zeros((L,), bool)
+    done = fm.size[cur] == 0                 # empty bucket: retryable
+    for _ in range(fm.max_depth):
+        chosen = _straw2_choose(fm, cur, x, r, pos)
+        is_bucket = chosen < 0
+        cbid = jnp.where(is_bucket, -1 - chosen, 0)
+        ctype = jnp.where(is_bucket, fm.btype[cbid], 0)
+        oob = (~is_bucket) & (chosen >= fm.max_devices)
+        reach = (~done) & (ctype == want_type) & (~oob)
+        wrongdev = (~done) & (~reach) & ((~is_bucket) | oob)
+        empty_next = (~done) & (~reach) & is_bucket & (fm.size[cbid] == 0)
+        item = jnp.where(reach, chosen, item)
+        ok = ok | reach
+        perm = perm | wrongdev
+        done = done | reach | wrongdev | empty_next
+        cur = jnp.where((~done) & is_bucket, cbid, cur)
+    return item, ok, perm
+
+
+def _is_out(dev_weights, item, x):
+    """Reweight rejection (mapper.c:402-416)."""
+    idx = jnp.clip(item, 0, dev_weights.shape[0] - 1)
+    w = dev_weights[idx]
+    oob = (item >= dev_weights.shape[0]) | (item < 0)
+    hh = (hash32_2_j(x, item) & _u32(0xFFFF)).astype(jnp.int32)
+    return oob | (w == 0) | ((w < 0x10000) & (hh >= w))
+
+
+# ---------------------------------------------------------------------------
+# firstn / indep
+# ---------------------------------------------------------------------------
+
+
+def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
+                       result_max: int, want_type: int,
+                       recurse_to_leaf: bool, dev_weights,
+                       tries: int, recurse_tries: int, vary_r: int,
+                       stable: int):
+    """crush_choose_firstn (mapper.c:438-626) for local-tries==0: per
+    replica, retry whole descents while collided/rejected (masked
+    lanes); chooseleaf recursion selects one leaf per chosen bucket."""
+    L = xs.shape[0]
+    slots = min(numrep, result_max)
+    out = jnp.full((L, slots), ITEM_NONE, jnp.int32)      # level items
+    leaves = jnp.full((L, slots), ITEM_NONE, jnp.int32)   # devices
+    outpos = jnp.zeros((L,), jnp.int32)
+
+    result_slots = out.shape[1]
+
+    def rep_body(rep, carry):
+        out, leaves, outpos = carry
+
+        def body(state):
+            ftotal, active, out, leaves, outpos = state
+            r = jnp.full((L,), 0, jnp.int32) + rep + ftotal
+            item, ok, perm = _descend(fm, take_bid, xs, r, want_type,
+                                      outpos)
+            if recurse_to_leaf:
+                if vary_r:
+                    sub_r = r >> (vary_r - 1)
+                else:
+                    sub_r = jnp.zeros_like(r)
+                rep_i = (jnp.zeros_like(outpos) if stable else outpos)
+                bid_in = jnp.where(item < 0, -1 - item, 0)
+
+                def inner_body(istate):
+                    ift, iact, leaf, leaf_ok = istate
+                    r_in = rep_i + sub_r + ift
+                    cand, cok, _cperm = _descend(
+                        fm, bid_in, xs, r_in, 0, outpos)
+                    cok = cok & (item < 0)
+                    cok = cok & ~_is_out(dev_weights, cand, xs)
+                    take = iact & cok
+                    leaf = jnp.where(take, cand, leaf)
+                    leaf_ok = leaf_ok | take
+                    iact = iact & (~cok) & (ift + 1 < recurse_tries)
+                    return ift + 1, iact, leaf, leaf_ok
+
+                izero = jnp.zeros((L,), jnp.int32)
+                leaf0 = jnp.full((L,), ITEM_NONE, jnp.int32)
+                _, _, leaf, leaf_ok = jax.lax.while_loop(
+                    lambda s: jnp.any(s[1]), inner_body,
+                    (izero, active & ok, leaf0, jnp.zeros((L,), bool)))
+                final, final_ok = leaf, ok & leaf_ok
+            else:
+                final = item
+                final_ok = ok
+                if want_type == 0:
+                    final_ok = final_ok & ~_is_out(dev_weights, item, xs)
+            collide = jnp.any(out == item[:, None], axis=1) & ok
+            success = (active & final_ok & ~collide
+                       & (outpos < result_slots))
+            slot = jnp.arange(result_slots)[None, :] == outpos[:, None]
+            put = slot & success[:, None]
+            out = jnp.where(put, item[:, None], out)
+            leaves = jnp.where(put, final[:, None], leaves)
+            outpos = outpos + success.astype(jnp.int32)
+            ftotal = ftotal + 1
+            active = active & ~success & ~perm & (ftotal < tries)
+            return ftotal, active, out, leaves, outpos
+
+        z = jnp.zeros((L,), jnp.int32)
+        act = jnp.ones((L,), bool)
+        _, _, out, leaves, outpos = jax.lax.while_loop(
+            lambda s: jnp.any(s[1]), body, (z, act, out, leaves, outpos))
+        return out, leaves, outpos
+
+    out, leaves, outpos = jax.lax.fori_loop(
+        0, numrep, rep_body, (out, leaves, outpos))
+    return (leaves if recurse_to_leaf else out), outpos
+
+
+def _choose_indep_vec(fm: FlatMap, take_bid, xs, numrep: int,
+                      result_max: int, want_type: int,
+                      recurse_to_leaf: bool, dev_weights,
+                      tries: int, recurse_tries: int):
+    """crush_choose_indep (mapper.c:633-821): positionally-stable, slots
+    left UNDEF retry with r advanced by numrep per round (numrep is the
+    full replica count even when fewer slots fit result_max)."""
+    L = xs.shape[0]
+    slots = min(numrep, result_max)
+    out = jnp.full((L, slots), ITEM_UNDEF, jnp.int32)
+    leaves = jnp.full((L, slots), ITEM_UNDEF, jnp.int32)
+    pos0 = jnp.zeros((L,), jnp.int32)
+
+    def body(state):
+        ftotal, out, leaves = state
+
+        def rep_body(rep, carry):
+            out, leaves = carry
+            undecided = out[:, rep] == ITEM_UNDEF
+            r = jnp.full((L,), 0, jnp.int32) + rep + numrep * ftotal
+            item, ok, perm = _descend(fm, take_bid, xs, r, want_type, pos0)
+            collide = jnp.any(out == item[:, None], axis=1) & ok
+            if recurse_to_leaf:
+                bid_in = jnp.where(item < 0, -1 - item, 0)
+                pos_r = jnp.full((L,), 0, jnp.int32) + rep
+
+                def inner_body(istate):
+                    ift, iact, leaf, leaf_ok = istate
+                    r_in = r + rep + numrep * ift
+                    cand, cok, _cp = _descend(fm, bid_in, xs, r_in, 0,
+                                              pos_r)
+                    cok = cok & (item < 0)
+                    cok = cok & ~_is_out(dev_weights, cand, xs)
+                    take = iact & cok
+                    leaf = jnp.where(take, cand, leaf)
+                    leaf_ok = leaf_ok | take
+                    iact = iact & (~cok) & (ift + 1 < recurse_tries)
+                    return ift + 1, iact, leaf, leaf_ok
+
+                izero = jnp.zeros((L,), jnp.int32)
+                leaf0 = jnp.full((L,), ITEM_NONE, jnp.int32)
+                _, _, leaf, leaf_ok = jax.lax.while_loop(
+                    lambda s: jnp.any(s[1]), inner_body,
+                    (izero, undecided & ok & ~collide, leaf0,
+                     jnp.zeros((L,), bool)))
+                final, final_ok = leaf, ok & leaf_ok
+            else:
+                final = item
+                final_ok = ok
+                if want_type == 0:
+                    final_ok = final_ok & ~_is_out(dev_weights, item, xs)
+            success = undecided & final_ok & ~collide
+            permfail = undecided & perm
+            col = jnp.arange(slots)[None, :] == rep
+            out = jnp.where(col & success[:, None], item[:, None], out)
+            out = jnp.where(col & permfail[:, None], ITEM_NONE, out)
+            leaves = jnp.where(col & success[:, None], final[:, None],
+                               leaves)
+            leaves = jnp.where(col & permfail[:, None], ITEM_NONE, leaves)
+            return out, leaves
+
+        out, leaves = jax.lax.fori_loop(0, slots, rep_body, (out, leaves))
+        return ftotal + 1, out, leaves
+
+    def cond(state):
+        ftotal, out, _ = state
+        return jnp.any(out == ITEM_UNDEF) & (ftotal < tries)
+
+    z = jnp.zeros((), jnp.int32)
+    _, out, leaves = jax.lax.while_loop(cond, body, (z, out, leaves))
+    res = leaves if recurse_to_leaf else out
+    return jnp.where(res == ITEM_UNDEF, ITEM_NONE, res)
+
+
+# ---------------------------------------------------------------------------
+# rule driver
+# ---------------------------------------------------------------------------
+
+
+class DeviceMapper:
+    """Bulk do_rule on device for straw2 maps with single-choose rules.
+
+    do_rule_batch(ruleno, xs, result_max, dev_weights) mirrors
+    CrushWrapper::do_rule over a whole batch of inputs; results carry
+    ITEM_NONE holes exactly like the host engine.
+    """
+
+    def __init__(self, crushmap: CrushMap,
+                 choose_args_name: str | None = None):
+        self.fm = FlatMap(crushmap, choose_args_name)
+        self.map = crushmap
+
+    def _compile(self, ruleno: int, result_max: int):
+        rule = self.fm.rules[ruleno]
+        t = self.fm.tunables
+        tries = t.choose_total_tries + 1     # historical off-by-one
+        leaf_tries = 0
+        vary_r = t.chooseleaf_vary_r
+        stable = t.chooseleaf_stable
+        take_id = None
+        plan = None
+        for op, arg1, arg2 in rule.steps:
+            if op == TAKE:
+                take_id = arg1
+            elif op == SET_CHOOSE_TRIES:
+                if arg1 > 0:
+                    tries = arg1
+            elif op == SET_CHOOSELEAF_TRIES:
+                if arg1 > 0:
+                    leaf_tries = arg1
+            elif op == SET_CHOOSELEAF_VARY_R:
+                if arg1 >= 0:
+                    vary_r = arg1
+            elif op == SET_CHOOSELEAF_STABLE:
+                if arg1 >= 0:
+                    stable = arg1
+            elif op in (CHOOSE_FIRSTN, CHOOSELEAF_FIRSTN,
+                        CHOOSE_INDEP, CHOOSELEAF_INDEP):
+                if plan is not None:
+                    raise ValueError(
+                        "device mapper supports a single choose step")
+                if take_id is None or take_id >= 0:
+                    raise ValueError("choose without a bucket take")
+                numrep = arg1
+                if numrep <= 0:
+                    numrep += result_max
+                firstn = op in (CHOOSE_FIRSTN, CHOOSELEAF_FIRSTN)
+                leaf = op in (CHOOSELEAF_FIRSTN, CHOOSELEAF_INDEP)
+                plan = (take_id, numrep, arg2, firstn, leaf)
+            elif op == EMIT:
+                pass
+        if plan is None:
+            raise ValueError("rule has no choose step")
+        take_id, numrep, want_type, firstn, leaf = plan
+        if firstn:
+            recurse = (leaf_tries if leaf_tries
+                       else (1 if t.chooseleaf_descend_once else tries))
+        else:
+            recurse = leaf_tries if leaf_tries else 1
+        fm = self.fm
+        take_bid_val = -1 - take_id
+
+        @jax.jit
+        def run(xs, dev_weights):
+            L = xs.shape[0]
+            take_bid = jnp.full((L,), take_bid_val, jnp.int32)
+            if firstn:
+                res, _ = _choose_firstn_vec(
+                    fm, take_bid, xs, numrep, result_max, want_type,
+                    leaf, dev_weights, tries, recurse, vary_r, stable)
+            else:
+                res = _choose_indep_vec(
+                    fm, take_bid, xs, numrep, result_max, want_type,
+                    leaf, dev_weights, tries, recurse)
+            return res
+
+        return run
+
+    @functools.lru_cache(maxsize=None)
+    def _compiled(self, ruleno: int, result_max: int):
+        return self._compile(ruleno, result_max)
+
+    def do_rule_batch(self, ruleno: int, xs, result_max: int,
+                      dev_weights) -> np.ndarray:
+        """xs: int array [L] of inputs (pps values); dev_weights: int32
+        [max_devices] 16.16 reweights.  Returns [L, numrep] int32 with
+        ITEM_NONE holes."""
+        fn = self._compiled(ruleno, result_max)
+        xs = jnp.asarray(np.asarray(xs, dtype=np.int64) & 0xFFFFFFFF,
+                         dtype=jnp.uint32)
+        w = jnp.asarray(np.asarray(dev_weights, dtype=np.int32))
+        return np.asarray(fn(xs, w))

@@ -1,0 +1,427 @@
+"""OSDMap: the versioned cluster map and the PG->OSD mapping pipeline.
+
+Re-derivation of src/osd/OSDMap.{h,cc} and pg_pool_t (src/osd/
+osd_types.cc): epoch-versioned device states/weights plus an embedded
+CrushMap, with the deterministic mapping pipeline every node computes
+identically (OSDMap.cc:2879 _pg_to_up_acting_osds):
+
+    raw_pg_to_pps (stable-mod + rjenkins pool mix, osd_types.cc:1815)
+    -> crush do_rule            (host Mapper or vectorized DeviceMapper)
+    -> _apply_upmap             (OSDMap.cc:2656)
+    -> _raw_to_up_osds          (OSDMap.cc:2724)
+    -> _pick_primary / _apply_primary_affinity (OSDMap.cc:2749)
+    -> pg_temp / primary_temp   (OSDMap.cc:2804)
+
+Incremental mutation follows the same new_* field pattern as
+OSDMap::Incremental so monitors can publish deltas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..models.crushmap import ITEM_NONE, CrushMap
+from ..ops.crush.hashes import hash32_2, str_hash_rjenkins
+from ..ops.crush.host import Mapper
+
+CEPH_OSD_MAX_PRIMARY_AFFINITY = 0x10000
+CEPH_OSD_DEFAULT_PRIMARY_AFFINITY = 0x10000
+CEPH_OSD_IN = 0x10000
+CEPH_OSD_OUT = 0
+
+# osd_state bits
+OSD_EXISTS = 1
+OSD_UP = 2
+
+POOL_TYPE_REPLICATED = 1
+POOL_TYPE_ERASURE = 3
+
+FLAG_HASHPSPOOL = 1
+
+
+def calc_bits_of(t: int) -> int:
+    b = 0
+    while t:
+        t >>= 1
+        b += 1
+    return b
+
+
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    """Stable modulo: remaps only the necessary inputs when b grows
+    toward the next power of two (include/ceph_hash-adjacent helper used
+    by pg selection)."""
+    if (x & bmask) < b:
+        return x & bmask
+    return x & (bmask >> 1)
+
+
+@dataclass(frozen=True)
+class pg_t:
+    """Raw placement-group id: (pool, ps)."""
+
+    pool: int
+    ps: int
+
+    def __str__(self) -> str:
+        return "%d.%x" % (self.pool, self.ps)
+
+
+@dataclass
+class PGPool:
+    """pg_pool_t analog (the subset the mapping/data path needs)."""
+
+    id: int
+    name: str
+    type: int = POOL_TYPE_REPLICATED
+    size: int = 3
+    min_size: int = 2
+    pg_num: int = 32
+    pgp_num: int = 0
+    crush_rule: int = 0
+    flags: int = FLAG_HASHPSPOOL
+    erasure_code_profile: str = ""
+    object_hash: str = "rjenkins"  # only rjenkins supported
+    last_change: int = 0
+
+    def __post_init__(self):
+        if not self.pgp_num:
+            self.pgp_num = self.pg_num
+
+    @property
+    def pg_num_mask(self) -> int:
+        return (1 << calc_bits_of(self.pg_num - 1)) - 1
+
+    @property
+    def pgp_num_mask(self) -> int:
+        return (1 << calc_bits_of(self.pgp_num - 1)) - 1
+
+    def is_erasure(self) -> bool:
+        return self.type == POOL_TYPE_ERASURE
+
+    def can_shift_osds(self) -> bool:
+        # replicated sets compact; erasure sets are positional
+        return self.type == POOL_TYPE_REPLICATED
+
+    def hash_key(self, key: str, nspace: str) -> int:
+        """Object key -> 32-bit ps hash (osd_types.cc:1777-1794): the
+        namespace, when present, is prefixed with a 0x1f separator."""
+        if nspace:
+            buf = nspace.encode() + b"\x1f" + key.encode()
+        else:
+            buf = key.encode()
+        return str_hash_rjenkins(buf)
+
+    def raw_pg_to_pg(self, pg: pg_t) -> pg_t:
+        return pg_t(pg.pool, ceph_stable_mod(pg.ps, self.pg_num,
+                                             self.pg_num_mask))
+
+    def raw_pg_to_pps(self, pg: pg_t) -> int:
+        """Placement seed (osd_types.cc:1815-1831)."""
+        if self.flags & FLAG_HASHPSPOOL:
+            return hash32_2(
+                ceph_stable_mod(pg.ps, self.pgp_num, self.pgp_num_mask),
+                pg.pool)
+        return ceph_stable_mod(pg.ps, self.pgp_num,
+                               self.pgp_num_mask) + pg.pool
+
+
+class OSDMap:
+    """The cluster map. All mutation goes through apply_incremental so
+    every node's copy stays identical per epoch."""
+
+    def __init__(self):
+        self.epoch = 0
+        self.fsid = ""
+        self.max_osd = 0
+        self.osd_state: list[int] = []
+        self.osd_weight: list[int] = []      # 16.16 in/out weight
+        self.osd_primary_affinity: list[int] | None = None
+        self.osd_addrs: dict[int, str] = {}
+        self.crush = CrushMap()
+        self.pools: dict[int, PGPool] = {}
+        self.pool_max = -1
+        self.pg_temp: dict[pg_t, list[int]] = {}
+        self.primary_temp: dict[pg_t, int] = {}
+        self.pg_upmap: dict[pg_t, list[int]] = {}
+        self.pg_upmap_items: dict[pg_t, list[tuple[int, int]]] = {}
+        self.pg_upmap_primaries: dict[pg_t, int] = {}
+        self.blocklist: dict[str, float] = {}
+        self._mapper: Mapper | None = None
+
+    # -- device state ------------------------------------------------------
+
+    def set_max_osd(self, n: int) -> None:
+        while len(self.osd_state) < n:
+            self.osd_state.append(0)
+            self.osd_weight.append(CEPH_OSD_OUT)
+        self.max_osd = n
+
+    def exists(self, osd: int) -> bool:
+        return 0 <= osd < self.max_osd and bool(
+            self.osd_state[osd] & OSD_EXISTS)
+
+    def is_up(self, osd: int) -> bool:
+        return self.exists(osd) and bool(self.osd_state[osd] & OSD_UP)
+
+    def is_down(self, osd: int) -> bool:
+        return not self.is_up(osd)
+
+    def is_in(self, osd: int) -> bool:
+        return self.exists(osd) and self.osd_weight[osd] > 0
+
+    def is_out(self, osd: int) -> bool:
+        return not self.is_in(osd)
+
+    def get_weight(self, osd: int) -> int:
+        return self.osd_weight[osd]
+
+    def primary_affinity(self, osd: int) -> int:
+        if self.osd_primary_affinity is None:
+            return CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
+        return self.osd_primary_affinity[osd]
+
+    def get_pg_pool(self, pool: int) -> PGPool | None:
+        return self.pools.get(pool)
+
+    def _crush_mapper(self) -> Mapper:
+        if self._mapper is None:
+            self._mapper = Mapper(self.crush)
+        return self._mapper
+
+    # -- object -> pg ------------------------------------------------------
+
+    def object_locator_to_pg(self, name: str, pool: int,
+                             key: str = "", nspace: str = "") -> pg_t:
+        p = self.pools[pool]
+        ps = p.hash_key(key or name, nspace)
+        return pg_t(pool, ps)
+
+    # -- mapping pipeline --------------------------------------------------
+
+    def _pg_to_raw_osds(self, pool: PGPool, pg: pg_t) -> tuple[list[int], int]:
+        pps = pool.raw_pg_to_pps(pg)
+        raw = self._crush_mapper().do_rule(
+            pool.crush_rule, pps, pool.size, self.osd_weight)
+        self._remove_nonexistent_osds(pool, raw)
+        return raw, pps
+
+    def _remove_nonexistent_osds(self, pool: PGPool,
+                                 osds: list[int]) -> None:
+        if pool.can_shift_osds():
+            osds[:] = [o for o in osds if self.exists(o)]
+        else:
+            for i, o in enumerate(osds):
+                if o != ITEM_NONE and not self.exists(o):
+                    osds[i] = ITEM_NONE
+
+    def _apply_upmap(self, pool: PGPool, raw_pg: pg_t,
+                     raw: list[int]) -> None:
+        pg = pool.raw_pg_to_pg(raw_pg)
+        p = self.pg_upmap.get(pg)
+        if p is not None:
+            if not any(o != ITEM_NONE and 0 <= o < self.max_osd
+                       and self.osd_weight[o] == 0 for o in p):
+                raw[:] = list(p)
+        q = self.pg_upmap_items.get(pg)
+        if q is not None:
+            for osd_from, osd_to in q:
+                exists = False
+                pos = -1
+                for i, o in enumerate(raw):
+                    if o == osd_to:
+                        exists = True
+                        break
+                    if (o == osd_from and pos < 0 and not (
+                            osd_to != ITEM_NONE and 0 <= osd_to < self.max_osd
+                            and self.osd_weight[osd_to] == 0)):
+                        pos = i
+                if not exists and pos >= 0:
+                    raw[pos] = osd_to
+        r = self.pg_upmap_primaries.get(pg)
+        if r is not None:
+            if (r != ITEM_NONE and 0 <= r < self.max_osd
+                    and self.osd_weight[r] != 0):
+                idx = 0
+                for i in range(1, len(raw)):
+                    if raw[i] == r:
+                        idx = i
+                        break
+                if idx > 0:
+                    raw[idx] = raw[0]
+                    raw[0] = r
+
+    def _raw_to_up_osds(self, pool: PGPool, raw: list[int]) -> list[int]:
+        if pool.can_shift_osds():
+            return [o for o in raw if self.exists(o) and self.is_up(o)]
+        return [o if (self.exists(o) and self.is_up(o)) else ITEM_NONE
+                for o in raw]
+
+    @staticmethod
+    def _pick_primary(osds: list[int]) -> int:
+        for o in osds:
+            if o != ITEM_NONE:
+                return o
+        return -1
+
+    def _apply_primary_affinity(self, seed: int, pool: PGPool,
+                                osds: list[int], primary: int) -> int:
+        if self.osd_primary_affinity is None:
+            return primary
+        if not any(o != ITEM_NONE and
+                   self.osd_primary_affinity[o] !=
+                   CEPH_OSD_DEFAULT_PRIMARY_AFFINITY for o in osds):
+            return primary
+        pos = -1
+        for i, o in enumerate(osds):
+            if o == ITEM_NONE:
+                continue
+            a = self.osd_primary_affinity[o]
+            if (a < CEPH_OSD_MAX_PRIMARY_AFFINITY
+                    and (hash32_2(seed, o) >> 16) >= a):
+                if pos < 0:
+                    pos = i
+            else:
+                pos = i
+                break
+        if pos < 0:
+            return primary
+        primary = osds[pos]
+        if pool.can_shift_osds() and pos > 0:
+            for i in range(pos, 0, -1):
+                osds[i] = osds[i - 1]
+            osds[0] = primary
+        return primary
+
+    def _get_temp_osds(self, pool: PGPool,
+                       pg: pg_t) -> tuple[list[int], int]:
+        pg = pool.raw_pg_to_pg(pg)
+        temp = []
+        for o in self.pg_temp.get(pg, []):
+            if not self.exists(o) or self.is_down(o):
+                if pool.can_shift_osds():
+                    continue
+                temp.append(ITEM_NONE)
+            else:
+                temp.append(o)
+        temp_primary = self.primary_temp.get(pg, -1)
+        if temp_primary == -1 and temp:
+            for o in temp:
+                if o != ITEM_NONE:
+                    temp_primary = o
+                    break
+        return temp, temp_primary
+
+    def pg_to_up_acting_osds(
+        self, pg: pg_t,
+    ) -> tuple[list[int], int, list[int], int]:
+        """Returns (up, up_primary, acting, acting_primary) — the full
+        OSDMap.cc:2879 composition."""
+        pool = self.pools.get(pg.pool)
+        if pool is None or pg.ps >= pool.pg_num:
+            return [], -1, [], -1
+        acting, acting_primary = self._get_temp_osds(pool, pg)
+        raw, pps = self._pg_to_raw_osds(pool, pg)
+        self._apply_upmap(pool, pg, raw)
+        up = self._raw_to_up_osds(pool, raw)
+        up_primary = self._pick_primary(up)
+        up_primary = self._apply_primary_affinity(pps, pool, up, up_primary)
+        if not acting:
+            acting = list(up)
+            if acting_primary == -1:
+                acting_primary = up_primary
+        return up, up_primary, acting, acting_primary
+
+    def pg_to_acting_osds(self, pg: pg_t) -> tuple[list[int], int]:
+        _, _, acting, primary = self.pg_to_up_acting_osds(pg)
+        return acting, primary
+
+    @staticmethod
+    def calc_pg_role(osd: int, acting: list[int]) -> int:
+        for i, o in enumerate(acting):
+            if o == osd:
+                return i
+        return -1
+
+    # -- incremental mutation ---------------------------------------------
+
+    def apply_incremental(self, inc: "Incremental") -> None:
+        if inc.epoch != self.epoch + 1:
+            raise ValueError("incremental epoch %d does not follow %d"
+                             % (inc.epoch, self.epoch))
+        self.epoch = inc.epoch
+        if inc.new_max_osd >= 0:
+            self.set_max_osd(inc.new_max_osd)
+        for pid, pool in inc.new_pools.items():
+            self.pools[pid] = pool
+            self.pool_max = max(self.pool_max, pid)
+        for pid in inc.old_pools:
+            self.pools.pop(pid, None)
+        for osd, st in inc.new_state.items():
+            # xor semantics like the reference: toggles the given bits
+            self.osd_state[osd] ^= st
+        for osd, w in inc.new_weight.items():
+            self.osd_weight[osd] = w
+        for osd, aff in inc.new_primary_affinity.items():
+            if self.osd_primary_affinity is None:
+                self.osd_primary_affinity = (
+                    [CEPH_OSD_DEFAULT_PRIMARY_AFFINITY] * self.max_osd)
+            while len(self.osd_primary_affinity) < self.max_osd:
+                self.osd_primary_affinity.append(
+                    CEPH_OSD_DEFAULT_PRIMARY_AFFINITY)
+            self.osd_primary_affinity[osd] = aff
+        for osd, addr in inc.new_up_client.items():
+            self.osd_state[osd] |= OSD_EXISTS | OSD_UP
+            self.osd_addrs[osd] = addr
+        for pg, osds in inc.new_pg_temp.items():
+            if osds:
+                self.pg_temp[pg] = list(osds)
+            else:
+                self.pg_temp.pop(pg, None)
+        for pg, p in inc.new_primary_temp.items():
+            if p >= 0:
+                self.primary_temp[pg] = p
+            else:
+                self.primary_temp.pop(pg, None)
+        for pg, osds in inc.new_pg_upmap.items():
+            if osds:
+                self.pg_upmap[pg] = list(osds)
+            else:
+                self.pg_upmap.pop(pg, None)
+        for pg in inc.old_pg_upmap:
+            self.pg_upmap.pop(pg, None)
+        for pg, items in inc.new_pg_upmap_items.items():
+            if items:
+                self.pg_upmap_items[pg] = [tuple(t) for t in items]
+            else:
+                self.pg_upmap_items.pop(pg, None)
+        for pg in inc.old_pg_upmap_items:
+            self.pg_upmap_items.pop(pg, None)
+        if inc.new_crush is not None:
+            self.crush = inc.new_crush
+            self._mapper = None
+
+    def new_incremental(self) -> "Incremental":
+        return Incremental(epoch=self.epoch + 1)
+
+
+@dataclass
+class Incremental:
+    """OSDMap::Incremental analog: a sparse delta to the next epoch."""
+
+    epoch: int
+    new_max_osd: int = -1
+    new_pools: dict[int, PGPool] = field(default_factory=dict)
+    old_pools: list[int] = field(default_factory=list)
+    new_state: dict[int, int] = field(default_factory=dict)    # xor bits
+    new_weight: dict[int, int] = field(default_factory=dict)
+    new_primary_affinity: dict[int, int] = field(default_factory=dict)
+    new_up_client: dict[int, str] = field(default_factory=dict)
+    new_pg_temp: dict[pg_t, list[int]] = field(default_factory=dict)
+    new_primary_temp: dict[pg_t, int] = field(default_factory=dict)
+    new_pg_upmap: dict[pg_t, list[int]] = field(default_factory=dict)
+    old_pg_upmap: list[pg_t] = field(default_factory=list)
+    new_pg_upmap_items: dict[pg_t, list[tuple[int, int]]] = (
+        field(default_factory=dict))
+    old_pg_upmap_items: list[pg_t] = field(default_factory=list)
+    new_crush: CrushMap | None = None

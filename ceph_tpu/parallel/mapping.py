@@ -1,0 +1,98 @@
+"""Bulk PG mapping: the whole cluster's PG->OSD table in one device pass.
+
+Replaces the reference's ParallelPGMapper thread pool
+(src/osd/OSDMapMapping.h:18-120, used by the mgr and by OSDMonitor to
+prime pg_temp at OSDMonitor.cc:728-735,1067): instead of sharding PG
+ranges over threads, all PGs of a pool become one vector batch through
+the jitted CRUSH kernel; the sparse exception tables (pg_temp, upmaps)
+and the up-filter/affinity steps are applied on the host, where they
+are cheap and data-dependent.
+
+Falls back to the scalar pipeline per-PG when the crush map is outside
+the device scope (non-straw2 buckets, multi-choose rules).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.crushmap import ITEM_NONE
+from ..ops.crush.hashes import hash32_2_v
+from ..osd.osdmap import OSDMap, PGPool, pg_t, ceph_stable_mod
+
+
+class OSDMapMapping:
+    """Caches up/acting for every PG of every pool (OSDMapMapping.h:174)."""
+
+    def __init__(self, osdmap: OSDMap):
+        self.epoch = osdmap.epoch
+        self.up: dict[pg_t, list[int]] = {}
+        self.up_primary: dict[pg_t, int] = {}
+        self.acting: dict[pg_t, list[int]] = {}
+        self.acting_primary: dict[pg_t, int] = {}
+        self._build(osdmap)
+
+    def _build(self, osdmap: OSDMap) -> None:
+        for pool in osdmap.pools.values():
+            try:
+                self._build_pool_device(osdmap, pool)
+            except ValueError:
+                self._build_pool_scalar(osdmap, pool)
+
+    # -- vectorized pool mapping ------------------------------------------
+
+    def _build_pool_device(self, osdmap: OSDMap, pool: PGPool) -> None:
+        from ..ops.crush.device import DeviceMapper
+
+        dm = DeviceMapper(osdmap.crush)
+        pgs = [pg_t(pool.id, ps) for ps in range(pool.pg_num)]
+        pps = pps_for_pool(pool, np.arange(pool.pg_num))
+        raw = dm.do_rule_batch(pool.crush_rule, pps, pool.size,
+                               osdmap.osd_weight)
+        raw = np.asarray(raw)
+        for i, pg in enumerate(pgs):
+            row = [int(v) for v in raw[i]]
+            self._finish_pg(osdmap, pool, pg, int(pps[i]), row)
+
+    # -- scalar fallback ---------------------------------------------------
+
+    def _build_pool_scalar(self, osdmap: OSDMap, pool: PGPool) -> None:
+        for ps in range(pool.pg_num):
+            pg = pg_t(pool.id, ps)
+            raw, pps = osdmap._pg_to_raw_osds(pool, pg)
+            self._finish_pg(osdmap, pool, pg, pps, raw)
+
+    def _finish_pg(self, osdmap: OSDMap, pool: PGPool, pg: pg_t,
+                   pps: int, raw: list[int]) -> None:
+        osdmap._remove_nonexistent_osds(pool, raw)
+        osdmap._apply_upmap(pool, pg, raw)
+        up = osdmap._raw_to_up_osds(pool, raw)
+        up_primary = osdmap._pick_primary(up)
+        up_primary = osdmap._apply_primary_affinity(pps, pool, up,
+                                                    up_primary)
+        acting, acting_primary = osdmap._get_temp_osds(pool, pg)
+        if not acting:
+            acting = list(up)
+            if acting_primary == -1:
+                acting_primary = up_primary
+        self.up[pg] = up
+        self.up_primary[pg] = up_primary
+        self.acting[pg] = acting
+        self.acting_primary[pg] = acting_primary
+
+    def get(self, pg: pg_t) -> tuple[list[int], int, list[int], int]:
+        return (self.up.get(pg, []), self.up_primary.get(pg, -1),
+                self.acting.get(pg, []), self.acting_primary.get(pg, -1))
+
+
+def pps_for_pool(pool: PGPool, ps: np.ndarray) -> np.ndarray:
+    """Vectorized raw_pg_to_pps over a pool's ps range
+    (osd_types.cc:1815-1831)."""
+    b, bmask = pool.pgp_num, pool.pgp_num_mask
+    masked = np.where((ps & bmask) < b, ps & bmask, ps & (bmask >> 1))
+    from ..osd.osdmap import FLAG_HASHPSPOOL
+
+    if pool.flags & FLAG_HASHPSPOOL:
+        return hash32_2_v(masked.astype(np.uint32),
+                          np.uint32(pool.id)).astype(np.int64)
+    return masked.astype(np.int64) + pool.id

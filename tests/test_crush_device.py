@@ -1,0 +1,186 @@
+"""Device (vectorized JAX) CRUSH engine parity against the host engine.
+
+The host engine is itself pinned to reference golden vectors
+(test_crush_host.py), so host equality here implies reference
+bit-exactness for the device path too."""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from ceph_tpu.models.crushmap import (
+    CHOOSE_FIRSTN,
+    CHOOSE_INDEP,
+    CHOOSELEAF_FIRSTN,
+    CHOOSELEAF_INDEP,
+    EMIT,
+    STRAW2,
+    TAKE,
+    CrushMap,
+    Tunables,
+    WeightSet,
+)
+from ceph_tpu.ops.crush.device import DeviceMapper
+from ceph_tpu.ops.crush.host import Mapper
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _flat_map(n=12, seed=0):
+    rng = random.Random(seed)
+    m = CrushMap()
+    weights = [rng.choice([0x8000, 0x10000, 0x20000, 0x30000])
+               for _ in range(n)]
+    m.add_bucket(STRAW2, 1, list(range(n)), weights, id=-1)
+    m.add_rule([(TAKE, -1, 0), (CHOOSE_FIRSTN, 0, 0), (EMIT, 0, 0)], id=0)
+    m.add_rule([(TAKE, -1, 0), (CHOOSE_INDEP, 0, 0), (EMIT, 0, 0)], id=1)
+    return m
+
+
+def _two_level_map(hosts=6, per_host=4, seed=1):
+    rng = random.Random(seed)
+    m = CrushMap()
+    host_ids = []
+    dev = 0
+    for h in range(hosts):
+        items = list(range(dev, dev + per_host))
+        dev += per_host
+        w = [rng.choice([0x10000, 0x18000, 0x20000]) for _ in items]
+        b = m.add_bucket(STRAW2, 1, items, w, id=-(h + 2))
+        host_ids.append(b.id)
+    m.add_bucket(STRAW2, 2, host_ids,
+                 [m.buckets[h].weight for h in host_ids], id=-1)
+    m.add_rule([(TAKE, -1, 0), (CHOOSELEAF_FIRSTN, 0, 1), (EMIT, 0, 0)],
+               id=0)
+    m.add_rule([(TAKE, -1, 0), (CHOOSELEAF_INDEP, 0, 1), (EMIT, 0, 0)],
+               id=1)
+    m.add_rule([(TAKE, -1, 0), (CHOOSE_FIRSTN, 0, 1), (EMIT, 0, 0)], id=2)
+    return m
+
+
+def _compare(m, ruleno, result_max, xs, dev_weights):
+    host = Mapper(m)
+    dm = DeviceMapper(m)
+    got = dm.do_rule_batch(ruleno, xs, result_max, dev_weights)
+    for i, x in enumerate(xs):
+        expect = host.do_rule(ruleno, int(x), result_max, list(dev_weights))
+        row = [v for v in got[i].tolist()]
+        # host returns a compacted/padded list; pad to result_max
+        expect = expect + [0x7FFFFFFF] * (result_max - len(expect))
+        assert row == expect, (
+            "x=%d rule=%d: device %s != host %s" % (x, ruleno, row, expect))
+
+
+class TestFlatStraw2:
+    @pytest.mark.parametrize("ruleno", [0, 1])
+    def test_all_in(self, ruleno):
+        m = _flat_map()
+        xs = np.arange(96, dtype=np.int64)
+        _compare(m, ruleno, 3, xs, [0x10000] * 12)
+
+    @pytest.mark.parametrize("ruleno", [0, 1])
+    def test_reweight_and_out(self, ruleno):
+        m = _flat_map(seed=3)
+        w = [0x10000] * 12
+        w[2] = 0          # out
+        w[5] = 0x8000     # half reweight
+        w[7] = 0
+        xs = np.arange(160, dtype=np.int64)
+        _compare(m, ruleno, 4, xs, w)
+
+
+class TestTwoLevel:
+    @pytest.mark.parametrize("ruleno", [0, 1, 2])
+    def test_chooseleaf(self, ruleno):
+        m = _two_level_map()
+        xs = np.arange(96, dtype=np.int64)
+        _compare(m, ruleno, 3, xs, [0x10000] * 24)
+
+    @pytest.mark.parametrize("ruleno", [0, 1])
+    def test_chooseleaf_with_failures(self, ruleno):
+        m = _two_level_map(seed=7)
+        w = [0x10000] * 24
+        for d in (0, 1, 2, 3, 9, 17):   # one whole host + some others
+            w[d] = 0
+        w[12] = 0x4000
+        xs = np.arange(160, dtype=np.int64)
+        _compare(m, ruleno, 3, xs, w)
+
+    @pytest.mark.parametrize("stable,vary_r", [(0, 0), (0, 1), (1, 1),
+                                               (1, 2)])
+    def test_tunable_variants(self, stable, vary_r):
+        m = _two_level_map(seed=9)
+        m.tunables = Tunables(chooseleaf_stable=stable,
+                              chooseleaf_vary_r=vary_r)
+        w = [0x10000] * 24
+        w[4] = 0
+        xs = np.arange(96, dtype=np.int64)
+        _compare(m, 0, 3, xs, w)
+
+    def test_choose_args_weight_set(self):
+        m = _two_level_map(seed=11)
+        per_pos = []
+        rng = random.Random(5)
+        for pos in range(3):
+            per_pos.append(None)
+        cargs = {}
+        for bid, b in m.buckets.items():
+            wsets = [[rng.choice([0x8000, 0x10000, 0x20000])
+                      for _ in b.items] for _ in range(3)]
+            cargs[bid] = WeightSet(bucket_id=bid, weight_sets=wsets)
+        m.choose_args["opt"] = cargs
+        host = Mapper(m)
+        dm = DeviceMapper(m, choose_args_name="opt")
+        xs = np.arange(64, dtype=np.int64)
+        w = [0x10000] * 24
+        got = dm.do_rule_batch(0, xs, 3, w)
+        for i, x in enumerate(xs):
+            expect = host.do_rule(0, int(x), 3, w, choose_args=cargs)
+            expect = expect + [0x7FFFFFFF] * (3 - len(expect))
+            assert got[i].tolist() == expect, "x=%d" % x
+
+
+class TestGoldenMaps:
+    """Replay the reference-generated golden vectors on the device engine
+    for every straw2-only map in the corpus."""
+
+    def test_golden_straw2_maps(self):
+        with open(os.path.join(GOLDEN, "crush_mappings.json")) as f:
+            cases = json.load(f)
+        ran = 0
+        for name, case in cases.items():
+            m = CrushMap.from_dict(case["map"])
+            if any(b.alg != STRAW2 for b in m.buckets.values()):
+                continue
+            try:
+                dm = DeviceMapper(m, case.get("choose_args_name"))
+            except ValueError:
+                continue
+            # group queries by (rule, result_max) into batches
+            groups: dict[tuple, list[tuple[int, int]]] = {}
+            for qi, (ruleno, x, rmax) in enumerate(case["queries"]):
+                groups.setdefault((ruleno, rmax), []).append((qi, x))
+            for (ruleno, rmax), pairs in groups.items():
+                rule = m.rules[ruleno]
+                n_choose = sum(1 for s in rule.steps if s[0] in (
+                    CHOOSE_FIRSTN, CHOOSE_INDEP, CHOOSELEAF_FIRSTN,
+                    CHOOSELEAF_INDEP))
+                if n_choose != 1:
+                    continue
+                xs = np.asarray([x for _, x in pairs], dtype=np.int64)
+                try:
+                    got = dm.do_rule_batch(ruleno, xs, rmax,
+                                           case["reweights"])
+                except ValueError:
+                    continue
+                for row, (qi, x) in zip(got, pairs):
+                    exp = case["results"][qi]
+                    exp = exp + [0x7FFFFFFF] * (rmax - len(exp))
+                    assert row.tolist() == exp, (
+                        "%s rule %d x=%d: %s != %s"
+                        % (name, ruleno, x, row.tolist(), exp))
+                ran += 1
+        assert ran > 0, "no straw2 golden cases matched the device scope"
