@@ -52,6 +52,11 @@ class ErasureCodeJerasure(ErasureCode):
         self.k = self._to_int(profile, "k", self.DEFAULT_K)
         self.m = self._to_int(profile, "m", self.DEFAULT_M)
         self.w = self._to_int(profile, "w", self.DEFAULT_W)
+        # opt-in gate for techniques whose parity layout is NOT
+        # bit-identical to the reference (liber8tion search tables and
+        # the legacy blaum_roth w=7 construction are unavailable here)
+        self.allow_nonreference_layout = self._to_bool(
+            profile, "jerasure-allow-nonreference-layout", "false")
         self._parse_mapping(profile)
         if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
             raise ValueError("mapping %r maps %d chunks, expected %d" % (
@@ -184,13 +189,26 @@ class _BitmatrixTechnique(ErasureCodeJerasure):
         if self.supports_per_chunk_alignment:
             self.per_chunk_alignment = self._to_bool(
                 profile, "jerasure-per-chunk-alignment", "false")
+        if (self.per_chunk_alignment
+                and (self.w * self.packetsize) % LARGEST_VECTOR_WORDSIZE):
+            # chunk sizes would not be whole w*packetsize windows; reject
+            # at profile parse (the _packets guard stays as a backstop)
+            raise ValueError(
+                "%s: per-chunk alignment requires w*packetsize (%d) to be "
+                "a multiple of %d; chunks would contain a partial window"
+                % (self.technique, self.w * self.packetsize,
+                   LARGEST_VECTOR_WORDSIZE))
 
     def get_alignment(self) -> int:
         if self.per_chunk_alignment:
-            # chunks must stay a whole number of w*packetsize windows AND
-            # SIMD-aligned: round to the lcm of both
-            return math.lcm(self.w * self.packetsize,
-                            LARGEST_VECTOR_WORDSIZE)
+            # ErasureCodeJerasureCauchy::get_alignment: w*packetsize
+            # rounded UP to the SIMD width (not the lcm) — chunk sizes
+            # must match the reference byte-for-byte.  When the result
+            # is not a whole number of w*packetsize windows the encode
+            # path rejects the profile loudly (the reference would feed
+            # jerasure a partial window).
+            return _align_up(self.w * self.packetsize,
+                             LARGEST_VECTOR_WORDSIZE)
         alignment = self.k * self.w * self.packetsize * 4
         if (self.w * self.packetsize * 4) % LARGEST_VECTOR_WORDSIZE:
             alignment = self.k * self.w * self.packetsize * \
@@ -199,6 +217,13 @@ class _BitmatrixTechnique(ErasureCodeJerasure):
 
     def _packets(self, chunk: bytes) -> np.ndarray:
         """(n_windows, w, packetsize) uint8 view."""
+        window = self.w * self.packetsize
+        if len(chunk) % window:
+            raise ValueError(
+                "%s: chunk of %d bytes is not a whole number of "
+                "w*packetsize=%d windows (profile would feed the "
+                "reference a partial window)"
+                % (self.technique, len(chunk), window))
         a = np.frombuffer(chunk, dtype=np.uint8)
         return a.reshape(-1, self.w, self.packetsize)
 
@@ -345,6 +370,14 @@ class BlaumRoth(Liberation):
         # w=7 tolerated for backward compatibility with old default
         if self.w != 7 and (self.w <= 2 or not _is_prime(self.w + 1)):
             raise ValueError("blaum_roth: w+1=%d must be prime" % (self.w + 1))
+        if self.w == 7 and not self.allow_nonreference_layout:
+            raise ValueError(
+                "blaum_roth w=7: the legacy reference construction is not "
+                "implemented bit-identically; chunks written by a "
+                "reference cluster would decode WRONG.  Set "
+                "jerasure-allow-nonreference-layout=true to accept a "
+                "self-consistent (but non-interoperable) layout, or use "
+                "a w with w+1 prime.")
 
     def prepare(self) -> None:
         k, w = self.k, self.w
@@ -396,6 +429,14 @@ class Liber8tion(Liberation):
             raise ValueError("liber8tion: w must be 8")
         if self.k > self.w:
             raise ValueError("liber8tion: k=%d must be <= 8" % self.k)
+        if not self.allow_nonreference_layout:
+            raise ValueError(
+                "liber8tion: the reference's search-derived liber8tion.c "
+                "bitmatrices are not available; parity would not be "
+                "bit-identical and chunks written by a reference cluster "
+                "would decode WRONG.  Set "
+                "jerasure-allow-nonreference-layout=true to accept a "
+                "self-consistent (but non-interoperable) layout.")
 
     def prepare(self) -> None:
         mat = matrices.reed_sol_r6_coding_matrix(self.k, 8)

@@ -379,6 +379,10 @@ def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
                     cand, cok, _cperm = _descend(
                         fm, bid_in, xs, r_in, 0, outpos)
                     cok = cok & (item < 0)
+                    # leaf collision: the recursive call checks candidates
+                    # against leaves already placed in out2[0..outpos)
+                    # (mapper.c:535-541 with out=out2)
+                    cok = cok & ~jnp.any(leaves == cand[:, None], axis=1)
                     cok = cok & ~_is_out(dev_weights, cand, xs)
                     take = iact & cok
                     leaf = jnp.where(take, cand, leaf)

@@ -220,9 +220,12 @@ class OSDMap:
         pg = pool.raw_pg_to_pg(raw_pg)
         p = self.pg_upmap.get(pg)
         if p is not None:
-            if not any(o != ITEM_NONE and 0 <= o < self.max_osd
-                       and self.osd_weight[o] == 0 for o in p):
-                raw[:] = list(p)
+            # any out target rejects the whole explicit mapping — and,
+            # like OSDMap.cc:2666, skips items/primaries too
+            if any(o != ITEM_NONE and 0 <= o < self.max_osd
+                   and self.osd_weight[o] == 0 for o in p):
+                return
+            raw[:] = list(p)
         q = self.pg_upmap_items.get(pg)
         if q is not None:
             for osd_from, osd_to in q:

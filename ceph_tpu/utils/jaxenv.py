@@ -1,0 +1,32 @@
+"""Shared bootstrap for the virtual multi-device CPU platform.
+
+Multi-chip hardware is not available in CI: sharding correctness runs on
+a virtual N-device CPU platform instead.  Both the test suite
+(tests/conftest.py) and the driver dry-run (__graft_entry__.py) need the
+same fragile recipe, kept here so they cannot drift:
+
+  * JAX_PLATFORMS from the session (e.g. the real-TPU tunnel) must be
+    DROPPED, not overridden — setting it to "cpu" does not reliably win;
+    the platform is pinned via jax.config in-process instead.
+  * any pre-existing xla_force_host_platform_device_count pin must be
+    stripped (it may be smaller than the requested count) before adding
+    ours.
+  * JAX_ENABLE_X64 is required for bit-exact straw2 int64 math.
+"""
+
+from __future__ import annotations
+
+
+def force_virtual_cpu_env(env: dict, n_devices: int) -> dict:
+    """Mutate ``env`` (an os.environ-like mapping) so a JAX process
+    started with it sees an ``n_devices``-device CPU platform once it
+    also runs ``jax.config.update("jax_platforms", "cpu")``."""
+    env.pop("JAX_PLATFORMS", None)
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    env.setdefault("JAX_ENABLE_X64", "1")
+    return env

@@ -1,23 +1,16 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before JAX initialises.
-
-Multi-chip hardware is not available in CI; sharding correctness is tested
-on a virtual 8-device CPU platform (the driver separately dry-run-compiles
-the multi-chip path via __graft_entry__.dryrun_multichip).
-
-The session environment presets JAX_PLATFORMS=axon (the real-TPU tunnel);
-setting the env var to "cpu" does NOT override it reliably, so the var is
-dropped and the platform pinned through jax.config instead.
-"""
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX
+initialises (the driver separately dry-run-compiles the multi-chip path
+via __graft_entry__.dryrun_multichip, which shares this recipe through
+ceph_tpu.utils.jaxenv)."""
 
 import os
+import sys
 
-os.environ.pop("JAX_PLATFORMS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from ceph_tpu.utils.jaxenv import force_virtual_cpu_env  # noqa: E402
+
+force_virtual_cpu_env(os.environ, 8)
 
 import jax  # noqa: E402
 

@@ -143,6 +143,36 @@ class TestTwoLevel:
             assert got[i].tolist() == expect, "x=%d" % x
 
 
+class TestOverlappingHosts:
+    """A device reachable under more than one host bucket: the firstn
+    chooseleaf recursion must reject leaves already placed (mapper.c:
+    535-541 with out=out2), or the device path emits duplicate OSDs."""
+
+    def _overlap_map(self):
+        m = CrushMap()
+        # osd.0 is a member of both hosts
+        m.add_bucket(STRAW2, 1, [0, 1], [0x10000, 0x10000], id=-2)
+        m.add_bucket(STRAW2, 1, [0, 2], [0x10000, 0x10000], id=-3)
+        m.add_bucket(STRAW2, 2, [-2, -3], [0x20000, 0x20000], id=-1)
+        m.add_rule([(TAKE, -1, 0), (CHOOSELEAF_FIRSTN, 0, 1), (EMIT, 0, 0)],
+                   id=0)
+        return m
+
+    def test_no_duplicate_leaves(self):
+        m = self._overlap_map()
+        xs = np.arange(256, dtype=np.int64)
+        dm = DeviceMapper(m)
+        got = dm.do_rule_batch(0, xs, 2, [0x10000] * 3)
+        for row in got.tolist():
+            placed = [v for v in row if v != 0x7FFFFFFF]
+            assert len(placed) == len(set(placed)), row
+
+    def test_matches_host(self):
+        m = self._overlap_map()
+        xs = np.arange(256, dtype=np.int64)
+        _compare(m, 0, 2, xs, [0x10000] * 3)
+
+
 class TestGoldenMaps:
     """Replay the reference-generated golden vectors on the device engine
     for every straw2-only map in the corpus."""

@@ -35,7 +35,8 @@ JERASURE_PROFILES = [
     {"plugin": "jerasure", "technique": "blaum_roth", "k": "4", "m": "2",
      "w": "6", "packetsize": "8"},
     {"plugin": "jerasure", "technique": "liber8tion", "k": "2", "m": "2",
-     "w": "8", "packetsize": "8"},
+     "w": "8", "packetsize": "8",
+     "jerasure-allow-nonreference-layout": "true"},
 ]
 
 ISA_PROFILES = [
@@ -173,9 +174,22 @@ class TestMapping:
         encoded = codec.encode(set(range(6)), b"")
         assert all(c == b"" for c in encoded.values())
 
+    def test_blaum_roth_legacy_w7_requires_opt_in(self):
+        # the legacy w=7 layout is not bit-identical to the reference:
+        # init must fail loudly without the explicit opt-in flag
+        with pytest.raises(ValueError, match="non-interoperable"):
+            new_codec({"plugin": "jerasure", "technique": "blaum_roth",
+                       "k": "4", "m": "2", "w": "7", "packetsize": "8"})
+
+    def test_liber8tion_requires_opt_in(self):
+        with pytest.raises(ValueError, match="non-interoperable"):
+            new_codec({"plugin": "jerasure", "technique": "liber8tion",
+                       "k": "2", "m": "2", "w": "8", "packetsize": "8"})
+
     def test_blaum_roth_legacy_w7_decodable(self):
         codec = new_codec({"plugin": "jerasure", "technique": "blaum_roth",
-                           "k": "4", "m": "2", "w": "7", "packetsize": "8"})
+                           "k": "4", "m": "2", "w": "7", "packetsize": "8",
+                           "jerasure-allow-nonreference-layout": "true"})
         payload = _payload(2048)
         encoded = codec.encode(set(range(6)), payload)
         for lost in itertools.combinations(range(6), 2):
@@ -184,16 +198,27 @@ class TestMapping:
             assert all(decoded[i] == encoded[i] for i in lost)
 
     def test_cauchy_per_chunk_alignment(self):
+        # w=8, ps=8: w*ps=64 is already 16-aligned, so chunks stay whole
+        # windows and the alignment matches the reference's round-up
         codec = new_codec({"plugin": "jerasure", "technique": "cauchy_orig",
-                           "k": "3", "m": "2", "w": "7", "packetsize": "8",
+                           "k": "3", "m": "2", "w": "8", "packetsize": "8",
                            "jerasure-per-chunk-alignment": "true"})
         payload = _payload(300)
         cs = codec.get_chunk_size(len(payload))
-        assert cs % (7 * 8) == 0 and cs % 16 == 0
+        assert cs % (8 * 8) == 0 and cs % 16 == 0
         encoded = codec.encode(set(range(5)), payload)
         chunks = {i: c for i, c in encoded.items() if i not in (0, 1)}
         decoded = codec.decode({0, 1}, chunks)
         assert decoded[0] == encoded[0] and decoded[1] == encoded[1]
+
+    def test_cauchy_per_chunk_alignment_rejects_partial_windows(self):
+        # w=7, ps=8: reference alignment = round_up(56, 16) = 64, which
+        # is not a whole number of 56-byte windows — such a profile can
+        # never encode correctly, so parse rejects it up front
+        with pytest.raises(ValueError, match="partial window"):
+            new_codec({"plugin": "jerasure", "technique": "cauchy_orig",
+                       "k": "3", "m": "2", "w": "7", "packetsize": "8",
+                       "jerasure-per-chunk-alignment": "true"})
 
     def test_bad_mapping_length_rejected(self):
         with pytest.raises(ValueError):
