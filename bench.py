@@ -23,11 +23,97 @@ BASELINE.json carries no published figure.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
 
 BASELINE_GIBPS = 100.0  # ISA-L k=8,m=3 on 64-core host (documented proxy)
+
+# north-star #2 (BASELINE.json): full 10M-PG remap < 1 s on one chip
+CRUSH_N_PGS = 10_000_000
+CRUSH_N_OSDS = 1000
+CRUSH_TARGET_S = 1.0
+
+
+def bench_crush(n_pgs: int = CRUSH_N_PGS,
+                n_osds: int = CRUSH_N_OSDS) -> dict:
+    """Bulk CRUSH remap (crushtool --test analog, BASELINE config #5):
+    a 1000-OSD straw2 two-level map, every PG of a 10M-PG pool through
+    the full fused pg->up pipeline, then again after reweight churn
+    (10 OSDs out) counting moved PGs."""
+    from ceph_tpu.models.crushmap import (CHOOSELEAF_FIRSTN, EMIT, STRAW2,
+                                          TAKE, CrushMap)
+    from ceph_tpu.osd.osdmap import (OSD_EXISTS, OSD_UP, Incremental,
+                                     OSDMap, PGPool)
+    from ceph_tpu.parallel.mapping import pps_for_pool
+
+    per_host = 20
+    hosts = n_osds // per_host
+    crush = CrushMap()
+    host_ids = []
+    for h in range(hosts):
+        items = list(range(h * per_host, (h + 1) * per_host))
+        b = crush.add_bucket(STRAW2, 1, items, [0x10000] * per_host,
+                             id=-(h + 2))
+        host_ids.append(b.id)
+    crush.add_bucket(STRAW2, 2, host_ids,
+                     [crush.buckets[h].weight for h in host_ids], id=-1)
+    crush.add_rule([(TAKE, -1, 0), (CHOOSELEAF_FIRSTN, 0, 1),
+                    (EMIT, 0, 0)], id=0)
+    m = OSDMap()
+    inc = Incremental(epoch=1)
+    inc.new_max_osd = n_osds
+    inc.new_crush = crush
+    inc.new_pools[1] = PGPool(id=1, name="bench", pg_num=n_pgs, size=3,
+                              crush_rule=0)
+    m.apply_incremental(inc)
+    inc = m.new_incremental()
+    for o in range(n_osds):
+        inc.new_state[o] = OSD_EXISTS | OSD_UP
+        inc.new_weight[o] = 0x10000
+    m.apply_incremental(inc)
+
+    pool = m.pools[1]
+    dm = m.device_mapper()
+    state = np.asarray(m.osd_state, dtype=np.int32)
+    exists = (state & OSD_EXISTS) != 0
+    isup = (state & OSD_UP) != 0
+
+    def full_map():
+        pps = pps_for_pool(pool, np.arange(pool.pg_num))
+        return dm.map_pgs_batch(0, pps, pool.size, m.osd_weight,
+                                exists, isup, None, True)
+
+    # warm/compile on a small slice
+    dm.map_pgs_batch(0, np.arange(dm.CHUNK), pool.size, m.osd_weight,
+                     exists, isup, None, True)
+    t0 = time.perf_counter()
+    up0, _ = full_map()
+    t_map = time.perf_counter() - t0
+
+    # churn: 10 OSDs down+out -> remap, count moved PGs
+    inc = m.new_incremental()
+    churned = list(range(0, n_osds, max(1, n_osds // 10)))[:10]
+    for o in churned:
+        inc.new_state[o] = OSD_UP      # toggle down
+        inc.new_weight[o] = 0
+    m.apply_incremental(inc)
+    state = np.asarray(m.osd_state, dtype=np.int32)
+    exists = (state & OSD_EXISTS) != 0
+    isup = (state & OSD_UP) != 0
+    t0 = time.perf_counter()
+    up1, _ = full_map()
+    t_remap = time.perf_counter() - t0
+    moved = int(np.sum(np.any(up0 != up1, axis=1)))
+
+    return {
+        "crush_map_10m_s": round(t_map, 3),
+        "crush_remap_10m_s": round(t_remap, 3),
+        "crush_pgs_per_s": int(n_pgs / t_remap),
+        "crush_moved_pgs": moved,
+        "crush_vs_target": round(CRUSH_TARGET_S / t_remap, 2),
+    }
 
 
 def main() -> None:
@@ -86,6 +172,10 @@ def main() -> None:
         "unit": "GiB/s",
         "vs_baseline": round(gibps / BASELINE_GIBPS, 2),
     }
+    try:
+        result["extra"] = bench_crush()
+    except Exception as e:  # crush bench must never sink the headline
+        result["extra"] = {"crush_error": repr(e)[:200]}
     print(json.dumps(result))
 
 

@@ -119,25 +119,87 @@ def crush_ln_j(xin):
 U64_MAX = (1 << 64) - 1
 
 
-def _neg_ln_table() -> np.ndarray:
-    """neg[u] = 2^48 - crush_ln(u) for every 16-bit u (the full domain of
-    the straw2 hash draw)."""
-    from .host import crush_ln
+# ---------------------------------------------------------------------------
+# gather-free table lookups
+#
+# TPU gathers are scalar-rate (~60M elem/s measured through the tunnel)
+# while the mapping pipeline needs billions of small-table lookups per
+# full-cluster remap.  Every lookup therefore runs as a one-hot int8
+# matmul on the MXU: table values are split into 8-bit limbs offset by
+# -128 (so they fit signed int8), the index becomes a one-hot row, and
+# a single [N, K] @ [K, n_limbs] int8->int32 matmul fetches all limbs
+# at MXU rate.  Exactness: one row is hot, so each output element IS a
+# limb value (no summation error).
+# ---------------------------------------------------------------------------
 
-    return np.array([(1 << 48) - crush_ln(u) for u in range(1 << 16)],
-                    dtype=np.int64)
+
+def pack_limbs(table: np.ndarray, n_limbs: int,
+               offset: int = 0) -> np.ndarray:
+    """[K] int -> [K, n_limbs] int8 of 8-bit limbs of (v - offset),
+    biased by -128 into signed range."""
+    t = table.astype(object) - offset
+    out = np.zeros((len(t), n_limbs), dtype=np.int8)
+    for i, v in enumerate(t):
+        v = int(v)
+        assert 0 <= v < (1 << (8 * n_limbs)), (v, n_limbs)
+        for j in range(n_limbs):
+            out[i, j] = ((v >> (8 * j)) & 0xFF) - 128
+    return out
 
 
-_NEG_LN_NP: np.ndarray | None = None
+def unpack_limbs(l32, n_limbs: int, offset: int = 0,
+                 dtype=jnp.int64):
+    """[.., n_limbs] int32 (from the one-hot matmul) -> [..] dtype."""
+    acc = jnp.zeros(l32.shape[:-1], jnp.int64)
+    for j in range(n_limbs):
+        limb = (l32[..., j] + 128).astype(jnp.int64)
+        acc = acc + (limb << (8 * j))
+    return (acc + offset).astype(dtype)
 
 
-def _neg_ln() -> jnp.ndarray:
-    """Must be materialised OUTSIDE any jit trace (see FlatMap.__init__);
-    inside a trace it would leak a tracer through the module global."""
-    global _NEG_LN_NP
-    if _NEG_LN_NP is None:
-        _NEG_LN_NP = _neg_ln_table()
-    return jnp.asarray(_NEG_LN_NP)
+def onehot_fetch(idx, limb_table):
+    """idx [..] int32 in [0, K); limb_table [K, C] int8.
+    Returns [.., C] int32 via one MXU matmul."""
+    K = limb_table.shape[0]
+    shape = idx.shape
+    flat = idx.reshape(-1)
+    oh = (flat[:, None] == jnp.arange(K, dtype=jnp.int32)[None, :]
+          ).astype(jnp.int8)
+    out = jnp.matmul(oh, limb_table, preferred_element_type=jnp.int32)
+    return out.reshape(*shape, limb_table.shape[1])
+
+
+_RH_NP = np.array(RH_LH_TBL[0::2], dtype=np.uint64)   # 129 reciprocals
+_LH_NP = np.array(RH_LH_TBL[1::2], dtype=np.uint64)
+_LL_NP = np.array(LL_TBL, dtype=np.uint64)
+_LN_NLIMB = 7  # values < 2^56
+_RHLH_LIMBS_NP = np.concatenate(
+    [pack_limbs(_RH_NP, _LN_NLIMB), pack_limbs(_LH_NP, _LN_NLIMB)], axis=1)
+_LL_LIMBS_NP = pack_limbs(_LL_NP, _LN_NLIMB)
+
+
+def neg_ln_mxu(u, rhlh_limbs, ll_limbs):
+    """2^48 - crush_ln(u) for u int64 in [0, 0xFFFF], no gathers:
+    the iexpon/normalisation arithmetic stays on the VPU and the three
+    table fetches (RH, LH, LL — crush_ln's own structure, mapper.c:
+    226-268) ride the MXU as one-hot matmuls."""
+    x = u.astype(jnp.int64) + 1            # [1, 0x10000]
+    bl = jnp.ones_like(x)
+    for kbit in range(1, 17):
+        bl = bl + (x >= (1 << kbit)).astype(jnp.int64)
+    need = (x & 0x18000) == 0
+    bits = jnp.maximum(16 - bl, 0)
+    x2 = jnp.where(need, x << bits, x)
+    iexpon = jnp.where(need, 15 - bits, 15)
+    p = ((x2 >> 8) - 128).astype(jnp.int32)          # [0, 128]
+    rl = onehot_fetch(p, rhlh_limbs)
+    rh = unpack_limbs(rl[..., :_LN_NLIMB], _LN_NLIMB)
+    lh = unpack_limbs(rl[..., _LN_NLIMB:], _LN_NLIMB)
+    xl64 = (x2 * rh) >> 48
+    i2 = (xl64 & 0xFF).astype(jnp.int32)
+    ll = unpack_limbs(onehot_fetch(i2, ll_limbs), _LN_NLIMB)
+    lh2 = (lh + ll) >> 4
+    return (1 << 48) - ((iexpon << 44) + lh2)
 
 
 def magic_for_divisor(d: int) -> tuple[int, int]:
@@ -181,13 +243,13 @@ def _magic_divide(a, m_arr, k_arr):
     return jnp.where(k < 64, q_low, q_high).astype(jnp.int64)
 
 
-def _straw2_draw_q(x, ids, r, m_arr, k_arr):
+def _straw2_draw_q(x, ids, r, m_arr, k_arr, rhlh_limbs, ll_limbs):
     """Quotient of the exponential draw (mapper.c:312-345): the reference
     maximises trunc((ln-2^48)/w); we minimise q = (2^48-ln)//w, which is
     the same winner with the same first-index tie-break.  Zero-weight
     items (k==0) get q = S64_MAX."""
     u = (hash32_3_j(x, ids, r) & _u32(0xFFFF)).astype(jnp.int64)
-    neg = _neg_ln()[u]
+    neg = neg_ln_mxu(u, rhlh_limbs, ll_limbs)
     q = _magic_divide(neg, m_arr, k_arr)
     return jnp.where(k_arr > 0, q, jnp.int64((1 << 63) - 1))
 
@@ -263,15 +325,61 @@ class FlatMap:
                     M, k = magic_for_divisor(int(pos_w[p, bi, si]))
                     magic_m[p, bi, si] = M
                     magic_k[p, bi, si] = k
-        self.size = jnp.asarray(size)
-        self.btype = jnp.asarray(btype)
-        self.items = jnp.asarray(items)
-        self.ids = jnp.asarray(ids)
-        self.magic_m = jnp.asarray(magic_m)
-        self.magic_k = jnp.asarray(magic_k)
-        self.neg_ln = _neg_ln()              # materialise outside jit
         self.n_pos = n_pos
         self.rules = dict(m.rules)
+
+        # -- gather-free lookup tables (see module comment) --------------
+        # per-(pos,bucket) row: for each item slot s, 16 int8 limbs
+        # [ids(4) | items(4) | magic_m(7) | magic_k(1)], then size(2) +
+        # btype(2) at the tail.  Fetched with ONE one-hot matmul per
+        # bucket visit.  Tables are built per requested item capacity
+        # S' (row_limbs_for) so each descent level only pays for the
+        # largest bucket actually reachable there.
+        id_lo = min([0] + [int(v) for v in items.reshape(-1)]
+                    + [int(v) for v in ids.reshape(-1)])
+        self.id_offset = id_lo
+        self._ids_np = ids
+        self._items_np = items
+        self._mm_np = magic_m
+        self._mk_np = magic_k
+        self._size_np = size
+        self._btype_np = btype
+        self._row_cache: dict[int, jnp.ndarray] = {}
+        # per-bucket metadata fetch for arbitrary bucket ids (the child
+        # bucket chosen during descent): size(2) + btype(2)
+        meta = np.zeros((B, 4), np.int8)
+        meta[:, 0:2] = pack_limbs(size, 2)
+        meta[:, 2:4] = pack_limbs(btype, 2)
+        self.meta_limbs = jnp.asarray(meta)
+        self.rhlh_limbs = jnp.asarray(_RHLH_LIMBS_NP)
+        self.ll_limbs = jnp.asarray(_LL_LIMBS_NP)
+
+    def row_limbs_for(self, S: int) -> jnp.ndarray:
+        """[n_pos*B, 16*S+4] int8 rows truncated to S item slots (only
+        fetched for buckets whose size fits — callers pick S per level)."""
+        tbl = self._row_cache.get(S)
+        if tbl is not None:
+            return tbl
+        B, n_pos = self.B, self.n_pos
+        rows = np.zeros((n_pos * B, 16 * S + 4), np.int8)
+        for p in range(n_pos):
+            for bi in range(B):
+                row = np.zeros((S, 16), np.int8)
+                row[:, 0:4] = pack_limbs(self._ids_np[bi, :S], 4,
+                                         self.id_offset)
+                row[:, 4:8] = pack_limbs(self._items_np[bi, :S], 4,
+                                         self.id_offset)
+                row[:, 8:15] = pack_limbs(self._mm_np[p, bi, :S], 7)
+                row[:, 15:16] = pack_limbs(self._mk_np[p, bi, :S], 1)
+                r = rows[p * B + bi]
+                r[:16 * S] = row.reshape(-1)
+                r[16 * S:16 * S + 2] = pack_limbs(
+                    self._size_np[bi:bi + 1], 2)[0]
+                r[16 * S + 2:] = pack_limbs(
+                    self._btype_np[bi:bi + 1], 2)[0]
+        tbl = jnp.asarray(rows)
+        self._row_cache[S] = tbl
+        return tbl
 
 
 # ---------------------------------------------------------------------------
@@ -279,26 +387,55 @@ class FlatMap:
 # ---------------------------------------------------------------------------
 
 
-def _straw2_choose(fm: FlatMap, bid, x, r, pos):
-    """Winning item per lane. bid [L] bucket indices; pos [L] output
-    positions (selects the choose_args weight-set, CrushWrapper.h:1500)."""
-    idv = fm.ids[bid]                        # [L, S]
+def _fetch_row(fm: FlatMap, bid, pos, S: int):
+    """One one-hot matmul fetches a bucket's full choose row:
+    (ids [L,S], items [L,S], magic_m [L,S], magic_k [L,S], size [L])."""
     if fm.n_pos == 1:
-        m_arr = fm.magic_m[0][bid]
-        k_arr = fm.magic_k[0][bid]
+        idx = bid
     else:
-        p = jnp.minimum(pos, fm.n_pos - 1)
-        m_arr = fm.magic_m[p, bid]
-        k_arr = fm.magic_k[p, bid]
-    q = _straw2_draw_q(x[:, None], idv, r[:, None], m_arr, k_arr)
-    valid = jnp.arange(fm.S)[None, :] < fm.size[bid][:, None]
+        idx = jnp.minimum(pos, fm.n_pos - 1) * fm.B + bid
+    r = onehot_fetch(idx, fm.row_limbs_for(S))        # [L, 16S+4] int32
+    per = r[..., :16 * S].reshape(*bid.shape, S, 16)
+    ids = unpack_limbs(per[..., 0:4], 4, fm.id_offset, jnp.int32)
+    items = unpack_limbs(per[..., 4:8], 4, fm.id_offset, jnp.int32)
+    m_arr = unpack_limbs(per[..., 8:15], 7, 0, jnp.uint64)
+    k_arr = unpack_limbs(per[..., 15:16], 1, 0, jnp.int32)
+    size = unpack_limbs(r[..., 16 * S:16 * S + 2], 2, 0, jnp.int32)
+    return ids, items, m_arr, k_arr, size
+
+
+def _fetch_meta(fm: FlatMap, bid):
+    """(size [L], btype [L]) of arbitrary bucket indices."""
+    r = onehot_fetch(bid, fm.meta_limbs)
+    size = unpack_limbs(r[..., 0:2], 2, 0, jnp.int32)
+    btype = unpack_limbs(r[..., 2:4], 2, 0, jnp.int32)
+    return size, btype
+
+
+def _straw2_choose(fm: FlatMap, bid, x, r, pos, S: int):
+    """Winning item per lane. bid [L] bucket indices; pos [L] output
+    positions (selects the choose_args weight-set, CrushWrapper.h:1500).
+    S = item capacity for this level (>= size of every bucket that can
+    appear in bid).  Returns item [L]."""
+    idv, items, m_arr, k_arr, size = _fetch_row(fm, bid, pos, S)
+    q = _straw2_draw_q(x[:, None], idv, r[:, None], m_arr, k_arr,
+                       fm.rhlh_limbs, fm.ll_limbs)
+    valid = jnp.arange(S)[None, :] < size[:, None]
     q = jnp.where(valid, q, jnp.int64((1 << 63) - 1))
     win = jnp.argmin(q, axis=1)
-    return fm.items[bid, win].astype(jnp.int32)
+    # select column `win` without a gather
+    sel = jnp.arange(S)[None, :] == win[:, None]
+    item = jnp.sum(jnp.where(sel, items, 0), axis=1).astype(jnp.int32)
+    return item
 
 
-def _descend(fm: FlatMap, take_bid, x, r, want_type: int, pos):
+def _descend(fm: FlatMap, take_bid, x, r, want_type: int, pos,
+             depth_sizes: tuple):
     """Walk bucket->bucket until an item of want_type.
+
+    depth_sizes[d] = max bucket size reachable at depth d from the
+    start set (static per rule), so each level's draw only pays for
+    the buckets that can actually appear there.
 
     Returns (item, ok, perm_fail): ok = reached an item of the wanted
     type; perm_fail = hit a wrong-type device (host skips the replica
@@ -309,16 +446,18 @@ def _descend(fm: FlatMap, take_bid, x, r, want_type: int, pos):
     item = jnp.full((L,), ITEM_NONE, jnp.int32)
     ok = jnp.zeros((L,), bool)
     perm = jnp.zeros((L,), bool)
-    done = fm.size[cur] == 0                 # empty bucket: retryable
-    for _ in range(fm.max_depth):
-        chosen = _straw2_choose(fm, cur, x, r, pos)
+    cur_size, _ = _fetch_meta(fm, cur)
+    done = cur_size == 0                     # empty bucket: retryable
+    for S_d in depth_sizes:
+        chosen = _straw2_choose(fm, cur, x, r, pos, S_d)
         is_bucket = chosen < 0
         cbid = jnp.where(is_bucket, -1 - chosen, 0)
-        ctype = jnp.where(is_bucket, fm.btype[cbid], 0)
+        csize, cbtype = _fetch_meta(fm, cbid)
+        ctype = jnp.where(is_bucket, cbtype, 0)
         oob = (~is_bucket) & (chosen >= fm.max_devices)
         reach = (~done) & (ctype == want_type) & (~oob)
         wrongdev = (~done) & (~reach) & ((~is_bucket) | oob)
-        empty_next = (~done) & (~reach) & is_bucket & (fm.size[cbid] == 0)
+        empty_next = (~done) & (~reach) & is_bucket & (csize == 0)
         item = jnp.where(reach, chosen, item)
         ok = ok | reach
         perm = perm | wrongdev
@@ -345,7 +484,7 @@ def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
                        result_max: int, want_type: int,
                        recurse_to_leaf: bool, dev_weights,
                        tries: int, recurse_tries: int, vary_r: int,
-                       stable: int):
+                       stable: int, outer_ds: tuple, inner_ds: tuple):
     """crush_choose_firstn (mapper.c:438-626) for local-tries==0: per
     replica, retry whole descents while collided/rejected (masked
     lanes); chooseleaf recursion selects one leaf per chosen bucket."""
@@ -364,7 +503,7 @@ def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
             ftotal, active, out, leaves, outpos = state
             r = jnp.full((L,), 0, jnp.int32) + rep + ftotal
             item, ok, perm = _descend(fm, take_bid, xs, r, want_type,
-                                      outpos)
+                                      outpos, outer_ds)
             if recurse_to_leaf:
                 if vary_r:
                     sub_r = r >> (vary_r - 1)
@@ -377,7 +516,7 @@ def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
                     ift, iact, leaf, leaf_ok = istate
                     r_in = rep_i + sub_r + ift
                     cand, cok, _cperm = _descend(
-                        fm, bid_in, xs, r_in, 0, outpos)
+                        fm, bid_in, xs, r_in, 0, outpos, inner_ds)
                     cok = cok & (item < 0)
                     # leaf collision: the recursive call checks candidates
                     # against leaves already placed in out2[0..outpos)
@@ -427,7 +566,8 @@ def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
 def _choose_indep_vec(fm: FlatMap, take_bid, xs, numrep: int,
                       result_max: int, want_type: int,
                       recurse_to_leaf: bool, dev_weights,
-                      tries: int, recurse_tries: int):
+                      tries: int, recurse_tries: int,
+                      outer_ds: tuple, inner_ds: tuple):
     """crush_choose_indep (mapper.c:633-821): positionally-stable, slots
     left UNDEF retry with r advanced by numrep per round (numrep is the
     full replica count even when fewer slots fit result_max)."""
@@ -444,7 +584,8 @@ def _choose_indep_vec(fm: FlatMap, take_bid, xs, numrep: int,
             out, leaves = carry
             undecided = out[:, rep] == ITEM_UNDEF
             r = jnp.full((L,), 0, jnp.int32) + rep + numrep * ftotal
-            item, ok, perm = _descend(fm, take_bid, xs, r, want_type, pos0)
+            item, ok, perm = _descend(fm, take_bid, xs, r, want_type,
+                                      pos0, outer_ds)
             collide = jnp.any(out == item[:, None], axis=1) & ok
             if recurse_to_leaf:
                 bid_in = jnp.where(item < 0, -1 - item, 0)
@@ -454,7 +595,7 @@ def _choose_indep_vec(fm: FlatMap, take_bid, xs, numrep: int,
                     ift, iact, leaf, leaf_ok = istate
                     r_in = r + rep + numrep * ift
                     cand, cok, _cp = _descend(fm, bid_in, xs, r_in, 0,
-                                              pos_r)
+                                              pos_r, inner_ds)
                     cok = cok & (item < 0)
                     cok = cok & ~_is_out(dev_weights, cand, xs)
                     take = iact & cok
@@ -496,6 +637,62 @@ def _choose_indep_vec(fm: FlatMap, take_bid, xs, numrep: int,
     _, out, leaves = jax.lax.while_loop(cond, body, (z, out, leaves))
     res = leaves if recurse_to_leaf else out
     return jnp.where(res == ITEM_UNDEF, ITEM_NONE, res)
+
+
+# ---------------------------------------------------------------------------
+# post-CRUSH mapping pipeline (fused on device)
+# ---------------------------------------------------------------------------
+
+CEPH_OSD_MAX_PRIMARY_AFFINITY = 0x10000
+CEPH_OSD_DEFAULT_PRIMARY_AFFINITY = 0x10000
+
+
+def _post_process(raw, seeds, exists_b, isup_b, aff, can_shift: bool,
+                  use_aff: bool):
+    """Fused _remove_nonexistent_osds + _raw_to_up_osds + _pick_primary +
+    _apply_primary_affinity (OSDMap.cc:2626-2802) over the whole batch.
+
+    raw [L,S] int32 with ITEM_NONE holes; seeds [L] uint32 pps values;
+    exists_b/isup_b [D] bool; aff [D] int32 16.16 primary affinities.
+    Only valid for PGs with no upmap/pg_temp exception (the bulk mapper
+    recomputes exception rows on the host scalar path).
+    """
+    D = exists_b.shape[0]
+    valid = raw != ITEM_NONE
+    idx = jnp.clip(raw, 0, D - 1)
+    keep = valid & (raw < D) & exists_b[idx] & isup_b[idx]
+    up = jnp.where(keep, raw, ITEM_NONE)
+    if can_shift:
+        # stable compaction: surviving osds keep order, holes go last
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        up = jnp.take_along_axis(up, order, axis=1)
+    nonnone = up != ITEM_NONE
+    has = jnp.any(nonnone, axis=1)
+    first = jnp.argmax(nonnone, axis=1)
+    prim = jnp.where(
+        has, jnp.take_along_axis(up, first[:, None], 1)[:, 0], -1)
+    if use_aff:
+        a = aff[jnp.clip(up, 0, D - 1)]
+        row_applies = jnp.any(
+            nonnone & (a != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY), axis=1)
+        h = (hash32_2_j(seeds[:, None], up.astype(jnp.uint32))
+             >> _u32(16)).astype(jnp.int32)
+        rejected = (a < CEPH_OSD_MAX_PRIMARY_AFFINITY) & (h >= a)
+        accept = nonnone & ~rejected
+        has_acc = jnp.any(accept, axis=1)
+        pos = jnp.where(has_acc, jnp.argmax(accept, axis=1), first)
+        applies = row_applies & has
+        new_prim = jnp.take_along_axis(up, pos[:, None], 1)[:, 0]
+        prim = jnp.where(applies, new_prim, prim)
+        if can_shift:
+            # move the new primary to the front, shifting [0..pos) right
+            S = up.shape[1]
+            i = jnp.arange(S)[None, :]
+            rotated = jnp.where(
+                i == 0, new_prim[:, None],
+                jnp.where(i <= pos[:, None], jnp.roll(up, 1, axis=1), up))
+            up = jnp.where(applies[:, None], rotated, up)
+    return up, prim
 
 
 # ---------------------------------------------------------------------------
@@ -565,26 +762,106 @@ class DeviceMapper:
             recurse = leaf_tries if leaf_tries else 1
         fm = self.fm
         take_bid_val = -1 - take_id
+        outer_ds = self._depth_sizes([take_id])
+        if leaf:
+            starts = [b.id for b in self.map.buckets.values()
+                      if b.type == want_type]
+            inner_ds = self._depth_sizes(starts)
+        else:
+            inner_ds = ()
 
-        @jax.jit
-        def run(xs, dev_weights):
+        def core(xs, dev_weights):
             L = xs.shape[0]
             take_bid = jnp.full((L,), take_bid_val, jnp.int32)
             if firstn:
                 res, _ = _choose_firstn_vec(
                     fm, take_bid, xs, numrep, result_max, want_type,
-                    leaf, dev_weights, tries, recurse, vary_r, stable)
+                    leaf, dev_weights, tries, recurse, vary_r, stable,
+                    outer_ds, inner_ds)
             else:
                 res = _choose_indep_vec(
                     fm, take_bid, xs, numrep, result_max, want_type,
-                    leaf, dev_weights, tries, recurse)
+                    leaf, dev_weights, tries, recurse,
+                    outer_ds, inner_ds)
             return res
 
-        return run
+        return core
+
+    def _depth_sizes(self, start_bucket_ids: list[int]) -> tuple:
+        """depth_sizes[d] = max size of any bucket reachable at depth d
+        by walking bucket children from the start set (static per
+        rule/map)."""
+        m = self.map
+        sizes = []
+        level = {b for b in start_bucket_ids if b in m.buckets}
+        seen_levels = 0
+        while level and seen_levels < 64:    # cycle guard
+            sizes.append(max(
+                (m.buckets[b].size for b in level), default=1) or 1)
+            level = {c for b in level for c in m.buckets[b].items
+                     if c < 0 and c in m.buckets}
+            seen_levels += 1
+        return tuple(sizes) if sizes else (1,)
 
     @functools.lru_cache(maxsize=None)
     def _compiled(self, ruleno: int, result_max: int):
-        return self._compile(ruleno, result_max)
+        return jax.jit(self._compile(ruleno, result_max))
+
+    @functools.lru_cache(maxsize=None)
+    def _compiled_map(self, ruleno: int, result_max: int,
+                      can_shift: bool, use_aff: bool):
+        core = self._compile(ruleno, result_max)
+
+        @jax.jit
+        def run(xs, dev_weights, exists_b, isup_b, aff):
+            raw = core(xs, dev_weights)
+            return _post_process(raw, xs, exists_b, isup_b, aff,
+                                 can_shift, use_aff)
+
+        return run
+
+    # per-dispatch PG cap: intermediates are [L, S] int64 (several live
+    # temps inside the choose loops), so huge pools are chunked to bound
+    # device memory — 512k lanes * 64 items * 8B ~ 256 MiB per temp
+    CHUNK = 1 << 19
+
+    def map_pgs_batch(self, ruleno: int, pps, result_max: int,
+                      dev_weights, exists, isup, aff=None,
+                      can_shift: bool = True):
+        """Full do_rule -> up/up_primary pipeline for a batch of PGs
+        with no upmap/pg_temp exceptions.  pps [L] placement seeds;
+        exists/isup bool [max_osd]; aff int32 [max_osd] primary
+        affinities or None.  Returns (up [L,S] int32, up_primary [L]
+        int32) as numpy arrays."""
+        use_aff = aff is not None
+        fn = self._compiled_map(ruleno, result_max, bool(can_shift),
+                                use_aff)
+        pps = np.asarray(pps, dtype=np.int64) & 0xFFFFFFFF
+        w = jnp.asarray(np.asarray(dev_weights, dtype=np.int32))
+        ex = jnp.asarray(np.asarray(exists, dtype=bool))
+        iu = jnp.asarray(np.asarray(isup, dtype=bool))
+        if use_aff:
+            af = jnp.asarray(np.asarray(aff, dtype=np.int32))
+        else:
+            af = jnp.zeros((ex.shape[0],), jnp.int32)
+        L = pps.shape[0]
+        if L <= self.CHUNK:
+            up, prim = fn(jnp.asarray(pps, dtype=jnp.uint32),
+                          w, ex, iu, af)
+            # np.array (not asarray): device buffers are read-only views
+            # and callers patch exception rows in place
+            return np.array(up), np.array(prim)
+        # fixed-size chunks (tail padded) so one compilation serves all
+        ups, prims = [], []
+        for off in range(0, L, self.CHUNK):
+            part = pps[off:off + self.CHUNK]
+            n = part.shape[0]
+            if n < self.CHUNK:
+                part = np.pad(part, (0, self.CHUNK - n))
+            u, p = fn(jnp.asarray(part, dtype=jnp.uint32), w, ex, iu, af)
+            ups.append(np.array(u[:n]))
+            prims.append(np.array(p[:n]))
+        return np.concatenate(ups), np.concatenate(prims)
 
     def do_rule_batch(self, ruleno: int, xs, result_max: int,
                       dev_weights) -> np.ndarray:

@@ -148,6 +148,7 @@ class OSDMap:
         self.pg_upmap_primaries: dict[pg_t, int] = {}
         self.blocklist: dict[str, float] = {}
         self._mapper: Mapper | None = None
+        self._dmapper = None  # lazily-built DeviceMapper, same lifetime
 
     # -- device state ------------------------------------------------------
 
@@ -188,6 +189,15 @@ class OSDMap:
         if self._mapper is None:
             self._mapper = Mapper(self.crush)
         return self._mapper
+
+    def device_mapper(self):
+        """Shared vectorized mapper, flattened once per crush epoch
+        (raises ValueError when the map is outside device scope)."""
+        if self._dmapper is None:
+            from ..ops.crush.device import DeviceMapper
+
+            self._dmapper = DeviceMapper(self.crush)
+        return self._dmapper
 
     # -- object -> pg ------------------------------------------------------
 
@@ -403,6 +413,7 @@ class OSDMap:
         if inc.new_crush is not None:
             self.crush = inc.new_crush
             self._mapper = None
+            self._dmapper = None
 
     def new_incremental(self) -> "Incremental":
         return Incremental(epoch=self.epoch + 1)

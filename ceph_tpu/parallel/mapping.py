@@ -4,9 +4,11 @@ Replaces the reference's ParallelPGMapper thread pool
 (src/osd/OSDMapMapping.h:18-120, used by the mgr and by OSDMonitor to
 prime pg_temp at OSDMonitor.cc:728-735,1067): instead of sharding PG
 ranges over threads, all PGs of a pool become one vector batch through
-the jitted CRUSH kernel; the sparse exception tables (pg_temp, upmaps)
-and the up-filter/affinity steps are applied on the host, where they
-are cheap and data-dependent.
+one jitted program that fuses do_rule with the whole post-CRUSH
+pipeline (up-filter, compaction, primary pick, primary affinity —
+OSDMap.cc:2626-2802).  Results stay dense numpy arrays per pool; the
+sparse exception tables (pg_upmap*, pg_temp, primary_temp) are applied
+by recomputing only the excepted PGs through the host scalar pipeline.
 
 Falls back to the scalar pipeline per-PG when the crush map is outside
 the device scope (non-straw2 buckets, multi-choose rules).
@@ -18,71 +20,121 @@ import numpy as np
 
 from ..models.crushmap import ITEM_NONE
 from ..ops.crush.hashes import hash32_2_v
-from ..osd.osdmap import OSDMap, PGPool, pg_t, ceph_stable_mod
+from ..osd.osdmap import OSD_EXISTS, OSD_UP, OSDMap, PGPool, pg_t
+
+class PoolMapping:
+    """Dense up/acting arrays for one pool ([pg_num, size] int32 with
+    ITEM_NONE holes; compacted rows for replicated pools)."""
+
+    __slots__ = ("pool_id", "can_shift", "up", "up_primary", "acting",
+                 "acting_primary")
+
+    def __init__(self, pool: PGPool, up: np.ndarray,
+                 up_primary: np.ndarray):
+        self.pool_id = pool.id
+        self.can_shift = pool.can_shift_osds()
+        self.up = up
+        self.up_primary = up_primary
+        self.acting = up.copy()
+        self.acting_primary = up_primary.copy()
+
+    def _row(self, arr: np.ndarray, ps: int) -> list[int]:
+        row = arr[ps].tolist()
+        if self.can_shift:
+            return [v for v in row if v != ITEM_NONE]
+        return row
+
+    def get(self, ps: int) -> tuple[list[int], int, list[int], int]:
+        return (self._row(self.up, ps), int(self.up_primary[ps]),
+                self._row(self.acting, ps), int(self.acting_primary[ps]))
 
 
 class OSDMapMapping:
-    """Caches up/acting for every PG of every pool (OSDMapMapping.h:174)."""
+    """Caches up/acting for every PG of every pool (OSDMapMapping.h:174)
+    as dense arrays."""
 
-    def __init__(self, osdmap: OSDMap):
+    def __init__(self, osdmap: OSDMap, device_mapper=None):
         self.epoch = osdmap.epoch
-        self.up: dict[pg_t, list[int]] = {}
-        self.up_primary: dict[pg_t, int] = {}
-        self.acting: dict[pg_t, list[int]] = {}
-        self.acting_primary: dict[pg_t, int] = {}
-        self._build(osdmap)
+        self.pools: dict[int, PoolMapping] = {}
+        self._build(osdmap, device_mapper)
 
-    def _build(self, osdmap: OSDMap) -> None:
+    def _build(self, osdmap: OSDMap, device_mapper) -> None:
+        state = np.asarray(osdmap.osd_state, dtype=np.int32)
+        exists = (state & OSD_EXISTS) != 0
+        isup = (state & OSD_UP) != 0
+        aff = (np.asarray(osdmap.osd_primary_affinity, dtype=np.int32)
+               if osdmap.osd_primary_affinity is not None else None)
+        dm = device_mapper
         for pool in osdmap.pools.values():
             try:
-                self._build_pool_device(osdmap, pool)
+                if dm is None:
+                    dm = osdmap.device_mapper()
+                up, prim = self._map_pool_device(osdmap, pool, dm,
+                                                 exists, isup, aff)
             except ValueError:
-                self._build_pool_scalar(osdmap, pool)
+                up, prim = self._map_pool_scalar(osdmap, pool)
+            pm = PoolMapping(pool, up, prim)
+            self._apply_exceptions(osdmap, pool, pm)
+            self.pools[pool.id] = pm
 
     # -- vectorized pool mapping ------------------------------------------
 
-    def _build_pool_device(self, osdmap: OSDMap, pool: PGPool) -> None:
-        from ..ops.crush.device import DeviceMapper
-
-        dm = DeviceMapper(osdmap.crush)
-        pgs = [pg_t(pool.id, ps) for ps in range(pool.pg_num)]
+    def _map_pool_device(self, osdmap: OSDMap, pool: PGPool, dm,
+                         exists, isup, aff):
         pps = pps_for_pool(pool, np.arange(pool.pg_num))
-        raw = dm.do_rule_batch(pool.crush_rule, pps, pool.size,
-                               osdmap.osd_weight)
-        raw = np.asarray(raw)
-        for i, pg in enumerate(pgs):
-            row = [int(v) for v in raw[i]]
-            self._finish_pg(osdmap, pool, pg, int(pps[i]), row)
+        return dm.map_pgs_batch(
+            pool.crush_rule, pps, pool.size, osdmap.osd_weight,
+            exists, isup, aff, can_shift=pool.can_shift_osds())
 
     # -- scalar fallback ---------------------------------------------------
 
-    def _build_pool_scalar(self, osdmap: OSDMap, pool: PGPool) -> None:
+    def _map_pool_scalar(self, osdmap: OSDMap, pool: PGPool):
+        up = np.full((pool.pg_num, pool.size), ITEM_NONE, np.int32)
+        prim = np.full((pool.pg_num,), -1, np.int32)
         for ps in range(pool.pg_num):
             pg = pg_t(pool.id, ps)
             raw, pps = osdmap._pg_to_raw_osds(pool, pg)
-            self._finish_pg(osdmap, pool, pg, pps, raw)
+            row = osdmap._raw_to_up_osds(pool, raw)
+            p = osdmap._pick_primary(row)
+            p = osdmap._apply_primary_affinity(pps, pool, row, p)
+            up[ps, :len(row)] = row
+            prim[ps] = p
+        return up, prim
 
-    def _finish_pg(self, osdmap: OSDMap, pool: PGPool, pg: pg_t,
-                   pps: int, raw: list[int]) -> None:
-        osdmap._remove_nonexistent_osds(pool, raw)
-        osdmap._apply_upmap(pool, pg, raw)
-        up = osdmap._raw_to_up_osds(pool, raw)
-        up_primary = osdmap._pick_primary(up)
-        up_primary = osdmap._apply_primary_affinity(pps, pool, up,
-                                                    up_primary)
-        acting, acting_primary = osdmap._get_temp_osds(pool, pg)
-        if not acting:
-            acting = list(up)
-            if acting_primary == -1:
-                acting_primary = up_primary
-        self.up[pg] = up
-        self.up_primary[pg] = up_primary
-        self.acting[pg] = acting
-        self.acting_primary[pg] = acting_primary
+    # -- sparse exceptions -------------------------------------------------
+
+    def _apply_exceptions(self, osdmap: OSDMap, pool: PGPool,
+                          pm: PoolMapping) -> None:
+        """Recompute the (few) PGs carrying upmap/temp entries through
+        the exact scalar pipeline and overwrite their rows."""
+        excepted: set[int] = set()
+        for table in (osdmap.pg_upmap, osdmap.pg_upmap_items,
+                      osdmap.pg_upmap_primaries, osdmap.pg_temp,
+                      osdmap.primary_temp):
+            for pg in table:
+                if pg.pool == pool.id and pg.ps < pool.pg_num:
+                    excepted.add(pg.ps)
+        for ps in excepted:
+            pg = pg_t(pool.id, ps)
+            up, upp, acting, actingp = osdmap.pg_to_up_acting_osds(pg)
+            self._write_row(pm.up, ps, up)
+            pm.up_primary[ps] = upp
+            self._write_row(pm.acting, ps, acting)
+            pm.acting_primary[ps] = actingp
+
+    @staticmethod
+    def _write_row(arr: np.ndarray, ps: int, vals: list[int]) -> None:
+        n = min(len(vals), arr.shape[1])
+        arr[ps, :n] = vals[:n]
+        arr[ps, n:] = ITEM_NONE
+
+    # -- lookup ------------------------------------------------------------
 
     def get(self, pg: pg_t) -> tuple[list[int], int, list[int], int]:
-        return (self.up.get(pg, []), self.up_primary.get(pg, -1),
-                self.acting.get(pg, []), self.acting_primary.get(pg, -1))
+        pm = self.pools.get(pg.pool)
+        if pm is None or pg.ps >= pm.up.shape[0]:
+            return [], -1, [], -1
+        return pm.get(pg.ps)
 
 
 def pps_for_pool(pool: PGPool, ps: np.ndarray) -> np.ndarray:

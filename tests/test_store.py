@@ -1,0 +1,301 @@
+"""ObjectStore tier tests (MemStore + KStore), modeled on the
+reference's store_test.cc basics: transaction semantics, object facets,
+collection listing order, splits, and KStore durability across
+mount cycles."""
+
+import pytest
+
+from ceph_tpu.store.kstore import KStore
+from ceph_tpu.store.kv import MemKV, SQLiteKV
+from ceph_tpu.store.memstore import MemStore
+from ceph_tpu.store.objectstore import (
+    AlreadyExists,
+    NotFound,
+    Transaction,
+    coll_t,
+    hobject_t,
+)
+
+CID = coll_t.pg(1, 0)
+
+
+def make_memstore(tmp_path):
+    s = MemStore()
+    s.mkfs()
+    s.mount()
+    return s
+
+
+def make_kstore(tmp_path):
+    s = KStore(str(tmp_path / "kstore.db"))
+    s.mkfs()
+    s.mount()
+    return s
+
+
+@pytest.fixture(params=["memstore", "kstore"])
+def store(request, tmp_path):
+    s = (make_memstore if request.param == "memstore"
+         else make_kstore)(tmp_path)
+    yield s
+    s.umount()
+
+
+def _mkcoll(store, cid=CID):
+    t = Transaction()
+    t.create_collection(cid)
+    store.apply_transaction(t)
+
+
+class TestBasics:
+    def test_write_read(self, store):
+        _mkcoll(store)
+        oid = hobject_t("foo", pool=1)
+        t = Transaction()
+        t.write(CID, oid, 0, 5, b"hello")
+        t.write(CID, oid, 5, 6, b" world")
+        store.apply_transaction(t)
+        assert store.read(CID, oid) == b"hello world"
+        assert store.read(CID, oid, 6, 5) == b"world"
+        assert store.stat(CID, oid) == 11
+
+    def test_sparse_write_zero_fills(self, store):
+        _mkcoll(store)
+        oid = hobject_t("sparse", pool=1)
+        t = Transaction()
+        t.write(CID, oid, 4, 2, b"xy")
+        store.apply_transaction(t)
+        assert store.read(CID, oid) == b"\x00\x00\x00\x00xy"
+
+    def test_zero_truncate(self, store):
+        _mkcoll(store)
+        oid = hobject_t("z", pool=1)
+        t = Transaction()
+        t.write(CID, oid, 0, 8, b"abcdefgh")
+        t.zero(CID, oid, 2, 3)
+        t.truncate(CID, oid, 6)
+        store.apply_transaction(t)
+        assert store.read(CID, oid) == b"ab\x00\x00\x00f"
+
+    def test_remove(self, store):
+        _mkcoll(store)
+        oid = hobject_t("gone", pool=1)
+        t = Transaction()
+        t.touch(CID, oid)
+        store.apply_transaction(t)
+        assert store.exists(CID, oid)
+        t = Transaction()
+        t.remove(CID, oid)
+        store.apply_transaction(t)
+        assert not store.exists(CID, oid)
+        with pytest.raises(NotFound):
+            store.read(CID, oid)
+
+    def test_create_exclusive(self, store):
+        _mkcoll(store)
+        oid = hobject_t("x", pool=1)
+        t = Transaction()
+        t.create(CID, oid)
+        store.apply_transaction(t)
+        t = Transaction()
+        t.create(CID, oid)
+        with pytest.raises(AlreadyExists):
+            store.apply_transaction(t)
+
+    def test_xattrs(self, store):
+        _mkcoll(store)
+        oid = hobject_t("attr", pool=1)
+        t = Transaction()
+        t.touch(CID, oid)
+        t.setattr(CID, oid, "_", b"oi")
+        t.setattrs(CID, oid, {"snapset": b"ss", "v": b"1"})
+        store.apply_transaction(t)
+        assert store.getattr(CID, oid, "_") == b"oi"
+        assert store.getattrs(CID, oid) == {
+            "_": b"oi", "snapset": b"ss", "v": b"1"}
+        t = Transaction()
+        t.rmattr(CID, oid, "v")
+        store.apply_transaction(t)
+        assert "v" not in store.getattrs(CID, oid)
+
+    def test_omap(self, store):
+        _mkcoll(store)
+        oid = hobject_t("om", pool=1)
+        t = Transaction()
+        t.touch(CID, oid)
+        t.omap_setheader(CID, oid, b"hdr")
+        t.omap_setkeys(CID, oid, {"b": b"2", "a": b"1", "c": b"3"})
+        store.apply_transaction(t)
+        assert store.omap_get_header(CID, oid) == b"hdr"
+        assert list(store.omap_get(CID, oid)) == ["a", "b", "c"]
+        assert store.omap_get_values(CID, oid, ["a", "zz"]) == {"a": b"1"}
+        t = Transaction()
+        t.omap_rmkeys(CID, oid, ["a"])
+        store.apply_transaction(t)
+        assert "a" not in store.omap_get(CID, oid)
+        t = Transaction()
+        t.omap_rmkeyrange(CID, oid, "b", "c")
+        store.apply_transaction(t)
+        assert list(store.omap_get(CID, oid)) == ["c"]
+
+    def test_clone(self, store):
+        _mkcoll(store)
+        a = hobject_t("src", pool=1)
+        b = hobject_t("dst", pool=1)
+        t = Transaction()
+        t.write(CID, a, 0, 4, b"data")
+        t.setattr(CID, a, "_", b"x")
+        t.omap_setkeys(CID, a, {"k": b"v"})
+        t.clone(CID, a, b)
+        t.write(CID, a, 0, 4, b"DATA")
+        store.apply_transaction(t)
+        assert store.read(CID, b) == b"data"
+        assert store.read(CID, a) == b"DATA"
+        assert store.getattr(CID, b, "_") == b"x"
+        assert store.omap_get(CID, b) == {"k": b"v"}
+
+    def test_collection_list_order_and_range(self, store):
+        _mkcoll(store)
+        oids = [hobject_t("obj%d" % i, pool=1) for i in range(20)]
+        t = Transaction()
+        for o in oids:
+            t.touch(CID, o)
+        store.apply_transaction(t)
+        listed = store.collection_list(CID)
+        assert len(listed) == 20
+        keys = [o.sort_key() for o in listed]
+        assert keys == sorted(keys)
+        # pagination
+        first = store.collection_list(CID, max_count=7)
+        rest = store.collection_list(CID, start=listed[7])
+        assert first == listed[:7]
+        assert rest == listed[7:]
+
+    def test_split_collection(self, store):
+        _mkcoll(store)
+        dest = coll_t.pg(1, 2)
+        t = Transaction()
+        t.create_collection(dest, bits=2)
+        store.apply_transaction(t)
+        oids = [hobject_t("o%d" % i, pool=1) for i in range(32)]
+        t = Transaction()
+        for o in oids:
+            t.touch(CID, o)
+        store.apply_transaction(t)
+        t = Transaction()
+        t.split_collection(CID, 2, 2, dest)
+        store.apply_transaction(t)
+        left = store.collection_list(CID)
+        right = store.collection_list(dest)
+        assert len(left) + len(right) == 32
+        assert all(o.hash & 3 == 2 for o in right)
+        assert all(o.hash & 3 != 2 for o in left)
+        assert store.collection_bits(dest) == 2
+
+    def test_move_rename(self, store):
+        _mkcoll(store)
+        c2 = coll_t.pg(1, 1)
+        t = Transaction()
+        t.create_collection(c2)
+        a = hobject_t("mv", pool=1)
+        b = hobject_t("mv2", pool=1)
+        t.write(CID, a, 0, 3, b"abc")
+        t.collection_move_rename(CID, a, c2, b)
+        store.apply_transaction(t)
+        assert not store.exists(CID, a)
+        assert store.read(c2, b) == b"abc"
+
+
+class TestKStoreDurability:
+    def test_survives_remount(self, tmp_path):
+        path = str(tmp_path / "k.db")
+        s = KStore(path)
+        s.mkfs()
+        s.mount()
+        _mkcoll(s)
+        oid = hobject_t("persist", pool=1)
+        t = Transaction()
+        t.write(CID, oid, 0, 4, b"keep")
+        t.setattr(CID, oid, "_", b"meta")
+        t.omap_setkeys(CID, oid, {"log.1": b"e1"})
+        t.omap_setheader(CID, oid, b"H")
+        s.apply_transaction(t)
+        s.umount()
+
+        s2 = KStore(path)
+        s2.mount()
+        assert s2.read(CID, oid) == b"keep"
+        assert s2.getattr(CID, oid, "_") == b"meta"
+        assert s2.omap_get(CID, oid) == {"log.1": b"e1"}
+        assert s2.omap_get_header(CID, oid) == b"H"
+        assert s2.collection_list(CID) == [oid]
+        s2.umount()
+
+    def test_remove_durable(self, tmp_path):
+        path = str(tmp_path / "k2.db")
+        s = KStore(path)
+        s.mkfs()
+        s.mount()
+        _mkcoll(s)
+        a = hobject_t("a", pool=1)
+        b = hobject_t("b", pool=1)
+        t = Transaction()
+        t.write(CID, a, 0, 1, b"1")
+        t.write(CID, b, 0, 1, b"2")
+        s.apply_transaction(t)
+        t = Transaction()
+        t.remove(CID, a)
+        s.apply_transaction(t)
+        s.umount()
+        s2 = KStore(path)
+        s2.mount()
+        assert not s2.exists(CID, a)
+        assert s2.read(CID, b) == b"2"
+        s2.umount()
+
+    def test_memkv_engine(self):
+        s = KStore("", db=MemKV())
+        s.mkfs()
+        s.mount()
+        _mkcoll(s)
+        oid = hobject_t("m", pool=1)
+        t = Transaction()
+        t.write(CID, oid, 0, 2, b"ok")
+        s.apply_transaction(t)
+        assert s.read(CID, oid) == b"ok"
+
+    def test_split_durable(self, tmp_path):
+        path = str(tmp_path / "k3.db")
+        s = KStore(path)
+        s.mkfs()
+        s.mount()
+        _mkcoll(s)
+        dest = coll_t.pg(1, 1)
+        t = Transaction()
+        t.create_collection(dest, bits=1)
+        for i in range(16):
+            t.touch(CID, hobject_t("s%d" % i, pool=1))
+        t.split_collection(CID, 1, 1, dest)
+        s.apply_transaction(t)
+        n_left = len(s.collection_list(CID))
+        n_right = len(s.collection_list(dest))
+        s.umount()
+        s2 = KStore(path)
+        s2.mount()
+        assert len(s2.collection_list(CID)) == n_left
+        assert len(s2.collection_list(dest)) == n_right
+        assert all(o.hash & 1 == 1 for o in s2.collection_list(dest))
+        s2.umount()
+
+
+class TestCallbacks:
+    def test_on_commit_fires(self, tmp_path):
+        s = make_kstore(tmp_path)
+        _mkcoll(s)
+        fired = []
+        t = Transaction()
+        t.touch(CID, hobject_t("cb", pool=1))
+        s.queue_transactions([t], on_applied=lambda: fired.append("a"),
+                             on_commit=lambda: fired.append("c"))
+        assert fired == ["a", "c"]
+        s.umount()
