@@ -354,7 +354,7 @@ class FlatMap:
         self.rhlh_limbs = jnp.asarray(_RHLH_LIMBS_NP)
         self.ll_limbs = jnp.asarray(_LL_LIMBS_NP)
 
-    def row_limbs_for(self, S: int) -> jnp.ndarray:
+    def row_limbs_for(self, S: int) -> np.ndarray:
         """[n_pos*B, 16*S+4] int8 rows truncated to S item slots (only
         fetched for buckets whose size fits — callers pick S per level)."""
         tbl = self._row_cache.get(S)
@@ -377,9 +377,11 @@ class FlatMap:
                     self._size_np[bi:bi + 1], 2)[0]
                 r[16 * S + 2:] = pack_limbs(
                     self._btype_np[bi:bi + 1], 2)[0]
-        tbl = jnp.asarray(rows)
-        self._row_cache[S] = tbl
-        return tbl
+        # Cache as host numpy: this is lazily reached inside jit traces,
+        # where jnp.asarray would bind the constant to the live trace and
+        # the cached tracer would leak into later traces.
+        self._row_cache[S] = rows
+        return rows
 
 
 # ---------------------------------------------------------------------------
