@@ -1,0 +1,76 @@
+"""Message base class + wire codec.
+
+The framework's analog of the reference's Message hierarchy
+(src/msg/Message.h): every message is a typed record with a small,
+declarative field list, encoded with the deterministic denc TLV format
+into the payload segment of a v2-style frame (src/msg/async/frames_v2.h
+puts header/payload segments inside a CRC-checked envelope; here the
+envelope lives in ceph_tpu.msg.messenger).
+
+A registry keyed by the wire TYPE string replaces the reference's
+numeric message-type switch in decode_message (src/msg/Message.cc:256).
+"""
+
+from __future__ import annotations
+
+from ..utils import denc
+
+_REGISTRY: dict[str, type["Message"]] = {}
+
+
+def register(cls: type["Message"]) -> type["Message"]:
+    """Class decorator: adds the message type to the wire registry."""
+    if not cls.TYPE:
+        raise ValueError("message class %s has no TYPE" % cls.__name__)
+    if cls.TYPE in _REGISTRY:
+        raise ValueError("duplicate message TYPE %r" % cls.TYPE)
+    _REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+class Message:
+    """Base message: subclasses declare TYPE and FIELDS.
+
+    Fields must be denc-encodable values; messages carrying richer
+    structures (pg_t, OSDMap) convert in to_wire/from_wire overrides.
+    """
+
+    TYPE = ""
+    FIELDS: tuple[str, ...] = ()
+
+    def __init__(self, **kw):
+        for f in self.FIELDS:
+            setattr(self, f, kw.pop(f, None))
+        if kw:
+            raise TypeError("%s: unknown fields %r"
+                            % (type(self).__name__, sorted(kw)))
+        # stamped by the messenger on send/receive
+        self.seq = 0
+        self.src = ""
+
+    def to_wire(self) -> dict:
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Message":
+        return cls(**d)
+
+    def __repr__(self) -> str:
+        kv = ", ".join("%s=%r" % (f, getattr(self, f))
+                       for f in self.FIELDS)
+        return "%s(%s)" % (type(self).__name__, kv)
+
+
+def encode_message(msg: Message) -> bytes:
+    return denc.encode([msg.TYPE, msg.seq, msg.src, msg.to_wire()])
+
+
+def decode_message(data: bytes | memoryview) -> Message:
+    mtype, seq, src, fields = denc.decode(data)
+    cls = _REGISTRY.get(mtype)
+    if cls is None:
+        raise ValueError("unknown message type %r" % mtype)
+    msg = cls.from_wire(fields)
+    msg.seq = seq
+    msg.src = src
+    return msg

@@ -1,0 +1,210 @@
+"""Concrete wire messages for the mon/osd/client protocol.
+
+Analog of src/messages/* (MOSDOp.h, MOSDRepOp.h, MOSDMap.h,
+MOSDBoot.h, MOSDFailure.h, MOSDPing.h, MMonCommand.h ...): the subset
+the framework's daemons speak, with payloads as plain denc values.
+
+Object-op lists inside MOSDOp/MOSDOpReply use dicts
+{"op": name, ...} instead of the reference's numeric opcode union
+(src/osd/osd_types.h OSDOp) — the OSD's do_osd_ops interpreter switches
+on the name.
+"""
+
+from __future__ import annotations
+
+from .message import Message, register
+
+
+@register
+class MPing(Message):
+    TYPE = "ping"
+    FIELDS = ("stamp",)
+
+
+@register
+class MPong(Message):
+    TYPE = "pong"
+    FIELDS = ("stamp",)
+
+
+# -- monitor <-> anyone ----------------------------------------------------
+
+
+@register
+class MMonGetMap(Message):
+    """Request the cluster map: full if have < 0 else incrementals
+    after `have` (MMonGetOSDMap.h)."""
+
+    TYPE = "mon_get_map"
+    FIELDS = ("have",)
+
+
+@register
+class MMonSubscribe(Message):
+    """Subscribe to map publications from epoch `start` (MMonSubscribe.h)."""
+
+    TYPE = "mon_subscribe"
+    FIELDS = ("start",)
+
+
+@register
+class MOSDMapMsg(Message):
+    """Map publication (MOSDMap.h): optional full map bytes plus a list
+    of incremental bytes, each OSDMap/Incremental.encode() output."""
+
+    TYPE = "osd_map"
+    FIELDS = ("fsid", "full", "incrementals")
+
+
+@register
+class MOSDBoot(Message):
+    """OSD -> mon: I'm up at this addr (MOSDBoot.h)."""
+
+    TYPE = "osd_boot"
+    FIELDS = ("osd", "addr", "epoch")
+
+
+@register
+class MOSDFailure(Message):
+    """OSD -> mon failure report (MOSDFailure.h): target osd,
+    seconds it has been unresponsive, reporter's map epoch."""
+
+    TYPE = "osd_failure"
+    FIELDS = ("target", "failed_for", "epoch")
+
+
+@register
+class MOSDAlive(Message):
+    """OSD -> mon: cancel my pending failure reports (MOSDAlive.h)."""
+
+    TYPE = "osd_alive"
+    FIELDS = ("osd", "epoch")
+
+
+@register
+class MMonCommand(Message):
+    """Generic admin command (MMonCommand.h): {"prefix": ..., args}."""
+
+    TYPE = "mon_command"
+    FIELDS = ("tid", "cmd")
+
+
+@register
+class MMonCommandAck(Message):
+    TYPE = "mon_command_ack"
+    FIELDS = ("tid", "result", "out")
+
+
+# -- client <-> osd --------------------------------------------------------
+
+
+@register
+class MOSDOp(Message):
+    """Client object op (MOSDOp.h): tid for reply matching; pgid the
+    client computed; ops = [{"op": "write", "offset": o, "data": b}...];
+    epoch = client's map epoch for gating."""
+
+    TYPE = "osd_op"
+    FIELDS = ("tid", "pool", "ps", "oid", "snapc", "ops", "epoch",
+              "flags")
+
+
+@register
+class MOSDOpReply(Message):
+    TYPE = "osd_op_reply"
+    FIELDS = ("tid", "result", "outs", "epoch", "version")
+
+
+# -- osd <-> osd (replication / peering / recovery) ------------------------
+
+
+@register
+class MOSDRepOp(Message):
+    """Primary -> replica transaction (MOSDRepOp.h): serialized
+    Transaction + the pg log entry it carries."""
+
+    TYPE = "osd_repop"
+    FIELDS = ("pool", "ps", "tid", "txn", "log_entry", "epoch",
+              "min_epoch", "pg_trim_to")
+
+
+@register
+class MOSDRepOpReply(Message):
+    TYPE = "osd_repop_reply"
+    FIELDS = ("pool", "ps", "tid", "result", "epoch")
+
+
+@register
+class MOSDPing(Message):
+    """Heartbeat (MOSDPing.h): op is "ping" or "reply"."""
+
+    TYPE = "osd_ping"
+    FIELDS = ("osd", "op", "stamp", "epoch")
+
+
+@register
+class MOSDPGQuery(Message):
+    """Primary -> replica: send me your info+log for pgid
+    (MOSDPGQuery.h)."""
+
+    TYPE = "pg_query"
+    FIELDS = ("pool", "ps", "epoch")
+
+
+@register
+class MOSDPGLog(Message):
+    """Replica -> primary: my pg info + full log (MOSDPGLog.h);
+    info = {last_update, last_complete, log: [entries]}."""
+
+    TYPE = "pg_log"
+    FIELDS = ("pool", "ps", "epoch", "info")
+
+
+@register
+class MOSDPGPush(Message):
+    """Recovery push (MOSDPGPush.h): full-object pushes
+    [{oid fields, data, attrs, omap, version}...]."""
+
+    TYPE = "pg_push"
+    FIELDS = ("pool", "ps", "epoch", "pushes")
+
+
+@register
+class MOSDPGPushReply(Message):
+    TYPE = "pg_push_reply"
+    FIELDS = ("pool", "ps", "epoch", "oids")
+
+
+# -- osd <-> osd (EC sub-ops) ----------------------------------------------
+
+
+@register
+class MOSDECSubOpWrite(Message):
+    """Primary -> shard k write (MOSDECSubOpWrite.h): the shard's
+    serialized transaction for one EC op."""
+
+    TYPE = "ec_sub_write"
+    FIELDS = ("pool", "ps", "shard", "tid", "txn", "log_entry",
+              "epoch")
+
+
+@register
+class MOSDECSubOpWriteReply(Message):
+    TYPE = "ec_sub_write_reply"
+    FIELDS = ("pool", "ps", "shard", "tid", "result", "epoch")
+
+
+@register
+class MOSDECSubOpRead(Message):
+    """Primary -> shard read (MOSDECSubOpRead.h): extents to read from
+    the shard object: [[oid_key, off, len]...]."""
+
+    TYPE = "ec_sub_read"
+    FIELDS = ("pool", "ps", "shard", "tid", "reads", "epoch")
+
+
+@register
+class MOSDECSubOpReadReply(Message):
+    TYPE = "ec_sub_read_reply"
+    FIELDS = ("pool", "ps", "shard", "tid", "buffers", "errors",
+              "epoch")

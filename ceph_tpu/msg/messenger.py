@@ -1,0 +1,466 @@
+"""Asyncio messenger: v2-style framed transport with policies.
+
+The framework's L3 — the analog of AsyncMessenger + ProtocolV2
+(src/msg/Messenger.cc:31, src/msg/async/ProtocolV2.cc,
+src/msg/Policy.h), re-expressed on asyncio instead of epoll threads:
+
+* one Messenger per daemon endpoint, bound to a TCP addr (DCN path;
+  ICI never carries the RADOS protocol — it lives inside device
+  kernels, see SURVEY §2.3);
+* Connections perform a banner + identification handshake, then
+  exchange CRC-checked frames (tag, length, crc32, payload);
+* Policy decides lossy vs lossless semantics: lossy connections die
+  with the socket (clients resend via Objecter epoch logic, as in the
+  reference); lossless peers keep a session — unacked messages are
+  replayed after reconnect and the receiver drops duplicates by seq
+  (ProtocolV2 session reconnect, ProtocolV2.cc:2143 reuse path); a
+  peer presenting a new nonce is a restarted daemon and gets a fresh
+  session (reset_session semantics);
+* Dispatchers receive ms_dispatch / ms_handle_reset callbacks.
+
+Structure: every Connection is owned by ONE supervisor task that loops
+{acquire transport -> run session (reader+writer subtasks) -> decide
+redial/die} — no fire-and-forget task chains, so faults can't orphan
+state.
+
+Fault injection: set ``inject_socket_failures`` to N>0 to abort roughly
+one in N frame writes (ms_inject_socket_failures,
+src/common/options/global.yaml.in:1242) — the thrasher's lever.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+import zlib
+
+from .message import Message, decode_message, encode_message
+
+BANNER = b"ceph-tpu v2\n"
+
+# frame tags
+TAG_MSG = 1
+TAG_ACK = 2
+TAG_CLOSE = 4
+
+_HDR = struct.Struct(">BII")  # tag, length, crc32
+
+
+class Policy:
+    """Connection semantics per peer type (src/msg/Policy.h)."""
+
+    __slots__ = ("lossy", "resend")
+
+    def __init__(self, lossy: bool, resend: bool):
+        self.lossy = lossy
+        self.resend = resend
+
+    @classmethod
+    def lossy_client(cls) -> "Policy":
+        return cls(lossy=True, resend=False)
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False, resend=True)
+
+
+class ConnectionError_(Exception):
+    pass
+
+
+class _PeerClosed(Exception):
+    """Peer sent TAG_CLOSE: orderly teardown, not a fault."""
+
+
+async def _write_frame(writer: asyncio.StreamWriter, tag: int,
+                       payload: bytes) -> None:
+    writer.write(_HDR.pack(tag, len(payload), zlib.crc32(payload)))
+    writer.write(payload)
+    await writer.drain()
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, bytes]:
+    hdr = await reader.readexactly(_HDR.size)
+    tag, length, crc = _HDR.unpack(hdr)
+    payload = await reader.readexactly(length)
+    if zlib.crc32(payload) != crc:
+        raise ConnectionError_("frame crc mismatch (tag %d)" % tag)
+    return tag, payload
+
+
+class Connection:
+    """One logical session with a peer entity.
+
+    Survives TCP reconnects when the policy is lossless: out_seq /
+    in_seq and the unacked replay queue persist across transports.
+    """
+
+    def __init__(self, msgr: "Messenger", peer_addr: str | None,
+                 policy: Policy):
+        self.msgr = msgr
+        self.peer_addr = peer_addr      # dial address (None on inbound)
+        self.peer_entity = ""           # learned in handshake
+        self.peer_nonce = -1            # detects peer restarts
+        self.policy = policy
+        self.out_seq = 0
+        self.in_seq = 0
+        self.unacked: list[tuple[int, bytes]] = []
+        self.out_q: asyncio.Queue = asyncio.Queue()
+        self._open = True
+        self._transports: asyncio.Queue = asyncio.Queue()  # inbound only
+        self._supervisor: asyncio.Task | None = None
+        self._writer: asyncio.StreamWriter | None = None
+
+    # -- public API --------------------------------------------------------
+
+    def send(self, msg: Message) -> None:
+        """Queue a message (fire and forget, like Messenger::
+        send_message). Dropped silently once the connection is down
+        (lossy semantics surface as resets, not send errors)."""
+        if not self._open:
+            return
+        self.out_seq += 1
+        msg.seq = self.out_seq
+        msg.src = self.msgr.entity
+        data = encode_message(msg)
+        if self.policy.resend:
+            self.unacked.append((msg.seq, data))
+        self.out_q.put_nowait((TAG_MSG, data))
+
+    def mark_down(self) -> None:
+        """Administrative teardown: no reset callback fires."""
+        if not self._open:
+            return
+        self._open = False
+        if self._writer is not None:
+            try:
+                # best-effort graceful close so the peer resets promptly
+                self._writer.write(
+                    _HDR.pack(TAG_CLOSE, 0, zlib.crc32(b"")))
+                self._writer.close()
+            except Exception:
+                pass
+        if self._supervisor is not None:
+            self._supervisor.cancel()
+        self.msgr._forget(self)
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+    # -- supervisor --------------------------------------------------------
+
+    def _start(self) -> None:
+        runner = (self._run_outbound if self.peer_addr is not None
+                  else self._run_inbound)
+        self._supervisor = self.msgr.spawn(runner())
+
+    async def _run_outbound(self) -> None:
+        backoff = 0.02
+        while self._open:
+            try:
+                host, port = self.peer_addr.rsplit(":", 1)
+                reader, writer = await asyncio.open_connection(
+                    host, int(port))
+                await self.msgr._handshake_out(self, reader, writer)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                if self.policy.lossy:
+                    await self._die()
+                    return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            backoff = 0.02
+            closed = await self._session(reader, writer)
+            if closed or self.policy.lossy:
+                await self._die()
+                return
+            await asyncio.sleep(0.01)
+
+    async def _run_inbound(self) -> None:
+        while self._open:
+            try:
+                reader, writer = await self._transports.get()
+            except asyncio.CancelledError:
+                return
+            closed = await self._session(reader, writer)
+            if closed or self.policy.lossy:
+                await self._die()
+                return
+
+    async def _session(self, reader, writer) -> bool:
+        """Run one transport until it faults. Returns True when the
+        peer closed gracefully (no replay should follow)."""
+        self._writer = writer
+        if self.policy.resend:
+            self._replay_unacked()
+        rt = asyncio.ensure_future(self._read_frames(reader))
+        wt = asyncio.ensure_future(self._write_frames(writer))
+        try:
+            done, pending = await asyncio.wait(
+                {rt, wt}, return_when=asyncio.FIRST_COMPLETED)
+        except asyncio.CancelledError:
+            rt.cancel()
+            wt.cancel()
+            await asyncio.gather(rt, wt, return_exceptions=True)
+            raise
+        for t in (rt, wt):
+            t.cancel()
+        results = await asyncio.gather(rt, wt, return_exceptions=True)
+        try:
+            writer.close()
+        except Exception:
+            pass
+        self._writer = None
+        return any(isinstance(r, _PeerClosed) for r in results)
+
+    async def _die(self) -> None:
+        if not self._open:
+            return
+        self._open = False
+        self.msgr._forget(self)
+        await self.msgr._reset(self)
+
+    # -- frame loops (subtasks of _session) ---------------------------------
+
+    async def _write_frames(self, writer) -> None:
+        while True:
+            tag, payload = await self.out_q.get()
+            try:
+                if (self.msgr.inject_socket_failures and
+                        random.randrange(
+                            self.msgr.inject_socket_failures) == 0):
+                    raise ConnectionError_("injected socket failure")
+                await _write_frame(writer, tag, payload)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # resend policy: the popped payload is still in unacked
+                # and will be replayed on the next transport
+                return
+
+    async def _read_frames(self, reader) -> None:
+        while True:
+            try:
+                tag, payload = await _read_frame(reader)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                return  # transport fault -> session ends
+            if tag == TAG_MSG:
+                msg = decode_message(payload)  # poison frame = fault
+                dup = msg.seq <= self.in_seq
+                self.in_seq = max(self.in_seq, msg.seq)
+                if self.policy.resend:
+                    # ack duplicates too: the original ack may have
+                    # been lost with the previous transport
+                    self.out_q.put_nowait(
+                        (TAG_ACK, struct.pack(">Q", self.in_seq)))
+                if not dup:
+                    await self.msgr._dispatch(self, msg)
+            elif tag == TAG_ACK:
+                (seq,) = struct.unpack(">Q", payload)
+                self.unacked = [(s, d) for s, d in self.unacked
+                                if s > seq]
+            elif tag == TAG_CLOSE:
+                raise _PeerClosed()
+
+    def _replay_unacked(self) -> None:
+        """Requeue unacked payloads ahead of pending traffic so the new
+        transport replays them in seq order (receiver dedupes by seq)."""
+        pending = []
+        while not self.out_q.empty():
+            item = self.out_q.get_nowait()
+            if item[0] == TAG_MSG:
+                pending.append(item)
+        replay = {d: None for _, d in self.unacked}
+        for d in replay:
+            self.out_q.put_nowait((TAG_MSG, d))
+        for item in pending:
+            if item[1] not in replay:
+                self.out_q.put_nowait(item)
+
+
+class Messenger:
+    """Endpoint owning connections + the dispatch path."""
+
+    def __init__(self, entity: str, nonce: int = 0):
+        self.entity = entity
+        # the nonce identifies this messenger *instance*: a restarted
+        # daemon must present a different one so peers reset sessions
+        self.nonce = nonce if nonce else random.getrandbits(63)
+        self.addr: str | None = None
+        self.dispatchers: list = []
+        self.inject_socket_failures = 0
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: dict[str, Connection] = {}     # by dial addr
+        self._inbound: list[Connection] = []
+        # strong refs: the event loop only weakly references tasks, so
+        # fire-and-forget tasks would be GC'd mid-await
+        self._tasks: set = set()
+        self.default_policy = Policy.lossy_client()
+        self.peer_policy: dict[str, Policy] = {}    # by entity type
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def spawn(self, coro) -> asyncio.Task:
+        """ensure_future with a strong reference held until done."""
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._server = await asyncio.start_server(
+            self._accept, host=host, port=port)
+        sock = self._server.sockets[0]
+        self.addr = "%s:%d" % sock.getsockname()[:2]
+        return self.addr
+
+    async def shutdown(self) -> None:
+        for conn in list(self._conns.values()) + list(self._inbound):
+            conn.mark_down()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._tasks):
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    def add_dispatcher(self, d) -> None:
+        self.dispatchers.append(d)
+
+    # -- policies ----------------------------------------------------------
+
+    def policy_for(self, entity: str) -> Policy:
+        etype = entity.split(".", 1)[0]
+        return self.peer_policy.get(etype, self.default_policy)
+
+    # -- outbound ----------------------------------------------------------
+
+    def connect_to(self, addr: str, entity_hint: str = "") -> Connection:
+        """Get (or create) the connection to addr. The TCP dial happens
+        lazily in the supervisor; sends queue meanwhile."""
+        conn = self._conns.get(addr)
+        if conn is not None and conn.is_open:
+            return conn
+        policy = self.policy_for(entity_hint) if entity_hint \
+            else self.default_policy
+        conn = Connection(self, addr, policy)
+        self._conns[addr] = conn
+        conn._start()
+        return conn
+
+    def send_to(self, addr: str, msg: Message,
+                entity_hint: str = "") -> None:
+        self.connect_to(addr, entity_hint).send(msg)
+
+    async def _handshake_out(self, conn, reader, writer) -> None:
+        from ..utils import denc
+
+        writer.write(BANNER)
+        # "ack" mirrors ProtocolV2's reconnect msg_seq exchange
+        # (ProtocolV2.cc ReconnectFrame): each side tells the other how
+        # much it already received, so replay covers only the gap
+        ident = denc.encode({"entity": self.entity, "nonce": self.nonce,
+                             "addr": self.addr or "",
+                             "ack": conn.in_seq})
+        writer.write(struct.pack(">I", len(ident)) + ident)
+        await writer.drain()
+        banner = await reader.readexactly(len(BANNER))
+        if banner != BANNER:
+            raise ConnectionError_("bad banner %r" % banner)
+        (n,) = struct.unpack(">I", await reader.readexactly(4))
+        peer = denc.decode(await reader.readexactly(n))
+        conn.peer_entity = peer["entity"]
+        nonce = peer.get("nonce", 0)
+        if conn.peer_nonce >= 0 and conn.peer_nonce != nonce:
+            # peer restarted: its seq numbering starts over
+            conn.in_seq = 0
+        conn.peer_nonce = nonce
+        ack = peer.get("ack", 0)
+        conn.unacked = [(s, d) for s, d in conn.unacked if s > ack]
+
+    # -- inbound -----------------------------------------------------------
+
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        from ..utils import denc
+
+        try:
+            banner = await reader.readexactly(len(BANNER))
+            if banner != BANNER:
+                writer.close()
+                return
+            (n,) = struct.unpack(">I", await reader.readexactly(4))
+            peer = denc.decode(await reader.readexactly(n))
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            return
+        entity = peer["entity"]
+        nonce = peer.get("nonce", 0)
+        policy = self.policy_for(entity)
+        # session reuse: a lossless peer reconnecting with the SAME
+        # nonce reattaches to its existing Connection so seq state and
+        # replay work; a different nonce means the peer restarted and
+        # gets a fresh session (ProtocolV2 reset_session)
+        conn = None
+        if not policy.lossy:
+            for c in list(self._inbound):
+                if c.peer_entity == entity and c.is_open:
+                    if c.peer_nonce == nonce:
+                        conn = c
+                    else:
+                        c.mark_down()
+                        await self._reset(c)
+                    break
+        if conn is None:
+            conn = Connection(self, None, policy)
+            conn.peer_entity = entity
+            conn.peer_nonce = nonce
+            self._inbound.append(conn)
+            conn._start()
+        conn.unacked = [(s, d) for s, d in conn.unacked
+                        if s > peer.get("ack", 0)]
+        try:
+            writer.write(BANNER)
+            ident = denc.encode({"entity": self.entity,
+                                 "nonce": self.nonce,
+                                 "addr": self.addr or "",
+                                 "ack": conn.in_seq})
+            writer.write(struct.pack(">I", len(ident)) + ident)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            return
+        conn._transports.put_nowait((reader, writer))
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        for d in self.dispatchers:
+            handler = getattr(d, "ms_dispatch", None)
+            if handler is None:
+                continue
+            res = handler(conn, msg)
+            if asyncio.iscoroutine(res):
+                res = await res
+            if res:
+                return
+
+    async def _reset(self, conn: Connection) -> None:
+        for d in self.dispatchers:
+            handler = getattr(d, "ms_handle_reset", None)
+            if handler is not None:
+                res = handler(conn)
+                if asyncio.iscoroutine(res):
+                    await res
+
+    def _forget(self, conn: Connection) -> None:
+        if conn.peer_addr is not None:
+            if self._conns.get(conn.peer_addr) is conn:
+                del self._conns[conn.peer_addr]
+        elif conn in self._inbound:
+            self._inbound.remove(conn)

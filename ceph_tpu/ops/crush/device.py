@@ -344,7 +344,7 @@ class FlatMap:
         self._mk_np = magic_k
         self._size_np = size
         self._btype_np = btype
-        self._row_cache: dict[int, jnp.ndarray] = {}
+        self._row_cache: dict[int, np.ndarray] = {}
         # per-bucket metadata fetch for arbitrary bucket ids (the child
         # bucket chosen during descent): size(2) + btype(2)
         meta = np.zeros((B, 4), np.int8)

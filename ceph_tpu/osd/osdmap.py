@@ -125,6 +125,21 @@ class PGPool:
         return ceph_stable_mod(pg.ps, self.pgp_num,
                                self.pgp_num_mask) + pg.pool
 
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "name": self.name, "type": self.type,
+            "size": self.size, "min_size": self.min_size,
+            "pg_num": self.pg_num, "pgp_num": self.pgp_num,
+            "crush_rule": self.crush_rule, "flags": self.flags,
+            "erasure_code_profile": self.erasure_code_profile,
+            "object_hash": self.object_hash,
+            "last_change": self.last_change,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PGPool":
+        return cls(**d)
+
 
 class OSDMap:
     """The cluster map. All mutation goes through apply_incremental so
@@ -418,6 +433,81 @@ class OSDMap:
     def new_incremental(self) -> "Incremental":
         return Incremental(epoch=self.epoch + 1)
 
+    # -- wire encoding (OSDMap::encode/decode analog) ----------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "fsid": self.fsid,
+            "max_osd": self.max_osd,
+            "osd_state": list(self.osd_state),
+            "osd_weight": list(self.osd_weight),
+            "osd_primary_affinity": (
+                list(self.osd_primary_affinity)
+                if self.osd_primary_affinity is not None else None),
+            "osd_addrs": {str(k): v for k, v in self.osd_addrs.items()},
+            "crush": self.crush.to_dict(),
+            "pools": {str(k): p.to_dict() for k, p in self.pools.items()},
+            "pool_max": self.pool_max,
+            "pg_temp": _enc_pg_map(self.pg_temp),
+            "primary_temp": _enc_pg_map(self.primary_temp),
+            "pg_upmap": _enc_pg_map(self.pg_upmap),
+            "pg_upmap_items": [
+                [pg.pool, pg.ps, [list(t) for t in items]]
+                for pg, items in self.pg_upmap_items.items()],
+            "pg_upmap_primaries": _enc_pg_map(self.pg_upmap_primaries),
+            "blocklist": dict(self.blocklist),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OSDMap":
+        m = cls()
+        m.epoch = d["epoch"]
+        m.fsid = d["fsid"]
+        m.max_osd = d["max_osd"]
+        m.osd_state = list(d["osd_state"])
+        m.osd_weight = list(d["osd_weight"])
+        m.osd_primary_affinity = (
+            list(d["osd_primary_affinity"])
+            if d["osd_primary_affinity"] is not None else None)
+        m.osd_addrs = {int(k): v for k, v in d["osd_addrs"].items()}
+        m.crush = CrushMap.from_dict(d["crush"])
+        m.pools = {int(k): PGPool.from_dict(p)
+                   for k, p in d["pools"].items()}
+        m.pool_max = d["pool_max"]
+        m.pg_temp = _dec_pg_map(d["pg_temp"], list)
+        m.primary_temp = _dec_pg_map(d["primary_temp"], int)
+        m.pg_upmap = _dec_pg_map(d["pg_upmap"], list)
+        m.pg_upmap_items = {
+            pg_t(p, ps): [tuple(t) for t in items]
+            for p, ps, items in d["pg_upmap_items"]}
+        m.pg_upmap_primaries = _dec_pg_map(d["pg_upmap_primaries"], int)
+        m.blocklist = dict(d["blocklist"])
+        return m
+
+    def encode(self) -> bytes:
+        from ..utils import denc
+
+        return denc.encode(self.to_dict())
+
+    @classmethod
+    def decode(cls, data: bytes) -> "OSDMap":
+        from ..utils import denc
+
+        return cls.from_dict(denc.decode(data))
+
+
+def _enc_pg_map(d: dict) -> list:
+    return [[pg.pool, pg.ps,
+             list(v) if isinstance(v, (list, tuple)) else v]
+            for pg, v in d.items()]
+
+
+def _dec_pg_map(rows: list, vtype) -> dict:
+    if vtype is list:
+        return {pg_t(p, ps): list(v) for p, ps, v in rows}
+    return {pg_t(p, ps): v for p, ps, v in rows}
+
 
 @dataclass
 class Incremental:
@@ -439,3 +529,66 @@ class Incremental:
         field(default_factory=dict))
     old_pg_upmap_items: list[pg_t] = field(default_factory=list)
     new_crush: CrushMap | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "new_max_osd": self.new_max_osd,
+            "new_pools": {str(k): p.to_dict()
+                          for k, p in self.new_pools.items()},
+            "old_pools": list(self.old_pools),
+            "new_state": {str(k): v for k, v in self.new_state.items()},
+            "new_weight": {str(k): v for k, v in self.new_weight.items()},
+            "new_primary_affinity": {
+                str(k): v for k, v in self.new_primary_affinity.items()},
+            "new_up_client": {str(k): v
+                              for k, v in self.new_up_client.items()},
+            "new_pg_temp": _enc_pg_map(self.new_pg_temp),
+            "new_primary_temp": _enc_pg_map(self.new_primary_temp),
+            "new_pg_upmap": _enc_pg_map(self.new_pg_upmap),
+            "old_pg_upmap": [[pg.pool, pg.ps] for pg in self.old_pg_upmap],
+            "new_pg_upmap_items": [
+                [pg.pool, pg.ps, [list(t) for t in items]]
+                for pg, items in self.new_pg_upmap_items.items()],
+            "old_pg_upmap_items": [[pg.pool, pg.ps]
+                                   for pg in self.old_pg_upmap_items],
+            "new_crush": (self.new_crush.to_dict()
+                          if self.new_crush is not None else None),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Incremental":
+        inc = cls(epoch=d["epoch"])
+        inc.new_max_osd = d["new_max_osd"]
+        inc.new_pools = {int(k): PGPool.from_dict(p)
+                         for k, p in d["new_pools"].items()}
+        inc.old_pools = list(d["old_pools"])
+        inc.new_state = {int(k): v for k, v in d["new_state"].items()}
+        inc.new_weight = {int(k): v for k, v in d["new_weight"].items()}
+        inc.new_primary_affinity = {
+            int(k): v for k, v in d["new_primary_affinity"].items()}
+        inc.new_up_client = {int(k): v
+                             for k, v in d["new_up_client"].items()}
+        inc.new_pg_temp = _dec_pg_map(d["new_pg_temp"], list)
+        inc.new_primary_temp = _dec_pg_map(d["new_primary_temp"], int)
+        inc.new_pg_upmap = _dec_pg_map(d["new_pg_upmap"], list)
+        inc.old_pg_upmap = [pg_t(p, ps) for p, ps in d["old_pg_upmap"]]
+        inc.new_pg_upmap_items = {
+            pg_t(p, ps): [tuple(t) for t in items]
+            for p, ps, items in d["new_pg_upmap_items"]}
+        inc.old_pg_upmap_items = [pg_t(p, ps)
+                                  for p, ps in d["old_pg_upmap_items"]]
+        inc.new_crush = (CrushMap.from_dict(d["new_crush"])
+                         if d["new_crush"] is not None else None)
+        return inc
+
+    def encode(self) -> bytes:
+        from ..utils import denc
+
+        return denc.encode(self.to_dict())
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Incremental":
+        from ..utils import denc
+
+        return cls.from_dict(denc.decode(data))

@@ -1,0 +1,205 @@
+"""Messenger + wire-encoding tests (src/test/msgr/ analog, in-process)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.models.crushmap import STRAW2, CrushMap
+from ceph_tpu.msg import (Messenger, Policy, decode_message,
+                          encode_message)
+from ceph_tpu.msg.messages import (MOSDMapMsg, MOSDOp, MOSDOpReply,
+                                   MPing, MPong)
+from ceph_tpu.osd.osdmap import Incremental, OSDMap, PGPool, pg_t
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=20))
+
+
+# -- codec -----------------------------------------------------------------
+
+
+def test_message_roundtrip():
+    m = MOSDOp(tid=7, pool=1, ps=0x1f, oid="foo", snapc=None,
+               ops=[{"op": "write", "offset": 0, "data": b"abc"}],
+               epoch=3, flags=0)
+    m.seq = 42
+    m.src = "client.1"
+    out = decode_message(encode_message(m))
+    assert isinstance(out, MOSDOp)
+    assert out.tid == 7 and out.oid == "foo" and out.seq == 42
+    assert out.ops[0]["data"] == b"abc"
+    assert out.src == "client.1"
+
+
+def test_osdmap_wire_roundtrip():
+    crush = CrushMap()
+    crush.add_bucket(STRAW2, 1, [0, 1, 2], [0x10000] * 3, id=-1)
+    m = OSDMap()
+    inc = Incremental(epoch=1)
+    inc.new_max_osd = 3
+    inc.new_crush = crush
+    inc.new_pools[1] = PGPool(id=1, name="p", pg_num=8, size=2)
+    m.apply_incremental(inc)
+    inc2 = m.new_incremental()
+    inc2.new_state[0] = 3  # EXISTS|UP
+    inc2.new_weight[0] = 0x10000
+    inc2.new_pg_temp[pg_t(1, 3)] = [2, 0]
+    inc2.new_pg_upmap_items[pg_t(1, 4)] = [(0, 2)]
+    m.apply_incremental(inc2)
+    m.osd_addrs[0] = "127.0.0.1:5555"
+
+    m2 = OSDMap.decode(m.encode())
+    assert m2.epoch == m.epoch
+    assert m2.pools[1].pg_num == 8
+    assert m2.pg_temp[pg_t(1, 3)] == [2, 0]
+    assert m2.pg_upmap_items[pg_t(1, 4)] == [(0, 2)]
+    assert m2.osd_addrs[0] == "127.0.0.1:5555"
+    assert m2.crush.buckets[-1].items == [0, 1, 2]
+    # mapping must agree between original and decoded copy
+    for ps in range(8):
+        assert (m.pg_to_up_acting_osds(pg_t(1, ps))
+                == m2.pg_to_up_acting_osds(pg_t(1, ps)))
+
+
+def test_incremental_wire_roundtrip():
+    inc = Incremental(epoch=5)
+    inc.new_state[3] = 2
+    inc.new_weight[3] = 0
+    inc.new_pg_temp[pg_t(1, 0)] = [1, 2]
+    inc2 = Incremental.decode(inc.encode())
+    assert inc2.epoch == 5
+    assert inc2.new_state == {3: 2}
+    assert inc2.new_pg_temp == {pg_t(1, 0): [1, 2]}
+
+
+# -- transport -------------------------------------------------------------
+
+
+class Echo:
+    """Replies MPong to MPing; collects everything else."""
+
+    def __init__(self, msgr):
+        self.msgr = msgr
+        self.got = []
+        self.resets = 0
+
+    def ms_dispatch(self, conn, msg):
+        if isinstance(msg, MPing):
+            conn.send(MPong(stamp=msg.stamp))
+            return True
+        self.got.append(msg)
+        return True
+
+    def ms_handle_reset(self, conn):
+        self.resets += 1
+
+
+class Collector:
+    def __init__(self):
+        self.got = []
+        self.event = asyncio.Event()
+
+    def ms_dispatch(self, conn, msg):
+        self.got.append(msg)
+        self.event.set()
+        return True
+
+
+def test_ping_pong():
+    async def main():
+        server = Messenger("osd.0")
+        await server.bind()
+        server.add_dispatcher(Echo(server))
+
+        client = Messenger("client.1")
+        col = Collector()
+        client.add_dispatcher(col)
+        client.send_to(server.addr, MPing(stamp=1.5))
+        await asyncio.wait_for(col.event.wait(), 5)
+        assert isinstance(col.got[0], MPong)
+        assert col.got[0].stamp == 1.5
+        assert col.got[0].src == "osd.0"
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_large_message():
+    async def main():
+        server = Messenger("osd.0")
+        await server.bind()
+        echo = Echo(server)
+        server.add_dispatcher(echo)
+        client = Messenger("client.1")
+        payload = bytes(range(256)) * 40000  # ~10 MiB
+        client.send_to(
+            server.addr,
+            MOSDMapMsg(fsid="x", full=payload, incrementals=[]))
+        for _ in range(200):
+            if echo.got:
+                break
+            await asyncio.sleep(0.05)
+        assert echo.got and echo.got[0].full == payload
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_lossless_peer_resend():
+    """With injected socket failures, every message still arrives
+    exactly once, in order (ProtocolV2 session reconnect analog)."""
+
+    async def main():
+        server = Messenger("osd.0")
+        server.peer_policy["osd"] = Policy.lossless_peer()
+        await server.bind()
+        echo = Echo(server)
+        server.add_dispatcher(echo)
+
+        client = Messenger("osd.1")
+        client.peer_policy["osd"] = Policy.lossless_peer()
+        client.inject_socket_failures = 5  # ~1 in 5 writes aborts
+        conn = client.connect_to(server.addr, entity_hint="osd.0")
+        n = 40
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        for _ in range(400):
+            if len(echo.got) >= n:
+                break
+            await asyncio.sleep(0.05)
+        tids = [m.tid for m in echo.got]
+        assert tids == list(range(n))
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_lossy_client_reset():
+    """A lossy connection dies on transport fault and the dispatcher
+    sees a reset."""
+
+    async def main():
+        server = Messenger("osd.0")
+        await server.bind()
+        server.add_dispatcher(Echo(server))
+        client = Messenger("client.1")
+        col = Collector()
+        client.add_dispatcher(col)
+        conn = client.connect_to(server.addr)
+        conn.send(MPing(stamp=0.0))
+        await asyncio.wait_for(col.event.wait(), 5)
+        await server.shutdown()  # hard-close the transport
+        for _ in range(100):
+            if not conn.is_open:
+                break
+            conn.send(MPing(stamp=1.0))
+            await asyncio.sleep(0.05)
+        assert not conn.is_open
+        await client.shutdown()
+
+    run(main())
