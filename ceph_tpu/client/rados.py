@@ -1,0 +1,248 @@
+"""librados-equivalent client: cluster handle, IoCtx, Objecter.
+
+Analog of src/librados (RadosClient/IoCtx) over src/osdc/Objecter.cc:
+the client computes placement itself from its subscribed OSDMap
+(_calc_target, Objecter.cc:2776 — the same pg_to_up_acting_osds
+pipeline every daemon runs), sends MOSDOp straight to the acting
+primary, and owns all retry logic: on every new map epoch it re-targets
+in-flight ops and resends those whose primary moved (handle_osd_map ->
+_scan_requests, Objecter.cc:1303,2091); a connection reset requeues
+everything that was in flight on that session (lossy client policy —
+the reference's RESETSESSION handling).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..msg import Messenger
+from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
+                            MOSDMapMsg, MOSDOp, MOSDOpReply)
+from ..osd.osdmap import OSDMap, consume_map_payload
+from ..utils.context import Context
+
+
+class ObjectNotFound(Exception):
+    pass
+
+
+class RadosError(Exception):
+    def __init__(self, code: int, detail=None):
+        super().__init__("rados error %d: %r" % (code, detail))
+        self.code = code
+        self.detail = detail
+
+
+class _InFlight:
+    __slots__ = ("tid", "pool", "oid", "ops", "future", "target",
+                 "pgid", "acting")
+
+    def __init__(self, tid, pool, oid, ops, future):
+        self.tid = tid
+        self.pool = pool
+        self.oid = oid
+        self.ops = ops
+        self.future = future
+        self.target = -1        # osd the op was last sent to
+        self.pgid = None
+        self.acting: list = []  # acting set at send time
+
+
+class RadosClient:
+    """Cluster handle (librados::Rados / RadosClient)."""
+
+    def __init__(self, mon_addr: str, ctx: Context | None = None,
+                 name: str = "client.0"):
+        self.ctx = ctx or Context(name)
+        self.mon_addr = mon_addr
+        self.msgr = Messenger(name)
+        self.msgr.add_dispatcher(self)
+        # epoch-0 empty map is the universal incremental base
+        self.osdmap: OSDMap = OSDMap()
+        self._map_event = asyncio.Event()
+        self._tid = 0
+        self._inflight: dict[int, _InFlight] = {}
+        self._cmd_futures: dict[int, asyncio.Future] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def connect(self, timeout: float = 10.0) -> None:
+        self.msgr.send_to(self.mon_addr, MMonSubscribe(start=1),
+                          entity_hint="mon.0")
+        await asyncio.wait_for(self._map_event.wait(), timeout)
+
+    async def shutdown(self) -> None:
+        await self.msgr.shutdown()
+
+    def io_ctx(self, pool_name: str) -> "IoCtx":
+        for pid, pool in (self.osdmap.pools if self.osdmap else {}) \
+                .items():
+            if pool.name == pool_name:
+                return IoCtx(self, pid)
+        raise ValueError("no pool %r" % pool_name)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MOSDMapMsg):
+            self._handle_map(msg)
+        elif isinstance(msg, MOSDOpReply):
+            self._handle_reply(msg)
+        elif isinstance(msg, MMonCommandAck):
+            fut = self._cmd_futures.pop(msg.tid, None)
+            if fut is not None and not fut.done():
+                fut.set_result((msg.result, msg.out))
+        else:
+            return False
+        return True
+
+    def ms_handle_reset(self, conn) -> None:
+        """Lossy session died: re-target in-flight ops.  Ops whose
+        interval is unchanged stay queued — a dead osd produces a new
+        map epoch, which is what actually re-routes them (the
+        reference's kick_requests-on-reset + wait-for-map behavior).
+        A reset of the MON link also dropped our subscription on the
+        mon side, so renew it."""
+        if conn.peer_addr == self.mon_addr:
+            self.msgr.send_to(self.mon_addr,
+                              MMonSubscribe(start=self.osdmap.epoch + 1),
+                              entity_hint="mon.0")
+        self._scan_requests()
+
+    # -- maps --------------------------------------------------------------
+
+    def _handle_map(self, msg: MOSDMapMsg) -> None:
+        self.osdmap, changed = consume_map_payload(
+            self.osdmap, msg.full, msg.incrementals)
+        if changed and self.osdmap.epoch > 0:
+            self._map_event.set()
+            self._scan_requests()
+
+    def _scan_requests(self) -> None:
+        """Re-target in-flight ops; resend those whose interval changed
+        (Objecter::_scan_requests).  Any acting-set change counts: a
+        replica death aborts the primary's in-flight repops, so the op
+        must be resent even when the primary itself is unchanged."""
+        for op in list(self._inflight.values()):
+            primary, pgid, acting = self._calc_target(op.pool, op.oid)
+            if (primary != op.target or pgid != op.pgid
+                    or acting != op.acting):
+                self._send_op(op)
+
+    # -- op submission -----------------------------------------------------
+
+    def _calc_target(self, pool_id: int, oid: str):
+        pool = self.osdmap.pools[pool_id]
+        raw = self.osdmap.object_locator_to_pg(oid, pool_id)
+        pgid = pool.raw_pg_to_pg(raw)  # Objecter.cc:2830
+        up, upp, acting, actingp = \
+            self.osdmap.pg_to_up_acting_osds(pgid)
+        return actingp, pgid, acting
+
+    def submit_op(self, pool_id: int, oid: str,
+                  ops: list[dict]) -> asyncio.Future:
+        self._tid += 1
+        fut = asyncio.get_running_loop().create_future()
+        op = _InFlight(self._tid, pool_id, oid, ops, fut)
+        self._inflight[self._tid] = op
+        self._send_op(op)
+        return fut
+
+    def _send_op(self, op: _InFlight) -> None:
+        primary, pgid, acting = self._calc_target(op.pool, op.oid)
+        op.target = primary
+        op.pgid = pgid
+        op.acting = acting
+        if primary < 0:
+            return  # no acting primary yet: wait for the next map
+        addr = self.osdmap.osd_addrs.get(primary)
+        if not addr:
+            return
+        self.msgr.send_to(addr, MOSDOp(
+            tid=op.tid, pool=op.pool, ps=pgid.ps, oid=op.oid,
+            snapc=None, ops=op.ops, epoch=self.osdmap.epoch, flags=0),
+            entity_hint="osd.%d" % primary)
+
+    def _handle_reply(self, msg: MOSDOpReply) -> None:
+        op = self._inflight.pop(msg.tid, None)
+        if op is None or op.future.done():
+            return
+        if msg.result == 0:
+            op.future.set_result(msg.outs)
+        elif msg.result == -2:
+            op.future.set_exception(ObjectNotFound(op.oid))
+        else:
+            op.future.set_exception(RadosError(msg.result, msg.outs))
+
+    # -- mon commands ------------------------------------------------------
+
+    async def mon_command(self, prefix: str, timeout: float = 10.0,
+                          **args) -> dict:
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_running_loop().create_future()
+        self._cmd_futures[tid] = fut
+        cmd = {"prefix": prefix}
+        cmd.update(args)
+        self.msgr.send_to(self.mon_addr, MMonCommand(tid=tid, cmd=cmd),
+                          entity_hint="mon.0")
+        try:
+            result, out = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._cmd_futures.pop(tid, None)
+        if result != 0:
+            raise RadosError(result, out)
+        return out
+
+    async def wait_for_epoch(self, epoch: int,
+                             timeout: float = 10.0) -> None:
+        t0 = asyncio.get_running_loop().time()
+        while self.osdmap is None or self.osdmap.epoch < epoch:
+            if asyncio.get_running_loop().time() - t0 > timeout:
+                raise TimeoutError("epoch %d not reached" % epoch)
+            await asyncio.sleep(0.02)
+
+
+class IoCtx:
+    """Per-pool I/O context (librados::IoCtx)."""
+
+    def __init__(self, client: RadosClient, pool_id: int):
+        self.client = client
+        self.pool_id = pool_id
+
+    async def write(self, oid: str, data: bytes,
+                    offset: int = 0) -> None:
+        await self.client.submit_op(self.pool_id, oid, [
+            {"op": "write", "offset": offset, "data": bytes(data)}])
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        await self.client.submit_op(self.pool_id, oid, [
+            {"op": "writefull", "data": bytes(data)}])
+
+    async def read(self, oid: str, length: int = 0,
+                   offset: int = 0) -> bytes:
+        outs = await self.client.submit_op(self.pool_id, oid, [
+            {"op": "read", "offset": offset, "length": length}])
+        return outs[0]["data"]
+
+    async def stat(self, oid: str) -> int:
+        outs = await self.client.submit_op(self.pool_id, oid, [
+            {"op": "stat"}])
+        return outs[0]["size"]
+
+    async def remove(self, oid: str) -> None:
+        await self.client.submit_op(self.pool_id, oid, [
+            {"op": "delete"}])
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        await self.client.submit_op(self.pool_id, oid, [
+            {"op": "setxattr", "name": name, "value": bytes(value)}])
+
+    async def omap_set(self, oid: str, kv: dict) -> None:
+        await self.client.submit_op(self.pool_id, oid, [
+            {"op": "omap-set", "kv": dict(kv)}])
+
+    async def omap_get(self, oid: str) -> dict:
+        outs = await self.client.submit_op(self.pool_id, oid, [
+            {"op": "omap-get"}])
+        return outs[0]["kv"]

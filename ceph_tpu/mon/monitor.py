@@ -1,0 +1,445 @@
+"""Monitor: the cluster control plane (map authority).
+
+Analog of src/mon/Monitor.cc + OSDMonitor.cc as one asyncio daemon:
+the authoritative OSDMap evolves only through Incrementals committed
+via the Paxos log (PaxosService::propose_pending pattern), and every
+committed epoch is pushed to subscribers (clients and OSDs follow maps,
+never each other).
+
+Implemented service logic (OSDMonitor):
+* boot      — MOSDBoot marks the osd EXISTS|UP at its addr and adds it
+              to the default CRUSH root (OSDMonitor::preprocess_boot).
+* failure   — MOSDFailure reports gated by reporter count + grace
+              (OSDMonitor::check_failure, mon/OSDMonitor.cc:3171),
+              then the osd is marked down in a new epoch.
+* auto-out  — down for mon_osd_down_out_interval -> weight 0
+              (OSDMonitor::tick, "will mark out" flow).
+* pools     — create/rm/set replicated and erasure pools; erasure
+              profiles live in the map (OSDMap::erasure_code_profiles).
+* commands  — MMonCommand dict protocol ("osd pool create", "status",
+              "osd out/in/down", "osd dump" ...), the mon CLI surface.
+
+Map persistence: every commit stores the Incremental in the paxos log
+and the full map at osdmap:full:<epoch> (OSDMonitor's full/inc dual
+storage), so a restarted monitor resumes at its last epoch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..models.crushmap import (CHOOSE_FIRSTN, CHOOSE_INDEP, EMIT, STRAW2,
+                               TAKE, CrushMap)
+from ..msg import Messenger
+from ..msg.messages import (MMonCommand, MMonCommandAck, MMonGetMap,
+                            MMonSubscribe, MOSDAlive, MOSDBoot,
+                            MOSDFailure, MOSDMapMsg, MOSDOp)
+from ..osd.osdmap import (CEPH_OSD_OUT, OSD_EXISTS, OSD_UP,
+                          POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED,
+                          Incremental, OSDMap, PGPool)
+from ..store.kv import KeyValueDB, MemKV
+from ..utils import denc
+from ..utils.context import Context
+from .paxos import Paxos
+
+DEFAULT_EC_PROFILE = {"plugin": "jerasure", "k": "2", "m": "1",
+                      "technique": "reed_sol_van"}
+
+
+class FailureReport:
+    __slots__ = ("first", "last", "failed_for")
+
+    def __init__(self, now: float, failed_for: float):
+        self.first = now
+        self.last = now
+        self.failed_for = failed_for
+
+
+class Monitor:
+    def __init__(self, ctx: Context | None = None, name: str = "mon.0",
+                 store: KeyValueDB | None = None, fsid: str = "tpu"):
+        self.ctx = ctx or Context("mon")
+        self.name = name
+        self.fsid = fsid
+        self.store = store or MemKV()
+        self.store.open()
+        self.paxos = Paxos(self.store)
+        self.msgr = Messenger(name)
+        self.msgr.add_dispatcher(self)
+        self.osdmap = OSDMap()
+        self.osdmap.fsid = fsid
+        self.pending_inc: Incremental | None = None
+        # conn -> epoch already sent (subscription state)
+        self.subscribers: dict = {}
+        # target osd -> reporter osd -> FailureReport
+        self.failure_info: dict[int, dict[int, FailureReport]] = {}
+        self.down_pending_out: dict[int, float] = {}
+        self._tick_task = None
+        self._load()
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self) -> None:
+        raw = self.store.get(b"osdmap:last_epoch")
+        if raw is not None:
+            epoch = denc.decode(raw)
+            full = self.store.get(b"osdmap:full:%016d" % epoch)
+            if full is not None:
+                self.osdmap = OSDMap.decode(full)
+        # a crash between paxos commit and map apply leaves a committed
+        # blob the map never reflected: recover() replays it through
+        # the same apply+persist path as a live commit
+        self.paxos.on_commit.append(self._on_paxos_commit)
+        self.paxos.recover()
+
+    def _on_paxos_commit(self, version: int, blob: bytes) -> None:
+        payload = denc.decode(blob)
+        inc_d = payload.get("osdmap_inc")
+        if inc_d is None:
+            return
+        inc = Incremental.from_dict(inc_d)
+        if inc.epoch != self.osdmap.epoch + 1:
+            return  # already reflected in the stored full map
+        self.osdmap.apply_incremental(inc)
+        self._store_map(inc)
+
+    def _store_map(self, inc: Incremental) -> None:
+        tx = self.store.get_transaction()
+        tx.set(b"osdmap:inc:%016d" % inc.epoch, inc.encode())
+        tx.set(b"osdmap:full:%016d" % self.osdmap.epoch,
+               self.osdmap.encode())
+        tx.set(b"osdmap:last_epoch", denc.encode(self.osdmap.epoch))
+        self.store.submit_transaction(tx)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> str:
+        addr = await self.msgr.bind(host, port)
+        self._tick_task = self.msgr.spawn(self._tick_loop())
+        self.ctx.log.info("mon", "%s serving at %s epoch %d"
+                          % (self.name, addr, self.osdmap.epoch))
+        return addr
+
+    async def shutdown(self) -> None:
+        await self.msgr.shutdown()
+        self.store.close()
+
+    @property
+    def addr(self) -> str:
+        return self.msgr.addr
+
+    # -- pending incremental / commit -------------------------------------
+
+    def _pending(self) -> Incremental:
+        if self.pending_inc is None:
+            self.pending_inc = self.osdmap.new_incremental()
+        return self.pending_inc
+
+    def _propose_pending(self) -> None:
+        """PaxosService::propose_pending: commit the pending Incremental
+        through paxos, apply it, persist, publish."""
+        inc = self.pending_inc
+        if inc is None:
+            return
+        self.pending_inc = None
+        # the on_commit hook applies the incremental to the map and
+        # persists both (same path live and during crash recovery)
+        self.paxos.propose(denc.encode({"osdmap_inc": inc.to_dict()}))
+        self.ctx.log.debug("mon", "committed epoch %d"
+                           % self.osdmap.epoch)
+        self._publish()
+
+    def _publish(self) -> None:
+        """Push incrementals to every subscriber past its known epoch."""
+        for conn, have in list(self.subscribers.items()):
+            if not conn.is_open:
+                del self.subscribers[conn]
+                continue
+            if have >= self.osdmap.epoch:
+                continue
+            incs = self._collect_incs(have)
+            conn.send(MOSDMapMsg(fsid=self.fsid, full=None,
+                                 incrementals=incs))
+            self.subscribers[conn] = self.osdmap.epoch
+
+    def _collect_incs(self, have: int) -> list[bytes]:
+        out = []
+        for e in range(have + 1, self.osdmap.epoch + 1):
+            raw = self.store.get(b"osdmap:inc:%016d" % e)
+            if raw is None:
+                return []  # gap: caller falls back to full map
+            out.append(raw)
+        return out
+
+    def _send_map(self, conn, have: int = -1) -> None:
+        if 0 <= have < self.osdmap.epoch:
+            incs = self._collect_incs(have)
+            if incs:
+                conn.send(MOSDMapMsg(fsid=self.fsid, full=None,
+                                     incrementals=incs))
+                return
+        conn.send(MOSDMapMsg(fsid=self.fsid, full=self.osdmap.encode(),
+                             incrementals=[]))
+
+    # -- dispatch ----------------------------------------------------------
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MMonGetMap):
+            self._send_map(conn, msg.have)
+        elif isinstance(msg, MMonSubscribe):
+            self.subscribers[conn] = min(msg.start - 1,
+                                         self.osdmap.epoch)
+            self._send_map(conn, msg.start - 1)
+            self.subscribers[conn] = self.osdmap.epoch
+        elif isinstance(msg, MOSDBoot):
+            self._handle_boot(conn, msg)
+        elif isinstance(msg, MOSDFailure):
+            self._handle_failure(conn, msg)
+        elif isinstance(msg, MOSDAlive):
+            self.failure_info.pop(msg.osd, None)
+        elif isinstance(msg, MMonCommand):
+            self._handle_command(conn, msg)
+        else:
+            return False
+        return True
+
+    def ms_handle_reset(self, conn) -> None:
+        self.subscribers.pop(conn, None)
+
+    # -- boot --------------------------------------------------------------
+
+    def _handle_boot(self, conn, msg: MOSDBoot) -> None:
+        osd, addr = msg.osd, msg.addr
+        if (osd < self.osdmap.max_osd and self.osdmap.is_up(osd)
+                and self.osdmap.osd_addrs.get(osd) == addr):
+            return  # already up at that addr (preprocess_boot dup)
+        inc = self._pending()
+        if osd >= self.osdmap.max_osd and osd >= inc.new_max_osd:
+            inc.new_max_osd = osd + 1
+        known = osd < self.osdmap.max_osd
+        cur_state = self.osdmap.osd_state[osd] if known else 0
+        inc.new_up_client[osd] = addr
+        if not (cur_state & OSD_EXISTS) or not known \
+                or self.osdmap.is_out(osd):
+            inc.new_weight[osd] = 0x10000
+        if not self._in_crush(osd):
+            inc.new_crush = self._crush_with(osd)
+        self.failure_info.pop(osd, None)
+        self.down_pending_out.pop(osd, None)
+        self._propose_pending()
+        self.ctx.log.info("mon", "osd.%d booted at %s (epoch %d)"
+                          % (osd, addr, self.osdmap.epoch))
+
+    def _in_crush(self, osd: int) -> bool:
+        root = self.osdmap.crush.buckets.get(-1)
+        return root is not None and osd in root.items
+
+    def _crush_with(self, osd: int) -> CrushMap:
+        """Flat default map: one straw2 root holding every known osd,
+        one replicated rule (chooseleaf type 0 — the vstart dev-cluster
+        shape) and one EC indep rule."""
+        known = set()
+        root = self.osdmap.crush.buckets.get(-1)
+        if root is not None:
+            known.update(root.items)
+        pending = self.pending_inc
+        if pending is not None:
+            known.update(pending.new_up_client)
+        known.add(osd)
+        items = sorted(known)
+        crush = CrushMap()
+        crush.add_bucket(STRAW2, 1, items, [0x10000] * len(items),
+                         id=-1)
+        crush.add_rule([(TAKE, -1, 0), (CHOOSE_FIRSTN, 0, 0),
+                        (EMIT, 0, 0)], id=0, name="replicated_rule")
+        crush.add_rule([(TAKE, -1, 0), (CHOOSE_INDEP, 0, 0),
+                        (EMIT, 0, 0)], id=1, name="erasure_rule")
+        return crush
+
+    # -- failure detection (OSDMonitor.cc:3171 check_failure) --------------
+
+    def _handle_failure(self, conn, msg: MOSDFailure) -> None:
+        target = msg.target
+        reporter = int(msg.src.split(".", 1)[1]) if "." in msg.src else -1
+        if (target >= self.osdmap.max_osd
+                or not self.osdmap.is_up(target)):
+            return
+        now = time.monotonic()
+        reports = self.failure_info.setdefault(target, {})
+        rep = reports.get(reporter)
+        if rep is None:
+            reports[reporter] = FailureReport(now, msg.failed_for)
+        else:
+            rep.last = now
+            rep.failed_for = max(rep.failed_for, msg.failed_for)
+        self._check_failure(target)
+
+    def _check_failure(self, target: int) -> None:
+        reports = self.failure_info.get(target, {})
+        min_reporters = self.ctx.conf["mon_osd_min_down_reporters"]
+        grace = self.ctx.conf["heartbeat_grace"]
+        if len(reports) < min_reporters:
+            return
+        if max(r.failed_for for r in reports.values()) < grace:
+            return
+        self.ctx.log.info("mon", "marking osd.%d down (%d reporters)"
+                          % (target, len(reports)))
+        inc = self._pending()
+        inc.new_state[target] = OSD_UP  # xor clears UP
+        del self.failure_info[target]
+        self.down_pending_out[target] = time.monotonic()
+        self._propose_pending()
+
+    async def _tick_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            self._tick()
+
+    def _tick(self) -> None:
+        """Auto-out down osds after the down-out interval."""
+        now = time.monotonic()
+        interval = self.ctx.conf["mon_osd_down_out_interval"]
+        changed = False
+        for osd, down_at in list(self.down_pending_out.items()):
+            if self.osdmap.is_up(osd):
+                del self.down_pending_out[osd]
+                continue
+            if now - down_at >= interval and self.osdmap.is_in(osd):
+                self._pending().new_weight[osd] = CEPH_OSD_OUT
+                del self.down_pending_out[osd]
+                changed = True
+                self.ctx.log.info("mon", "marking osd.%d out" % osd)
+        if changed:
+            self._propose_pending()
+
+    # -- commands ----------------------------------------------------------
+
+    def _handle_command(self, conn, msg: MMonCommand) -> None:
+        cmd = msg.cmd or {}
+        prefix = cmd.get("prefix", "")
+        try:
+            out = self._run_command(prefix, cmd)
+            conn.send(MMonCommandAck(tid=msg.tid, result=0, out=out))
+        except Exception as e:
+            conn.send(MMonCommandAck(tid=msg.tid, result=-22,
+                                     out={"error": str(e)}))
+
+    def _run_command(self, prefix: str, cmd: dict) -> dict:
+        if prefix == "osd pool create":
+            return self._cmd_pool_create(cmd)
+        if prefix == "osd pool rm":
+            name = cmd["pool"]
+            pid = self._pool_id(name)
+            inc = self._pending()
+            inc.old_pools.append(pid)
+            self._propose_pending()
+            return {}
+        if prefix == "osd pool set":
+            return self._cmd_pool_set(cmd)
+        if prefix == "osd erasure-code-profile set":
+            inc = self._pending()
+            inc.new_erasure_code_profiles[cmd["name"]] = dict(
+                cmd.get("profile", {}))
+            self._propose_pending()
+            return {}
+        if prefix == "osd out":
+            inc = self._pending()
+            inc.new_weight[int(cmd["id"])] = CEPH_OSD_OUT
+            self._propose_pending()
+            return {}
+        if prefix == "osd in":
+            inc = self._pending()
+            inc.new_weight[int(cmd["id"])] = 0x10000
+            self._propose_pending()
+            return {}
+        if prefix == "osd down":
+            osd = int(cmd["id"])
+            if self.osdmap.is_up(osd):
+                inc = self._pending()
+                inc.new_state[osd] = OSD_UP
+                self.down_pending_out[osd] = time.monotonic()
+                self._propose_pending()
+            return {}
+        if prefix == "status":
+            up = sum(1 for o in range(self.osdmap.max_osd)
+                     if self.osdmap.is_up(o))
+            inn = sum(1 for o in range(self.osdmap.max_osd)
+                      if self.osdmap.is_in(o))
+            return {"epoch": self.osdmap.epoch, "fsid": self.fsid,
+                    "num_osds": self.osdmap.max_osd, "num_up_osds": up,
+                    "num_in_osds": inn,
+                    "pools": sorted(self.osdmap.pools)}
+        if prefix == "osd dump":
+            return self.osdmap.to_dict()
+        raise ValueError("unknown command %r" % prefix)
+
+    def _pool_id(self, name: str) -> int:
+        for pid, pool in self.osdmap.pools.items():
+            if pool.name == name:
+                return pid
+        raise ValueError("pool %r does not exist" % name)
+
+    def _cmd_pool_create(self, cmd: dict) -> dict:
+        name = cmd["pool"]
+        for pool in self.osdmap.pools.values():
+            if pool.name == name:
+                return {"pool_id": pool.id}  # idempotent
+        ptype = cmd.get("pool_type", "replicated")
+        pid = max(self.osdmap.pool_max, 0) + 1
+        if self.pending_inc is not None and self.pending_inc.new_pools:
+            pid = max(pid, max(self.pending_inc.new_pools) + 1)
+        conf = self.ctx.conf
+        pg_num = int(cmd.get("pg_num",
+                             conf["osd_pool_default_pg_num"]))
+        if ptype == "erasure":
+            pname = cmd.get("erasure_code_profile", "default")
+            profile = self.osdmap.erasure_code_profiles.get(pname)
+            if profile is None and pname == "default":
+                profile = dict(DEFAULT_EC_PROFILE)
+                self._pending().new_erasure_code_profiles[pname] = \
+                    profile
+            if profile is None:
+                raise ValueError("no erasure profile %r" % pname)
+            k = int(profile.get("k", 2))
+            m = int(profile.get("m", 1))
+            pool = PGPool(id=pid, name=name, type=POOL_TYPE_ERASURE,
+                          size=k + m, min_size=k, pg_num=pg_num,
+                          crush_rule=int(cmd.get("crush_rule", 1)),
+                          erasure_code_profile=pname)
+        else:
+            pool = PGPool(id=pid, name=name,
+                          type=POOL_TYPE_REPLICATED,
+                          size=int(cmd.get("size",
+                                           conf["osd_pool_default_size"])),
+                          min_size=conf["osd_pool_default_min_size"],
+                          pg_num=pg_num,
+                          crush_rule=int(cmd.get("crush_rule", 0)))
+        inc = self._pending()
+        inc.new_pools[pid] = pool
+        self._propose_pending()
+        return {"pool_id": pid}
+
+    def _cmd_pool_set(self, cmd: dict) -> dict:
+        pid = self._pool_id(cmd["pool"])
+        import copy
+
+        pool = copy.copy(self.osdmap.pools[pid])
+        key, val = cmd["var"], cmd["val"]
+        if key == "size":
+            pool.size = int(val)
+        elif key == "min_size":
+            pool.min_size = int(val)
+        elif key == "pg_num":
+            pool.pg_num = int(val)
+            pool.pgp_num = int(val)
+        elif key == "crush_rule":
+            pool.crush_rule = int(val)
+        else:
+            raise ValueError("cannot set %r" % key)
+        pool.last_change = self.osdmap.epoch + 1
+        inc = self._pending()
+        inc.new_pools[pid] = pool
+        self._propose_pending()
+        return {}

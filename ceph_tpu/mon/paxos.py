@@ -1,0 +1,134 @@
+"""Paxos commit log over a KeyValueDB.
+
+The reference's monitor consensus (src/mon/Paxos.{h,cc}): one Paxos
+instance per monitor replicates a single totally-ordered log of
+transaction blobs; services (OSDMonitor etc.) encode their pending
+state into one blob per round and apply it on commit
+(src/mon/PaxosService.cc propose_pending -> Paxos::propose_new_value).
+
+Store layout mirrors the reference (Paxos.cc get_store() keys):
+    paxos:first_committed / paxos:last_committed  (u64 as denc int)
+    paxos:<version>                               (tx blob)
+    paxos:accepted_pn / paxos:pending_v / paxos:pending_pn
+
+This class implements the proposer/acceptor state machine for a quorum
+of size 1 synchronously (the collect/begin/accept/commit round degrades
+to: bump pn, write pending, commit) while keeping the phase structure
+and durable bookkeeping, so the multi-mon message exchange
+(OP_COLLECT/OP_BEGIN/OP_ACCEPT/OP_COMMIT/OP_LEASE, Paxos.h:24-104) can
+be layered on without changing the storage contract or callers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..store.kv import KeyValueDB, KVTransaction
+from ..utils import denc
+
+PREFIX = b"paxos:"
+
+
+def _k(name: str) -> bytes:
+    return PREFIX + name.encode()
+
+
+def _kv(version: int) -> bytes:
+    return PREFIX + b"v%016d" % version
+
+
+class Paxos:
+    """Durable, ordered log of committed transaction blobs."""
+
+    def __init__(self, store: KeyValueDB, rank: int = 0,
+                 quorum: int = 1):
+        self.store = store
+        self.rank = rank
+        self.quorum = quorum
+        self.first_committed = self._get_int("first_committed", 0)
+        self.last_committed = self._get_int("last_committed", 0)
+        self.accepted_pn = self._get_int("accepted_pn", 0)
+        # commit subscribers (the services' refresh hook)
+        self.on_commit: list[Callable[[int, bytes], None]] = []
+
+    # -- storage helpers ---------------------------------------------------
+
+    def _get_int(self, name: str, default: int) -> int:
+        raw = self.store.get(_k(name))
+        return denc.decode(raw) if raw is not None else default
+
+    def get_version(self, version: int) -> bytes | None:
+        return self.store.get(_kv(version))
+
+    # -- proposer ----------------------------------------------------------
+
+    def _next_pn(self) -> int:
+        """Proposal numbers are globally unique per rank
+        (Paxos::get_new_proposal_number)."""
+        pn = (self.accepted_pn // 100 + 1) * 100 + self.rank
+        return pn
+
+    def propose(self, blob: bytes) -> int:
+        """Run one consensus round for the next version; returns the
+        committed version.  Quorum of one: the collect/begin/accept
+        phases are all local, but every durable step is taken in the
+        same order as the reference so recovery semantics match."""
+        # phase 1 (collect): adopt a higher pn
+        pn = self._next_pn()
+        self.accepted_pn = pn
+        version = self.last_committed + 1
+        tx = self.store.get_transaction()
+        tx.set(_k("accepted_pn"), denc.encode(pn))
+        # phase 2 (begin): persist the pending value
+        tx.set(_k("pending_v"), denc.encode(version))
+        tx.set(_k("pending_pn"), denc.encode(pn))
+        tx.set(_kv(version), blob)
+        self.store.submit_transaction(tx)
+        # phase 3 (commit): quorum of one has already accepted
+        tx = self.store.get_transaction()
+        tx.set(_k("last_committed"), denc.encode(version))
+        if self.first_committed == 0:
+            self.first_committed = 1
+            tx.set(_k("first_committed"), denc.encode(1))
+        tx.rmkey(_k("pending_v"))
+        tx.rmkey(_k("pending_pn"))
+        self.store.submit_transaction(tx)
+        self.last_committed = version
+        for cb in self.on_commit:
+            cb(version, blob)
+        return version
+
+    def recover(self) -> None:
+        """Crash recovery: an uncommitted pending value at
+        last_committed+1 is re-committed (quorum of one: it was
+        accepted by a majority, namely us — Paxos.cc handle_last
+        uncommitted handling)."""
+        raw = self.store.get(_k("pending_v"))
+        if raw is None:
+            return
+        version = denc.decode(raw)
+        if version != self.last_committed + 1:
+            return
+        blob = self.get_version(version)
+        if blob is None:
+            return
+        tx = self.store.get_transaction()
+        tx.set(_k("last_committed"), denc.encode(version))
+        tx.rmkey(_k("pending_v"))
+        tx.rmkey(_k("pending_pn"))
+        self.store.submit_transaction(tx)
+        self.last_committed = version
+        for cb in self.on_commit:
+            cb(version, blob)
+
+    def trim(self, keep: int = 500) -> None:
+        """Drop log entries older than keep versions
+        (Paxos::trim, paxos_max_join_drift semantics simplified)."""
+        floor = self.last_committed - keep
+        if floor <= self.first_committed:
+            return
+        tx = self.store.get_transaction()
+        tx.rm_range(_kv(self.first_committed), _kv(floor))
+        tx.set(_k("first_committed"), denc.encode(floor))
+        self.store.submit_transaction(tx)
+        self.first_committed = floor

@@ -65,12 +65,22 @@ def encode_message(msg: Message) -> bytes:
     return denc.encode([msg.TYPE, msg.seq, msg.src, msg.to_wire()])
 
 
+class UnknownMessage(Message):
+    """Placeholder for a type missing from the local registry (version
+    skew): carries seq so the transport can ack + drop it instead of
+    faulting the session into a replay livelock."""
+
+    TYPE = "__unknown__"
+    FIELDS = ("wire_type",)
+
+
 def decode_message(data: bytes | memoryview) -> Message:
     mtype, seq, src, fields = denc.decode(data)
     cls = _REGISTRY.get(mtype)
     if cls is None:
-        raise ValueError("unknown message type %r" % mtype)
-    msg = cls.from_wire(fields)
+        msg = UnknownMessage(wire_type=mtype)
+    else:
+        msg = cls.from_wire(fields)
     msg.seq = seq
     msg.src = src
     return msg

@@ -35,7 +35,8 @@ import random
 import struct
 import zlib
 
-from .message import Message, decode_message, encode_message
+from .message import (Message, UnknownMessage, decode_message,
+                      encode_message)
 
 BANNER = b"ceph-tpu v2\n"
 
@@ -159,14 +160,19 @@ class Connection:
     async def _run_outbound(self) -> None:
         backoff = 0.02
         while self._open:
+            writer = None
             try:
                 host, port = self.peer_addr.rsplit(":", 1)
                 reader, writer = await asyncio.open_connection(
                     host, int(port))
                 await self.msgr._handshake_out(self, reader, writer)
             except asyncio.CancelledError:
+                if writer is not None:
+                    writer.close()
                 return
             except Exception:
+                if writer is not None:
+                    writer.close()
                 if self.policy.lossy:
                     await self._die()
                     return
@@ -260,7 +266,19 @@ class Connection:
                     self.out_q.put_nowait(
                         (TAG_ACK, struct.pack(">Q", self.in_seq)))
                 if not dup:
-                    await self.msgr._dispatch(self, msg)
+                    if isinstance(msg, UnknownMessage):
+                        continue  # acked + dropped (registry skew)
+                    try:
+                        await self.msgr._dispatch(self, msg)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        # dispatcher bug: drop the transport so the
+                        # fault is visible, but never silently
+                        import traceback
+
+                        traceback.print_exc()
+                        return
             elif tag == TAG_ACK:
                 (seq,) = struct.unpack(">Q", payload)
                 self.unacked = [(s, d) for s, d in self.unacked

@@ -1,0 +1,663 @@
+"""OSD daemon: the data-plane process serving PGs.
+
+Condensed analog of src/osd/OSD.cc + PrimaryLogPG.cc for the replicated
+path, on asyncio:
+
+boot      OSD::init (OSD.cc:3592): mount store, load PGs from
+          collections, subscribe to the monitor, MOSDBoot, consume maps.
+maps      handle_osd_map / advance_map: apply incrementals in order;
+          interval changes drive per-PG peering (PeeringState AdvMap).
+ops       ms_fast_dispatch -> dequeue_op -> PrimaryLogPG::do_request:
+          primary executes the op list (do_osd_ops interpreter),
+          replicates via MOSDRepOp (ReplicatedBackend::submit_transaction,
+          ReplicatedBackend.cc:465), acks -> client reply.
+peering   GetInfo/GetLog via MOSDPGQuery -> MOSDPGLog; authoritative log
+          selection (find_best_info), activation MOSDPGLog to replicas,
+          missing-set computation.
+recovery  log-based: pull objects the primary lacks (MOSDPGPull ->
+          MOSDPGPush), push to replicas missing them; whole-object
+          granularity (recovery_state flow of ECBackend/ReplicatedBackend
+          simplified to PushOp full-object form).
+failure   OSD<->OSD heartbeats (OSD.cc:5436,5575) -> MOSDFailure reports
+          to the monitor with failed_for durations.
+
+The heavy mapping work (which PGs live here) runs through the same
+pg_to_up_acting_osds pipeline every node computes; bulk priming for
+large pools can use parallel.mapping.OSDMapMapping.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..msg import Messenger, Policy
+from ..msg.messages import (MMonSubscribe, MOSDAlive, MOSDBoot,
+                            MOSDFailure, MOSDMapMsg, MOSDOp,
+                            MOSDOpReply, MOSDPGLog, MOSDPGPush,
+                            MOSDPGPushReply, MOSDPGQuery, MOSDPing,
+                            MOSDRepOp, MOSDRepOpReply)
+from ..store.memstore import MemStore
+from ..store.objectstore import (NotFound, ObjectStore, Transaction,
+                                 coll_t, hobject_t)
+from ..utils import denc
+from ..utils.context import Context
+from .osdmap import OSDMap, consume_map_payload, pg_t
+from .pg import (PG, STATE_ACTIVE, STATE_PEERING, STATE_REPLICA,
+                 LogEntry, PGInfo)
+
+
+class OSD:
+    def __init__(self, whoami: int, mon_addr: str,
+                 ctx: Context | None = None,
+                 store: ObjectStore | None = None):
+        self.whoami = whoami
+        self.mon_addr = mon_addr
+        self.ctx = ctx or Context("osd.%d" % whoami)
+        self.store = store or MemStore()
+        self.msgr = Messenger("osd.%d" % whoami)
+        self.msgr.peer_policy["osd"] = Policy.lossless_peer()
+        self.msgr.add_dispatcher(self)
+        # epoch-0 empty map is the universal incremental base
+        self.osdmap: OSDMap = OSDMap()
+        self.pgs: dict[pg_t, PG] = {}
+        self.booted = False
+        self.stopping = False
+        self._boot_sent_epoch = -1
+        self._rep_tid = 0
+        self._waiting_for_map: list = []
+        # heartbeat state: peer -> last seen stamp
+        self.hb_last_rx: dict[int, float] = {}
+        self._tasks = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.store.mount()
+        addr = await self.msgr.bind(host, port)
+        self._load_pgs()
+        mon = self.msgr.connect_to(self.mon_addr, entity_hint="mon.0")
+        mon.send(MMonSubscribe(start=1))
+        self._tasks.append(self.msgr.spawn(self._heartbeat_loop()))
+        return addr
+
+    async def wait_for_boot(self, timeout: float = 10.0) -> None:
+        t0 = time.monotonic()
+        while not self.booted:
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError("osd.%d did not boot" % self.whoami)
+            await asyncio.sleep(0.02)
+
+    async def shutdown(self) -> None:
+        self.stopping = True
+        await self.msgr.shutdown()
+        self.store.umount()
+
+    def _load_pgs(self) -> None:
+        """Recreate PG objects from on-disk collections (OSD::load_pgs)."""
+        for cid in self.store.list_collections():
+            if not cid.is_pg():
+                continue
+            pool_s, ps_s = cid.name.split(".")
+            pg = PG(self, int(pool_s), int(ps_s, 16))
+            if pg.load():
+                self.pgs[pg_t(pg.pool_id, pg.ps)] = pg
+
+    # -- dispatch ----------------------------------------------------------
+
+    def ms_handle_reset(self, conn) -> None:
+        """A lossy fault on the monitor link drops our subscription on
+        the mon side: re-subscribe from our current epoch."""
+        if conn.peer_addr == self.mon_addr and not self.stopping:
+            self.msgr.send_to(self.mon_addr,
+                              MMonSubscribe(start=self.osdmap.epoch + 1),
+                              entity_hint="mon.0")
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MOSDMapMsg):
+            self._handle_osd_map(msg)
+        elif isinstance(msg, MOSDOp):
+            self._handle_op(conn, msg)
+        elif isinstance(msg, MOSDRepOp):
+            self._handle_repop(conn, msg)
+        elif isinstance(msg, MOSDRepOpReply):
+            self._handle_repop_reply(msg)
+        elif isinstance(msg, MOSDPGQuery):
+            self._handle_pg_query(conn, msg)
+        elif isinstance(msg, MOSDPGLog):
+            self._handle_pg_log(conn, msg)
+        elif isinstance(msg, MOSDPGPush):
+            self._handle_pg_push(conn, msg)
+        elif isinstance(msg, MOSDPGPushReply):
+            self._handle_pg_push_reply(msg)
+        elif isinstance(msg, MOSDPing):
+            self._handle_ping(conn, msg)
+        else:
+            return False
+        return True
+
+    # -- map handling ------------------------------------------------------
+
+    def _handle_osd_map(self, msg: MOSDMapMsg) -> None:
+        self.osdmap, changed = consume_map_payload(
+            self.osdmap, msg.full, msg.incrementals)
+        up_here = (self.osdmap.is_up(self.whoami)
+                   and self.osdmap.osd_addrs.get(self.whoami)
+                   == self.msgr.addr)
+        if not self.booted:
+            if up_here:
+                self.booted = True
+                self.ctx.log.info("osd", "osd.%d booted" % self.whoami)
+            else:
+                self._send_boot()
+        elif not up_here:
+            # map says we are down but we are alive: protest and
+            # re-boot (OSD "wrongly marked me down" flow)
+            self.booted = False
+            self._boot_sent_epoch = -1
+            self.msgr.send_to(self.mon_addr,
+                              MOSDAlive(osd=self.whoami,
+                                        epoch=self.osdmap.epoch),
+                              entity_hint="mon.0")
+            self._send_boot()
+        if not changed or self.osdmap.epoch == 0:
+            return
+        self.ctx.log.debug(
+            "osd", "osd.%d at epoch %d" % (self.whoami,
+                                           self.osdmap.epoch))
+        self._advance_pgs()
+        waiting, self._waiting_for_map = self._waiting_for_map, []
+        for conn, m in waiting:
+            self._handle_op(conn, m)
+
+    def _send_boot(self) -> None:
+        epoch = self.osdmap.epoch if self.osdmap else 0
+        if self._boot_sent_epoch >= 0 and epoch <= self._boot_sent_epoch:
+            return  # already asked; wait for a newer epoch
+        self._boot_sent_epoch = epoch
+        self.msgr.send_to(
+            self.mon_addr,
+            MOSDBoot(osd=self.whoami, addr=self.msgr.addr, epoch=epoch),
+            entity_hint="mon.0")
+
+    def _advance_pgs(self) -> None:
+        """Recompute mappings; create/advance PGs (OSD::advance_map)."""
+        m = self.osdmap
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                pgid = pg_t(pool_id, ps)
+                up, upp, acting, actingp = m.pg_to_up_acting_osds(pgid)
+                mine = self.whoami in acting
+                pg = self.pgs.get(pgid)
+                if pg is None:
+                    if not mine:
+                        continue
+                    pg = PG(self, pool_id, ps)
+                    pg.create_onstore()
+                    self.pgs[pgid] = pg
+                self._advance_pg(pg, up, upp, acting, actingp)
+        # pools removed from the map: drop their PGs
+        for pgid in [p for p in self.pgs if p.pool not in m.pools]:
+            del self.pgs[pgid]
+
+    def _advance_pg(self, pg: PG, up, upp, acting, actingp) -> None:
+        interval_changed = (acting != pg.acting or actingp != pg.primary)
+        pg.up, pg.acting, pg.primary = up, acting, actingp
+        if not interval_changed and pg.state in (STATE_ACTIVE,
+                                                 STATE_REPLICA):
+            return
+        pg.info.same_interval_since = self.osdmap.epoch
+        pg.in_flight.clear()
+        if pg.is_primary():
+            self._start_peering(pg)
+        else:
+            pg.state = STATE_REPLICA
+
+    # -- peering (primary) -------------------------------------------------
+
+    def _start_peering(self, pg: PG) -> None:
+        pg.state = STATE_PEERING
+        pg.peer_info.clear()
+        pg.waiting_for_peers = {}
+        peers = [o for o in pg.acting if o >= 0 and o != self.whoami]
+        if not peers:
+            self._finish_peering(pg)
+            return
+        epoch = self.osdmap.epoch
+        pg.waiting_for_peers = {o: None for o in peers}
+        for o in peers:
+            self._send_osd(o, MOSDPGQuery(pool=pg.pool_id, ps=pg.ps,
+                                          epoch=epoch))
+
+    def _handle_pg_query(self, conn, msg: MOSDPGQuery) -> None:
+        """Replica side: reply with info + full log (MOSDPGLog)."""
+        pg = self.pgs.get(pg_t(msg.pool, msg.ps))
+        if pg is None:
+            pg = PG(self, msg.pool, msg.ps)
+            pg.create_onstore()
+            self.pgs[pg_t(msg.pool, msg.ps)] = pg
+        conn.send(MOSDPGLog(pool=msg.pool, ps=msg.ps,
+                            epoch=msg.epoch,
+                            info=self._pack_log(pg, activate=False)))
+
+    def _pack_log(self, pg: PG, activate: bool) -> dict:
+        return {
+            "activate": activate,
+            "info": pg.info.to_wire(),
+            "log": [e.to_wire() for e in pg.log.entries],
+            "log_tail": list(pg.log.tail),
+        }
+
+    def _handle_pg_log(self, conn, msg: MOSDPGLog) -> None:
+        pgid = pg_t(msg.pool, msg.ps)
+        pg = self.pgs.get(pgid)
+        if pg is None:
+            return
+        payload = msg.info
+        if payload.get("activate"):
+            self._activate_replica(pg, payload)
+            return
+        # primary collecting peering responses
+        if pg.state != STATE_PEERING:
+            return
+        sender = int(msg.src.split(".")[1])
+        if sender not in pg.waiting_for_peers:
+            return
+        pg.waiting_for_peers[sender] = payload
+        if all(v is not None for v in pg.waiting_for_peers.values()):
+            self._choose_authoritative(pg)
+
+    def _choose_authoritative(self, pg: PG) -> None:
+        """find_best_info: highest last_update wins; merge its log."""
+        best_osd = self.whoami
+        best_lu = pg.info.last_update
+        for osd, payload in pg.waiting_for_peers.items():
+            lu = tuple(payload["info"]["last_update"])
+            if lu > best_lu:
+                best_lu, best_osd = lu, osd
+        if best_osd != self.whoami:
+            payload = pg.waiting_for_peers[best_osd]
+            merged = [LogEntry.from_wire(w) for w in payload["log"]]
+            self._merge_authoritative(pg, merged,
+                                      tuple(payload["log_tail"]),
+                                      tuple(payload["info"]
+                                            ["last_update"]))
+        for osd, payload in pg.waiting_for_peers.items():
+            info = PGInfo.from_wire(payload["info"])
+            pg.peer_info[osd] = info
+            pg.peer_missing[osd] = pg.log.objects_since(
+                info.last_update)
+        self._finish_peering(pg)
+
+    def _merge_authoritative(self, pg: PG, entries: list[LogEntry],
+                             tail, last_update) -> None:
+        """Adopt a peer's newer log; what we lack becomes our missing
+        set (PGLog::merge_log)."""
+        mine = pg.info.last_update
+        pg.missing = {}
+        for e in entries:
+            if e.version > mine:
+                pg.missing[e.oid] = e.op
+        pg.log.entries = entries
+        pg.log.tail = tail
+        pg.info.last_update = last_update
+        t = Transaction()
+        for e in entries:
+            pg.persist_log_entry(t, e)
+        pg.persist_meta(t)
+        self.store.apply_transaction(t)
+
+    def _finish_peering(self, pg: PG) -> None:
+        pg.state = STATE_ACTIVE
+        # activate replicas with the authoritative log
+        for osd in pg.acting:
+            if osd >= 0 and osd != self.whoami:
+                self._send_osd(osd, MOSDPGLog(
+                    pool=pg.pool_id, ps=pg.ps, epoch=self.osdmap.epoch,
+                    info=self._pack_log(pg, activate=True)))
+        self.ctx.log.debug(
+            "osd", "pg %s active on osd.%d acting=%s missing=%d"
+            % (pg.pgid, self.whoami, pg.acting, len(pg.missing)))
+        self._kick_recovery(pg)
+        if not pg.missing:
+            self._requeue_waiters(pg)
+
+    def _activate_replica(self, pg: PG, payload: dict) -> None:
+        entries = [LogEntry.from_wire(w) for w in payload["log"]]
+        self._merge_authoritative(pg, entries,
+                                  tuple(payload["log_tail"]),
+                                  tuple(payload["info"]["last_update"]))
+        pg.state = STATE_REPLICA
+
+    # -- recovery ----------------------------------------------------------
+
+    def _kick_recovery(self, pg: PG) -> None:
+        if pg.missing:
+            # pull what the primary lacks from a peer that has it
+            src = None
+            for osd, info in pg.peer_info.items():
+                if not pg.peer_missing.get(osd):
+                    src = osd
+                    break
+            if src is None:
+                for osd in pg.acting:
+                    if osd >= 0 and osd != self.whoami:
+                        src = osd
+                        break
+            if src is not None:
+                oids = sorted(pg.missing)
+                pg.recovering.update(oids)
+                self._send_osd(src, MOSDPGPush(
+                    pool=pg.pool_id, ps=pg.ps, epoch=self.osdmap.epoch,
+                    pushes=[{"pull": True, "oids": oids}]))
+            return
+        # push to replicas missing objects
+        for osd, missing in list(pg.peer_missing.items()):
+            if not missing:
+                continue
+            pushes = []
+            for oid, op in sorted(missing.items()):
+                pushes.append(self._make_push(pg, oid, op))
+            self._send_osd(osd, MOSDPGPush(
+                pool=pg.pool_id, ps=pg.ps, epoch=self.osdmap.epoch,
+                pushes=pushes))
+
+    def _make_push(self, pg: PG, oid: str, op: str) -> dict:
+        ho = hobject_t(oid)
+        if op == LogEntry.DELETE or not self.store.exists(pg.cid, ho):
+            return {"oid": oid, "delete": True}
+        return {
+            "oid": oid,
+            "delete": False,
+            "data": self.store.read(pg.cid, ho),
+            "attrs": {k: v for k, v in
+                      self.store.getattrs(pg.cid, ho).items()},
+            "omap": self.store.omap_get(pg.cid, ho),
+        }
+
+    def _handle_pg_push(self, conn, msg: MOSDPGPush) -> None:
+        pg = self.pgs.get(pg_t(msg.pool, msg.ps))
+        if pg is None:
+            return
+        # pull request from the primary: respond with object pushes
+        if msg.pushes and msg.pushes[0].get("pull"):
+            oids = msg.pushes[0]["oids"]
+            pushes = [self._make_push(pg, oid,
+                                      pg.log.objects_since((0, 0)).get(
+                                          oid, LogEntry.MODIFY))
+                      for oid in oids]
+            conn.send(MOSDPGPush(pool=msg.pool, ps=msg.ps,
+                                 epoch=msg.epoch, pushes=pushes))
+            return
+        # real pushes: apply objects
+        t = Transaction()
+        done = []
+        for push in msg.pushes:
+            ho = hobject_t(push["oid"])
+            if push.get("delete"):
+                if self.store.exists(pg.cid, ho):
+                    t.remove(pg.cid, ho)
+            else:
+                t.remove(pg.cid, ho) if self.store.exists(pg.cid, ho) \
+                    else None
+                t.touch(pg.cid, ho)
+                t.write(pg.cid, ho, 0, len(push["data"]), push["data"])
+                for k, v in (push.get("attrs") or {}).items():
+                    t.setattr(pg.cid, ho, k, v)
+                if push.get("omap"):
+                    t.omap_setkeys(pg.cid, ho, push["omap"])
+            done.append(push["oid"])
+            pg.missing.pop(push["oid"], None)
+            pg.recovering.discard(push["oid"])
+        pg.info.last_complete = pg.info.last_update
+        pg.persist_meta(t)
+        self.store.apply_transaction(t)
+        conn.send(MOSDPGPushReply(pool=msg.pool, ps=msg.ps,
+                                  epoch=msg.epoch, oids=done))
+        if pg.is_primary() and not pg.missing:
+            # primary finished pulling: now push to replicas + serve
+            self._kick_recovery(pg)
+            self._requeue_waiters(pg)
+
+    def _handle_pg_push_reply(self, msg: MOSDPGPushReply) -> None:
+        pg = self.pgs.get(pg_t(msg.pool, msg.ps))
+        if pg is None or not pg.is_primary():
+            return
+        sender = int(msg.src.split(".")[1])
+        pm = pg.peer_missing.get(sender)
+        if pm:
+            for oid in msg.oids:
+                pm.pop(oid, None)
+
+    def _requeue_waiters(self, pg: PG) -> None:
+        waiting, pg.waiting_for_active = pg.waiting_for_active, []
+        for conn, msg in waiting:
+            self._handle_op(conn, msg)
+
+    # -- client ops --------------------------------------------------------
+
+    def _handle_op(self, conn, msg: MOSDOp) -> None:
+        if self.osdmap is None or msg.epoch > self.osdmap.epoch:
+            self._waiting_for_map.append((conn, msg))
+            return
+        pool = self.osdmap.pools.get(msg.pool)
+        if pool is None:
+            conn.send(MOSDOpReply(tid=msg.tid, result=-2, outs=[],
+                                  epoch=self.osdmap.epoch, version=0))
+            return
+        pgid = pg_t(msg.pool, msg.ps)
+        pg = self.pgs.get(pgid)
+        if pg is None or not pg.is_primary():
+            # not mine: drop — the client resends on map change
+            # (Objecter handle_osd_map -> _scan_requests)
+            return
+        if pg.state != STATE_ACTIVE:
+            pg.waiting_for_active.append((conn, msg))
+            return
+        writes = any(o["op"] in _WRITE_OPS for o in msg.ops)
+        oid = msg.oid
+        if oid in pg.missing:
+            pg.waiting_for_active.append((conn, msg))
+            self._kick_recovery(pg)
+            return
+        if writes:
+            self._execute_write(pg, conn, msg)
+        else:
+            outs, result = self._do_read_ops(pg, msg.oid, msg.ops)
+            conn.send(MOSDOpReply(tid=msg.tid, result=result,
+                                  outs=outs, epoch=self.osdmap.epoch,
+                                  version=0))
+
+    # read-side op interpreter (do_osd_ops read branch)
+    def _do_read_ops(self, pg: PG, oid: str, ops: list):
+        ho = hobject_t(oid)
+        outs = []
+        result = 0
+        for op in ops:
+            name = op["op"]
+            try:
+                if name == "read":
+                    length = op.get("length", 0) or -1
+                    data = self.store.read(pg.cid, ho,
+                                           op.get("offset", 0), length)
+                    outs.append({"data": data})
+                elif name == "stat":
+                    outs.append({"size": self.store.stat(pg.cid, ho)})
+                elif name == "getxattr":
+                    outs.append({"value": self.store.getattr(
+                        pg.cid, ho, op["name"])})
+                elif name == "omap-get":
+                    outs.append({"kv": self.store.omap_get(pg.cid, ho)})
+                else:
+                    outs.append({"error": "bad op %s" % name})
+                    result = -22
+            except NotFound:
+                outs.append({"error": "not found"})
+                result = -2
+        return outs, result
+
+    def _execute_write(self, pg: PG, conn, msg: MOSDOp) -> None:
+        """prepare_transaction + issue_repop (PrimaryLogPG.cc:8869,
+        11394)."""
+        epoch = self.osdmap.epoch
+        ver = pg.info.last_update[1] + 1
+        version = (epoch, ver)
+        ho = hobject_t(msg.oid)
+        t = Transaction()
+        outs, result = [], 0
+        is_delete = False
+        for op in msg.ops:
+            name = op["op"]
+            if name == "write":
+                data = op["data"]
+                off = op.get("offset", 0)
+                if not self.store.exists(pg.cid, ho):
+                    t.touch(pg.cid, ho)
+                t.write(pg.cid, ho, off, len(data), data)
+                outs.append({})
+            elif name == "writefull":
+                data = op["data"]
+                if self.store.exists(pg.cid, ho):
+                    t.truncate(pg.cid, ho, 0)
+                else:
+                    t.touch(pg.cid, ho)
+                t.write(pg.cid, ho, 0, len(data), data)
+                outs.append({})
+            elif name == "delete":
+                t.remove(pg.cid, ho)
+                is_delete = True
+                outs.append({})
+            elif name == "truncate":
+                t.truncate(pg.cid, ho, op["length"])
+                outs.append({})
+            elif name == "setxattr":
+                t.setattr(pg.cid, ho, op["name"], op["value"])
+                outs.append({})
+            elif name == "omap-set":
+                t.omap_setkeys(pg.cid, ho, op["kv"])
+                outs.append({})
+            elif name in _WRITE_OPS or name in ("read", "stat"):
+                outs.append({"error": "mixed rw unsupported"})
+                result = -22
+            else:
+                outs.append({"error": "bad op %s" % name})
+                result = -22
+        if result != 0:
+            conn.send(MOSDOpReply(tid=msg.tid, result=result, outs=outs,
+                                  epoch=epoch, version=0))
+            return
+        entry = LogEntry(
+            LogEntry.DELETE if is_delete else LogEntry.MODIFY,
+            msg.oid, version, pg.info.last_update)
+        pg.info.last_update = version
+        pg.log.append(entry)
+        pg.persist_log_entry(t, entry)
+        pg.persist_meta(t)
+        self._rep_tid += 1
+        rep_tid = self._rep_tid
+        waiting = set()
+        txn_wire = denc.encode(t.to_wire())
+        for osd in pg.acting:
+            if osd < 0 or osd == self.whoami:
+                continue
+            waiting.add(osd)
+            self._send_osd(osd, MOSDRepOp(
+                pool=pg.pool_id, ps=pg.ps, tid=rep_tid, txn=txn_wire,
+                log_entry=entry.to_wire(), epoch=epoch,
+                min_epoch=pg.info.same_interval_since,
+                pg_trim_to=None))
+        self.store.apply_transaction(t)
+        if not waiting:
+            conn.send(MOSDOpReply(tid=msg.tid, result=0, outs=outs,
+                                  epoch=epoch, version=ver))
+            return
+        pg.in_flight[rep_tid] = {
+            "waiting": waiting, "conn": conn, "tid": msg.tid,
+            "outs": outs, "version": ver,
+        }
+
+    def _handle_repop(self, conn, msg: MOSDRepOp) -> None:
+        """Replica apply (ReplicatedBackend handle_message sub_op)."""
+        pgid = pg_t(msg.pool, msg.ps)
+        pg = self.pgs.get(pgid)
+        if pg is None:
+            pg = PG(self, msg.pool, msg.ps)
+            pg.create_onstore()
+            self.pgs[pgid] = pg
+        t = Transaction.from_wire(denc.decode(msg.txn))
+        entry = LogEntry.from_wire(msg.log_entry)
+        pg.log.append(entry)
+        pg.info.last_update = entry.version
+        self.store.apply_transaction(t)
+        conn.send(MOSDRepOpReply(pool=msg.pool, ps=msg.ps, tid=msg.tid,
+                                 result=0, epoch=msg.epoch))
+
+    def _handle_repop_reply(self, msg: MOSDRepOpReply) -> None:
+        pg = self.pgs.get(pg_t(msg.pool, msg.ps))
+        if pg is None:
+            return
+        st = pg.in_flight.get(msg.tid)
+        if st is None:
+            return
+        sender = int(msg.src.split(".")[1])
+        st["waiting"].discard(sender)
+        if not st["waiting"]:
+            del pg.in_flight[msg.tid]
+            st["conn"].send(MOSDOpReply(
+                tid=st["tid"], result=0, outs=st["outs"],
+                epoch=self.osdmap.epoch, version=st["version"]))
+
+    # -- heartbeats --------------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        conf = self.ctx.conf
+        while not self.stopping:
+            await asyncio.sleep(conf["heartbeat_interval"])
+            if self.osdmap is None or not self.booted:
+                continue
+            now = time.monotonic()
+            grace = conf["heartbeat_grace"]
+            # prune state for peers the map says are down, so a later
+            # reboot starts with a fresh window instead of a stale
+            # stamp that would instantly re-report it failed
+            for osd in list(self.hb_last_rx):
+                if osd >= self.osdmap.max_osd \
+                        or not self.osdmap.is_up(osd):
+                    del self.hb_last_rx[osd]
+            for osd in range(self.osdmap.max_osd):
+                if osd == self.whoami or not self.osdmap.is_up(osd):
+                    continue
+                addr = self.osdmap.osd_addrs.get(osd)
+                if not addr:
+                    continue
+                self.msgr.send_to(addr, MOSDPing(
+                    osd=self.whoami, op="ping", stamp=now,
+                    epoch=self.osdmap.epoch),
+                    entity_hint="osd.%d" % osd)
+                last = self.hb_last_rx.get(osd)
+                if last is None:
+                    self.hb_last_rx[osd] = now
+                elif now - last > grace:
+                    self.msgr.send_to(self.mon_addr, MOSDFailure(
+                        target=osd, failed_for=now - last,
+                        epoch=self.osdmap.epoch), entity_hint="mon.0")
+
+    def _handle_ping(self, conn, msg: MOSDPing) -> None:
+        if msg.op == "ping":
+            conn.send(MOSDPing(osd=self.whoami, op="reply",
+                               stamp=msg.stamp,
+                               epoch=self.osdmap.epoch
+                               if self.osdmap else 0))
+        else:
+            self.hb_last_rx[msg.osd] = time.monotonic()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _send_osd(self, osd: int, msg) -> None:
+        addr = self.osdmap.osd_addrs.get(osd)
+        if addr:
+            self.msgr.send_to(addr, msg, entity_hint="osd.%d" % osd)
+
+
+_WRITE_OPS = {"write", "writefull", "delete", "truncate", "setxattr",
+              "omap-set"}

@@ -162,6 +162,8 @@ class OSDMap:
         self.pg_upmap_items: dict[pg_t, list[tuple[int, int]]] = {}
         self.pg_upmap_primaries: dict[pg_t, int] = {}
         self.blocklist: dict[str, float] = {}
+        # name -> profile kv (OSDMap::erasure_code_profiles)
+        self.erasure_code_profiles: dict[str, dict] = {}
         self._mapper: Mapper | None = None
         self._dmapper = None  # lazily-built DeviceMapper, same lifetime
 
@@ -425,6 +427,10 @@ class OSDMap:
                 self.pg_upmap_items.pop(pg, None)
         for pg in inc.old_pg_upmap_items:
             self.pg_upmap_items.pop(pg, None)
+        for name, prof in inc.new_erasure_code_profiles.items():
+            self.erasure_code_profiles[name] = dict(prof)
+        for name in inc.old_erasure_code_profiles:
+            self.erasure_code_profiles.pop(name, None)
         if inc.new_crush is not None:
             self.crush = inc.new_crush
             self._mapper = None
@@ -457,6 +463,9 @@ class OSDMap:
                 for pg, items in self.pg_upmap_items.items()],
             "pg_upmap_primaries": _enc_pg_map(self.pg_upmap_primaries),
             "blocklist": dict(self.blocklist),
+            "erasure_code_profiles": {
+                k: dict(v)
+                for k, v in self.erasure_code_profiles.items()},
         }
 
     @classmethod
@@ -483,6 +492,9 @@ class OSDMap:
             for p, ps, items in d["pg_upmap_items"]}
         m.pg_upmap_primaries = _dec_pg_map(d["pg_upmap_primaries"], int)
         m.blocklist = dict(d["blocklist"])
+        m.erasure_code_profiles = {
+            k: dict(v)
+            for k, v in d.get("erasure_code_profiles", {}).items()}
         return m
 
     def encode(self) -> bytes:
@@ -495,6 +507,26 @@ class OSDMap:
         from ..utils import denc
 
         return cls.from_dict(denc.decode(data))
+
+
+def consume_map_payload(cur: "OSDMap", full: bytes | None,
+                        incrementals: list | None
+                        ) -> tuple["OSDMap", bool]:
+    """Shared subscriber-side map consumption (Objecter::handle_osd_map
+    / OSD::handle_osd_map): adopt a newer full map, then apply every
+    contiguous incremental.  Returns (map, changed)."""
+    changed = False
+    if full is not None:
+        m = OSDMap.decode(full)
+        if m.epoch > cur.epoch:
+            cur = m
+            changed = True
+    for raw in incrementals or []:
+        inc = Incremental.decode(raw)
+        if inc.epoch == cur.epoch + 1:
+            cur.apply_incremental(inc)
+            changed = True
+    return cur, changed
 
 
 def _enc_pg_map(d: dict) -> list:
@@ -529,6 +561,9 @@ class Incremental:
         field(default_factory=dict))
     old_pg_upmap_items: list[pg_t] = field(default_factory=list)
     new_crush: CrushMap | None = None
+    new_erasure_code_profiles: dict[str, dict] = field(
+        default_factory=dict)
+    old_erasure_code_profiles: list[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -554,6 +589,11 @@ class Incremental:
                                    for pg in self.old_pg_upmap_items],
             "new_crush": (self.new_crush.to_dict()
                           if self.new_crush is not None else None),
+            "new_erasure_code_profiles": {
+                k: dict(v)
+                for k, v in self.new_erasure_code_profiles.items()},
+            "old_erasure_code_profiles": list(
+                self.old_erasure_code_profiles),
         }
 
     @classmethod
@@ -580,6 +620,11 @@ class Incremental:
                                   for p, ps in d["old_pg_upmap_items"]]
         inc.new_crush = (CrushMap.from_dict(d["new_crush"])
                          if d["new_crush"] is not None else None)
+        inc.new_erasure_code_profiles = {
+            k: dict(v)
+            for k, v in d.get("new_erasure_code_profiles", {}).items()}
+        inc.old_erasure_code_profiles = list(
+            d.get("old_erasure_code_profiles", []))
         return inc
 
     def encode(self) -> bytes:

@@ -1,0 +1,196 @@
+"""PG: placement group state, log, and peering for the replicated path.
+
+Condensed re-derivation of the reference's per-PG machinery:
+
+* PGLog (src/osd/PGLog.h): an ordered list of versioned entries
+  (eversion = (epoch, ver)) used for delta recovery — a peer whose
+  last_update is inside our log tail recovers only the objects named
+  by the newer entries; one that diverged or fell behind the tail gets
+  backfill (full object set).
+* PeeringState (src/osd/PeeringState.h:587): the full boost::statechart
+  is collapsed to the GetInfo -> GetLog -> Active path a fresh primary
+  walks: query every acting peer's info+log (MOSDPGQuery/MOSDPGLog),
+  pick the authoritative log (highest last_update — the reference's
+  find_best_info), merge it, compute per-peer missing sets, activate,
+  then recover by pushing whole objects (MOSDPGPush) — the
+  log-based-recovery flow of doc/dev/osd_internals/log_based_pg.rst.
+* Op execution (PrimaryLogPG::do_osd_ops, PrimaryLogPG.cc:5969): the
+  opcode interpreter over the object store, here a name-keyed dict of
+  handlers producing one ObjectStore Transaction per client op.
+
+Durability: the log + info persist in the pgmeta object's omap
+(coll_t pgmeta, like PG::prepare_write_info) within the same
+transaction as the data mutation, so a restarted OSD replays exact
+state.
+"""
+
+from __future__ import annotations
+
+from ..store.objectstore import Transaction, coll_t, hobject_t
+from ..utils import denc
+
+PGMETA_OID = hobject_t("__pgmeta__")
+
+
+def ev_key(ev: tuple[int, int]) -> bytes:
+    return b"%010d.%010d" % tuple(ev)
+
+
+class LogEntry:
+    """One pg-log record (pg_log_entry_t)."""
+
+    __slots__ = ("op", "oid", "version", "prior_version")
+
+    MODIFY = "modify"
+    DELETE = "delete"
+
+    def __init__(self, op: str, oid: str, version: tuple[int, int],
+                 prior_version: tuple[int, int]):
+        self.op = op
+        self.oid = oid
+        self.version = tuple(version)
+        self.prior_version = tuple(prior_version)
+
+    def to_wire(self) -> list:
+        return [self.op, self.oid, list(self.version),
+                list(self.prior_version)]
+
+    @classmethod
+    def from_wire(cls, w: list) -> "LogEntry":
+        return cls(w[0], w[1], (w[2][0], w[2][1]), (w[3][0], w[3][1]))
+
+
+class PGLog:
+    """Bounded, ordered op log (src/osd/PGLog.h)."""
+
+    def __init__(self):
+        self.entries: list[LogEntry] = []
+        self.tail: tuple[int, int] = (0, 0)  # versions <= tail trimmed
+
+    @property
+    def head(self) -> tuple[int, int]:
+        return self.entries[-1].version if self.entries else self.tail
+
+    def append(self, e: LogEntry) -> None:
+        self.entries.append(e)
+
+    def trim(self, to: tuple[int, int]) -> list[LogEntry]:
+        """Drop entries <= to; returns them for omap cleanup."""
+        dropped = [e for e in self.entries if e.version <= to]
+        if dropped:
+            self.entries = [e for e in self.entries if e.version > to]
+            self.tail = max(self.tail, dropped[-1].version)
+        return dropped
+
+    def objects_since(self, since: tuple[int, int]) -> dict[str, str]:
+        """oid -> final op for entries newer than `since` (the missing
+        set a peer at `since` must recover)."""
+        out: dict[str, str] = {}
+        for e in self.entries:
+            if e.version > since:
+                out[e.oid] = e.op
+        return out
+
+
+class PGInfo:
+    """pg_info_t subset: identity + log bounds."""
+
+    def __init__(self, pool: int, ps: int):
+        self.pool = pool
+        self.ps = ps
+        self.last_update: tuple[int, int] = (0, 0)
+        self.last_complete: tuple[int, int] = (0, 0)
+        self.log_tail: tuple[int, int] = (0, 0)
+        self.same_interval_since = 0
+
+    def to_wire(self) -> dict:
+        return {"pool": self.pool, "ps": self.ps,
+                "last_update": list(self.last_update),
+                "last_complete": list(self.last_complete),
+                "log_tail": list(self.log_tail),
+                "same_interval_since": self.same_interval_since}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PGInfo":
+        info = cls(d["pool"], d["ps"])
+        info.last_update = tuple(d["last_update"])
+        info.last_complete = tuple(d["last_complete"])
+        info.log_tail = tuple(d["log_tail"])
+        info.same_interval_since = d["same_interval_since"]
+        return info
+
+
+# PG lifecycle states (PeeringState.h state names, flattened)
+STATE_INITIAL = "initial"
+STATE_PEERING = "peering"
+STATE_ACTIVE = "active"
+STATE_REPLICA = "replica"  # ReplicaActive / Stray
+
+
+class PG:
+    """One placement group on one OSD."""
+
+    def __init__(self, osd, pool_id: int, ps: int):
+        self.osd = osd                      # owning daemon
+        self.pool_id = pool_id
+        self.ps = ps
+        self.cid = coll_t.pg(pool_id, ps)
+        self.info = PGInfo(pool_id, ps)
+        self.log = PGLog()
+        self.state = STATE_INITIAL
+        self.up: list[int] = []
+        self.acting: list[int] = []
+        self.primary = -1
+        self.missing: dict[str, str] = {}       # oid -> op to recover
+        self.peer_missing: dict[int, dict[str, str]] = {}
+        self.peer_info: dict[int, PGInfo] = {}
+        self.waiting_for_active: list = []      # queued ops
+        self.waiting_for_peers: dict[int, dict] = {}   # peering round
+        self.recovering: set[str] = set()
+        self.in_flight: dict[int, dict] = {}    # repop tid -> state
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def pgid(self) -> str:
+        return "%d.%x" % (self.pool_id, self.ps)
+
+    def is_primary(self) -> bool:
+        return self.primary == self.osd.whoami
+
+    # -- durable state -----------------------------------------------------
+
+    def persist_meta(self, t: Transaction) -> None:
+        t.omap_setkeys(self.cid, PGMETA_OID, {
+            b"info": denc.encode(self.info.to_wire()),
+        })
+
+    def persist_log_entry(self, t: Transaction, e: LogEntry) -> None:
+        t.omap_setkeys(self.cid, PGMETA_OID, {
+            b"log." + ev_key(e.version): denc.encode(e.to_wire()),
+        })
+
+    def load(self) -> bool:
+        """Restore info+log from the pgmeta omap; False if absent."""
+        store = self.osd.store
+        try:
+            data = store.omap_get(self.cid, PGMETA_OID)
+        except Exception:
+            return False
+        if b"info" not in data:
+            return False
+        self.info = PGInfo.from_wire(denc.decode(data[b"info"]))
+        entries = []
+        for k, v in sorted(data.items()):
+            if k.startswith(b"log."):
+                entries.append(LogEntry.from_wire(denc.decode(v)))
+        self.log.entries = entries
+        self.log.tail = self.info.log_tail
+        return True
+
+    def create_onstore(self) -> None:
+        t = Transaction()
+        t.create_collection(self.cid)
+        t.touch(self.cid, PGMETA_OID)
+        self.persist_meta(t)
+        self.osd.store.apply_transaction(t)

@@ -140,6 +140,41 @@ class Transaction:
     def append(self, other: "Transaction") -> None:
         self.ops.extend(other.ops)
 
+    # -- wire form (Transaction::encode/decode analog) ---------------------
+
+    def to_wire(self) -> list:
+        """denc-encodable op list: coll_t -> name str, hobject_t ->
+        [name, pool, nspace, key, snap] list; other args pass through."""
+        out = []
+        for op in self.ops:
+            row = []
+            for a in op:
+                if isinstance(a, coll_t):
+                    row.append(("C", a.name))
+                elif isinstance(a, hobject_t):
+                    row.append(("H", a.name, a.pool, a.nspace, a.key,
+                                a.snap))
+                else:
+                    row.append(a)
+            out.append(row)
+        return out
+
+    @classmethod
+    def from_wire(cls, rows: list) -> "Transaction":
+        t = cls()
+        for row in rows:
+            op = []
+            for a in row:
+                if isinstance(a, tuple) and a and a[0] == "C":
+                    op.append(coll_t(a[1]))
+                elif isinstance(a, tuple) and a and a[0] == "H":
+                    op.append(hobject_t(a[1], pool=a[2], nspace=a[3],
+                                        key=a[4], snap=a[5]))
+                else:
+                    op.append(a)
+            t.ops.append(tuple(op))
+        return t
+
     # -- object data -------------------------------------------------------
 
     def nop(self):

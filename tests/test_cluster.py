@@ -1,0 +1,301 @@
+"""End-to-end cluster tests: mon + OSDs + client in one event loop.
+
+The framework's fake-cluster tier (SURVEY §4.2/§4.3): real daemons and
+real wire protocol over loopback TCP, in-process for determinism —
+the moral equivalent of qa/standalone/ceph-helpers.sh run_mon/run_osd
+plus librados_test_stub's in-process convenience.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import ObjectNotFound, RadosClient
+from ceph_tpu.mon import Monitor
+from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.utils.context import Context
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+FAST_CONF = {
+    "heartbeat_interval": 0.1,
+    "heartbeat_grace": 0.6,
+    "mon_osd_down_out_interval": 1.0,
+    "mon_osd_min_down_reporters": 1,
+    "osd_pool_default_pg_num": 8,
+}
+
+
+class Cluster:
+    """Test harness: one mon + n OSDs (vstart.sh analog)."""
+
+    def __init__(self, n_osds=3):
+        self.n_osds = n_osds
+        self.mon = None
+        self.osds = []
+        self.client = None
+
+    async def start(self):
+        self.mon = Monitor(Context("mon", conf_overrides=FAST_CONF))
+        await self.mon.start()
+        for i in range(self.n_osds):
+            osd = OSD(i, self.mon.addr,
+                      Context("osd.%d" % i, conf_overrides=FAST_CONF))
+            await osd.start()
+            self.osds.append(osd)
+        for osd in self.osds:
+            await osd.wait_for_boot()
+        self.client = RadosClient(self.mon.addr)
+        await self.client.connect()
+        return self
+
+    async def stop(self):
+        if self.client:
+            await self.client.shutdown()
+        for osd in self.osds:
+            if not osd.stopping:
+                await osd.shutdown()
+        await self.mon.shutdown()
+
+    async def kill_osd(self, i):
+        await self.osds[i].shutdown()
+
+    async def wait_health(self, pool_id, timeout=20):
+        """Wait until every PG of the pool is active and clean on the
+        current primaries."""
+        t0 = asyncio.get_running_loop().time()
+        while True:
+            if self._healthy(pool_id):
+                return
+            if asyncio.get_running_loop().time() - t0 > timeout:
+                raise TimeoutError("pool %d never went clean" % pool_id)
+            await asyncio.sleep(0.05)
+
+    def _healthy(self, pool_id):
+        from ceph_tpu.osd.osdmap import pg_t
+        from ceph_tpu.osd.pg import STATE_ACTIVE
+
+        m = None
+        for osd in self.osds:
+            if not osd.stopping and osd.osdmap is not None:
+                if m is None or osd.osdmap.epoch > m.epoch:
+                    m = osd.osdmap
+        if m is None or pool_id not in m.pools:
+            return False
+        pool = m.pools[pool_id]
+        alive = {o.whoami: o for o in self.osds if not o.stopping}
+        for ps in range(pool.pg_num):
+            up, upp, acting, actingp = m.pg_to_up_acting_osds(
+                pg_t(pool_id, ps))
+            if actingp < 0 or actingp not in alive:
+                return False
+            prim = alive[actingp]
+            if prim.osdmap is None or prim.osdmap.epoch != m.epoch:
+                return False
+            pg = prim.pgs.get(pg_t(pool_id, ps))
+            if pg is None or pg.state != STATE_ACTIVE:
+                return False
+            if pg.missing or any(pm for pm in pg.peer_missing.values()):
+                return False
+        return True
+
+
+def test_cluster_boot_and_pool_create():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            status = await c.client.mon_command("status")
+            assert status["num_osds"] == 3
+            assert status["num_up_osds"] == 3
+            out = await c.client.mon_command(
+                "osd pool create", pool="rbd", pg_num=8, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_put_get_roundtrip():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="data", pg_num=8, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            payloads = {}
+            for i in range(20):
+                oid = "obj-%d" % i
+                data = bytes([i % 256]) * (100 + i * 37)
+                payloads[oid] = data
+                await io.write_full(oid, data)
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+                assert await io.stat(oid) == len(data)
+            # omap + xattr round trip
+            await io.omap_set("obj-0", {b"k1": b"v1", b"k2": b"v2"})
+            kv = await io.omap_get("obj-0")
+            assert kv == {b"k1": b"v1", b"k2": b"v2"}
+            # delete
+            await io.remove("obj-1")
+            with pytest.raises(ObjectNotFound):
+                await io.read("obj-1")
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_replication_on_all_acting():
+    """Every acting osd holds every object replica after writes."""
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="data", pg_num=8, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            await io.write_full("x", b"payload")
+            await asyncio.sleep(0.2)  # let replica acks land
+            from ceph_tpu.store.objectstore import coll_t, hobject_t
+
+            pool = c.client.osdmap.pools[pid]
+            pgid = pool.raw_pg_to_pg(
+                c.client.osdmap.object_locator_to_pg("x", pid))
+            up, upp, acting, actingp = \
+                c.client.osdmap.pg_to_up_acting_osds(pgid)
+            assert len(acting) == 3
+            for osd_id in acting:
+                store = c.osds[osd_id].store
+                data = store.read(coll_t.pg(pid, pgid.ps),
+                                  hobject_t("x"))
+                assert data == b"payload", "osd.%d missing" % osd_id
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_kill_osd_degraded_get_then_recover():
+    """SURVEY §7 acceptance core: kill an osd, degraded get works, the
+    cluster remaps + recovers, and bytes survive re-replication."""
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="data", pg_num=8, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            payloads = {}
+            for i in range(12):
+                oid = "k-%d" % i
+                data = ("value-%d" % i).encode() * 50
+                payloads[oid] = data
+                await io.write_full(oid, data)
+
+            victim = 2
+            await c.kill_osd(victim)
+            # heartbeats detect the failure; mon marks it down
+            epoch0 = c.client.osdmap.epoch
+            t0 = asyncio.get_running_loop().time()
+            while c.client.osdmap.is_up(victim):
+                assert asyncio.get_running_loop().time() - t0 < 30, \
+                    "mon never marked osd.%d down" % victim
+                await asyncio.sleep(0.05)
+            assert c.client.osdmap.epoch > epoch0
+
+            # degraded reads: remaining replicas serve everything
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+
+            # degraded write still works
+            await io.write_full("post-kill", b"written degraded")
+
+            # auto-out fires -> remap -> recovery to the survivors
+            t0 = asyncio.get_running_loop().time()
+            while c.client.osdmap.is_in(victim):
+                assert asyncio.get_running_loop().time() - t0 < 30, \
+                    "mon never marked osd.%d out" % victim
+                await asyncio.sleep(0.05)
+            await c.wait_health(pid, timeout=30)
+
+            # all objects fully re-replicated on both survivors
+            from ceph_tpu.osd.osdmap import pg_t as PgT
+            from ceph_tpu.store.objectstore import coll_t, hobject_t
+
+            m = c.client.osdmap
+            for oid, data in list(payloads.items()) + [
+                    ("post-kill", b"written degraded")]:
+                assert await io.read(oid) == data
+                pgid = m.pools[pid].raw_pg_to_pg(
+                    m.object_locator_to_pg(oid, pid))
+                up, upp, acting, actingp = m.pg_to_up_acting_osds(pgid)
+                assert victim not in acting
+                for osd_id in acting:
+                    store = c.osds[osd_id].store
+                    got = store.read(coll_t.pg(pid, pgid.ps),
+                                     hobject_t(oid))
+                    assert got == data, \
+                        "osd.%d stale for %s" % (osd_id, oid)
+        finally:
+            await c.stop()
+
+    run(main(), timeout=120)
+
+
+def test_osd_restart_rejoins_and_backfills():
+    """A rebooted osd (fresh messenger nonce, same store) rejoins and
+    reconverges."""
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="data", pg_num=8, size=2)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            for i in range(8):
+                await io.write_full("r-%d" % i, b"x" * (50 + i))
+
+            victim = 1
+            store = c.osds[victim].store  # keep the "disk"
+            await c.kill_osd(victim)
+            t0 = asyncio.get_running_loop().time()
+            while c.client.osdmap.is_up(victim):
+                assert asyncio.get_running_loop().time() - t0 < 30
+                await asyncio.sleep(0.05)
+
+            # write while it is down (its copy goes stale)
+            await io.write_full("while-down", b"fresh data")
+
+            # restart on the same store
+            osd = OSD(victim, c.mon.addr,
+                      Context("osd.%d" % victim,
+                              conf_overrides=FAST_CONF), store=store)
+            await osd.start()
+            await osd.wait_for_boot()
+            c.osds[victim] = osd
+            await c.wait_health(pid, timeout=30)
+            for i in range(8):
+                assert await io.read("r-%d" % i) == b"x" * (50 + i)
+            assert await io.read("while-down") == b"fresh data"
+        finally:
+            await c.stop()
+
+    run(main(), timeout=120)

@@ -1,0 +1,115 @@
+"""Monitor + paxos unit tests (no cluster; store-level)."""
+
+import asyncio
+
+from ceph_tpu.mon import Monitor, Paxos
+from ceph_tpu.mon.paxos import _k, _kv
+from ceph_tpu.store.kv import MemKV
+from ceph_tpu.utils import denc
+from ceph_tpu.utils.context import Context
+
+
+def test_paxos_log_roundtrip():
+    store = MemKV()
+    store.open()
+    p = Paxos(store)
+    v1 = p.propose(b"blob-1")
+    v2 = p.propose(b"blob-2")
+    assert (v1, v2) == (1, 2)
+    assert p.get_version(1) == b"blob-1"
+    assert p.get_version(2) == b"blob-2"
+    # a fresh instance on the same store resumes
+    p2 = Paxos(store)
+    assert p2.last_committed == 2
+    assert p2.accepted_pn == p.accepted_pn
+
+
+def test_paxos_recover_pending():
+    """A crash after phase-2 (pending persisted) but before phase-3
+    re-commits on recovery."""
+    store = MemKV()
+    store.open()
+    p = Paxos(store)
+    p.propose(b"committed")
+    # simulate the crash: phase-2 state only for version 2
+    tx = store.get_transaction()
+    tx.set(_k("pending_v"), denc.encode(2))
+    tx.set(_k("pending_pn"), denc.encode(p.accepted_pn + 100))
+    tx.set(_kv(2), b"in-flight")
+    store.submit_transaction(tx)
+
+    p2 = Paxos(store)
+    seen = []
+    p2.on_commit.append(lambda v, b: seen.append((v, b)))
+    p2.recover()
+    assert p2.last_committed == 2
+    assert seen == [(2, b"in-flight")]
+    assert store.get(_k("pending_v")) is None
+
+
+def test_paxos_trim():
+    store = MemKV()
+    store.open()
+    p = Paxos(store)
+    for i in range(30):
+        p.propose(b"b%d" % i)
+    p.trim(keep=10)
+    assert p.first_committed == 20
+    assert p.get_version(5) is None
+    assert p.get_version(25) == b"b24"  # version i+1 holds blob b{i}
+
+
+def test_monitor_restart_resumes_epoch():
+    async def main():
+        store = MemKV()
+        mon = Monitor(Context("mon"), store=store)
+        await mon.start()
+        # drive a few epochs without any osd: pool create via command
+        inc = mon._pending()
+        inc.new_max_osd = 4
+        mon._propose_pending()
+        epoch = mon.osdmap.epoch
+        assert epoch >= 1
+        await mon.shutdown()
+
+        mon2 = Monitor(Context("mon"), store=store)
+        assert mon2.osdmap.epoch == epoch
+        assert mon2.osdmap.max_osd == 4
+        assert mon2.paxos.last_committed >= 1
+        await mon2.msgr.shutdown()
+        mon2.store.close()
+
+    asyncio.run(asyncio.wait_for(main(), 20))
+
+
+def test_monitor_crash_between_commit_and_apply():
+    """Paxos committed a map change the full map never reflected: the
+    on_commit recovery hook replays it."""
+
+    async def main():
+        store = MemKV()
+        mon = Monitor(Context("mon"), store=store)
+        inc = mon._pending()
+        inc.new_max_osd = 2
+        mon._propose_pending()
+        epoch = mon.osdmap.epoch
+
+        # craft the next incremental directly into the paxos log but
+        # "crash" before map apply/persist (bypass the monitor)
+        inc2 = mon.osdmap.new_incremental()
+        inc2.new_max_osd = 7
+        blob = denc.encode({"osdmap_inc": inc2.to_dict()})
+        tx = store.get_transaction()
+        tx.set(_k("pending_v"), denc.encode(mon.paxos.last_committed + 1))
+        tx.set(_k("pending_pn"), denc.encode(mon.paxos.accepted_pn + 100))
+        tx.set(_kv(mon.paxos.last_committed + 1), blob)
+        store.submit_transaction(tx)
+        await mon.msgr.shutdown()
+
+        mon2 = Monitor(Context("mon"), store=store)
+        assert mon2.osdmap.epoch == epoch + 1
+        assert mon2.osdmap.max_osd == 7
+        await mon2.msgr.shutdown()
+        mon2.store.close()
+
+    asyncio.run(asyncio.wait_for(main(), 20))
