@@ -33,10 +33,13 @@ import time
 
 from ..msg import Messenger, Policy
 from ..msg.messages import (MMonSubscribe, MOSDAlive, MOSDBoot,
+                            MOSDECSubOpRead, MOSDECSubOpReadReply,
+                            MOSDECSubOpWrite, MOSDECSubOpWriteReply,
                             MOSDFailure, MOSDMapMsg, MOSDOp,
                             MOSDOpReply, MOSDPGLog, MOSDPGPush,
                             MOSDPGPushReply, MOSDPGQuery, MOSDPing,
                             MOSDRepOp, MOSDRepOpReply)
+from ..models.crushmap import ITEM_NONE
 from ..store.memstore import MemStore
 from ..store.objectstore import (NotFound, ObjectStore, Transaction,
                                  coll_t, hobject_t)
@@ -58,6 +61,9 @@ class OSD:
         self.msgr = Messenger("osd.%d" % whoami)
         self.msgr.peer_policy["osd"] = Policy.lossless_peer()
         self.msgr.add_dispatcher(self)
+        from .ecbackend import ECPGBackend
+
+        self.ec = ECPGBackend(self)
         # epoch-0 empty map is the universal incremental base
         self.osdmap: OSDMap = OSDMap()
         self.pgs: dict[pg_t, PG] = {}
@@ -132,6 +138,14 @@ class OSD:
             self._handle_pg_push_reply(msg)
         elif isinstance(msg, MOSDPing):
             self._handle_ping(conn, msg)
+        elif isinstance(msg, MOSDECSubOpWrite):
+            self.ec.handle_sub_write(conn, msg)
+        elif isinstance(msg, MOSDECSubOpWriteReply):
+            self.ec.handle_sub_write_reply(msg)
+        elif isinstance(msg, MOSDECSubOpRead):
+            self.ec.handle_sub_read(conn, msg)
+        elif isinstance(msg, MOSDECSubOpReadReply):
+            self.ec.handle_sub_read_reply(msg)
         else:
             return False
         return True
@@ -208,6 +222,12 @@ class OSD:
             return
         pg.info.same_interval_since = self.osdmap.epoch
         pg.in_flight.clear()
+        pool = self.osdmap.pools.get(pg.pool_id)
+        if pool is not None and pool.is_erasure():
+            # a reshuffled acting set can leave this osd holding bytes
+            # for a position it no longer has: mark them missing
+            for oid, op in self.ec.scan_stale_shards(pg).items():
+                pg.missing.setdefault(oid, op)
         if pg.is_primary():
             self._start_peering(pg)
         else:
@@ -219,7 +239,8 @@ class OSD:
         pg.state = STATE_PEERING
         pg.peer_info.clear()
         pg.waiting_for_peers = {}
-        peers = [o for o in pg.acting if o >= 0 and o != self.whoami]
+        peers = [o for o in pg.acting
+                 if 0 <= o != self.whoami and o != ITEM_NONE]
         if not peers:
             self._finish_peering(pg)
             return
@@ -246,6 +267,8 @@ class OSD:
             "info": pg.info.to_wire(),
             "log": [e.to_wire() for e in pg.log.entries],
             "log_tail": list(pg.log.tail),
+            # objects this osd knows it lacks (e.g. stale EC shards)
+            "missing": {oid: op for oid, op in pg.missing.items()},
         }
 
     def _handle_pg_log(self, conn, msg: MOSDPGLog) -> None:
@@ -285,16 +308,23 @@ class OSD:
         for osd, payload in pg.waiting_for_peers.items():
             info = PGInfo.from_wire(payload["info"])
             pg.peer_info[osd] = info
-            pg.peer_missing[osd] = pg.log.objects_since(
-                info.last_update)
+            missing = pg.log.objects_since(info.last_update)
+            missing.update(payload.get("missing") or {})
+            pg.peer_missing[osd] = missing
         self._finish_peering(pg)
 
     def _merge_authoritative(self, pg: PG, entries: list[LogEntry],
                              tail, last_update) -> None:
         """Adopt a peer's newer log; what we lack becomes our missing
-        set (PGLog::merge_log)."""
+        set (PGLog::merge_log).  The set is recomputed from scratch —
+        except stale-EC-shard entries from this interval's scan, which
+        the log cannot see (replicated pools carry none, so for them
+        this is a plain reset that discards leftovers from previous
+        intervals)."""
+        pool = self.osdmap.pools.get(pg.pool_id)
+        if pool is None or not pool.is_erasure():
+            pg.missing = {}
         mine = pg.info.last_update
-        pg.missing = {}
         for e in entries:
             if e.version > mine:
                 pg.missing[e.oid] = e.op
@@ -311,7 +341,7 @@ class OSD:
         pg.state = STATE_ACTIVE
         # activate replicas with the authoritative log
         for osd in pg.acting:
-            if osd >= 0 and osd != self.whoami:
+            if 0 <= osd != self.whoami and osd != ITEM_NONE:
                 self._send_osd(osd, MOSDPGLog(
                     pool=pg.pool_id, ps=pg.ps, epoch=self.osdmap.epoch,
                     info=self._pack_log(pg, activate=True)))
@@ -332,6 +362,10 @@ class OSD:
     # -- recovery ----------------------------------------------------------
 
     def _kick_recovery(self, pg: PG) -> None:
+        pool = self.osdmap.pools.get(pg.pool_id)
+        if pool is not None and pool.is_erasure():
+            self.msgr.spawn(self._ec_recover(pg))
+            return
         if pg.missing:
             # pull what the primary lacks from a peer that has it
             src = None
@@ -341,7 +375,7 @@ class OSD:
                     break
             if src is None:
                 for osd in pg.acting:
-                    if osd >= 0 and osd != self.whoami:
+                    if 0 <= osd != self.whoami and osd != ITEM_NONE:
                         src = osd
                         break
             if src is not None:
@@ -361,6 +395,16 @@ class OSD:
             self._send_osd(osd, MOSDPGPush(
                 pool=pg.pool_id, ps=pg.ps, epoch=self.osdmap.epoch,
                 pushes=pushes))
+
+    async def _ec_recover(self, pg: PG) -> None:
+        """EC recovery: reconstruct (never copy) shards
+        (ECBackend::continue_recovery_op)."""
+        await self.ec.recover_primary_shards(pg)
+        for osd_id, missing in list(pg.peer_missing.items()):
+            if missing:
+                await self.ec.recover_peer_shards(pg, osd_id, missing)
+        if not pg.missing:
+            self._requeue_waiters(pg)
 
     def _make_push(self, pg: PG, oid: str, op: str) -> dict:
         ho = hobject_t(oid)
@@ -453,6 +497,9 @@ class OSD:
             return
         if pg.state != STATE_ACTIVE:
             pg.waiting_for_active.append((conn, msg))
+            return
+        if pool.is_erasure():
+            self.msgr.spawn(self.ec.handle_op(pg, conn, msg))
             return
         writes = any(o["op"] in _WRITE_OPS for o in msg.ops)
         oid = msg.oid

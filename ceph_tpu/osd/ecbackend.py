@@ -1,0 +1,612 @@
+"""EC backend: erasure-coded PG I/O over positional shards.
+
+Condensed analog of src/osd/ECBackend.cc + ECUtil.{h,cc}: an EC pool's
+PG stores each object as k+m shards, one per acting-set position —
+acting[j] holds shard j (shard_id_t).  The primary:
+
+* write  — encodes the object payload through the ErasureCodeInterface
+  plugin (ECUtil::encode -> encode_chunks), persists its own shard, and
+  sends each remote shard its transaction via MOSDECSubOpWrite
+  (ECBackend::submit_transaction -> handle_sub_write,
+  ECBackend.cc:1539,945); partial-extent writes are read-modify-write
+  through the reconstruct path (start_rmw, ECBackend.cc:1898).
+* read   — fetches the minimum shard set first (local + enough remotes
+  for k distinct shards) and widens to every member on shortfall
+  (objects_read_and_reconstruct + minimum_to_decode,
+  ECBackend.cc:2405).  Sourcing is by *stored* shard, not acting
+  position: any k distinct shards decode, so a member whose bytes
+  belong to a previous layout still serves as a reconstruction source —
+  availability the reference keeps via pg_temp + backfill.
+* recover— rebuilds exactly the TARGET's shard from k survivors and
+  pushes it (continue_recovery_op, ECBackend.cc:591): unlike the
+  replicated backend, a pushed EC object is the recipient's shard, not
+  a copy of the pusher's.
+
+Shard metadata xattrs (the role ECUtil::HashInfo plays):
+  ec_size  — true (unpadded) object length;
+  ec_shard — which shard index these bytes encode (the shard_id_t the
+             reference bakes into hobject_t);
+  ec_ver   — the pg-log version that produced the bytes, so readers
+             never mix shards from different writes (a member that
+             missed a write is simply not a source until recovered).
+
+Ordering: a per-(pg, oid) refcounted asyncio lock serializes client
+RMW cycles AND recovery of the same object, the way ECBackend's
+pipeline ordering (waiting_state -> waiting_reads -> waiting_commit)
+plus the recovery read lock do.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..ec.plugin import ErasureCodePluginRegistry
+from ..models.crushmap import ITEM_NONE
+from ..msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
+                            MOSDECSubOpWrite, MOSDECSubOpWriteReply,
+                            MOSDOpReply, MOSDPGPush)
+from ..store.objectstore import NotFound, Transaction, hobject_t
+from ..utils import denc
+from .pg import PG, LogEntry
+
+SIZE_XATTR = "ec_size"
+SHARD_XATTR = "ec_shard"
+VER_XATTR = "ec_ver"
+
+
+def _ver_bytes(version: tuple[int, int]) -> bytes:
+    return b"%d.%d" % tuple(version)
+
+
+def _parse_ver(raw: bytes) -> tuple[int, int]:
+    a, b = raw.split(b".")
+    return (int(a), int(b))
+
+
+class _OidLock:
+    """Refcounted per-oid lock so the registry stays bounded."""
+
+    __slots__ = ("lock", "refs")
+
+    def __init__(self):
+        self.lock = asyncio.Lock()
+        self.refs = 0
+
+
+class ECPGBackend:
+    """Per-daemon EC I/O engine (shared across the daemon's EC PGs)."""
+
+    def __init__(self, osd):
+        self.osd = osd
+        self._codecs: dict[str, object] = {}
+        self._tid = 0
+        # tid -> {"waiting": set, "event": Event, "buffers": dict,
+        #         "errors": dict}
+        self._reads: dict[int, dict] = {}
+        self._writes: dict[int, dict] = {}
+        self._locks: dict[tuple, _OidLock] = {}
+
+    # -- codec -------------------------------------------------------------
+
+    def codec(self, pool):
+        prof_name = pool.erasure_code_profile or "default"
+        c = self._codecs.get(prof_name)
+        if c is None:
+            profile = dict(
+                self.osd.osdmap.erasure_code_profiles.get(prof_name)
+                or {"plugin": "jerasure", "k": "2", "m": "1",
+                    "technique": "reed_sol_van"})
+            plugin = profile.get("plugin", "jerasure")
+            c = ErasureCodePluginRegistry.instance().factory(
+                plugin, profile)
+            self._codecs[prof_name] = c
+        return c
+
+    class _Locked:
+        def __init__(self, backend, key):
+            self.backend = backend
+            self.key = key
+
+        async def __aenter__(self):
+            entry = self.backend._locks.get(self.key)
+            if entry is None:
+                entry = self.backend._locks[self.key] = _OidLock()
+            entry.refs += 1
+            self.entry = entry
+            await entry.lock.acquire()
+
+        async def __aexit__(self, *exc):
+            self.entry.lock.release()
+            self.entry.refs -= 1
+            if self.entry.refs == 0 and \
+                    self.backend._locks.get(self.key) is self.entry:
+                del self.backend._locks[self.key]
+
+    def oid_lock(self, pg: PG, oid: str) -> "_Locked":
+        return self._Locked(self, (pg.pool_id, pg.ps, oid))
+
+    # -- client op entry ---------------------------------------------------
+
+    async def handle_op(self, pg: PG, conn, msg) -> None:
+        """Primary-side execution of one client op list."""
+        async with self.oid_lock(pg, msg.oid):
+            try:
+                await self._do_op(pg, conn, msg)
+            except Exception as e:
+                import traceback
+
+                traceback.print_exc()
+                conn.send(MOSDOpReply(
+                    tid=msg.tid, result=-5, outs=[{"error": repr(e)}],
+                    epoch=self.osd.osdmap.epoch, version=0))
+
+    async def _do_op(self, pg: PG, conn, msg) -> None:
+        writes = any(o["op"] in _EC_WRITE_OPS for o in msg.ops)
+        epoch = self.osd.osdmap.epoch
+        if not writes:
+            outs, result = [], 0
+            data = None
+            fetched = False
+            for op in msg.ops:
+                name = op["op"]
+                if name in ("read", "stat"):
+                    if not fetched:
+                        data, _ = await self.read_object(pg, msg.oid)
+                        fetched = True
+                    if data is None:
+                        outs.append({"error": "not found"})
+                        result = -2
+                    elif name == "read":
+                        off = op.get("offset", 0)
+                        ln = op.get("length", 0)
+                        outs.append({"data": data[off:off + ln]
+                                     if ln else data[off:]})
+                    else:
+                        outs.append({"size": len(data)})
+                elif name == "getxattr":
+                    val = await self._fetch_xattr(pg, msg.oid,
+                                                  op["name"])
+                    if val is None:
+                        outs.append({"error": "not found"})
+                        result = -2
+                    else:
+                        outs.append({"value": val})
+                else:
+                    outs.append({"error": "bad ec op %s" % name})
+                    result = -22
+            conn.send(MOSDOpReply(tid=msg.tid, result=result, outs=outs,
+                                  epoch=epoch, version=0))
+            return
+
+        # write path: build the new object payload (RMW when needed)
+        outs = []
+        current: bytes | None = None
+        loaded = False
+        is_delete = False
+        for op in msg.ops:
+            name = op["op"]
+            if name == "writefull":
+                current = bytes(op["data"])
+                loaded = True
+                outs.append({})
+            elif name == "write":
+                off = op.get("offset", 0)
+                if not loaded:
+                    current, _ = await self.read_object(pg, msg.oid)
+                    current = current or b""
+                    loaded = True
+                data = op["data"]
+                if len(current) < off:
+                    current = current + b"\0" * (off - len(current))
+                current = current[:off] + data + \
+                    current[off + len(data):]
+                outs.append({})
+            elif name == "truncate":
+                if not loaded:
+                    current, _ = await self.read_object(pg, msg.oid)
+                    current = current or b""
+                    loaded = True
+                ln = op["length"]
+                if len(current) < ln:
+                    current = current + b"\0" * (ln - len(current))
+                else:
+                    current = current[:ln]
+                outs.append({})
+            elif name == "delete":
+                is_delete = True
+                current = None
+                loaded = True
+                outs.append({})
+            elif name == "setxattr":
+                outs.append({})  # applied with the shard transactions
+            else:
+                conn.send(MOSDOpReply(
+                    tid=msg.tid, result=-22,
+                    outs=[{"error": "bad ec op %s" % name}],
+                    epoch=epoch, version=0))
+                return
+        if not is_delete and not loaded:
+            # xattr-only mutation: rewrite the current payload
+            current, _ = await self.read_object(pg, msg.oid)
+            current = current or b""
+        xattrs = {op["name"]: op["value"] for op in msg.ops
+                  if op["op"] == "setxattr"}
+        ok = await self.submit_write(pg, msg.oid, current, is_delete,
+                                     xattrs)
+        ver = pg.info.last_update[1]
+        conn.send(MOSDOpReply(tid=msg.tid, result=0 if ok else -11,
+                              outs=outs, epoch=self.osd.osdmap.epoch,
+                              version=ver))
+
+    # -- write path --------------------------------------------------------
+
+    def _encode_shards(self, pg: PG, data: bytes) -> dict[int, bytes]:
+        codec = self.codec(self.osd.osdmap.pools[pg.pool_id])
+        n = codec.get_chunk_count()
+        return codec.encode(set(range(n)), data)
+
+    def _shard_txn(self, pg: PG, ho: hobject_t, shard: bytes, j: int,
+                   size: int, version, xattrs: dict | None
+                   ) -> Transaction:
+        t = Transaction()
+        # touch+truncate(0)+write replaces any older (possibly longer)
+        # shard without knowing remote existence
+        t.touch(pg.cid, ho)
+        t.truncate(pg.cid, ho, 0)
+        t.write(pg.cid, ho, 0, len(shard), shard)
+        t.setattr(pg.cid, ho, SIZE_XATTR, b"%d" % size)
+        t.setattr(pg.cid, ho, SHARD_XATTR, b"%d" % j)
+        t.setattr(pg.cid, ho, VER_XATTR, _ver_bytes(version))
+        for k, v in (xattrs or {}).items():
+            t.setattr(pg.cid, ho, k, v)
+        return t
+
+    async def submit_write(self, pg: PG, oid: str,
+                           data: bytes | None, is_delete: bool,
+                           xattrs: dict | None = None) -> bool:
+        """Encode + distribute one object write; True when every live
+        shard acked (ECBackend::try_reads_to_commit)."""
+        epoch = self.osd.osdmap.epoch
+        version = (epoch, pg.info.last_update[1] + 1)
+        entry = LogEntry(
+            LogEntry.DELETE if is_delete else LogEntry.MODIFY,
+            oid, version, pg.info.last_update)
+        pg.info.last_update = version
+        pg.log.append(entry)
+        # this write supersedes any pending recovery of the object
+        pg.missing.pop(oid, None)
+        for pm in pg.peer_missing.values():
+            pm.pop(oid, None)
+        shards = None if is_delete else self._encode_shards(pg, data)
+        ho = hobject_t(oid)
+
+        self._tid += 1
+        tid = self._tid
+        waiting: set[int] = set()
+        ev = asyncio.Event()
+        st = {"waiting": waiting, "event": ev}
+        self._writes[tid] = st
+        for j, osd_id in enumerate(pg.acting):
+            if osd_id == ITEM_NONE or osd_id < 0:
+                continue
+            if is_delete:
+                t = Transaction()
+                t.remove(pg.cid, ho)
+            else:
+                t = self._shard_txn(pg, ho, shards[j], j, len(data),
+                                    version, xattrs)
+            if osd_id == self.osd.whoami:
+                entryt = Transaction()
+                entryt.append(t)
+                pg.persist_log_entry(entryt, entry)
+                pg.persist_meta(entryt)
+                self.osd.store.apply_transaction(entryt)
+            else:
+                waiting.add(osd_id)
+                self.osd._send_osd(osd_id, MOSDECSubOpWrite(
+                    pool=pg.pool_id, ps=pg.ps, shard=j, tid=tid,
+                    txn=denc.encode(t.to_wire()),
+                    log_entry=entry.to_wire(), epoch=epoch))
+        if waiting:
+            try:
+                await asyncio.wait_for(ev.wait(), 10.0)
+            except asyncio.TimeoutError:
+                pass
+        self._writes.pop(tid, None)
+        if st["waiting"]:
+            # a member missed the write: its shard is now behind; mark
+            # it missing so recovery (or the next peering) repairs it
+            for osd_id in st["waiting"]:
+                pg.peer_missing.setdefault(osd_id, {})[oid] = entry.op
+            return False
+        return True
+
+    def handle_sub_write(self, conn, msg: MOSDECSubOpWrite) -> None:
+        """Shard side (ECBackend::handle_sub_write)."""
+        from .osdmap import pg_t
+
+        pgid = pg_t(msg.pool, msg.ps)
+        pg = self.osd.pgs.get(pgid)
+        if pg is None:
+            pg = PG(self.osd, msg.pool, msg.ps)
+            pg.create_onstore()
+            self.osd.pgs[pgid] = pg
+        t = Transaction.from_wire(denc.decode(msg.txn))
+        entry = LogEntry.from_wire(msg.log_entry)
+        pg.log.append(entry)
+        pg.info.last_update = entry.version
+        pg.missing.pop(entry.oid, None)  # the write heals the object
+        pg.persist_log_entry(t, entry)
+        pg.persist_meta(t)
+        self.osd.store.apply_transaction(t)
+        conn.send(MOSDECSubOpWriteReply(
+            pool=msg.pool, ps=msg.ps, shard=msg.shard, tid=msg.tid,
+            result=0, epoch=msg.epoch))
+
+    def handle_sub_write_reply(self, msg: MOSDECSubOpWriteReply) -> None:
+        st = self._writes.get(msg.tid)
+        if st is None:
+            return
+        sender = int(msg.src.split(".")[1])
+        st["waiting"].discard(sender)
+        if not st["waiting"]:
+            st["event"].set()
+
+    # -- read path ---------------------------------------------------------
+
+    def _local_shard(self, pg: PG, ho: hobject_t):
+        """(shard_index, bytes, size, version, attrs) of the local
+        object, or None."""
+        if not self.osd.store.exists(pg.cid, ho):
+            return None
+        try:
+            attrs = self.osd.store.getattrs(pg.cid, ho)
+            j = int(attrs[SHARD_XATTR])
+            size = int(attrs[SIZE_XATTR])
+            ver = _parse_ver(attrs[VER_XATTR])
+            return (j, self.osd.store.read(pg.cid, ho), size, ver,
+                    attrs)
+        except (NotFound, KeyError, ValueError):
+            return None
+
+    async def read_object(self, pg: PG, oid: str):
+        """Reconstructing whole-object read; returns (data, version)
+        or (None, None).  Fetches the minimum member set first and
+        widens on shortfall; only shards stamped with the newest
+        observed version are mixed (ec_ver)."""
+        pool = self.osd.osdmap.pools[pg.pool_id]
+        codec = self.codec(pool)
+        k = codec.get_data_chunk_count()
+        ho = hobject_t(oid)
+        members = []
+        for osd_id in pg.acting:
+            if osd_id != ITEM_NONE and osd_id >= 0 \
+                    and osd_id not in members:
+                members.append(osd_id)
+        # per-version shard pools: {ver: {j: (bytes, size)}}
+        by_ver: dict[tuple, dict[int, tuple]] = {}
+        local = self._local_shard(pg, ho) \
+            if self.osd.whoami in members else None
+        if local is not None:
+            j, buf, size, ver, _ = local
+            by_ver.setdefault(ver, {})[j] = (buf, size)
+        remote = [o for o in members if o != self.osd.whoami]
+        # ask the minimum first: enough members for k distinct shards
+        have = 1 if local is not None else 0
+        first = remote[:max(0, k - have)]
+        rest = remote[len(first):]
+        for batch in ([first, rest] if first else [rest]):
+            if not batch:
+                continue
+            for sender, rows in \
+                    (await self._sub_read(pg, oid, batch)).items():
+                for (j, buf, sz, verw, _attrs) in rows:
+                    ver = tuple(verw)
+                    by_ver.setdefault(ver, {}).setdefault(
+                        j, (buf, sz))
+            best = self._best_version(codec, k, by_ver)
+            if best is not None:
+                chunks = {j: b for j, (b, _s) in
+                          by_ver[best].items()}
+                size = next(iter(by_ver[best].values()))[1]
+                data = codec.decode_concat(chunks)
+                return data[:size], best
+        return None, None
+
+    def _best_version(self, codec, k, by_ver):
+        """Newest version with a decodable shard set, else None."""
+        want = set(range(k))
+        for ver in sorted(by_ver, reverse=True):
+            try:
+                codec.minimum_to_decode(want, set(by_ver[ver]))
+                return ver
+            except Exception:
+                continue
+        return None
+
+    async def _sub_read(self, pg: PG, oid: str,
+                        members: list) -> dict:
+        """One round of MOSDECSubOpRead to `members`; returns
+        {sender: [(j, bytes, size, ver), ...]}."""
+        self._tid += 1
+        tid = self._tid
+        ev = asyncio.Event()
+        st = {"waiting": set(members), "event": ev, "buffers": {},
+              "errors": {}}
+        self._reads[tid] = st
+        for osd_id in members:
+            self.osd._send_osd(osd_id, MOSDECSubOpRead(
+                pool=pg.pool_id, ps=pg.ps, shard=-1, tid=tid,
+                reads=[[oid, -1]], epoch=self.osd.osdmap.epoch))
+        try:
+            await asyncio.wait_for(ev.wait(), 10.0)
+        except asyncio.TimeoutError:
+            pass
+        self._reads.pop(tid, None)
+        return st["buffers"]
+
+    async def _fetch_xattr(self, pg: PG, oid: str,
+                           name: str) -> bytes | None:
+        """Client xattr read: local shard if present, else any member's
+        shard attrs (xattrs are replicated to every shard)."""
+        local = self._local_shard(pg, hobject_t(oid))
+        if local is not None:
+            return local[4].get(name)
+        members = [o for o in pg.acting
+                   if o != ITEM_NONE and 0 <= o != self.osd.whoami]
+        for osd_id in members:
+            rows = (await self._sub_read(pg, oid, [osd_id])) \
+                .get(osd_id) or []
+            if rows:
+                attrs = rows[0][4] if len(rows[0]) > 4 else {}
+                return attrs.get(name)
+        return None
+
+    def handle_sub_read(self, conn, msg: MOSDECSubOpRead) -> None:
+        """Shard side (ECBackend::handle_sub_read): serves whatever
+        shard index the stored bytes actually encode, with its version
+        stamp and attrs."""
+        from .osdmap import pg_t
+
+        pg = self.osd.pgs.get(pg_t(msg.pool, msg.ps))
+        buffers = []
+        errors = []
+        for row in msg.reads:
+            oid = row[0]
+            if pg is None:
+                errors.append([oid, -2])
+                continue
+            local = self._local_shard(pg, hobject_t(oid))
+            if local is None:
+                errors.append([oid, -2])
+                continue
+            j, buf, size, ver, attrs = local
+            wire_attrs = {k: v for k, v in attrs.items()
+                          if isinstance(k, str)}
+            buffers.append([oid, j, buf, size, list(ver), wire_attrs])
+        conn.send(MOSDECSubOpReadReply(
+            pool=msg.pool, ps=msg.ps, shard=msg.shard, tid=msg.tid,
+            buffers=buffers, errors=errors, epoch=msg.epoch))
+
+    def handle_sub_read_reply(self, msg: MOSDECSubOpReadReply) -> None:
+        st = self._reads.get(msg.tid)
+        if st is None:
+            return
+        sender = int(msg.src.split(".")[1])
+        rows = []
+        for row in msg.buffers:
+            oid, j, buf, sz, ver = row[0], row[1], row[2], row[3], \
+                row[4]
+            attrs = row[5] if len(row) > 5 else {}
+            rows.append((j, buf, sz, ver, attrs))
+        st["buffers"][sender] = rows
+        for oid, err in msg.errors:
+            st["errors"][sender] = err
+        st["waiting"].discard(sender)
+        if not st["waiting"]:
+            st["event"].set()
+
+    # -- recovery ----------------------------------------------------------
+
+    def scan_stale_shards(self, pg: PG) -> dict[str, str]:
+        """Objects whose stored bytes encode a different position than
+        this osd now holds (after a remap reshuffled acting): they are
+        effectively missing and must be reconstructed."""
+        pos = None
+        for j, o in enumerate(pg.acting):
+            if o == self.osd.whoami:
+                pos = j
+                break
+        if pos is None:
+            return {}
+        stale: dict[str, str] = {}
+        from .pg import PGMETA_OID
+
+        for ho in self.osd.store.collection_list(pg.cid):
+            if ho.name == PGMETA_OID.name:
+                continue
+            local = self._local_shard(pg, ho)
+            if local is None or local[0] != pos:
+                stale[ho.name] = LogEntry.MODIFY
+        return stale
+
+    async def recover_peer_shards(self, pg: PG, osd_id: int,
+                                  missing: dict) -> None:
+        """Reconstruct each missing object's TARGET shard and push it
+        (ECBackend::continue_recovery_op)."""
+        j = None
+        for pos, o in enumerate(pg.acting):
+            if o == osd_id:
+                j = pos
+                break
+        if j is None:
+            return
+        pool = self.osd.osdmap.pools[pg.pool_id]
+        codec = self.codec(pool)
+        pushes = []
+        for oid, op in sorted(missing.items()):
+            async with self.oid_lock(pg, oid):
+                if oid not in pg.peer_missing.get(osd_id, {}):
+                    continue  # superseded by a newer write
+                if op == LogEntry.DELETE:
+                    pushes.append({"oid": oid, "delete": True})
+                    continue
+                data, ver = await self.read_object(pg, oid)
+                if data is None:
+                    pushes.append({"oid": oid, "delete": True})
+                    continue
+                n = codec.get_chunk_count()
+                shards = codec.encode(set(range(n)), data)
+                attrs = {}
+                try:
+                    attrs = dict(self.osd.store.getattrs(
+                        pg.cid, hobject_t(oid)))
+                except NotFound:
+                    pass
+                attrs[SIZE_XATTR] = b"%d" % len(data)
+                attrs[SHARD_XATTR] = b"%d" % j
+                attrs[VER_XATTR] = _ver_bytes(ver)
+                pushes.append({"oid": oid, "delete": False,
+                               "data": shards[j], "attrs": attrs,
+                               "omap": {}})
+        if pushes:
+            self.osd._send_osd(osd_id, MOSDPGPush(
+                pool=pg.pool_id, ps=pg.ps,
+                epoch=self.osd.osdmap.epoch, pushes=pushes))
+
+    async def recover_primary_shards(self, pg: PG) -> None:
+        """Rebuild the primary's own missing shards from survivors."""
+        j = None
+        for pos, o in enumerate(pg.acting):
+            if o == self.osd.whoami:
+                j = pos
+                break
+        if j is None:
+            return
+        for oid, op in sorted(pg.missing.items()):
+            async with self.oid_lock(pg, oid):
+                if oid not in pg.missing:
+                    continue  # superseded by a newer write
+                ho = hobject_t(oid)
+                t = Transaction()
+                if op == LogEntry.DELETE:
+                    if self.osd.store.exists(pg.cid, ho):
+                        t.remove(pg.cid, ho)
+                else:
+                    data, ver = await self.read_object(pg, oid)
+                    if data is None:
+                        pg.missing.pop(oid, None)
+                        continue
+                    codec = self.codec(
+                        self.osd.osdmap.pools[pg.pool_id])
+                    n = codec.get_chunk_count()
+                    shards = codec.encode(set(range(n)), data)
+                    t = self._shard_txn(pg, ho, shards[j], j,
+                                        len(data), ver, None)
+                pg.missing.pop(oid, None)
+                pg.persist_meta(t)
+                self.osd.store.apply_transaction(t)
+
+
+_EC_WRITE_OPS = {"write", "writefull", "delete", "truncate",
+                 "setxattr"}
