@@ -1,0 +1,225 @@
+"""Pallas TPU kernels for the straw2 fixed-point log.
+
+neg_ln(u) = 2^48 - crush_ln(u) for u in [0, 0xFFFF] — the inner-loop
+table math of the straw2 exponential draw (mapper.c:226-268), which
+dominates bulk mapping cost.  The XLA one-hot-matmul formulation
+(device.neg_ln_mxu) materializes the [N, 129]/[N, 256] one-hots and the
+int64 intermediate planes in HBM (~20 GB of traffic per 26M draws —
+measured 52 ms); these kernels keep the one-hots, the MXU table fetches
+and the 65-bit product chain in VMEM (~1 GB total), cutting the op to
+a few ms.
+
+Exactness: every step is integer; 64-bit quantities (rh < 2^48,
+lh/ll < 2^49, the 65-bit product x2*rh) are carried as int32 hi/lo
+limb pairs (u32 bit patterns) with explicit carries; verified
+bit-exact against the host crush_ln for all 65536 inputs
+(tests/test_crush_device.py).
+
+Mosaic workarounds baked into the structure (this jax/libtpu version):
+* int64 anywhere in a kernel recurses at lowering — all limb math is
+  int32 with _ult/_lshr emulating unsigned semantics, and scalar
+  operands are explicitly typed (a weak python literal inside
+  where/maximum traces as i64[] under jax x64);
+* combining values from two chained dot_generals, more than two kernel
+  outputs, or combining dot-derived with compare-chain-derived values
+  in one output expression all fail to legalize ('func.return') or
+  crash the compile helper — hence THREE single-dot kernels
+  (A: RH fetch + product chain -> LL index; C: LH-high fetch;
+  B: LL fetch) with the cheap elementwise prep/combine left to XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._ln_tables import LL_TBL, RH_LH_TBL
+
+R, W = 16, 512          # block: R sublanes x W lanes
+BLOCK = R * W
+K = 256                 # one-hot width (table rows, padded)
+NL = 7                  # int8 limbs per 64-bit table value
+
+
+NPLANES = 8  # 8-bit limb planes of the 64-bit table values
+
+
+def _pack(table: np.ndarray) -> np.ndarray:
+    """[rows] u64 -> [256, 8] f32 of 8-bit limb planes.
+
+    8-bit values are exact even when the MXU runs the dot in bf16
+    (8-bit mantissa), and a one-hot row selects a single value so no
+    accumulation error exists — DEFAULT matmul precision stays exact."""
+    out = np.zeros((K, NPLANES), dtype=np.float32)
+    for i, v in enumerate(table):
+        v = int(v)
+        for j in range(NPLANES):
+            out[i, j] = (v >> (8 * j)) & 0xFF
+    return out
+
+
+_RH_LIMBS = _pack(np.array(RH_LH_TBL[0::2], dtype=np.uint64))
+_LH_LIMBS = _pack(np.array(RH_LH_TBL[1::2], dtype=np.uint64))
+_LL_LIMBS = _pack(np.array(LL_TBL, dtype=np.uint64))
+
+
+def _ult(a, b):
+    """Unsigned a < b on int32 bit patterns: signed compare flipped
+    when the sign bits differ."""
+    return (a < b) ^ ((a < 0) ^ (b < 0))
+
+
+def _lshr(x, s: int):
+    """Logical right shift of int32 bits by static s > 0."""
+    return (x >> s) & ((1 << (32 - s)) - 1)
+
+
+def _onehot_dot(idx, tbl_ref):
+    """f32 one-hot fetch: [R,W] indices -> [R,W,NPLANES] exact ints.
+    (int8 dots also work here, but slicing their 3D result fails to
+    legalize under a grid in this Mosaic version; f32 slices are fine.)"""
+    oh = (idx[..., None] == jax.lax.broadcasted_iota(
+        jnp.int32, (R, W, K), 2)).astype(jnp.float32)
+    return jax.lax.dot_general(
+        oh, tbl_ref[:], (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _plane(r, j):
+    return r[..., j].astype(jnp.int32)
+
+
+# one output per kernel: this Mosaic version also fails to legalize
+# multi-output kernels under a grid
+
+
+def _kernel_a(x2_ref, p_ref, rh_ref, i2_ref):
+    """RH fetch + the 65-bit x2*rh product; emits the LL index."""
+    x2 = x2_ref[:]
+    rl = _onehot_dot(p_ref[:], rh_ref)
+    # rh <= 2^48 as 16-bit pieces from the 8-bit limb planes (piece 3
+    # is the single bit 48, set only for RH[0] = ceil(2^56/256)).
+    # Combines are arithmetic (+/*), never or-of-shifts: Mosaic
+    # miscompiles shift-or chains over f32-dot slices here, while the
+    # disjoint-bit adds are exact and compile correctly.
+    pieces = (_plane(rl, 0) + _plane(rl, 1) * 256,
+              _plane(rl, 2) + _plane(rl, 3) * 256,
+              _plane(rl, 4) + _plane(rl, 5) * 256,
+              _plane(rl, 6))
+    vhi = jnp.zeros((R, W), jnp.int32)
+    vlo = jnp.zeros((R, W), jnp.int32)
+    for i, piece in enumerate(pieces[:4]):
+        term = x2 * piece                           # < 2^32 (wrap ok)
+        off = 16 * i
+        t_lo = term << off if off < 32 else jnp.zeros_like(term)
+        if off == 0:
+            t_hi = jnp.zeros_like(term)
+        elif off < 32:
+            t_hi = _lshr(term, 32 - off)
+        else:
+            t_hi = term << (off - 32)
+        nlo = vlo + t_lo
+        carry = _ult(nlo, vlo).astype(jnp.int32)
+        vhi = vhi + t_hi + carry
+        vlo = nlo
+    # xl64 = (x2*rh) >> 48; only its low 8 bits index LL
+    i2_ref[:] = _lshr(vhi, 16) & 0xFF
+
+
+def _kernel_fetch_lo(idx_ref, tbl_ref, lo_ref):
+    """Table fetch, low 32 bits (limb planes 0-3; arithmetic combine —
+    see _kernel_a).  plane3 * 2^24 can exceed 2^31: the wrapped int32
+    add keeps the correct u32 bit pattern."""
+    r = _onehot_dot(idx_ref[:], tbl_ref)
+    lo_ref[:] = (_plane(r, 0) + _plane(r, 1) * 256
+                 + _plane(r, 2) * 65536 + _plane(r, 3) * 16777216)
+
+
+def _kernel_fetch_hi(idx_ref, tbl_ref, hi_ref):
+    """Table fetch, high 32 bits (limb planes 4-6)."""
+    r = _onehot_dot(idx_ref[:], tbl_ref)
+    hi_ref[:] = (_plane(r, 4) + _plane(r, 5) * 256
+                 + _plane(r, 6) * 65536)
+
+
+def _pair_to_i64(hi, lo):
+    return (hi.astype(jnp.int64) << 32) | \
+        (lo.astype(jnp.int64) & 0xFFFFFFFF)
+
+
+@functools.partial(jax.jit, static_argnames=("n_pad",))
+def _run_kernels(u_flat, rh_t, lh_t, ll_t, n_pad: int):
+    """x64-DISABLED phase: under the repo's global jax x64 mode, the
+    BlockSpec index maps trace as i64[] and Mosaic fails to legalize
+    every kernel ('func.return'); the caller wraps this in
+    jax.enable_x64(False).  All math here is int32/float32."""
+    nblk = n_pad // BLOCK
+    u2 = u_flat.reshape(nblk * R, W)
+    shp = jax.ShapeDtypeStruct((nblk * R, W), jnp.int32)
+    blk = pl.BlockSpec((R, W), lambda i: (i, 0))
+    tblspec = pl.BlockSpec((K, NPLANES), lambda i: (0, 0))
+
+    # elementwise normalization (mapper.c:239-247), fused by XLA
+    x = u2 + 1
+    bl = jnp.ones_like(x)
+    for kbit in range(1, 17):
+        bl = bl + (x >= (1 << kbit)).astype(jnp.int32)
+    need = (x & 0x18000) == 0
+    bits = jnp.maximum(16 - bl, 0)
+    x2 = jnp.where(need, x << bits, x).astype(jnp.int32)
+    iexpon = jnp.where(need, 15 - bits, 15).astype(jnp.int32)
+    p = (x2 >> 8) - 128
+
+    i2 = pl.pallas_call(
+        _kernel_a, out_shape=shp, grid=(nblk,),
+        in_specs=[blk, blk, tblspec], out_specs=blk,
+    )(x2, p, rh_t)
+
+    def fetch(idx, tbl):
+        hi = pl.pallas_call(
+            _kernel_fetch_hi, out_shape=shp, grid=(nblk,),
+            in_specs=[blk, tblspec], out_specs=blk)(idx, tbl)
+        lo = pl.pallas_call(
+            _kernel_fetch_lo, out_shape=shp, grid=(nblk,),
+            in_specs=[blk, tblspec], out_specs=blk)(idx, tbl)
+        return hi, lo
+
+    lh_hi, lh_lo = fetch(p, lh_t)
+    ll_hi, ll_lo = fetch(i2, ll_t)
+    return iexpon, lh_hi, lh_lo, ll_hi, ll_lo
+
+
+@jax.jit
+def _combine(iexpon, lh_hi, lh_lo, ll_hi, ll_lo):
+    """x64 phase: assemble neg = 2^48 - ((iexpon<<44) + (lh+ll)>>4)."""
+    lh2 = (_pair_to_i64(lh_hi, lh_lo) + _pair_to_i64(ll_hi, ll_lo)) >> 4
+    return (jnp.int64(1) << 48) - \
+        ((iexpon.astype(jnp.int64) << 44) + lh2)
+
+
+class NegLnPallas:
+    """Callable returning 2^48 - crush_ln(u) as int64 (bit-exact)."""
+
+    def __init__(self):
+        self.rh = jnp.asarray(_RH_LIMBS)
+        self.lh = jnp.asarray(_LH_LIMBS)
+        self.ll = jnp.asarray(_LL_LIMBS)
+
+    def __call__(self, u):
+        """u int array (any shape) in [0, 0xFFFF] -> int64 same shape."""
+        shape = u.shape
+        flat = u.reshape(-1).astype(jnp.int32)
+        n = flat.shape[0]
+        n_pad = -(-n // BLOCK) * BLOCK
+        if n_pad != n:
+            flat = jnp.pad(flat, (0, n_pad - n))
+        with jax.enable_x64(False):
+            parts = _run_kernels(flat, self.rh, self.lh, self.ll,
+                                 n_pad)
+        neg = _combine(*parts)
+        return neg.reshape(-1)[:n].reshape(shape)

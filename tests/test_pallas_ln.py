@@ -1,0 +1,53 @@
+"""Pallas neg_ln kernel: bit-exactness vs the host crush_ln.
+
+Runs only on real TPU hardware — the test suite's conftest pins the
+suite to the virtual-CPU platform where Mosaic kernels cannot compile,
+and interpret mode at 65536 inputs is slow; the driver's bench runs
+exercise the kernel on-chip.
+"""
+
+import numpy as np
+import pytest
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform not in ("cpu",)
+    except Exception:
+        return False
+
+
+requires_tpu = pytest.mark.skipif(
+    not _on_tpu(), reason="pallas kernels need the real TPU backend")
+
+
+@requires_tpu
+def test_neg_ln_pallas_exact_all_inputs():
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.crush import device as D
+    from ceph_tpu.ops.crush.pallas_ln import NegLnPallas
+
+    ln = NegLnPallas()
+    u = jnp.arange(65536, dtype=jnp.int32)
+    got = np.asarray(ln(u))
+    expect = np.asarray((1 << 48) - D.crush_ln_j(u.astype(jnp.int64)))
+    np.testing.assert_array_equal(got, expect)
+
+
+@requires_tpu
+def test_neg_ln_pallas_shapes_and_padding():
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.crush import device as D
+    from ceph_tpu.ops.crush.pallas_ln import NegLnPallas
+
+    ln = NegLnPallas()
+    rng = np.random.default_rng(7)
+    u = jnp.asarray(rng.integers(0, 65536, size=(37, 53),
+                                 dtype=np.int32))
+    got = np.asarray(ln(u))
+    expect = np.asarray((1 << 48) - D.crush_ln_j(u.astype(jnp.int64)))
+    np.testing.assert_array_equal(got, expect)
