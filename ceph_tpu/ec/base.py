@@ -126,12 +126,18 @@ class ErasureCode(ErasureCodeInterface):
         encoded = self.encode_chunks(prepared)
         return {i: encoded[i] for i in want_to_encode}
 
+    # Locality-aware codes (LRC, SHEC) can repair from FEWER than k
+    # chunks (a local group / shingle window); they clear this flag so
+    # _decode skips the k-chunk floor while keeping the size check.
+    REQUIRES_K_CHUNKS = True
+
     def _decode(
         self, want_to_read: set[int], chunks: Mapping[int, bytes],
     ) -> dict[int, bytes]:
         if want_to_read <= set(chunks):
             return {i: bytes(chunks[i]) for i in want_to_read}
-        if len(chunks) < self.get_data_chunk_count():
+        if self.REQUIRES_K_CHUNKS and \
+                len(chunks) < self.get_data_chunk_count():
             raise IOError(
                 "cannot decode: %d chunks available, %d needed"
                 % (len(chunks), self.get_data_chunk_count()))

@@ -1,0 +1,77 @@
+"""Generate the erasure-code non-regression corpus.
+
+The analog of qa/workunits/erasure-code/encode-decode-non-regression.sh
++ ceph-erasure-code-corpus: pin the exact encoded bytes for every
+plugin/technique/profile so any future change to matrices, padding, or
+chunk layout that silently alters on-disk/on-wire bytes fails the test
+suite.  (The reference's own corpus submodules are not checked out in
+this environment, so cross-implementation byte parity is proven by the
+from-spec matrix derivations plus these pinned self-vectors; see
+tests/test_ec_corpus.py.)
+
+Run manually to regenerate after an INTENTIONAL format change:
+    python tests/golden/gen_ec_corpus.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+PAYLOAD = bytes((7 * i + 3) % 256 for i in range(4096)) + b"tail-bytes!"
+
+PROFILES = [
+    ("jerasure", {"technique": "reed_sol_van", "k": "2", "m": "1"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
+    ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "cauchy_orig", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "cauchy_good", "k": "6", "m": "3"}),
+    ("jerasure", {"technique": "liberation", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2",
+                  "w": "6"}),
+    ("isa", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
+    ("isa", {"technique": "reed_sol_van", "k": "10", "m": "4"}),
+    ("isa", {"technique": "cauchy", "k": "4", "m": "2"}),
+    ("lrc", {"k": "4", "m": "2", "l": "3"}),
+    ("shec", {"k": "4", "m": "3", "c": "2"}),
+    ("shec", {"k": "6", "m": "4", "c": "3"}),
+]
+
+OUT = os.path.join(os.path.dirname(__file__), "ec_corpus.json")
+
+
+def corpus_entry(plugin: str, profile: dict) -> dict:
+    from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+
+    codec = ErasureCodePluginRegistry.instance().factory(
+        plugin, dict(profile))
+    n = codec.get_chunk_count()
+    encoded = codec.encode(set(range(n)), PAYLOAD)
+    return {
+        "plugin": plugin,
+        "profile": dict(profile),
+        "chunk_count": n,
+        "data_chunk_count": codec.get_data_chunk_count(),
+        "chunk_size": len(encoded[0]),
+        "sha256": {str(i): hashlib.sha256(encoded[i]).hexdigest()
+                   for i in sorted(encoded)},
+    }
+
+
+def main() -> None:
+    entries = [corpus_entry(p, prof) for p, prof in PROFILES]
+    with open(OUT, "w") as f:
+        json.dump({"payload_sha256":
+                   hashlib.sha256(PAYLOAD).hexdigest(),
+                   "entries": entries}, f, indent=1, sort_keys=True)
+    print("wrote %s: %d entries" % (OUT, len(entries)))
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "..", ".."))
+    main()

@@ -1,0 +1,69 @@
+"""Erasure-code non-regression corpus: encoded bytes stay pinned.
+
+The analog of qa/workunits/erasure-code/encode-decode-non-regression.sh:
+every plugin/technique/profile encodes the corpus payload and the chunk
+hashes must match tests/golden/ec_corpus.json exactly.  A mismatch
+means the on-disk/on-wire chunk format changed — either a regression,
+or an intentional change that requires regenerating the corpus AND a
+data-migration story.
+"""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+
+# same formula as tests/golden/gen_ec_corpus.py; test_payload_pinned
+# guards both against drifting apart
+PAYLOAD = bytes((7 * i + 3) % 256 for i in range(4096)) + b"tail-bytes!"
+
+CORPUS = os.path.join(os.path.dirname(__file__), "golden",
+                      "ec_corpus.json")
+
+with open(CORPUS) as f:
+    _corpus = json.load(f)
+
+
+def test_payload_pinned():
+    assert hashlib.sha256(PAYLOAD).hexdigest() == \
+        _corpus["payload_sha256"]
+
+
+@pytest.mark.parametrize(
+    "entry", _corpus["entries"],
+    ids=["%s-%s" % (e["plugin"],
+                    e["profile"].get("technique",
+                                     "k%sm%s" % (e["profile"].get("k"),
+                                                 e["profile"].get("m"))))
+         for e in _corpus["entries"]])
+def test_encoding_is_pinned(entry):
+    codec = ErasureCodePluginRegistry.instance().factory(
+        entry["plugin"], dict(entry["profile"]))
+    assert codec.get_chunk_count() == entry["chunk_count"]
+    assert codec.get_data_chunk_count() == entry["data_chunk_count"]
+    n = entry["chunk_count"]
+    encoded = codec.encode(set(range(n)), PAYLOAD)
+    assert len(encoded[0]) == entry["chunk_size"]
+    got = {str(i): hashlib.sha256(encoded[i]).hexdigest()
+           for i in sorted(encoded)}
+    assert got == entry["sha256"], \
+        "%s/%s produced different bytes" % (entry["plugin"],
+                                            entry["profile"])
+
+
+@pytest.mark.parametrize(
+    "entry", _corpus["entries"],
+    ids=["%s-%s" % (e["plugin"],
+                    e["profile"].get("technique",
+                                     "k%sm%s" % (e["profile"].get("k"),
+                                                 e["profile"].get("m"))))
+         for e in _corpus["entries"]])
+def test_decode_roundtrip(entry):
+    codec = ErasureCodePluginRegistry.instance().factory(
+        entry["plugin"], dict(entry["profile"]))
+    n = entry["chunk_count"]
+    encoded = codec.encode(set(range(n)), PAYLOAD)
+    assert codec.decode_concat(encoded)[:len(PAYLOAD)] == PAYLOAD

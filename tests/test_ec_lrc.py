@@ -1,0 +1,118 @@
+"""LRC plugin: kml generation, layered encode/decode, locality.
+
+Mirrors src/test/erasure-code/TestErasureCodeLrc.cc: the generated
+mapping/layers for k/m/l profiles, whole-object roundtrip, repair from
+every single and double erasure, and the locality property — a single
+erasure inside a local group is repaired from at most l other chunks.
+"""
+
+import json
+
+import pytest
+
+from ceph_tpu.ec.lrc import ErasureCodeLrc, LrcError
+from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+
+
+def make(profile):
+    return ErasureCodePluginRegistry.instance().factory("lrc", profile)
+
+
+def test_kml_generates_reference_layout():
+    """parse_kml's generated strings (ErasureCodeLrc.cc:342-370)."""
+    c = ErasureCodeLrc()
+    c.init({"k": "4", "m": "2", "l": "3"})
+    assert c.mapping == "DD__DD__"
+    assert [l.chunks_map for l in c.layers] == [
+        "DDc_DDc_",   # global layer
+        "DDDc____",   # local group 0 (includes the global parity)
+        "____DDDc",   # local group 1
+    ]
+    assert c.get_chunk_count() == 8
+    assert c.get_data_chunk_count() == 4
+
+
+def test_kml_validation():
+    with pytest.raises(LrcError):
+        ErasureCodeLrc().init({"k": "4", "m": "2"})  # l missing
+    with pytest.raises(LrcError):
+        ErasureCodeLrc().init({"k": "4", "m": "2", "l": "4"})  # k+m%l
+    with pytest.raises(LrcError):
+        ErasureCodeLrc().init({"k": "3", "m": "3", "l": "3",
+                               "mapping": "x"})  # generated + explicit
+
+
+def test_roundtrip_all_single_and_double_erasures():
+    c = make({"k": "4", "m": "2", "l": "3"})
+    n = c.get_chunk_count()
+    data = bytes(range(256)) * 13
+    full = c.encode(set(range(n)), data)
+    want = set(range(n))
+    # every single erasure
+    for lost in range(n):
+        avail = {i: full[i] for i in want if i != lost}
+        out = c.decode({lost}, avail)
+        assert out[lost] == full[lost], "single erasure %d" % lost
+    # every double erasure
+    for a in range(n):
+        for b in range(a + 1, n):
+            avail = {i: full[i] for i in want if i not in (a, b)}
+            out = c.decode({a, b}, avail)
+            assert out[a] == full[a] and out[b] == full[b], \
+                "double erasure (%d,%d)" % (a, b)
+    # payload reconstructs
+    assert c.decode_concat(full)[:len(data)] == data
+
+
+def test_locality_minimum_to_decode():
+    """A single erasure is repaired from its local group only
+    (<= l chunks), not from k remote chunks."""
+    c = make({"k": "4", "m": "2", "l": "3"})
+    n = c.get_chunk_count()
+    # layout DD__DD__ / local groups {0,1,2,3} and {4,5,6,7}
+    avail = set(range(n)) - {0}
+    minimum = set(c.minimum_to_decode({0}, avail))
+    assert minimum <= {1, 2, 3}, minimum
+    assert len(minimum) <= 3
+    # wanting a chunk from the second group with a first-group erasure
+    minimum = set(c.minimum_to_decode({4}, set(range(n)) - {0}))
+    assert minimum == {4}
+
+
+def test_no_missing_reads_only_wanted():
+    c = make({"k": "4", "m": "2", "l": "3"})
+    n = c.get_chunk_count()
+    assert set(c.minimum_to_decode({1, 5}, set(range(n)))) == {1, 5}
+
+
+def test_explicit_layers_profile():
+    """The layers JSON form (ErasureCodeLrc.h:127-134 example)."""
+    profile = {
+        "mapping": "__DD__DD",
+        "layers": json.dumps([
+            ["_cDD_cDD", ""],
+            ["cDDD____", ""],
+            ["____cDDD", ""],
+        ]),
+    }
+    c = make(profile)
+    assert c.get_chunk_count() == 8
+    assert c.get_data_chunk_count() == 4
+    data = b"layered lrc" * 40
+    full = c.encode(set(range(8)), data)
+    for lost in range(8):
+        avail = {i: full[i] for i in range(8) if i != lost}
+        out = c.decode({lost}, avail)
+        assert out[lost] == full[lost]
+    assert c.decode_concat(full)[:len(data)] == data
+
+
+def test_undecodable_raises():
+    c = make({"k": "4", "m": "2", "l": "3"})
+    n = c.get_chunk_count()
+    # lose an entire local group plus one more data chunk: the code
+    # cannot recover that group's data chunks
+    lost = {0, 1, 2, 3, 4}
+    avail = set(range(n)) - lost
+    with pytest.raises(IOError):
+        c.minimum_to_decode({0}, avail)

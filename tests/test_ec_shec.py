@@ -1,0 +1,95 @@
+"""SHEC plugin: shingle matrix, recovery, locality.
+
+Mirrors src/test/erasure-code/TestErasureCodeShec.cc scope: roundtrip
+through every 1- and 2-erasure layout at the default (4,3,2) geometry,
+c-erasure durability, and the recovery-bandwidth property (a single
+erasure repairs from a shingle window smaller than k)."""
+
+from itertools import combinations
+
+import pytest
+
+from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+from ceph_tpu.ec.shec import shec_coding_matrix
+
+
+def make(profile):
+    return ErasureCodePluginRegistry.instance().factory("shec", profile)
+
+
+def test_shingle_matrix_shape():
+    m = shec_coding_matrix(4, 3, 2, 8, single=False)
+    assert len(m) == 3 and all(len(r) == 4 for r in m)
+    # the (4,3,2) search picks the m1=1/c1=1 + m2=2/c2=1 split: one
+    # full-coverage parity plus two half-window shingles
+    nonzero_per_row = sorted(sum(1 for v in r if v) for r in m)
+    assert nonzero_per_row == [2, 2, 4]
+    covered = {j for r in m for j, v in enumerate(r) if v}
+    assert covered == {0, 1, 2, 3}
+
+
+def test_roundtrip_all_erasures_up_to_c():
+    c = make({"k": "4", "m": "3", "c": "2"})
+    n = c.get_chunk_count()
+    assert n == 7
+    data = bytes(range(256)) * 9 + b"tail"
+    full = c.encode(set(range(n)), data)
+    for nlost in (1, 2):
+        for lost in combinations(range(n), nlost):
+            avail = {i: full[i] for i in range(n) if i not in lost}
+            out = c.decode(set(lost), avail)
+            for i in lost:
+                assert out[i] == full[i], "erasure %s" % (lost,)
+    assert c.decode_concat(full)[:len(data)] == data
+
+
+def test_recovery_bandwidth_locality():
+    """A single data erasure repairs from fewer than k chunks — the
+    property SHEC trades storage for."""
+    c = make({"k": "4", "m": "3", "c": "2"})
+    n = c.get_chunk_count()
+    smaller = 0
+    for lost in range(4):
+        minimum = c.minimum_to_decode({lost},
+                                      set(range(n)) - {lost})
+        assert lost not in minimum
+        if len(minimum) < 4:
+            smaller += 1
+    assert smaller > 0, "no erasure repaired below k chunks"
+
+
+def test_no_missing_reads_only_wanted():
+    c = make({"k": "4", "m": "3", "c": "2"})
+    n = c.get_chunk_count()
+    assert set(c.minimum_to_decode({2}, set(range(n)))) == {2}
+
+
+def test_single_technique():
+    c = make({"k": "4", "m": "3", "c": "2", "technique": "single"})
+    n = c.get_chunk_count()
+    data = b"single shingle" * 31
+    full = c.encode(set(range(n)), data)
+    for lost in range(n):
+        avail = {i: full[i] for i in range(n) if i != lost}
+        out = c.decode({lost}, avail)
+        assert out[lost] == full[lost]
+
+
+def test_parity_reencode_with_out_of_window_erasure():
+    """Rebuilding a parity must touch only its shingle window: a data
+    chunk with a zero coefficient may itself be erased (and unneeded)."""
+    c = make({"k": "4", "m": "3", "c": "2"})
+    n = c.get_chunk_count()
+    data = b"window" * 101
+    full = c.encode(set(range(n)), data)
+    # matrix row 1 is [x, y, 0, 0]: chunk 2 is outside parity 5's window
+    avail = {i: full[i] for i in range(n) if i not in (2, 5)}
+    out = c.decode({5}, avail)
+    assert out[5] == full[5]
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        make({"k": "4", "m": "2", "c": "3"})  # c > m
+    with pytest.raises(ValueError):
+        make({"k": "4", "m": "3", "c": "2", "w": "7"})
