@@ -1,0 +1,99 @@
+"""vstart: a one-command dev cluster (mon + N osds) in one process.
+
+Analog of src/vstart.sh for this framework: boots the monitor and N
+MemStore OSDs on loopback TCP, optionally creates pools, then either
+runs a put/get smoke workload or stays up serving until interrupted.
+
+    python -m ceph_tpu.cli.vstart --osds 3 --smoke
+    python -m ceph_tpu.cli.vstart --osds 3 --pool data --serve
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from ..client import RadosClient
+from ..mon import Monitor
+from ..osd.daemon import OSD
+from ..utils.context import Context
+
+FAST_CONF = {
+    "heartbeat_interval": 0.5,
+    "heartbeat_grace": 3.0,
+    "mon_osd_down_out_interval": 10.0,
+    "mon_osd_min_down_reporters": 1,
+}
+
+
+async def run(args) -> int:
+    mon = Monitor(Context("mon", conf_overrides=FAST_CONF))
+    addr = await mon.start()
+    print("mon.0 at %s" % addr)
+    osds = []
+    for i in range(args.osds):
+        osd = OSD(i, addr, Context("osd.%d" % i,
+                                   conf_overrides=FAST_CONF))
+        oaddr = await osd.start()
+        osds.append(osd)
+        print("osd.%d at %s" % (i, oaddr))
+    for osd in osds:
+        await osd.wait_for_boot()
+    client = RadosClient(addr)
+    await client.connect()
+    print("cluster up at epoch %d" % client.osdmap.epoch)
+
+    for name in args.pool or []:
+        out = await client.mon_command("osd pool create", pool=name,
+                                       pg_num=args.pg_num,
+                                       size=min(3, args.osds))
+        print("pool %s id=%d" % (name, out["pool_id"]))
+
+    rc = 0
+    if args.smoke:
+        out = await client.mon_command("osd pool create", pool="smoke",
+                                       pg_num=8,
+                                       size=min(3, args.osds))
+        await client.wait_for_epoch(mon.osdmap.epoch)
+        io = client.io_ctx("smoke")
+        payload = b"vstart smoke payload " * 64
+        for i in range(16):
+            await io.write_full("obj-%d" % i, payload + b"%d" % i)
+        bad = 0
+        for i in range(16):
+            got = await io.read("obj-%d" % i)
+            if got != payload + b"%d" % i:
+                bad += 1
+        status = await client.mon_command("status")
+        print("smoke: 16 objects written+read, %d mismatches; "
+              "status=%s" % (bad, status))
+        rc = 1 if bad else 0
+    elif args.serve:
+        print("serving; ctrl-c to stop")
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            pass
+
+    await client.shutdown()
+    for osd in osds:
+        await osd.shutdown()
+    await mon.shutdown()
+    return rc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="vstart")
+    p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--pool", action="append")
+    p.add_argument("--pg-num", type=int, default=32)
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--serve", action="store_true")
+    args = p.parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,6 +118,10 @@ class ErasureCodeShec(ErasureCode):
         self.c = 0
         self.w = DEFAULT_W
         self.matrix: list[list[int]] = []
+        # (want, avail) -> decoding plan: the 2^m subset search with a
+        # GF inversion per candidate is hot on degraded pools; the
+        # reference caches it too (ErasureCodeShecTableCache)
+        self._decoding_cache: dict[tuple, tuple] = {}
 
     def init(self, profile: ErasureCodeProfile) -> None:
         k = self._to_int(profile, "k", DEFAULT_K)
@@ -167,6 +171,17 @@ class ErasureCodeShec(ErasureCode):
         """Returns (dm_rows, dm_cols, inverse) for the smallest
         invertible recovery system, plus the minimum chunk set.
         Raises IOError when unrecoverable."""
+        key = (frozenset(want), frozenset(avail))
+        cached = self._decoding_cache.get(key)
+        if cached is not None:
+            return cached
+        result = self._make_decoding_uncached(want, avail)
+        if len(self._decoding_cache) > 256:
+            self._decoding_cache.clear()
+        self._decoding_cache[key] = result
+        return result
+
+    def _make_decoding_uncached(self, want: set[int], avail: set[int]):
         k, m = self.k, self.m
         want_vec = [1 if i in want else 0 for i in range(k + m)]
         # wanting an erased parity forces wanting its data window

@@ -53,6 +53,7 @@ class Bucket:
     items: list[int]             # child ids (devices >= 0, buckets < 0)
     weight: int = 0              # 16.16 total
     hash: int = RJENKINS1
+    name: str = ""               # bucket name (compiler/tooling)
     # per-algorithm derived state
     item_weight: int = 0               # uniform: shared weight
     item_weights: list[int] = field(default_factory=list)  # list/straw/straw2
@@ -68,6 +69,7 @@ class Bucket:
         return {
             "id": self.id, "alg": self.alg, "type": self.type,
             "items": self.items, "weight": self.weight, "hash": self.hash,
+            "name": self.name,
             "item_weight": self.item_weight,
             "item_weights": self.item_weights,
             "sum_weights": self.sum_weights,
@@ -77,6 +79,8 @@ class Bucket:
 
     @classmethod
     def from_dict(cls, d: dict) -> "Bucket":
+        d = dict(d)
+        d.setdefault("name", "")
         return cls(**d)
 
 
@@ -174,7 +178,7 @@ class CrushMap:
     # -- construction ----------------------------------------------------
     def add_bucket(
         self, alg: int, type: int, items: list[int], weights: list[int],
-        id: int | None = None, hash: int = RJENKINS1,
+        id: int | None = None, hash: int = RJENKINS1, name: str = "",
     ) -> Bucket:
         """Create a bucket, deriving its per-algorithm state the same way
         the reference builder does (builder.c:190-639)."""
@@ -182,7 +186,8 @@ class CrushMap:
             id = -(self.max_buckets + 1)
         assert id < 0 and id not in self.buckets
         assert len(items) == len(weights)
-        b = Bucket(id=id, alg=alg, type=type, items=list(items), hash=hash)
+        b = Bucket(id=id, alg=alg, type=type, items=list(items), hash=hash,
+                   name=name)
         if alg == UNIFORM:
             # uniform buckets share one item weight (first entry wins)
             b.item_weight = weights[0] if weights else 0

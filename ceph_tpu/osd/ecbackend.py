@@ -409,13 +409,20 @@ class ECPGBackend:
                 chunks = {j: b for j, (b, _s) in
                           by_ver[best].items()}
                 size = next(iter(by_ver[best].values()))[1]
-                data = codec.decode_concat(chunks)
+                try:
+                    data = codec.decode_concat(chunks)
+                except (IOError, OSError):
+                    continue  # widen to the remaining members
                 return data[:size], best
         return None, None
 
     def _best_version(self, codec, k, by_ver):
-        """Newest version with a decodable shard set, else None."""
-        want = set(range(k))
+        """Newest version with a decodable shard set, else None.
+        Data positions come from the codec's chunk mapping — LRC-style
+        layouts do NOT put data at 0..k-1."""
+        mapping = codec.get_chunk_mapping()
+        want = ({mapping[i] for i in range(k)} if mapping
+                else set(range(k)))
         for ver in sorted(by_ver, reverse=True):
             try:
                 codec.minimum_to_decode(want, set(by_ver[ver]))

@@ -358,6 +358,61 @@ def test_ec_pool_degraded_and_recovery():
     run(main(), timeout=120)
 
 
+def test_thrash_kill_revive_converges():
+    """Thrasher (qa/tasks/ceph_manager.py kill_osd/revive_osd analog):
+    alternately kill and revive osds under live IO; the cluster must
+    converge clean with every object intact."""
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="data", pg_num=8, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            payloads = {}
+            seq = 0
+
+            async def write_some(n):
+                nonlocal seq
+                for _ in range(n):
+                    oid = "t-%d" % seq
+                    data = ("thrash-%d|" % seq).encode() * 20
+                    payloads[oid] = data
+                    await io.write_full(oid, data)
+                    seq += 1
+
+            await write_some(6)
+            loop = asyncio.get_running_loop()
+            for round_no in range(2):
+                victim = round_no % 3
+                store = c.osds[victim].store
+                await c.kill_osd(victim)
+                t0 = loop.time()
+                while c.client.osdmap.is_up(victim):
+                    assert loop.time() - t0 < 30
+                    await asyncio.sleep(0.05)
+                await write_some(4)  # degraded writes
+                # revive on the same disk (fresh messenger nonce)
+                osd = OSD(victim, c.mon.addr,
+                          Context("osd.%d" % victim,
+                                  conf_overrides=FAST_CONF),
+                          store=store)
+                await osd.start()
+                await osd.wait_for_boot()
+                c.osds[victim] = osd
+                await c.wait_health(pid, timeout=30)
+                for oid, data in payloads.items():
+                    assert await io.read(oid) == data, \
+                        "round %d lost %s" % (round_no, oid)
+        finally:
+            await c.stop()
+
+    run(main(), timeout=180)
+
+
 def test_osd_restart_rejoins_and_backfills():
     """A rebooted osd (fresh messenger nonce, same store) rejoins and
     reconverges."""

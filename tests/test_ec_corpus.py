@@ -27,18 +27,23 @@ with open(CORPUS) as f:
     _corpus = json.load(f)
 
 
+
+def _entry_id(e):
+    prof = e["profile"]
+    return "%s-%s-k%sm%s" % (e["plugin"],
+                             prof.get("technique", "kml"),
+                             prof.get("k"), prof.get("m"))
+
+
+_IDS = [_entry_id(e) for e in _corpus["entries"]]
+
+
 def test_payload_pinned():
     assert hashlib.sha256(PAYLOAD).hexdigest() == \
         _corpus["payload_sha256"]
 
 
-@pytest.mark.parametrize(
-    "entry", _corpus["entries"],
-    ids=["%s-%s" % (e["plugin"],
-                    e["profile"].get("technique",
-                                     "k%sm%s" % (e["profile"].get("k"),
-                                                 e["profile"].get("m"))))
-         for e in _corpus["entries"]])
+@pytest.mark.parametrize("entry", _corpus["entries"], ids=_IDS)
 def test_encoding_is_pinned(entry):
     codec = ErasureCodePluginRegistry.instance().factory(
         entry["plugin"], dict(entry["profile"]))
@@ -54,13 +59,7 @@ def test_encoding_is_pinned(entry):
                                             entry["profile"])
 
 
-@pytest.mark.parametrize(
-    "entry", _corpus["entries"],
-    ids=["%s-%s" % (e["plugin"],
-                    e["profile"].get("technique",
-                                     "k%sm%s" % (e["profile"].get("k"),
-                                                 e["profile"].get("m"))))
-         for e in _corpus["entries"]])
+@pytest.mark.parametrize("entry", _corpus["entries"], ids=_IDS)
 def test_decode_roundtrip(entry):
     codec = ErasureCodePluginRegistry.instance().factory(
         entry["plugin"], dict(entry["profile"]))
