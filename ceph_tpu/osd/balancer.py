@@ -1,0 +1,105 @@
+"""Upmap balancer: calc_pg_upmaps.
+
+Condensed analog of OSDMap::calc_pg_upmaps (src/osd/OSDMap.cc:5159) —
+the flagship consumer of bulk mapping (the mgr balancer module drives
+it): compute every PG's up set, measure per-OSD deviation from the
+weight-proportional target, and emit pg_upmap_items exceptions that
+move PGs from overfull to underfull OSDs until the deviation is within
+max_deviation or no further progress is possible.
+
+Placement correctness is preserved the way the reference's
+try_pg_upmap path does: a remap target must not already appear in the
+PG's up set (no duplicate OSDs), must be up+in, and existing upmap
+exceptions for a PG are replaced, not stacked.
+"""
+
+from __future__ import annotations
+
+from .osdmap import Incremental, OSDMap, pg_t
+
+
+def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
+                   max_deviation: float = 1.0,
+                   max_iterations: int = 100,
+                   pools: list[int] | None = None) -> int:
+    """Fill inc.new_pg_upmap_items / old_pg_upmap_items; returns the
+    number of changes (OSDMap.cc:5159 contract)."""
+    pool_ids = sorted(pools if pools is not None else osdmap.pools)
+    pool_ids = [p for p in pool_ids if p in osdmap.pools]
+    if not pool_ids:
+        return 0
+
+    # current mapping + per-osd load
+    pg_up: dict[pg_t, list[int]] = {}
+    for pid in pool_ids:
+        pool = osdmap.pools[pid]
+        for ps in range(pool.pg_num):
+            pg = pg_t(pid, ps)
+            up, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
+            pg_up[pg] = up
+
+    # weight-proportional target over up+in osds
+    weights = {o: osdmap.osd_weight[o] / 0x10000
+               for o in range(osdmap.max_osd)
+               if osdmap.is_up(o) and osdmap.is_in(o)}
+    total_w = sum(weights.values())
+    if total_w <= 0:
+        return 0
+    total_placements = sum(len(up) for up in pg_up.values())
+    target = {o: total_placements * w / total_w
+              for o, w in weights.items()}
+
+    counts = {o: 0 for o in weights}
+    for up in pg_up.values():
+        for o in up:
+            if o in counts:
+                counts[o] += 1
+
+    # existing exceptions for these pools are re-derived from scratch
+    existing = {pg: items for pg, items in osdmap.pg_upmap_items.items()
+                if pg.pool in set(pool_ids)}
+    new_items: dict[pg_t, list[tuple[int, int]]] = {
+        pg: list(items) for pg, items in existing.items()}
+
+    changes = 0
+    for _ in range(max_iterations):
+        deviations = {o: counts[o] - target[o] for o in counts}
+        over = max(deviations, key=lambda o: deviations[o])
+        if deviations[over] <= max_deviation:
+            break
+        under_sorted = sorted(deviations, key=lambda o: deviations[o])
+        moved = False
+        for pg, up in pg_up.items():
+            if over not in up:
+                continue
+            for under in under_sorted:
+                if deviations[under] >= -0.0001:
+                    break  # nobody meaningfully underfull
+                if under in up:
+                    continue
+                # move pg's replica from `over` to `under`
+                items = [t for t in new_items.get(pg, [])
+                         if t[0] != over and t[1] != over]
+                items.append((over, under))
+                new_items[pg] = items
+                pg_up[pg] = [under if o == over else o for o in up]
+                counts[over] -= 1
+                counts[under] += 1
+                changes += 1
+                moved = True
+                break
+            if moved:
+                break
+        if not moved:
+            break
+
+    for pg, items in new_items.items():
+        if items != existing.get(pg, []):
+            if items:
+                inc.new_pg_upmap_items[pg] = items
+            elif pg in existing:
+                inc.old_pg_upmap_items.append(pg)
+    for pg in existing:
+        if pg not in new_items:
+            inc.old_pg_upmap_items.append(pg)
+    return changes

@@ -1,0 +1,81 @@
+"""Upmap balancer: deviation shrinks, mappings stay valid.
+
+Mirrors src/test/osd/TestOSDMap.cc's calc_pg_upmaps coverage."""
+
+from ceph_tpu.models.crushmap import (CHOOSE_FIRSTN, EMIT, STRAW2, TAKE,
+                                      CrushMap)
+from ceph_tpu.osd.balancer import calc_pg_upmaps
+from ceph_tpu.osd.osdmap import (OSD_EXISTS, OSD_UP, Incremental, OSDMap,
+                                 PGPool, pg_t)
+
+
+def build_cluster(n_osds=10, pg_num=64, size=3):
+    crush = CrushMap()
+    crush.add_bucket(STRAW2, 1, list(range(n_osds)),
+                     [0x10000] * n_osds, id=-1)
+    crush.add_rule([(TAKE, -1, 0), (CHOOSE_FIRSTN, 0, 0), (EMIT, 0, 0)],
+                   id=0)
+    m = OSDMap()
+    inc = Incremental(epoch=1)
+    inc.new_max_osd = n_osds
+    inc.new_crush = crush
+    inc.new_pools[1] = PGPool(id=1, name="p", pg_num=pg_num, size=size,
+                              crush_rule=0)
+    m.apply_incremental(inc)
+    inc = m.new_incremental()
+    for o in range(n_osds):
+        inc.new_state[o] = OSD_EXISTS | OSD_UP
+        inc.new_weight[o] = 0x10000
+    m.apply_incremental(inc)
+    return m
+
+
+def per_osd_counts(m, pid):
+    counts = {}
+    pool = m.pools[pid]
+    for ps in range(pool.pg_num):
+        up, _, _, _ = m.pg_to_up_acting_osds(pg_t(pid, ps))
+        for o in up:
+            counts[o] = counts.get(o, 0) + 1
+    return counts
+
+
+def test_balancer_reduces_deviation():
+    m = build_cluster()
+    before = per_osd_counts(m, 1)
+    spread_before = max(before.values()) - min(before.values())
+
+    inc = m.new_incremental()
+    changes = calc_pg_upmaps(m, inc, max_deviation=1.0,
+                             max_iterations=50)
+    assert changes > 0
+    assert inc.new_pg_upmap_items
+    m.apply_incremental(inc)
+
+    after = per_osd_counts(m, 1)
+    spread_after = max(after.values()) - min(after.values())
+    assert spread_after < spread_before
+    # target: every osd within ~1 of the mean
+    mean = sum(after.values()) / len(after)
+    assert max(after.values()) - mean <= 2.0
+
+    # mappings remain valid: full size, no duplicate osds
+    pool = m.pools[1]
+    for ps in range(pool.pg_num):
+        up, upp, acting, actingp = m.pg_to_up_acting_osds(pg_t(1, ps))
+        assert len(up) == pool.size
+        assert len(set(up)) == len(up)
+        assert actingp in acting
+
+
+def test_balancer_idempotent_when_balanced():
+    m = build_cluster()
+    inc = m.new_incremental()
+    calc_pg_upmaps(m, inc, max_deviation=1.0, max_iterations=50)
+    m.apply_incremental(inc)
+
+    inc2 = m.new_incremental()
+    changes = calc_pg_upmaps(m, inc2, max_deviation=1.0,
+                             max_iterations=50)
+    assert changes == 0
+    assert not inc2.new_pg_upmap_items
