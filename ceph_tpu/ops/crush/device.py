@@ -4,11 +4,39 @@ of PGs at once.
 This is the TPU replacement for the reference's threaded bulk mapper
 (src/osd/OSDMapMapping.h:18-120 ParallelPGMapper) and the inner loops it
 shards (crush_do_rule / crush_choose_firstn / crush_choose_indep,
-src/crush/mapper.c:438-821): the PG axis becomes the vector lane axis,
-retries become masked lax.while_loop iterations, and the straw2
-exponential draw (mapper.c:316-345) runs as int64 fixed-point math that
-is bit-identical to the host engine (ceph_tpu.ops.crush.host) and the
-reference golden vectors.
+src/crush/mapper.c:438-821): the PG axis becomes the vector lane axis
+and the straw2 exponential draw (mapper.c:316-345) runs vectorized with
+results bit-identical to the host engine (ceph_tpu.ops.crush.host) and
+the reference golden vectors.
+
+Bit-exactness strategy (the straw2 winner is argmax of
+trunc((crush_ln(u)-2^48)/w), equivalently argmin of
+q = floor((2^48-crush_ln(u))//w) with first-index tie-break):
+
+* **f32 fast path**: q is approximated as g_f32(u) * (1/w) where g_f32
+  is a degree-7 polynomial in the mantissa of u+1 fitted to the exact
+  crush_ln table (max abs deviation DELTA, measured exhaustively over
+  all 65536 inputs).  A per-item error bound
+  E_i = DELTA/w_i + |q_i|*2^-14 + 4 makes the winner *provably* exact
+  whenever the f32 gap between best and second-best exceeds E_1 + E_2.
+  That covers ~99.4% of draws; no int64, no table lookups, fuses into
+  a single XLA elementwise+reduce pass.
+* **exact top-2 resolution**: in resolve mode the remaining draws are
+  settled by computing the exact integer q for only the top-2
+  candidates — crush_ln via one-hot MXU table fetches on an [L,2]
+  slice (neg_ln_mxu) and an exact base-2^13 schoolbook division.
+  Sound because any item outside the top-2 is > E away from the
+  minimum (checked against the third-best).
+* **host dust**: lanes where even the top-3 are inside the bound
+  (~1e-5 of visits) fall back to the scalar host engine.
+
+Retry control flow (collision/rejection retries, mapper.c:475-626) is
+restructured for SIMD: each replica gets one optimistic full-width
+"attempt" (the overwhelmingly common case), and the few lanes that
+collide or get rejected are compacted (jnp.nonzero + gather) into a
+small tail batch that replays the full retry semantics.  A first pass
+runs the f32 path flagging uncertain lanes; a second pass re-runs only
+flagged lanes (~0.5%) in resolve mode.
 
 Device scope (the modern "optimal" tunables profile): straw2 buckets at
 every level, choose_local_tries == choose_local_fallback_tries == 0,
@@ -43,7 +71,7 @@ from ...models.crushmap import (
 )
 from ._ln_tables import LL_TBL, RH_LH_TBL
 
-S64_MIN = -(1 << 63)
+S64_MAX = (1 << 63) - 1
 LN_ONE = 1 << 48  # 2^48: crush_ln scale at u=0xFFFF+1
 
 HASH_SEED = 1315423911
@@ -92,43 +120,51 @@ def hash32_2_j(a, b):
     return h
 
 
-_RH_LH = jnp.asarray(np.array(RH_LH_TBL, dtype=np.int64))
-_LL = jnp.asarray(np.array(LL_TBL, dtype=np.int64))
+# ---------------------------------------------------------------------------
+# f32 certainty draw
+#
+# g_f32(u) ~ 2^48 - crush_ln(u): exponent via f32 bit tricks (u+1 <= 2^16
+# is f32-exact), mantissa log via a degree-7 polynomial least-squares
+# fitted to the exact table values (which themselves deviate from smooth
+# log2 by ~2^29.6 — the table's own 16-bit-mantissa quantization noise,
+# so a closer smooth fit is impossible).  _G_DELTA is the exhaustively
+# measured max |g_f32(u) - (2^48-crush_ln(u))| over all 65536 inputs
+# (f32-simulated Horner), doubled for device reassociation/FMA headroom.
+# Regenerated + verified by tests/test_crush_device.py::TestF32Draw.
+# ---------------------------------------------------------------------------
+
+_LOG2_COEF = (
+    5.405197953223251e-06, 1.4423911571502686, -0.7177810668945312,
+    0.46077853441238403, -0.2956102788448334, 0.15550757944583893,
+    -0.05415186285972595, 0.00885970052331686,
+)
+_G_DELTA = 825135650.0 * 2.0
+_EPS_Q = 2.0 ** -14      # covers recip truncation (2^-16) + f32 rounding
+_E_CONST = 4.0           # floor slack + crumbs
+_BIG = jnp.float32(3.0e38)
 
 
-def crush_ln_j(xin):
-    """Vector crush_ln: 2^44 * log2(xin+1) fixed point (mapper.c:226-268).
-    xin int64 in [0, 0xFFFF]."""
-    x = xin.astype(jnp.int64) + 1            # [1, 0x10000]
-    bl = jnp.ones_like(x)                    # exact bit_length via compares
-    for kbit in range(1, 17):
-        bl = bl + (x >= (1 << kbit)).astype(jnp.int64)
-    need_norm = (x & 0x18000) == 0
-    bits = jnp.maximum(16 - bl, 0)
-    x2 = jnp.where(need_norm, x << bits, x)
-    iexpon = jnp.where(need_norm, 15 - bits, 15)
-    index1 = (x2 >> 8) << 1
-    rh = _RH_LH[index1 - 256]
-    lh = _RH_LH[index1 + 1 - 256]
-    xl64 = (x2 * rh) >> 48
-    index2 = xl64 & 0xFF
-    lh2 = (lh + _LL[index2]) >> 4
-    return (iexpon << 44) + lh2
-
-
-U64_MAX = (1 << 64) - 1
+def _g_f32(u):
+    """f32 approximation of 2^48 - crush_ln(u), u int in [0, 0xFFFF]."""
+    x = (u + 1).astype(jnp.int32)
+    xf = x.astype(jnp.float32)
+    b = jax.lax.bitcast_convert_type(xf, jnp.int32)
+    e = ((b >> 23) - 127).astype(jnp.float32)
+    mm = jax.lax.bitcast_convert_type(
+        (b & 0x7FFFFF) | 0x3F800000, jnp.float32) - jnp.float32(1.0)
+    acc = jnp.float32(_LOG2_COEF[-1])
+    for c in _LOG2_COEF[-2::-1]:
+        acc = acc * mm + jnp.float32(c)
+    return jnp.float32(2.0 ** 44) * ((jnp.float32(16.0) - e) - acc)
 
 
 # ---------------------------------------------------------------------------
-# gather-free table lookups
+# gather-free table lookups (for the exact top-2 resolution)
 #
-# TPU gathers are scalar-rate (~60M elem/s measured through the tunnel)
-# while the mapping pipeline needs billions of small-table lookups per
-# full-cluster remap.  Every lookup therefore runs as a one-hot int8
-# matmul on the MXU: table values are split into 8-bit limbs offset by
-# -128 (so they fit signed int8), the index becomes a one-hot row, and
-# a single [N, K] @ [K, n_limbs] int8->int32 matmul fetches all limbs
-# at MXU rate.  Exactness: one row is hot, so each output element IS a
+# TPU gathers are scalar-rate while the one-hot int8 matmul rides the
+# MXU; table values are split into 8-bit limbs offset by -128, the index
+# becomes a one-hot row, and a single [N, K] @ [K, n_limbs] int8->int32
+# matmul fetches all limbs.  One row is hot, so each output element IS a
 # limb value (no summation error).
 # ---------------------------------------------------------------------------
 
@@ -155,6 +191,18 @@ def unpack_limbs(l32, n_limbs: int, offset: int = 0,
         limb = (l32[..., j] + 128).astype(jnp.int64)
         acc = acc + (limb << (8 * j))
     return (acc + offset).astype(dtype)
+
+
+def unpack_limbs32(l32, n_limbs: int, offset: int = 0):
+    """int32 fast-path unpack for values that fit 31 bits (ids, recip
+    bit patterns, sizes): int64 vector math halves TPU throughput and
+    doubles HBM traffic, so the hot path avoids it."""
+    acc = l32[..., 0] + 128
+    for j in range(1, n_limbs):
+        acc = acc + ((l32[..., j] + 128) << (8 * j))
+    if offset:
+        acc = acc + offset
+    return acc
 
 
 def onehot_fetch(idx, limb_table):
@@ -202,61 +250,76 @@ def neg_ln_mxu(u, rhlh_limbs, ll_limbs):
     return (1 << 48) - ((iexpon << 44) + lh2)
 
 
-def magic_for_divisor(d: int) -> tuple[int, int]:
-    """(M, k) such that a*M >> k == a // d exactly for all a <= 2^48.
-
-    Granlund-Montgomery: M = ceil(2^k / d) with k = 48 + bits(d); then
-    e = M*d - 2^k < 2^bits(d), so the error term a*e/(d*2^k) stays below
-    1/d for a <= 2^48 and the floor is exact.  M < 2^50 always fits."""
-    if d <= 0:
-        return 0, 0
-    k = 48 + d.bit_length()
-    M = -(-(1 << k) // d)
-    return M, k
-
-
-def _magic_divide(a, m_arr, k_arr):
-    """Exact a // d via the per-item magic (a int64 <= 2^48, arrays of
-    uint64 M and int32 k).  128-bit product by 32-bit limbs; TPU int64
-    multiply is cheap, only division is emulated slowly."""
-    a = a.astype(jnp.uint64)
-    m = m_arr
-    a0 = a & jnp.uint64(0xFFFFFFFF)
-    a1 = a >> jnp.uint64(32)
-    m0 = m & jnp.uint64(0xFFFFFFFF)
-    m1 = m >> jnp.uint64(32)
-    lo_lo = a0 * m0
-    c1 = a0 * m1
-    c2 = a1 * m0
-    hi_hi = a1 * m1
-    mid = (lo_lo >> jnp.uint64(32)) + (c1 & jnp.uint64(0xFFFFFFFF)) + \
-        (c2 & jnp.uint64(0xFFFFFFFF))
-    lo = (lo_lo & jnp.uint64(0xFFFFFFFF)) | (mid << jnp.uint64(32))
-    hi = hi_hi + (c1 >> jnp.uint64(32)) + (c2 >> jnp.uint64(32)) + \
-        (mid >> jnp.uint64(32))
-    k = k_arr.astype(jnp.uint64)
-    klo = jnp.minimum(k, jnp.uint64(63))
-    km64 = jnp.where(k > 64, k - jnp.uint64(64), jnp.uint64(0))
-    sh_up = jnp.where(k < 64, jnp.uint64(64) - k, jnp.uint64(0))
-    q_low = (hi << sh_up) | (lo >> klo)
-    q_high = hi >> km64
-    return jnp.where(k < 64, q_low, q_high).astype(jnp.int64)
+def _exact_floordiv(neg, w64, recipf):
+    """Exact floor(neg / w) for neg int64 in [0, 2^49), w64 int64 > 0:
+    base-2^13 schoolbook long division with f32 digit estimation and
+    +/-2-step correction (each digit < 2^13, so the f32 estimate of
+    cur/w is within 2 of the true digit).  Replaces per-item
+    magic-constant division: w arrives at runtime here."""
+    q = jnp.zeros_like(neg)
+    r = jnp.zeros_like(neg)
+    for shift in (39, 26, 13, 0):
+        d = (neg >> shift) & 0x1FFF
+        cur = (r << 13) + d
+        est = (cur.astype(jnp.float32) * recipf).astype(jnp.int64)
+        est = jnp.clip(est, 0, 1 << 13)
+        rem = cur - est * w64
+        for _ in range(2):
+            lo = rem < 0
+            est = jnp.where(lo, est - 1, est)
+            rem = jnp.where(lo, rem + w64, rem)
+        for _ in range(2):
+            hi = rem >= w64
+            est = jnp.where(hi, est + 1, est)
+            rem = jnp.where(hi, rem - w64, rem)
+        q = (q << 13) + est
+        r = rem
+    return q
 
 
-def _straw2_draw_q(x, ids, r, m_arr, k_arr, rhlh_limbs, ll_limbs):
-    """Quotient of the exponential draw (mapper.c:312-345): the reference
-    maximises trunc((ln-2^48)/w); we minimise q = (2^48-ln)//w, which is
-    the same winner with the same first-index tie-break.  Zero-weight
-    items (k==0) get q = S64_MAX."""
-    u = (hash32_3_j(x, ids, r) & _u32(0xFFFF)).astype(jnp.int64)
-    neg = neg_ln_mxu(u, rhlh_limbs, ll_limbs)
-    q = _magic_divide(neg, m_arr, k_arr)
-    return jnp.where(k_arr > 0, q, jnp.int64((1 << 63) - 1))
+def _exact3_winner(fm, us, ws, ss):
+    """Exact straw2 comparison among the three f32 front-runners:
+    integer q = floor((2^48-crush_ln(u))/w) for each, lexicographic
+    (q, slot) minimum — the first-slot tie-break mirrors mapper.c's
+    strict-> draw comparison keeping the earliest maximum.  Resolving
+    three (not two) candidates pushes the residual ambiguity (true
+    winner outside the resolved set) from ~2.5e-5 per visit to ~1e-7,
+    so retry-heavy lanes no longer shed host-fallback dust."""
+    u = jnp.stack(us, axis=-1)
+    neg = neg_ln_mxu(u, jnp.asarray(_RHLH_LIMBS_NP),
+                     jnp.asarray(_LL_LIMBS_NP))
+    w = jnp.stack(ws, axis=-1).astype(jnp.int64) & 0xFFFFFFFF
+    wsafe = jnp.maximum(w, 1)
+    recipf = jnp.float32(1.0) / wsafe.astype(jnp.float32)
+    q = _exact_floordiv(neg, wsafe, recipf)
+    q = jnp.where(w > 0, q, jnp.int64(S64_MAX))
+    best_q, best_s = q[..., 0], ss[0]
+    for j in range(1, len(ss)):
+        qj, sj = q[..., j], ss[j]
+        take = (qj < best_q) | ((qj == best_q) & (sj < best_s))
+        best_q = jnp.where(take, qj, best_q)
+        best_s = jnp.where(take, sj, best_s)
+    return best_s
 
 
 # ---------------------------------------------------------------------------
 # flattened map
 # ---------------------------------------------------------------------------
+
+
+class _ConstRow:
+    """Host-side row of one bucket (the static TAKE root): lets level-0
+    draws skip the one-hot row fetch entirely (every lane shares the
+    bucket, so ids/weights are jit-time constants)."""
+
+    __slots__ = ("ids", "items", "recipf", "w", "size")
+
+    def __init__(self, ids, items, recipf, w, size):
+        self.ids = ids          # np [S] int32
+        self.items = items      # np [S] int32
+        self.recipf = recipf    # np [S] f32 (24-bit-truncated reciprocal)
+        self.w = w              # np [S] int32
+        self.size = size        # python int
 
 
 class FlatMap:
@@ -286,7 +349,7 @@ class FlatMap:
         if cargs:
             n_pos = max((len(ws.weight_sets) for ws in cargs.values()
                          if ws.weight_sets), default=1) or 1
-        pos_w = np.zeros((n_pos, B, S), np.int32)
+        pos_w = np.zeros((n_pos, B, S), np.int64)
         for b in m.buckets.values():
             bid = -1 - b.id
             size[bid] = b.size
@@ -314,74 +377,110 @@ class FlatMap:
             return d
 
         self.max_depth = max((_depth(i) for i in m.buckets), default=1)
-        # magic-division constants per (pos, bucket, item) weight — the
-        # divisors are map constants, so the slow emulated int64 divide
-        # becomes a 128-bit multiply-shift on device
-        magic_m = np.zeros((n_pos, B, S), np.uint64)
-        magic_k = np.zeros((n_pos, B, S), np.int32)
-        for p in range(n_pos):
-            for bi in range(B):
-                for si in range(S):
-                    M, k = magic_for_divisor(int(pos_w[p, bi, si]))
-                    magic_m[p, bi, si] = M
-                    magic_k[p, bi, si] = k
         self.n_pos = n_pos
         self.rules = dict(m.rules)
 
-        # -- gather-free lookup tables (see module comment) --------------
-        # per-(pos,bucket) row: for each item slot s, 16 int8 limbs
-        # [ids(4) | items(4) | magic_m(7) | magic_k(1)], then size(2) +
-        # btype(2) at the tail.  Fetched with ONE one-hot matmul per
-        # bucket visit.  Tables are built per requested item capacity
-        # S' (row_limbs_for) so each descent level only pays for the
+        # 24-bit-truncated f32 reciprocals of the 16.16 weights: enough
+        # mantissa that the recip truncation term stays inside _EPS_Q
+        with np.errstate(divide="ignore"):
+            recipf = np.where(
+                pos_w > 0,
+                (np.float32(1.0)
+                 / np.maximum(pos_w, 1).astype(np.float32)),
+                np.float32(0.0)).astype(np.float32)
+        self._recipbits_np = (recipf.view(np.uint32) >> 8).astype(np.int64)
+        self._recipf_np = ((recipf.view(np.uint32) >> 8) << 8
+                           ).astype(np.uint32).view(np.float32)
+        self._w_np = pos_w
+
+        # -- gather-free lookup tables -----------------------------------
+        # per-(pos,bucket) row: for each item slot s, limbs
+        # [ids(nl) | items(nl) | recip(3)], then size(2) + btype(2) at
+        # the tail.  Fetched with ONE one-hot matmul per bucket visit.
+        # Tables are built per requested item capacity S'
+        # (row_limbs_for) so each descent level only pays for the
         # largest bucket actually reachable there.
         id_lo = min([0] + [int(v) for v in items.reshape(-1)]
                     + [int(v) for v in ids.reshape(-1)])
+        id_hi = max([0] + [int(v) for v in items.reshape(-1)]
+                    + [int(v) for v in ids.reshape(-1)])
         self.id_offset = id_lo
+        self.nl_id = 3 if (id_hi - id_lo) < (1 << 24) else 4
+        # without choose_args id remapping the ids ARE the items: rows
+        # then carry one copy and the fetch/unpack does half the work
+        self.ids_equal_items = bool(np.array_equal(ids, items))
         self._ids_np = ids
         self._items_np = items
-        self._mm_np = magic_m
-        self._mk_np = magic_k
         self._size_np = size
         self._btype_np = btype
         self._row_cache: dict[int, np.ndarray] = {}
+        self._roww_cache: dict[int, np.ndarray] = {}
         # per-bucket metadata fetch for arbitrary bucket ids (the child
         # bucket chosen during descent): size(2) + btype(2)
         meta = np.zeros((B, 4), np.int8)
         meta[:, 0:2] = pack_limbs(size, 2)
         meta[:, 2:4] = pack_limbs(btype, 2)
         self.meta_limbs = jnp.asarray(meta)
-        self.rhlh_limbs = jnp.asarray(_RHLH_LIMBS_NP)
-        self.ll_limbs = jnp.asarray(_LL_LIMBS_NP)
 
     def row_limbs_for(self, S: int) -> np.ndarray:
-        """[n_pos*B, 16*S+4] int8 rows truncated to S item slots (only
-        fetched for buckets whose size fits — callers pick S per level)."""
+        """[n_pos*B, (2*nl_id+3)*S+4] int8 rows truncated to S item
+        slots (only fetched for buckets whose size fits — callers pick
+        S per level)."""
         tbl = self._row_cache.get(S)
         if tbl is not None:
             return tbl
-        B, n_pos = self.B, self.n_pos
-        rows = np.zeros((n_pos * B, 16 * S + 4), np.int8)
+        B, n_pos, nl = self.B, self.n_pos, self.nl_id
+        dup = 0 if self.ids_equal_items else nl
+        pi = nl + dup + 3
+        rows = np.zeros((n_pos * B, pi * S + 4), np.int8)
         for p in range(n_pos):
             for bi in range(B):
-                row = np.zeros((S, 16), np.int8)
-                row[:, 0:4] = pack_limbs(self._ids_np[bi, :S], 4,
-                                         self.id_offset)
-                row[:, 4:8] = pack_limbs(self._items_np[bi, :S], 4,
-                                         self.id_offset)
-                row[:, 8:15] = pack_limbs(self._mm_np[p, bi, :S], 7)
-                row[:, 15:16] = pack_limbs(self._mk_np[p, bi, :S], 1)
+                row = np.zeros((S, pi), np.int8)
+                row[:, 0:nl] = pack_limbs(self._ids_np[bi, :S], nl,
+                                          self.id_offset)
+                if dup:
+                    row[:, nl:2 * nl] = pack_limbs(
+                        self._items_np[bi, :S], nl, self.id_offset)
+                row[:, nl + dup:pi] = pack_limbs(
+                    self._recipbits_np[p, bi, :S], 3)
                 r = rows[p * B + bi]
-                r[:16 * S] = row.reshape(-1)
-                r[16 * S:16 * S + 2] = pack_limbs(
+                r[:pi * S] = row.reshape(-1)
+                r[pi * S:pi * S + 2] = pack_limbs(
                     self._size_np[bi:bi + 1], 2)[0]
-                r[16 * S + 2:] = pack_limbs(
+                r[pi * S + 2:] = pack_limbs(
                     self._btype_np[bi:bi + 1], 2)[0]
         # Cache as host numpy: this is lazily reached inside jit traces,
         # where jnp.asarray would bind the constant to the live trace and
         # the cached tracer would leak into later traces.
         self._row_cache[S] = rows
         return rows
+
+    def roww_limbs_for(self, S: int) -> np.ndarray:
+        """[n_pos*B, 4*S] int8 weight rows (resolve mode only)."""
+        tbl = self._roww_cache.get(S)
+        if tbl is not None:
+            return tbl
+        B, n_pos = self.B, self.n_pos
+        rows = np.zeros((n_pos * B, 4 * S), np.int8)
+        for p in range(n_pos):
+            for bi in range(B):
+                rows[p * B + bi] = pack_limbs(
+                    self._w_np[p, bi, :S], 4).reshape(-1)
+        self._roww_cache[S] = rows
+        return rows
+
+    def const_row(self, bucket_id: int, S: int) -> _ConstRow | None:
+        """Host row of a single static bucket (level-0 fetch skip);
+        None when positional weight-sets make rows lane-dependent."""
+        if self.n_pos != 1 or bucket_id >= 0:
+            return None
+        bi = -1 - bucket_id
+        return _ConstRow(
+            ids=self._ids_np[bi, :S].copy(),
+            items=self._items_np[bi, :S].copy(),
+            recipf=self._recipf_np[0, bi, :S].copy(),
+            w=self._w_np[0, bi, :S].astype(np.int64),
+            size=int(self._size_np[bi]))
 
 
 # ---------------------------------------------------------------------------
@@ -391,67 +490,158 @@ class FlatMap:
 
 def _fetch_row(fm: FlatMap, bid, pos, S: int):
     """One one-hot matmul fetches a bucket's full choose row:
-    (ids [L,S], items [L,S], magic_m [L,S], magic_k [L,S], size [L])."""
+    (ids [L,S], items [L,S], recipf [L,S] f32, size [L])."""
     if fm.n_pos == 1:
         idx = bid
     else:
         idx = jnp.minimum(pos, fm.n_pos - 1) * fm.B + bid
-    r = onehot_fetch(idx, fm.row_limbs_for(S))        # [L, 16S+4] int32
-    per = r[..., :16 * S].reshape(*bid.shape, S, 16)
-    ids = unpack_limbs(per[..., 0:4], 4, fm.id_offset, jnp.int32)
-    items = unpack_limbs(per[..., 4:8], 4, fm.id_offset, jnp.int32)
-    m_arr = unpack_limbs(per[..., 8:15], 7, 0, jnp.uint64)
-    k_arr = unpack_limbs(per[..., 15:16], 1, 0, jnp.int32)
-    size = unpack_limbs(r[..., 16 * S:16 * S + 2], 2, 0, jnp.int32)
-    return ids, items, m_arr, k_arr, size
+    nl = fm.nl_id
+    dup = 0 if fm.ids_equal_items else nl
+    pi = nl + dup + 3
+    r = onehot_fetch(idx, fm.row_limbs_for(S))       # [L, pi*S+4] int32
+    per = r[..., :pi * S].reshape(*bid.shape, S, pi)
+    ids = unpack_limbs32(per[..., 0:nl], nl, fm.id_offset)
+    if dup:
+        items = unpack_limbs32(per[..., nl:nl + dup], nl, fm.id_offset)
+    else:
+        items = ids
+    rb = unpack_limbs32(per[..., nl + dup:pi], 3)
+    recipf = jax.lax.bitcast_convert_type(rb << 8, jnp.float32)
+    size = unpack_limbs32(r[..., pi * S:pi * S + 2], 2)
+    return ids, items, recipf, size
+
+
+def _fetch_w(fm: FlatMap, bid, pos, S: int):
+    """[L,S] int64 weights (resolve mode)."""
+    if fm.n_pos == 1:
+        idx = bid
+    else:
+        idx = jnp.minimum(pos, fm.n_pos - 1) * fm.B + bid
+    r = onehot_fetch(idx, fm.roww_limbs_for(S))
+    per = r.reshape(*bid.shape, S, 4)
+    return unpack_limbs(per, 4, 0, jnp.int64)
 
 
 def _fetch_meta(fm: FlatMap, bid):
     """(size [L], btype [L]) of arbitrary bucket indices."""
     r = onehot_fetch(bid, fm.meta_limbs)
-    size = unpack_limbs(r[..., 0:2], 2, 0, jnp.int32)
-    btype = unpack_limbs(r[..., 2:4], 2, 0, jnp.int32)
+    size = unpack_limbs32(r[..., 0:2], 2)
+    btype = unpack_limbs32(r[..., 2:4], 2)
     return size, btype
 
 
-def _straw2_choose(fm: FlatMap, bid, x, r, pos, S: int):
-    """Winning item per lane. bid [L] bucket indices; pos [L] output
-    positions (selects the choose_args weight-set, CrushWrapper.h:1500).
-    S = item capacity for this level (>= size of every bucket that can
-    appear in bid).  Returns item [L]."""
-    idv, items, m_arr, k_arr, size = _fetch_row(fm, bid, pos, S)
-    q = _straw2_draw_q(x[:, None], idv, r[:, None], m_arr, k_arr,
-                       fm.rhlh_limbs, fm.ll_limbs)
-    valid = jnp.arange(S)[None, :] < size[:, None]
-    q = jnp.where(valid, q, jnp.int64((1 << 63) - 1))
-    win = jnp.argmin(q, axis=1)
-    # select column `win` without a gather
-    sel = jnp.arange(S)[None, :] == win[:, None]
-    item = jnp.sum(jnp.where(sel, items, 0), axis=1).astype(jnp.int32)
-    return item
+def _pick(arr, sel):
+    """Gather-free row select: arr [L,S], sel [L,S] one-hot bool."""
+    return jnp.sum(jnp.where(sel, arr, jnp.zeros_like(arr)), axis=1)
+
+
+def _straw2_choose(fm: FlatMap, bid, x, r, pos, S: int, resolve: bool,
+                   crow: _ConstRow | None = None):
+    """Winning item per lane via the f32 certainty draw.
+
+    bid [L] bucket indices (ignored when crow fixes the bucket); pos [L]
+    output positions (selects the choose_args weight-set,
+    CrushWrapper.h:1500).  S = item capacity for this level.
+
+    Returns (item [L] int32, flag [L] bool): in fast mode flag marks
+    lanes whose winner is not certain (caller re-runs them in resolve
+    mode); in resolve mode the winner is exact and flag marks only the
+    top-3-inside-bound dust that must go to the host engine.
+    """
+    if crow is not None:
+        ids = jnp.asarray(crow.ids)[None, :]
+        items_a = jnp.asarray(crow.items)[None, :]
+        recipf = jnp.asarray(crow.recipf)[None, :]
+        size = jnp.int32(crow.size)
+        valid = (jnp.arange(S) < size)[None, :] & (recipf > 0)
+    else:
+        ids, items_a, recipf, size = _fetch_row(fm, bid, pos, S)
+        valid = (jnp.arange(S)[None, :] < size[:, None]) & (recipf > 0)
+    u = (hash32_3_j(x[:, None], ids, r[:, None])
+         & _u32(0xFFFF)).astype(jnp.int32)
+    g = _g_f32(u)
+    q = jnp.where(valid, g * recipf, _BIG)
+    E = (jnp.float32(_G_DELTA) * recipf + q * jnp.float32(_EPS_Q)
+         + jnp.float32(_E_CONST))
+    # contender intervals: exact q_i provably lies in [q_i-E_i, q_i+E_i]
+    # (per-item bound — E varies with 1/w_i, so gap tests against a
+    # single E would be unsound under skewed weights).  An item can be
+    # the exact winner only if its lower bound reaches the smallest
+    # upper bound.  Exactly one contender => winner proven.
+    hi = jnp.where(valid, q + E, _BIG)
+    low = jnp.where(valid, q - E, _BIG)
+    min_hi = jnp.min(hi, axis=1)
+    contend = valid & (low <= min_hi[:, None])
+    ncont = jnp.sum(contend.astype(jnp.int32), axis=1)
+    certain = ncont <= 1   # 0 = all-invalid: collapses to slot 0 below
+    i1 = jnp.argmin(q, axis=1).astype(jnp.int32)
+    win_c = jnp.argmax(contend, axis=1).astype(jnp.int32)
+    win1 = jnp.where(ncont == 1, win_c, i1)
+    if not resolve:
+        win = win1
+        flag = ~certain
+    else:
+        sel1 = jnp.arange(S)[None, :] == i1[:, None]
+        qm = jnp.where(sel1, _BIG, q)
+        i2 = jnp.argmin(qm, axis=1).astype(jnp.int32)
+        sel2 = jnp.arange(S)[None, :] == i2[:, None]
+        qm2 = jnp.where(sel2, _BIG, qm)
+        i3 = jnp.argmin(qm2, axis=1).astype(jnp.int32)
+        sel3 = jnp.arange(S)[None, :] == i3[:, None]
+        u1 = _pick(u, sel1)
+        u2 = _pick(u, sel2)
+        u3 = _pick(u, sel3)
+        if crow is not None:
+            wvalid = jnp.where(valid, jnp.asarray(crow.w)[None, :],
+                               jnp.int64(0))
+        else:
+            wv = _fetch_w(fm, bid, pos, S)
+            wvalid = jnp.where(valid, wv, jnp.int64(0))
+        w1 = _pick(wvalid, sel1)
+        w2 = _pick(wvalid, sel2)
+        w3 = _pick(wvalid, sel3)
+        win3 = _exact3_winner(fm, (u1, u2, u3), (w1, w2, w3),
+                              (i1, i2, i3))
+        win = jnp.where(certain, win1, win3)
+        # sound only when every contender was resolved exactly
+        outside = contend & ~(sel1 | sel2 | sel3)
+        flag = (~certain) & jnp.any(outside, axis=1)
+    selw = jnp.arange(S)[None, :] == win[:, None]
+    item = jnp.sum(jnp.where(selw, items_a, 0), axis=1).astype(jnp.int32)
+    return item, flag
 
 
 def _descend(fm: FlatMap, take_bid, x, r, want_type: int, pos,
-             depth_sizes: tuple):
+             depth_sizes: tuple, resolve: bool,
+             crow0: _ConstRow | None = None):
     """Walk bucket->bucket until an item of want_type.
 
     depth_sizes[d] = max bucket size reachable at depth d from the
     start set (static per rule), so each level's draw only pays for
-    the buckets that can actually appear there.
+    the buckets that can actually appear there.  crow0, when given, is
+    the static level-0 bucket row (fetch-free).
 
-    Returns (item, ok, perm_fail): ok = reached an item of the wanted
-    type; perm_fail = hit a wrong-type device (host skips the replica
-    permanently, mapper.c:516-520); neither = retryable (empty bucket).
+    Returns (item, ok, perm_fail, flag): ok = reached an item of the
+    wanted type; perm_fail = hit a wrong-type device (host skips the
+    replica permanently, mapper.c:516-520); neither = retryable (empty
+    bucket).  flag accumulates draw uncertainty over the levels
+    actually walked.
     """
     L = x.shape[0]
     cur = take_bid
     item = jnp.full((L,), ITEM_NONE, jnp.int32)
     ok = jnp.zeros((L,), bool)
     perm = jnp.zeros((L,), bool)
-    cur_size, _ = _fetch_meta(fm, cur)
-    done = cur_size == 0                     # empty bucket: retryable
-    for S_d in depth_sizes:
-        chosen = _straw2_choose(fm, cur, x, r, pos, S_d)
+    flag = jnp.zeros((L,), bool)
+    if crow0 is not None:
+        done = jnp.full((L,), crow0.size == 0)
+    else:
+        cur_size, _ = _fetch_meta(fm, cur)
+        done = cur_size == 0                 # empty bucket: retryable
+    for d, S_d in enumerate(depth_sizes):
+        chosen, f = _straw2_choose(fm, cur, x, r, pos, S_d, resolve,
+                                   crow0 if d == 0 else None)
+        flag = flag | ((~done) & f)
         is_bucket = chosen < 0
         cbid = jnp.where(is_bucket, -1 - chosen, 0)
         csize, cbtype = _fetch_meta(fm, cbid)
@@ -465,13 +655,38 @@ def _descend(fm: FlatMap, take_bid, x, r, want_type: int, pos,
         perm = perm | wrongdev
         done = done | reach | wrongdev | empty_next
         cur = jnp.where((~done) & is_bucket, cbid, cur)
-    return item, ok, perm
+    return item, ok, perm, flag
+
+
+_SF_LO = 16
+
+
+def small_fetch(table_i32, idx, n_limbs: int):
+    """Gather-free elementwise fetch from a small runtime [D] int table
+    (values < 2^(8*n_limbs)): one-hot MXU fetch over ceil(D/16) row
+    groups + a 16-way in-register column select.  TPU gathers run at
+    scalar rate; for the [L]/[L,S]-shaped cluster-state lookups
+    (device reweights, up/exists bits, affinities) this is far faster.
+    idx must already be clipped to [0, D)."""
+    D = table_i32.shape[0]
+    HI = -(-D // _SF_LO)
+    t = jnp.pad(table_i32.astype(jnp.int32), (0, HI * _SF_LO - D))
+    t = t.reshape(HI, _SF_LO)
+    planes = [((t >> (8 * j)) & 0xFF) - 128 for j in range(n_limbs)]
+    tl = jnp.concatenate(planes, axis=1).astype(jnp.int8)
+    hi = (idx >> 4).astype(jnp.int32)
+    lo = (idx & 15).astype(jnp.int32)
+    r = onehot_fetch(hi, tl).reshape(*idx.shape, n_limbs, _SF_LO)
+    sel = lo[..., None] == jnp.arange(_SF_LO)
+    pl = jnp.sum(jnp.where(sel[..., None, :], r, 0), axis=-1)
+    return unpack_limbs32(pl, n_limbs)
 
 
 def _is_out(dev_weights, item, x):
-    """Reweight rejection (mapper.c:402-416)."""
+    """Reweight rejection (mapper.c:402-416).  Reweights are 16.16
+    capped at 0x10000 (17 bits), so three limb planes suffice."""
     idx = jnp.clip(item, 0, dev_weights.shape[0] - 1)
-    w = dev_weights[idx]
+    w = small_fetch(dev_weights, idx, 3)
     oob = (item >= dev_weights.shape[0]) | (item < 0)
     hh = (hash32_2_j(x, item) & _u32(0xFFFF)).astype(jnp.int32)
     return oob | (w == 0) | ((w < 0x10000) & (hh >= w))
@@ -481,31 +696,38 @@ def _is_out(dev_weights, item, x):
 # firstn / indep
 # ---------------------------------------------------------------------------
 
+# optimistic retries fused into the full-width attempt pass; lanes
+# still failing after these land in the pass-2 resolve set
+_ATTEMPT_TRIES = 2
 
-def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
-                       result_max: int, want_type: int,
-                       recurse_to_leaf: bool, dev_weights,
-                       tries: int, recurse_tries: int, vary_r: int,
-                       stable: int, outer_ds: tuple, inner_ds: tuple):
+# below this lane count the optimistic attempt + compacted tail isn't
+# worth its bookkeeping; run the full retry loops directly
+_ATTEMPT_MIN_L = 16384
+
+
+def _firstn_full(fm: FlatMap, take_bid, xs, out, leaves, outpos,
+                 numrep: int, result_max: int, want_type: int,
+                 recurse_to_leaf: bool, dev_weights,
+                 tries: int, recurse_tries: int, vary_r: int,
+                 stable: int, outer_ds: tuple, inner_ds: tuple,
+                 resolve: bool, rootc: _ConstRow | None):
     """crush_choose_firstn (mapper.c:438-626) for local-tries==0: per
     replica, retry whole descents while collided/rejected (masked
-    lanes); chooseleaf recursion selects one leaf per chosen bucket."""
+    lanes); chooseleaf recursion selects one leaf per chosen bucket.
+    Full retry semantics; every lane replays from ftotal=0."""
     L = xs.shape[0]
-    slots = min(numrep, result_max)
-    out = jnp.full((L, slots), ITEM_NONE, jnp.int32)      # level items
-    leaves = jnp.full((L, slots), ITEM_NONE, jnp.int32)   # devices
-    outpos = jnp.zeros((L,), jnp.int32)
-
     result_slots = out.shape[1]
+    flag0 = jnp.zeros((L,), bool)
 
     def rep_body(rep, carry):
-        out, leaves, outpos = carry
+        out, leaves, outpos, flag = carry
 
         def body(state):
-            ftotal, active, out, leaves, outpos = state
+            ftotal, active, out, leaves, outpos, flag = state
             r = jnp.full((L,), 0, jnp.int32) + rep + ftotal
-            item, ok, perm = _descend(fm, take_bid, xs, r, want_type,
-                                      outpos, outer_ds)
+            item, ok, perm, f1 = _descend(fm, take_bid, xs, r, want_type,
+                                          outpos, outer_ds, resolve, rootc)
+            flag = flag | (active & f1)
             if recurse_to_leaf:
                 if vary_r:
                     sub_r = r >> (vary_r - 1)
@@ -515,10 +737,12 @@ def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
                 bid_in = jnp.where(item < 0, -1 - item, 0)
 
                 def inner_body(istate):
-                    ift, iact, leaf, leaf_ok = istate
+                    ift, iact, leaf, leaf_ok, iflag = istate
                     r_in = rep_i + sub_r + ift
-                    cand, cok, _cperm = _descend(
-                        fm, bid_in, xs, r_in, 0, outpos, inner_ds)
+                    cand, cok, _cperm, f2 = _descend(
+                        fm, bid_in, xs, r_in, 0, outpos, inner_ds,
+                        resolve, None)
+                    iflag = iflag | (iact & f2)
                     cok = cok & (item < 0)
                     # leaf collision: the recursive call checks candidates
                     # against leaves already placed in out2[0..outpos)
@@ -529,14 +753,16 @@ def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
                     leaf = jnp.where(take, cand, leaf)
                     leaf_ok = leaf_ok | take
                     iact = iact & (~cok) & (ift + 1 < recurse_tries)
-                    return ift + 1, iact, leaf, leaf_ok
+                    return ift + 1, iact, leaf, leaf_ok, iflag
 
                 izero = jnp.zeros((L,), jnp.int32)
                 leaf0 = jnp.full((L,), ITEM_NONE, jnp.int32)
-                _, _, leaf, leaf_ok = jax.lax.while_loop(
+                _, _, leaf, leaf_ok, iflag = jax.lax.while_loop(
                     lambda s: jnp.any(s[1]), inner_body,
-                    (izero, active & ok, leaf0, jnp.zeros((L,), bool)))
+                    (izero, active & ok, leaf0, jnp.zeros((L,), bool),
+                     jnp.zeros((L,), bool)))
                 final, final_ok = leaf, ok & leaf_ok
+                flag = flag | iflag
             else:
                 final = item
                 final_ok = ok
@@ -552,93 +778,228 @@ def _choose_firstn_vec(fm: FlatMap, take_bid, xs, numrep: int,
             outpos = outpos + success.astype(jnp.int32)
             ftotal = ftotal + 1
             active = active & ~success & ~perm & (ftotal < tries)
-            return ftotal, active, out, leaves, outpos
+            return ftotal, active, out, leaves, outpos, flag
 
         z = jnp.zeros((L,), jnp.int32)
         act = jnp.ones((L,), bool)
-        _, _, out, leaves, outpos = jax.lax.while_loop(
-            lambda s: jnp.any(s[1]), body, (z, act, out, leaves, outpos))
-        return out, leaves, outpos
+        _, _, out, leaves, outpos, flag = jax.lax.while_loop(
+            lambda s: jnp.any(s[1]), body,
+            (z, act, out, leaves, outpos, flag))
+        return out, leaves, outpos, flag
 
-    out, leaves, outpos = jax.lax.fori_loop(
-        0, numrep, rep_body, (out, leaves, outpos))
-    return (leaves if recurse_to_leaf else out), outpos
+    out, leaves, outpos, flag = jax.lax.fori_loop(
+        0, numrep, rep_body, (out, leaves, outpos, flag0))
+    return out, leaves, outpos, flag
 
 
-def _choose_indep_vec(fm: FlatMap, take_bid, xs, numrep: int,
-                      result_max: int, want_type: int,
-                      recurse_to_leaf: bool, dev_weights,
-                      tries: int, recurse_tries: int,
-                      outer_ds: tuple, inner_ds: tuple):
-    """crush_choose_indep (mapper.c:633-821): positionally-stable, slots
-    left UNDEF retry with r advanced by numrep per round (numrep is the
-    full replica count even when fewer slots fit result_max)."""
+def _choose_firstn_vec(fm: FlatMap, take_bid_val: int, xs, numrep: int,
+                       result_max: int, want_type: int,
+                       recurse_to_leaf: bool, dev_weights,
+                       tries: int, recurse_tries: int, vary_r: int,
+                       stable: int, outer_ds: tuple, inner_ds: tuple,
+                       resolve: bool, full: bool,
+                       rootc: _ConstRow | None):
+    """Fast-path firstn: _ATTEMPT_TRIES optimistic full-width rounds
+    per replica (ftotal = 0, 1, ...); a lane still unsatisfied after
+    them is flagged for the resolve pass instead of driving a masked
+    retry loop — data-dependent while loops, compaction gathers and
+    result scatters all cost more on TPU than recomputing the few
+    stragglers exactly in pass 2.  Resolve mode and small batches run
+    the full retry loops."""
     L = xs.shape[0]
     slots = min(numrep, result_max)
-    out = jnp.full((L, slots), ITEM_UNDEF, jnp.int32)
-    leaves = jnp.full((L, slots), ITEM_UNDEF, jnp.int32)
+    take_bid = jnp.full((L,), -1 - take_bid_val, jnp.int32)
+    out0 = jnp.full((L, slots), ITEM_NONE, jnp.int32)
+    leaves0 = jnp.full((L, slots), ITEM_NONE, jnp.int32)
     pos0 = jnp.zeros((L,), jnp.int32)
+    if full or L < _ATTEMPT_MIN_L:
+        out, leaves, outpos, flag = _firstn_full(
+            fm, take_bid, xs, out0, leaves0, pos0, numrep, result_max,
+            want_type, recurse_to_leaf, dev_weights, tries, recurse_tries,
+            vary_r, stable, outer_ds, inner_ds, resolve, rootc)
+        return (leaves if recurse_to_leaf else out), outpos, flag
 
-    def body(state):
-        ftotal, out, leaves = state
-
-        def rep_body(rep, carry):
-            out, leaves = carry
-            undecided = out[:, rep] == ITEM_UNDEF
-            r = jnp.full((L,), 0, jnp.int32) + rep + numrep * ftotal
-            item, ok, perm = _descend(fm, take_bid, xs, r, want_type,
-                                      pos0, outer_ds)
-            collide = jnp.any(out == item[:, None], axis=1) & ok
+    out, leaves, outpos = out0, leaves0, pos0
+    flag = jnp.zeros((L,), bool)
+    clean = jnp.ones((L,), bool)
+    # an outer retry (ftotal+1) after a leaf failure only matches the
+    # reference when the inner loop is single-try (chooseleaf_descend_
+    # once, the modern default); otherwise the inner retries first, so
+    # the optimistic pass stops at one round and defers to pass 2
+    n_attempts = min(_ATTEMPT_TRIES, tries)
+    if recurse_to_leaf and recurse_tries > 1:
+        n_attempts = 1
+    for rep in range(numrep):
+        done_rep = jnp.zeros((L,), bool)
+        for ft in range(n_attempts):
+            r = jnp.full((L,), rep + ft, jnp.int32)
+            item, ok, perm, f1 = _descend(fm, take_bid, xs, r,
+                                          want_type, outpos, outer_ds,
+                                          resolve, rootc)
             if recurse_to_leaf:
+                if vary_r:
+                    sub_r = r >> (vary_r - 1)
+                else:
+                    sub_r = jnp.zeros_like(r)
+                rep_i = (jnp.zeros_like(outpos) if stable else outpos)
                 bid_in = jnp.where(item < 0, -1 - item, 0)
-                pos_r = jnp.full((L,), 0, jnp.int32) + rep
-
-                def inner_body(istate):
-                    ift, iact, leaf, leaf_ok = istate
-                    r_in = r + rep + numrep * ift
-                    cand, cok, _cp = _descend(fm, bid_in, xs, r_in, 0,
-                                              pos_r, inner_ds)
-                    cok = cok & (item < 0)
-                    cok = cok & ~_is_out(dev_weights, cand, xs)
-                    take = iact & cok
-                    leaf = jnp.where(take, cand, leaf)
-                    leaf_ok = leaf_ok | take
-                    iact = iact & (~cok) & (ift + 1 < recurse_tries)
-                    return ift + 1, iact, leaf, leaf_ok
-
-                izero = jnp.zeros((L,), jnp.int32)
-                leaf0 = jnp.full((L,), ITEM_NONE, jnp.int32)
-                _, _, leaf, leaf_ok = jax.lax.while_loop(
-                    lambda s: jnp.any(s[1]), inner_body,
-                    (izero, undecided & ok & ~collide, leaf0,
-                     jnp.zeros((L,), bool)))
-                final, final_ok = leaf, ok & leaf_ok
+                r_in = rep_i + sub_r
+                cand, cok, _cp, f2 = _descend(fm, bid_in, xs, r_in, 0,
+                                              outpos, inner_ds, resolve,
+                                              None)
+                cok = cok & (item < 0)
+                cok = cok & ~jnp.any(leaves == cand[:, None], axis=1)
+                cok = cok & ~_is_out(dev_weights, cand, xs)
+                final, final_ok = cand, ok & cok
+                f1 = f1 | (f2 & ok & (item < 0))
             else:
                 final = item
                 final_ok = ok
                 if want_type == 0:
-                    final_ok = final_ok & ~_is_out(dev_weights, item, xs)
-            success = undecided & final_ok & ~collide
-            permfail = undecided & perm
-            col = jnp.arange(slots)[None, :] == rep
-            out = jnp.where(col & success[:, None], item[:, None], out)
-            out = jnp.where(col & permfail[:, None], ITEM_NONE, out)
-            leaves = jnp.where(col & success[:, None], final[:, None],
-                               leaves)
-            leaves = jnp.where(col & permfail[:, None], ITEM_NONE, leaves)
-            return out, leaves
+                    final_ok = final_ok & ~_is_out(dev_weights, item,
+                                                   xs)
+            collide = jnp.any(out == item[:, None], axis=1) & ok
+            act = ~done_rep
+            success = act & final_ok & ~collide & (outpos < slots)
+            slot = jnp.arange(slots)[None, :] == outpos[:, None]
+            put = slot & success[:, None]
+            out = jnp.where(put, item[:, None], out)
+            leaves = jnp.where(put, final[:, None], leaves)
+            outpos = outpos + success.astype(jnp.int32)
+            flag = flag | (clean & act & f1)
+            done_rep = done_rep | success | (act & perm)
+        clean = clean & done_rep
+    flag = flag | ~clean
+    return (leaves if recurse_to_leaf else out), outpos, flag
 
-        out, leaves = jax.lax.fori_loop(0, slots, rep_body, (out, leaves))
-        return ftotal + 1, out, leaves
+
+def _indep_round(fm: FlatMap, take_bid, xs, ftotal, out, leaves, flag,
+                 numrep: int, slots: int, want_type: int,
+                 recurse_to_leaf: bool, dev_weights,
+                 recurse_tries: int, outer_ds: tuple, inner_ds: tuple,
+                 resolve: bool, rootc: _ConstRow | None):
+    """One crush_choose_indep round (mapper.c:633-821): all UNDEF slots
+    draw with r = rep + numrep*ftotal."""
+    L = xs.shape[0]
+    pos0 = jnp.zeros((L,), jnp.int32)
+
+    def rep_body(rep, carry):
+        out, leaves, flag = carry
+        undecided = out[:, rep] == ITEM_UNDEF
+        r = jnp.full((L,), 0, jnp.int32) + rep + numrep * ftotal
+        item, ok, perm, f1 = _descend(fm, take_bid, xs, r, want_type,
+                                      pos0, outer_ds, resolve, rootc)
+        flag = flag | (undecided & f1)
+        collide = jnp.any(out == item[:, None], axis=1) & ok
+        if recurse_to_leaf:
+            bid_in = jnp.where(item < 0, -1 - item, 0)
+            pos_r = jnp.full((L,), 0, jnp.int32) + rep
+
+            def inner_body(istate):
+                ift, iact, leaf, leaf_ok, iflag = istate
+                r_in = r + rep + numrep * ift
+                cand, cok, _cp, f2 = _descend(fm, bid_in, xs, r_in, 0,
+                                              pos_r, inner_ds, resolve,
+                                              None)
+                iflag = iflag | (iact & f2)
+                cok = cok & (item < 0)
+                cok = cok & ~_is_out(dev_weights, cand, xs)
+                take = iact & cok
+                leaf = jnp.where(take, cand, leaf)
+                leaf_ok = leaf_ok | take
+                iact = iact & (~cok) & (ift + 1 < recurse_tries)
+                return ift + 1, iact, leaf, leaf_ok, iflag
+
+            izero = jnp.zeros((L,), jnp.int32)
+            leaf0 = jnp.full((L,), ITEM_NONE, jnp.int32)
+            _, _, leaf, leaf_ok, iflag = jax.lax.while_loop(
+                lambda s: jnp.any(s[1]), inner_body,
+                (izero, undecided & ok & ~collide, leaf0,
+                 jnp.zeros((L,), bool), jnp.zeros((L,), bool)))
+            final, final_ok = leaf, ok & leaf_ok
+            flag = flag | iflag
+        else:
+            final = item
+            final_ok = ok
+            if want_type == 0:
+                final_ok = final_ok & ~_is_out(dev_weights, item, xs)
+        success = undecided & final_ok & ~collide
+        permfail = undecided & perm
+        col = jnp.arange(slots)[None, :] == rep
+        out = jnp.where(col & success[:, None], item[:, None], out)
+        out = jnp.where(col & permfail[:, None], ITEM_NONE, out)
+        leaves = jnp.where(col & success[:, None], final[:, None],
+                           leaves)
+        leaves = jnp.where(col & permfail[:, None], ITEM_NONE, leaves)
+        return out, leaves, flag
+
+    return jax.lax.fori_loop(0, slots, rep_body, (out, leaves, flag))
+
+
+def _indep_full(fm: FlatMap, take_bid, xs, numrep: int, slots: int,
+                want_type: int, recurse_to_leaf: bool, dev_weights,
+                tries: int, recurse_tries: int, outer_ds: tuple,
+                inner_ds: tuple, resolve: bool,
+                rootc: _ConstRow | None):
+    """Full positionally-stable retry loop: slots left UNDEF retry with
+    r advanced by numrep per round."""
+    L = xs.shape[0]
+    out = jnp.full((L, slots), ITEM_UNDEF, jnp.int32)
+    leaves = jnp.full((L, slots), ITEM_UNDEF, jnp.int32)
+    flag = jnp.zeros((L,), bool)
+
+    def body(state):
+        ftotal, out, leaves, flag = state
+        out, leaves, flag = _indep_round(
+            fm, take_bid, xs, ftotal, out, leaves, flag, numrep, slots,
+            want_type, recurse_to_leaf, dev_weights, recurse_tries,
+            outer_ds, inner_ds, resolve, rootc)
+        return ftotal + 1, out, leaves, flag
 
     def cond(state):
-        ftotal, out, _ = state
+        ftotal, out, _, _ = state
         return jnp.any(out == ITEM_UNDEF) & (ftotal < tries)
 
     z = jnp.zeros((), jnp.int32)
-    _, out, leaves = jax.lax.while_loop(cond, body, (z, out, leaves))
+    _, out, leaves, flag = jax.lax.while_loop(cond, body,
+                                              (z, out, leaves, flag))
     res = leaves if recurse_to_leaf else out
-    return jnp.where(res == ITEM_UNDEF, ITEM_NONE, res)
+    return jnp.where(res == ITEM_UNDEF, ITEM_NONE, res), flag
+
+
+def _choose_indep_vec(fm: FlatMap, take_bid_val: int, xs, numrep: int,
+                      result_max: int, want_type: int,
+                      recurse_to_leaf: bool, dev_weights,
+                      tries: int, recurse_tries: int,
+                      outer_ds: tuple, inner_ds: tuple,
+                      resolve: bool, full: bool,
+                      rootc: _ConstRow | None):
+    """Fast-path indep: _ATTEMPT_TRIES optimistic full-width rounds
+    (each an exact crush_choose_indep round, so chaining them is the
+    reference retry semantics verbatim); lanes with UNDEF slots left
+    after them are flagged for the resolve pass."""
+    L = xs.shape[0]
+    slots = min(numrep, result_max)
+    take_bid = jnp.full((L,), -1 - take_bid_val, jnp.int32)
+    if full or L < _ATTEMPT_MIN_L:
+        res, flag = _indep_full(fm, take_bid, xs, numrep, slots,
+                                want_type, recurse_to_leaf, dev_weights,
+                                tries, recurse_tries, outer_ds, inner_ds,
+                                resolve, rootc)
+        return res, flag
+
+    out = jnp.full((L, slots), ITEM_UNDEF, jnp.int32)
+    leaves = jnp.full((L, slots), ITEM_UNDEF, jnp.int32)
+    flag = jnp.zeros((L,), bool)
+    for ft in range(min(_ATTEMPT_TRIES, tries)):
+        out, leaves, flag = _indep_round(
+            fm, take_bid, xs, jnp.full((), ft, jnp.int32), out, leaves,
+            flag, numrep, slots, want_type, recurse_to_leaf,
+            dev_weights, recurse_tries, outer_ds, inner_ds, resolve,
+            rootc)
+    res = leaves if recurse_to_leaf else out
+    flag = flag | jnp.any(out == ITEM_UNDEF, axis=1)
+    return jnp.where(res == ITEM_UNDEF, ITEM_NONE, res), flag
 
 
 # ---------------------------------------------------------------------------
@@ -662,19 +1023,36 @@ def _post_process(raw, seeds, exists_b, isup_b, aff, can_shift: bool,
     D = exists_b.shape[0]
     valid = raw != ITEM_NONE
     idx = jnp.clip(raw, 0, D - 1)
-    keep = valid & (raw < D) & exists_b[idx] & isup_b[idx]
+    # one fused 18-bit state fetch: keep bit | primary affinity
+    state_t = (((exists_b & isup_b).astype(jnp.int32) << 17)
+               | (aff & 0x1FFFF))
+    st = small_fetch(state_t, idx, 3)
+    keep = valid & (raw < D) & ((st >> 17) > 0)
     up = jnp.where(keep, raw, ITEM_NONE)
     if can_shift:
-        # stable compaction: surviving osds keep order, holes go last
-        order = jnp.argsort(~keep, axis=1, stable=True)
-        up = jnp.take_along_axis(up, order, axis=1)
+        # stable compaction: surviving osds keep order, holes go last.
+        # S is tiny, so an S^2 rank-select beats a sort by a mile.
+        S = up.shape[1]
+        rank = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        slots = jnp.arange(S)
+        hit = keep[:, None, :] & (rank[:, None, :] == slots[None, :, None])
+        up = jnp.where(
+            jnp.any(hit, axis=2),
+            jnp.sum(jnp.where(hit, up[:, None, :], 0), axis=2),
+            ITEM_NONE)
+    S = up.shape[1]
+    slots = jnp.arange(S)
     nonnone = up != ITEM_NONE
     has = jnp.any(nonnone, axis=1)
     first = jnp.argmax(nonnone, axis=1)
-    prim = jnp.where(
-        has, jnp.take_along_axis(up, first[:, None], 1)[:, 0], -1)
+
+    def pick_col(arr, col):
+        sel = slots[None, :] == col[:, None]
+        return jnp.sum(jnp.where(sel, arr, 0), axis=1)
+
+    prim = jnp.where(has, pick_col(up, first), -1)
     if use_aff:
-        a = aff[jnp.clip(up, 0, D - 1)]
+        a = small_fetch(aff, jnp.clip(up, 0, D - 1), 3)
         row_applies = jnp.any(
             nonnone & (a != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY), axis=1)
         h = (hash32_2_j(seeds[:, None], up.astype(jnp.uint32))
@@ -684,12 +1062,11 @@ def _post_process(raw, seeds, exists_b, isup_b, aff, can_shift: bool,
         has_acc = jnp.any(accept, axis=1)
         pos = jnp.where(has_acc, jnp.argmax(accept, axis=1), first)
         applies = row_applies & has
-        new_prim = jnp.take_along_axis(up, pos[:, None], 1)[:, 0]
+        new_prim = pick_col(up, pos)
         prim = jnp.where(applies, new_prim, prim)
         if can_shift:
             # move the new primary to the front, shifting [0..pos) right
-            S = up.shape[1]
-            i = jnp.arange(S)[None, :]
+            i = slots[None, :]
             rotated = jnp.where(
                 i == 0, new_prim[:, None],
                 jnp.where(i <= pos[:, None], jnp.roll(up, 1, axis=1), up))
@@ -707,15 +1084,21 @@ class DeviceMapper:
 
     do_rule_batch(ruleno, xs, result_max, dev_weights) mirrors
     CrushWrapper::do_rule over a whole batch of inputs; results carry
-    ITEM_NONE holes exactly like the host engine.
+    ITEM_NONE holes exactly like the host engine.  Internally a fast
+    f32 pass flags uncertain lanes, a resolve pass recomputes them
+    exactly, and top-3-ambiguous dust goes to the scalar host engine —
+    so results are always bit-identical to the host.
     """
 
     def __init__(self, crushmap: CrushMap,
                  choose_args_name: str | None = None):
         self.fm = FlatMap(crushmap, choose_args_name)
         self.map = crushmap
+        self._cargs = (crushmap.choose_args.get(choose_args_name)
+                       if choose_args_name else None)
 
-    def _compile(self, ruleno: int, result_max: int):
+    def _compile(self, ruleno: int, result_max: int, resolve: bool,
+                 full: bool = True):
         rule = self.fm.rules[ruleno]
         t = self.fm.tunables
         tries = t.choose_total_tries + 1     # historical off-by-one
@@ -763,36 +1146,38 @@ class DeviceMapper:
         else:
             recurse = leaf_tries if leaf_tries else 1
         fm = self.fm
-        take_bid_val = -1 - take_id
-        outer_ds = self._depth_sizes([take_id])
+        outer_ds = self._depth_sizes([take_id], want_type)
+        rootc = fm.const_row(take_id, outer_ds[0])
         if leaf:
             starts = [b.id for b in self.map.buckets.values()
                       if b.type == want_type]
-            inner_ds = self._depth_sizes(starts)
+            inner_ds = self._depth_sizes(starts, 0)
         else:
             inner_ds = ()
 
         def core(xs, dev_weights):
-            L = xs.shape[0]
-            take_bid = jnp.full((L,), take_bid_val, jnp.int32)
             if firstn:
-                res, _ = _choose_firstn_vec(
-                    fm, take_bid, xs, numrep, result_max, want_type,
+                res, _, flag = _choose_firstn_vec(
+                    fm, take_id, xs, numrep, result_max, want_type,
                     leaf, dev_weights, tries, recurse, vary_r, stable,
-                    outer_ds, inner_ds)
+                    outer_ds, inner_ds, resolve, full, rootc)
             else:
-                res = _choose_indep_vec(
-                    fm, take_bid, xs, numrep, result_max, want_type,
+                res, flag = _choose_indep_vec(
+                    fm, take_id, xs, numrep, result_max, want_type,
                     leaf, dev_weights, tries, recurse,
-                    outer_ds, inner_ds)
-            return res
+                    outer_ds, inner_ds, resolve, full, rootc)
+            return res, flag
 
         return core
 
-    def _depth_sizes(self, start_bucket_ids: list[int]) -> tuple:
+    def _depth_sizes(self, start_bucket_ids: list[int],
+                     want_type: int) -> tuple:
         """depth_sizes[d] = max size of any bucket reachable at depth d
         by walking bucket children from the start set (static per
-        rule/map)."""
+        rule/map).  The walk stops once no child bucket can continue
+        the descent — children of the wanted type are terminal (the
+        draw 'reach'es them), so e.g. a root->host chooseleaf descent
+        costs one draw level, not the tree height."""
         m = self.map
         sizes = []
         level = {b for b in start_bucket_ids if b in m.buckets}
@@ -801,77 +1186,302 @@ class DeviceMapper:
             sizes.append(max(
                 (m.buckets[b].size for b in level), default=1) or 1)
             level = {c for b in level for c in m.buckets[b].items
-                     if c < 0 and c in m.buckets}
+                     if c < 0 and c in m.buckets
+                     and m.buckets[c].type != want_type}
             seen_levels += 1
         return tuple(sizes) if sizes else (1,)
 
     @functools.lru_cache(maxsize=None)
-    def _compiled(self, ruleno: int, result_max: int):
-        return jax.jit(self._compile(ruleno, result_max))
+    def _compiled(self, ruleno: int, result_max: int, resolve: bool,
+                  full: bool = True):
+        return jax.jit(self._compile(ruleno, result_max, resolve, full))
 
     @functools.lru_cache(maxsize=None)
     def _compiled_map(self, ruleno: int, result_max: int,
-                      can_shift: bool, use_aff: bool):
-        core = self._compile(ruleno, result_max)
+                      can_shift: bool, use_aff: bool, resolve: bool,
+                      full: bool = True):
+        core = self._compile(ruleno, result_max, resolve, full)
 
         @jax.jit
         def run(xs, dev_weights, exists_b, isup_b, aff):
-            raw = core(xs, dev_weights)
-            return _post_process(raw, xs, exists_b, isup_b, aff,
-                                 can_shift, use_aff)
+            raw, flag = core(xs, dev_weights)
+            up, prim = _post_process(raw, xs, exists_b, isup_b, aff,
+                                     can_shift, use_aff)
+            return up, prim, flag
 
         return run
 
-    # per-dispatch PG cap: intermediates are [L, S] int64 (several live
-    # temps inside the choose loops), so huge pools are chunked to bound
-    # device memory — 512k lanes * 64 items * 8B ~ 256 MiB per temp
-    CHUNK = 1 << 19
+    # per-dispatch PG cap: bounds live [L, S] f32/int32 temps in HBM
+    CHUNK = 1 << 20
+    # resolve-pass chunk: flagged lanes are a few % of pass 1; one
+    # dispatch usually covers them all
+    CHUNK2 = 1 << 19
 
-    def map_pgs_batch(self, ruleno: int, pps, result_max: int,
-                      dev_weights, exists, isup, aff=None,
-                      can_shift: bool = True):
-        """Full do_rule -> up/up_primary pipeline for a batch of PGs
-        with no upmap/pg_temp exceptions.  pps [L] placement seeds;
-        exists/isup bool [max_osd]; aff int32 [max_osd] primary
-        affinities or None.  Returns (up [L,S] int32, up_primary [L]
-        int32) as numpy arrays."""
+    # -- whole-pool mapping with device-side pps -------------------------
+
+    def _pps_host_np(self, ps, pgp_num: int, pgp_mask: int,
+                     pool_id: int, hashps: bool) -> np.ndarray:
+        """Host-side pps seeds (used only for the flagged minority)."""
+        from .hashes import pps_seed_v
+        return pps_seed_v(ps, pgp_num, pgp_mask, pool_id, hashps)
+
+    @functools.lru_cache(maxsize=None)
+    def _compiled_pool(self, ruleno: int, result_max: int,
+                       can_shift: bool, use_aff: bool, pgp_num: int,
+                       pgp_mask: int, pool_id: int, hashps: bool,
+                       n: int, n_chunks: int):
+        """Whole pool in ONE dispatch: a lax.scan over fixed-size
+        chunks (the chunking bounds the live [L,S] temps, the scan
+        removes per-chunk dispatch/readback latency — significant over
+        a remote-chip tunnel)."""
+        core = self._compile(ruleno, result_max, False)
+
+        def chunk(start):
+            ps = jnp.arange(n, dtype=jnp.uint32) + start
+            masked = jnp.where((ps & _u32(pgp_mask)) < _u32(pgp_num),
+                               ps & _u32(pgp_mask),
+                               ps & _u32(pgp_mask >> 1))
+            if hashps:
+                xs = hash32_2_j(masked, _u32(pool_id))
+            else:
+                xs = masked + _u32(pool_id)
+            return xs
+
+        @jax.jit
+        def run(dev_weights, exists_b, isup_b, aff):
+            def body(_, start):
+                xs = chunk(start)
+                raw, flag = core(xs, dev_weights)
+                up, prim = _post_process(raw, xs, exists_b, isup_b,
+                                         aff, can_shift, use_aff)
+                # flags ride back as packed bits: tunnel readback is
+                # the scarce resource, not device compute
+                packed = jnp.sum(
+                    flag.reshape(-1, 8).astype(jnp.int32)
+                    * (1 << jnp.arange(8, dtype=jnp.int32)),
+                    axis=1).astype(jnp.uint8)
+                return 0, (up, prim, packed)
+
+            starts = (jnp.arange(n_chunks, dtype=jnp.uint32)
+                      * _u32(n))
+            _, (ups, prims, packs) = jax.lax.scan(body, 0, starts)
+            S = ups.shape[2]
+            return (ups.reshape(-1, S), prims.reshape(-1),
+                    packs.reshape(-1))
+
+        return run
+
+    @functools.lru_cache(maxsize=None)
+    def _compiled_resolve_rows(self, ruleno: int, result_max: int,
+                               can_shift: bool, use_aff: bool,
+                               full: bool, n: int):
+        """Resolve pass over n flagged lanes: returns exact rows to be
+        applied as host-side sparse patches (the Ceph way — exception
+        tables over a dense base mapping — and far cheaper than TPU
+        scatter, which runs at scalar rate)."""
+        core = self._compile(ruleno, result_max, True, full)
+
+        @jax.jit
+        def run(xs, dev_weights, exists_b, isup_b, aff):
+            raw, flag = core(xs, dev_weights)
+            u2, p2 = _post_process(raw, xs, exists_b, isup_b, aff,
+                                   can_shift, use_aff)
+            packed = jnp.sum(
+                flag.reshape(-1, 8).astype(jnp.int32)
+                * (1 << jnp.arange(8, dtype=jnp.int32)),
+                axis=1).astype(jnp.uint8)
+            return u2, p2, packed
+
+        return run
+
+    def _resolve_rows(self, ruleno, result_max, lanes, pps_f, C2, full,
+                      can_shift, use_aff, w, ex, iu, af):
+        """Run flagged lanes through a resolve pass in C2-sized
+        dispatches; returns (rows, prims, still_flagged_mask) numpy."""
+        res = self._compiled_resolve_rows(
+            ruleno, result_max, can_shift, use_aff, full, C2)
+        rows = None
+        prims = np.empty((lanes.size,), np.int32)
+        still = np.zeros((lanes.size,), bool)
+        for off in range(0, lanes.size, C2):
+            part = pps_f[off:off + C2]
+            nv = part.shape[0]
+            if nv < C2:
+                part = np.pad(part, (0, C2 - nv))
+            u2, p2, f2 = res(jnp.asarray(part, dtype=jnp.uint32),
+                             w, ex, iu, af)
+            if rows is None:
+                rows = np.full((lanes.size, int(u2.shape[1])),
+                               ITEM_NONE, np.int32)
+            rows[off:off + nv] = np.asarray(u2[:nv])
+            prims[off:off + nv] = np.asarray(p2[:nv])
+            still[off:off + nv] = np.unpackbits(
+                np.asarray(f2), bitorder="little")[:nv]
+        return rows, prims, still
+
+    def map_pool_batch(self, ruleno: int, result_max: int, pg_num: int,
+                       pgp_num: int, pgp_num_mask: int, pool_id: int,
+                       hashpspool: bool, dev_weights, exists, isup,
+                       aff=None, can_shift: bool = True,
+                       return_device: bool = False):
+        """Whole-pool pg->up pipeline: pps seeds computed on device
+        (raw_pg_to_pps), one scanned dispatch for the fast pass, and
+        the flagged minority resolved into host-side sparse patches.
+
+        return_device=False: patches are folded in and dense numpy
+        arrays come back.  return_device=True: returns
+        (up_dev [pg,S], prim_dev [pg], patches) with patches =
+        (lanes, rows, prims) numpy arrays — the rows that supersede
+        the device arrays (the consumers compose them exactly like
+        pg_temp/upmap exception tables)."""
         use_aff = aff is not None
-        fn = self._compiled_map(ruleno, result_max, bool(can_shift),
-                                use_aff)
-        pps = np.asarray(pps, dtype=np.int64) & 0xFFFFFFFF
         w = jnp.asarray(np.asarray(dev_weights, dtype=np.int32))
         ex = jnp.asarray(np.asarray(exists, dtype=bool))
         iu = jnp.asarray(np.asarray(isup, dtype=bool))
-        if use_aff:
-            af = jnp.asarray(np.asarray(aff, dtype=np.int32))
-        else:
-            af = jnp.zeros((ex.shape[0],), jnp.int32)
-        L = pps.shape[0]
-        if L <= self.CHUNK:
-            up, prim = fn(jnp.asarray(pps, dtype=jnp.uint32),
-                          w, ex, iu, af)
-            # np.array (not asarray): device buffers are read-only views
-            # and callers patch exception rows in place
-            return np.array(up), np.array(prim)
-        # fixed-size chunks (tail padded) so one compilation serves all
-        ups, prims = [], []
-        for off in range(0, L, self.CHUNK):
-            part = pps[off:off + self.CHUNK]
-            n = part.shape[0]
-            if n < self.CHUNK:
-                part = np.pad(part, (0, self.CHUNK - n))
-            u, p = fn(jnp.asarray(part, dtype=jnp.uint32), w, ex, iu, af)
-            ups.append(np.array(u[:n]))
-            prims.append(np.array(p[:n]))
-        return np.concatenate(ups), np.concatenate(prims)
+        af = (jnp.asarray(np.asarray(aff, dtype=np.int32)) if use_aff
+              else jnp.zeros((ex.shape[0],), jnp.int32))
+        C = min(self.CHUNK, max(8, -(-pg_num // 8) * 8))
+        n_chunks = -(-pg_num // C)
+        fn = self._compiled_pool(ruleno, result_max, bool(can_shift),
+                                 use_aff, int(pgp_num),
+                                 int(pgp_num_mask), int(pool_id),
+                                 bool(hashpspool), C, n_chunks)
+        up, prim, packed = fn(w, ex, iu, af)
+        flag = np.unpackbits(np.asarray(packed),
+                             bitorder="little")[:pg_num]
+        flagged = np.nonzero(flag)[0]
+        lanes_np = np.empty((0,), np.int64)
+        rows_np = np.empty((0, result_max), np.int32)
+        prims_np = np.empty((0,), np.int32)
+        if flagged.size:
+            pps_f = (self._pps_host_np(flagged, pgp_num, pgp_num_mask,
+                                       pool_id, hashpspool)
+                     & 0xFFFFFFFF)
+            # dispatch shapes derive from the pass-1 flagged count so
+            # the churned-remap call reuses the map call's compiles
+            # (a per-call pow2 of the straggler count would recompile
+            # mid-benchmark whenever it crossed a bucket)
+            if flagged.size > self.CHUNK2 // 4:
+                c2a = self.CHUNK2
+            else:
+                c2a = max(8, 1 << (int(flagged.size) - 1).bit_length())
+            c2b = max(8, min(1 << 15, c2a // 8))
+            # pass 2a: exact draws through the fast attempt structure
+            rows_np, prims_np, still = self._resolve_rows(
+                ruleno, result_max, flagged, pps_f, c2a, False,
+                bool(can_shift), use_aff, w, ex, iu, af)
+            lanes_np = flagged.astype(np.int64)
+            # pass 2b: stragglers through the full retry loops
+            again = np.nonzero(still)[0]
+            if again.size:
+                r2, p2, still2 = self._resolve_rows(
+                    ruleno, result_max, flagged[again], pps_f[again],
+                    c2b, True, bool(can_shift), use_aff,
+                    w, ex, iu, af)
+                rows_np[again] = r2
+                prims_np[again] = p2
+                # dust: top-3-ambiguous lanes -> scalar host engine
+                dust = again[np.nonzero(still2)[0]]
+                if dust.size:
+                    u_h = np.full((dust.size, rows_np.shape[1]),
+                                  ITEM_NONE, np.int32)
+                    p_h = np.full((dust.size,), -1, np.int32)
+                    self._host_map_rows(ruleno, pps_f[dust],
+                                        range(dust.size), result_max,
+                                        dev_weights, exists, isup, aff,
+                                        can_shift, u_h, p_h)
+                    rows_np[dust] = u_h
+                    prims_np[dust] = p_h
+        if return_device:
+            return (up[:pg_num], prim[:pg_num],
+                    (lanes_np, rows_np, prims_np))
+        up = np.array(up[:pg_num])
+        prim = np.array(prim[:pg_num])
+        if lanes_np.size:
+            up[lanes_np] = rows_np
+            prim[lanes_np] = prims_np
+        return up, prim
 
     def do_rule_batch(self, ruleno: int, xs, result_max: int,
                       dev_weights) -> np.ndarray:
         """xs: int array [L] of inputs (pps values); dev_weights: int32
         [max_devices] 16.16 reweights.  Returns [L, numrep] int32 with
         ITEM_NONE holes."""
-        fn = self._compiled(ruleno, result_max)
-        xs = jnp.asarray(np.asarray(xs, dtype=np.int64) & 0xFFFFFFFF,
-                         dtype=jnp.uint32)
+        fast = self._compiled(ruleno, result_max, False, full=False)
+        xs = np.asarray(xs, dtype=np.int64) & 0xFFFFFFFF
         w = jnp.asarray(np.asarray(dev_weights, dtype=np.int32))
-        return np.asarray(fn(xs, w))
+        res, flag = fast(jnp.asarray(xs, dtype=jnp.uint32), w)
+        res = np.array(res)
+        flag = np.array(flag)
+        flagged = np.nonzero(flag)[0]
+        if flagged.size:
+            rfn = self._compiled(ruleno, result_max, True)
+            part = xs[flagged]
+            r2, f2 = rfn(jnp.asarray(part, dtype=jnp.uint32), w)
+            res[flagged] = np.array(r2)
+            f2 = np.array(f2)
+            for lane in flagged[np.nonzero(f2)[0]]:
+                row = self._host_raw(ruleno, int(xs[lane]), result_max,
+                                     dev_weights)
+                res[lane] = row
+        return res
+
+    # -- host dust (scalar exact fallback) ------------------------------
+
+    def _host_raw(self, ruleno: int, x: int, result_max: int,
+                  dev_weights) -> np.ndarray:
+        from .host import Mapper
+        weights = [int(v) for v in np.asarray(dev_weights)]
+        raw = Mapper(self.map).do_rule(ruleno, x, result_max, weights,
+                                       choose_args=self._cargs)
+        row = np.full((result_max,), ITEM_NONE, np.int32)
+        row[:len(raw)] = raw[:result_max]
+        return row
+
+    def _host_map_rows(self, ruleno: int, pps, lanes, result_max: int,
+                       dev_weights, exists, isup, aff, can_shift,
+                       up, prim) -> None:
+        """Exact scalar pipeline for dust lanes: host do_rule + a host
+        mirror of _post_process."""
+        from .hashes import hash32_2 as h2  # host scalar hash
+        exists = np.asarray(exists, dtype=bool)
+        isup = np.asarray(isup, dtype=bool)
+        aff_a = (np.asarray(aff, dtype=np.int64)
+                 if aff is not None else None)
+        D = exists.shape[0]
+        for lane in lanes:
+            x = int(pps[lane])
+            raw = [int(v) for v in
+                   self._host_raw(ruleno, x, result_max, dev_weights)]
+            keep = [(o != ITEM_NONE and 0 <= o < D
+                     and bool(exists[o]) and bool(isup[o])) for o in raw]
+            if can_shift:
+                row = [o for o, k in zip(raw, keep) if k]
+            else:
+                row = [o if k else ITEM_NONE for o, k in zip(raw, keep)]
+            nonnone = [i for i, o in enumerate(row) if o != ITEM_NONE]
+            p = row[nonnone[0]] if nonnone else -1
+            if aff_a is not None and nonnone:
+                applies = any(
+                    aff_a[row[i]] != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
+                    for i in nonnone)
+                if applies:
+                    pos = None
+                    for i in nonnone:
+                        o = row[i]
+                        a = int(aff_a[o])
+                        hh = (h2(x, o) & 0xFFFFFFFF) >> 16
+                        if not (a < CEPH_OSD_MAX_PRIMARY_AFFINITY
+                                and hh >= a):
+                            pos = i
+                            break
+                    if pos is None:
+                        pos = nonnone[0]
+                    p = row[pos]
+                    if can_shift:
+                        row = [p] + row[:pos] + row[pos + 1:]
+            out_row = np.full((up.shape[1],), ITEM_NONE, np.int32)
+            out_row[:min(len(row), up.shape[1])] = \
+                row[:up.shape[1]]
+            up[lane] = out_row
+            prim[lane] = p

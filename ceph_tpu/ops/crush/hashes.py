@@ -185,3 +185,18 @@ def str_hash_rjenkins(key: bytes) -> int:
         a = (a + key[i]) & M32
     a, b, c = _mix(a, b, c)
     return c
+
+
+def pps_seed_v(ps, pgp_num: int, pgp_mask: int, pool_id: int,
+               hashpspool: bool):
+    """Vectorized raw_pg_to_pps placement seed (osd_types.cc:1815-1831)
+    — the single source for the stable-mod + pool-mix composition used
+    by the host pipeline, the bulk mapper's patch path, and (mirrored
+    in jnp inside DeviceMapper._compiled_pool) the device pass."""
+    import numpy as np
+    ps = np.asarray(ps, dtype=np.uint32)
+    masked = np.where((ps & pgp_mask) < pgp_num, ps & pgp_mask,
+                      ps & (pgp_mask >> 1)).astype(np.uint32)
+    if hashpspool:
+        return hash32_2_v(masked, np.uint32(pool_id)).astype(np.int64)
+    return masked.astype(np.int64) + pool_id

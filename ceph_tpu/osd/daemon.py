@@ -219,6 +219,13 @@ class OSD:
         pg.up, pg.acting, pg.primary = up, acting, actingp
         if not interval_changed and pg.state in (STATE_ACTIVE,
                                                  STATE_REPLICA):
+            # ops can be parked by the min_size gate while acting
+            # members are down; a peer rejoining without an acting-set
+            # change (e.g. pg_temp pinning) triggers no peering, so
+            # retry them on every map advance — _handle_op re-gates
+            if pg.state == STATE_ACTIVE and pg.waiting_for_active \
+                    and pg.is_primary():
+                self._requeue_waiters(pg)
             return
         pg.info.same_interval_since = self.osdmap.epoch
         pg.in_flight.clear()
@@ -499,9 +506,17 @@ class OSD:
             pg.waiting_for_active.append((conn, msg))
             return
         if pool.is_erasure():
+            from .ecbackend import _EC_WRITE_OPS
+            ec_writes = any(o["op"] in _EC_WRITE_OPS for o in msg.ops)
+            if ec_writes and not self._write_quorum(pg, pool):
+                pg.waiting_for_active.append((conn, msg))
+                return
             self.msgr.spawn(self.ec.handle_op(pg, conn, msg))
             return
         writes = any(o["op"] in _WRITE_OPS for o in msg.ops)
+        if writes and not self._write_quorum(pg, pool):
+            pg.waiting_for_active.append((conn, msg))
+            return
         oid = msg.oid
         if oid in pg.missing:
             pg.waiting_for_active.append((conn, msg))
@@ -514,6 +529,23 @@ class OSD:
             conn.send(MOSDOpReply(tid=msg.tid, result=result,
                                   outs=outs, epoch=self.osdmap.epoch,
                                   version=0))
+
+    def _write_quorum(self, pg: PG, pool) -> bool:
+        """min_size write gating (PeeringState is_active checks: the
+        reference blocks I/O while |acting| < pool.min_size).  EC
+        additionally requires k live shards — acking a write persisted
+        on fewer than k shards would make the object durable but
+        unreadable."""
+        live = sum(1 for o in pg.acting
+                   if o >= 0 and self.osdmap.is_up(o))
+        need = pool.min_size
+        if pool.is_erasure():
+            try:
+                need = max(need,
+                           self.ec.codec(pool).get_data_chunk_count())
+            except Exception:
+                pass  # unknown profile: handle_op will fail the op
+        return live >= need
 
     # read-side op interpreter (do_osd_ops read branch)
     def _do_read_ops(self, pg: PG, oid: str, ops: list):

@@ -81,9 +81,11 @@ class OSDMapMapping:
 
     def _map_pool_device(self, osdmap: OSDMap, pool: PGPool, dm,
                          exists, isup, aff):
-        pps = pps_for_pool(pool, np.arange(pool.pg_num))
-        return dm.map_pgs_batch(
-            pool.crush_rule, pps, pool.size, osdmap.osd_weight,
+        from ..osd.osdmap import FLAG_HASHPSPOOL
+        return dm.map_pool_batch(
+            pool.crush_rule, pool.size, pool.pg_num, pool.pgp_num,
+            pool.pgp_num_mask, pool.id,
+            bool(pool.flags & FLAG_HASHPSPOOL), osdmap.osd_weight,
             exists, isup, aff, can_shift=pool.can_shift_osds())
 
     # -- scalar fallback ---------------------------------------------------
@@ -138,13 +140,8 @@ class OSDMapMapping:
 
 
 def pps_for_pool(pool: PGPool, ps: np.ndarray) -> np.ndarray:
-    """Vectorized raw_pg_to_pps over a pool's ps range
-    (osd_types.cc:1815-1831)."""
-    b, bmask = pool.pgp_num, pool.pgp_num_mask
-    masked = np.where((ps & bmask) < b, ps & bmask, ps & (bmask >> 1))
+    """Vectorized raw_pg_to_pps over a pool's ps range."""
+    from ..ops.crush.hashes import pps_seed_v
     from ..osd.osdmap import FLAG_HASHPSPOOL
-
-    if pool.flags & FLAG_HASHPSPOOL:
-        return hash32_2_v(masked.astype(np.uint32),
-                          np.uint32(pool.id)).astype(np.int64)
-    return masked.astype(np.int64) + pool.id
+    return pps_seed_v(ps, pool.pgp_num, pool.pgp_num_mask, pool.id,
+                      bool(pool.flags & FLAG_HASHPSPOOL))

@@ -214,3 +214,101 @@ class TestGoldenMaps:
                         % (name, ruleno, x, row.tolist(), exp))
                 ran += 1
         assert ran > 0, "no straw2 golden cases matched the device scope"
+
+
+class TestF32Draw:
+    """The f32 certainty draw's soundness contract (device.py module
+    docstring): g_f32 must stay within _G_DELTA/2 of the exact
+    2^48-crush_ln over the whole 16-bit domain, and the exact division
+    used by the top-2 resolution must be exact."""
+
+    def test_poly_bound_exhaustive(self):
+        import jax.numpy as jnp
+        from ceph_tpu.ops.crush import device as D
+        from ceph_tpu.ops.crush.host import crush_ln
+
+        us = np.arange(65536, dtype=np.int64)
+        g = np.asarray(D._g_f32(jnp.asarray(us)), dtype=np.float64)
+        exact = np.array([(1 << 48) - crush_ln(int(u)) for u in us],
+                         dtype=np.float64)
+        err = np.abs(g - exact).max()
+        # margin: DELTA carries 2x headroom over the numpy-simulated fit
+        assert err <= D._G_DELTA * 0.75, err
+
+    def test_exact_floordiv(self):
+        import jax.numpy as jnp
+        from ceph_tpu.ops.crush.device import _exact_floordiv
+
+        rng = np.random.default_rng(11)
+        neg = rng.integers(0, 1 << 49, size=4096, dtype=np.int64)
+        neg[:8] = [0, 1, (1 << 49) - 1, 1 << 48, 12345, 65535, 2, 3]
+        w = rng.integers(1, 1 << 32, size=4096, dtype=np.int64)
+        w[:6] = [1, 2, 3, 0x10000, (1 << 32) - 1, 7]
+        recip = (1.0 / w).astype(np.float32)
+        q = np.asarray(_exact_floordiv(
+            jnp.asarray(neg), jnp.asarray(w), jnp.asarray(recip)))
+        assert np.array_equal(q, neg // w)
+
+    def test_exact2_matches_host_draw(self):
+        """Random u/w pairs through the top-2 resolver vs the host
+        engine's exponential draw comparison."""
+        import jax.numpy as jnp
+        from ceph_tpu.ops.crush import device as D
+        from ceph_tpu.ops.crush.host import crush_ln, _div_s64
+
+        rng = np.random.default_rng(12)
+        n = 2048
+        u1 = rng.integers(0, 65536, size=n).astype(np.int64)
+        u2 = rng.integers(0, 65536, size=n).astype(np.int64)
+        w1 = rng.integers(0, 1 << 20, size=n).astype(np.int64)
+        w2 = rng.integers(0, 1 << 20, size=n).astype(np.int64)
+        s1 = np.zeros(n, np.int32)
+        s2 = np.ones(n, np.int32)
+        # third candidate: zero weight, never wins
+        u3 = np.zeros(n, np.int64)
+        w3 = np.zeros(n, np.int64)
+        s3 = np.full(n, 2, np.int32)
+        win = np.asarray(D._exact3_winner(
+            None,
+            (jnp.asarray(u1), jnp.asarray(u2), jnp.asarray(u3)),
+            (jnp.asarray(w1), jnp.asarray(w2), jnp.asarray(w3)),
+            (jnp.asarray(s1), jnp.asarray(s2), jnp.asarray(s3))))
+        for i in range(n):
+            # host: maximize trunc((ln-2^48)/w), first index on ties
+            d1 = (_div_s64(crush_ln(int(u1[i])) - (1 << 48), int(w1[i]))
+                  if w1[i] else -(1 << 63))
+            d2 = (_div_s64(crush_ln(int(u2[i])) - (1 << 48), int(w2[i]))
+                  if w2[i] else -(1 << 63))
+            expect = 1 if d2 > d1 else 0
+            assert win[i] == expect, (i, u1[i], u2[i], w1[i], w2[i],
+                                      d1, d2, win[i])
+
+
+class TestLargeBatch:
+    """Exercises the optimistic-attempt + compacted-tail path
+    (L >= _ATTEMPT_MIN_L) and the pass-2 resolve flow, sampled against
+    the host engine."""
+
+    @pytest.mark.parametrize("ruleno", [0, 1])
+    def test_attempt_path_parity(self, ruleno):
+        m = _two_level_map(hosts=8, per_host=4, seed=5)
+        w = [0x10000] * 32
+        w[3] = 0
+        w[11] = 0x6000
+        L = 20000  # > _ATTEMPT_MIN_L
+        from ceph_tpu.ops.crush import device as D
+        old = D._ATTEMPT_MIN_L
+        D._ATTEMPT_MIN_L = 4096
+        try:
+            dm = DeviceMapper(m)
+            xs = np.arange(L, dtype=np.int64) * 2654435761 % (1 << 32)
+            got = dm.do_rule_batch(ruleno, xs, 3, w)
+            host = Mapper(m)
+            rng = random.Random(9)
+            lanes = rng.sample(range(L), 800)
+            for i in lanes:
+                expect = host.do_rule(ruleno, int(xs[i]), 3, list(w))
+                expect = expect + [0x7FFFFFFF] * (3 - len(expect))
+                assert got[i].tolist() == expect, (i, int(xs[i]))
+        finally:
+            D._ATTEMPT_MIN_L = old
