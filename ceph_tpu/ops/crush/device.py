@@ -611,6 +611,21 @@ def _straw2_choose(fm: FlatMap, bid, x, r, pos, S: int, resolve: bool,
     return item, flag
 
 
+def _get_pallas_descend(fm: FlatMap, depth_sizes: tuple,
+                        want_type: int):
+    """Cached fused-descent kernel for (fm, depth_sizes, want_type);
+    None when pallas is unavailable or the map exceeds its budget."""
+    from . import pallas_draw
+    if not pallas_draw.pallas_enabled():
+        return None
+    cache = fm.__dict__.setdefault("_pallas_cache", {})
+    key = (depth_sizes, want_type)
+    if key not in cache:
+        cache[key] = pallas_draw.make_descend_kernel(
+            fm, depth_sizes, want_type)
+    return cache[key]
+
+
 def _descend(fm: FlatMap, take_bid, x, r, want_type: int, pos,
              depth_sizes: tuple, resolve: bool,
              crow0: _ConstRow | None = None):
@@ -628,6 +643,14 @@ def _descend(fm: FlatMap, take_bid, x, r, want_type: int, pos,
     actually walked.
     """
     L = x.shape[0]
+    if not resolve:
+        from . import pallas_draw
+        if L % pallas_draw.TL == 0:
+            fn = _get_pallas_descend(fm, depth_sizes, want_type)
+            if fn is not None:
+                item, status = fn(x, r, take_bid, pos)
+                return (item, (status & 1) != 0, (status & 2) != 0,
+                        (status & 4) != 0)
     cur = take_bid
     item = jnp.full((L,), ITEM_NONE, jnp.int32)
     ok = jnp.zeros((L,), bool)
@@ -1023,11 +1046,9 @@ def _post_process(raw, seeds, exists_b, isup_b, aff, can_shift: bool,
     D = exists_b.shape[0]
     valid = raw != ITEM_NONE
     idx = jnp.clip(raw, 0, D - 1)
-    # one fused 18-bit state fetch: keep bit | primary affinity
-    state_t = (((exists_b & isup_b).astype(jnp.int32) << 17)
-               | (aff & 0x1FFFF))
-    st = small_fetch(state_t, idx, 3)
-    keep = valid & (raw < D) & ((st >> 17) > 0)
+    keep_t = (exists_b & isup_b).astype(jnp.int32)
+    st = small_fetch(keep_t, idx, 1)
+    keep = valid & (raw < D) & (st > 0)
     up = jnp.where(keep, raw, ITEM_NONE)
     if can_shift:
         # stable compaction: surviving osds keep order, holes go last.
@@ -1233,8 +1254,12 @@ class DeviceMapper:
         """Whole pool in ONE dispatch: a lax.scan over fixed-size
         chunks (the chunking bounds the live [L,S] temps, the scan
         removes per-chunk dispatch/readback latency — significant over
-        a remote-chip tunnel)."""
-        core = self._compile(ruleno, result_max, False)
+        a remote-chip tunnel).  full=False: the dense pass runs the
+        bounded optimistic-attempt structure; lanes needing deeper
+        retries are flagged and settled by the resolve passes, so the
+        dense cost is fixed at numrep×_ATTEMPT_TRIES descents instead
+        of being dragged by the worst lane's retry count."""
+        core = self._compile(ruleno, result_max, False, full=False)
 
         def chunk(start):
             ps = jnp.arange(n, dtype=jnp.uint32) + start
@@ -1416,14 +1441,18 @@ class DeviceMapper:
         flagged = np.nonzero(flag)[0]
         if flagged.size:
             rfn = self._compiled(ruleno, result_max, True)
-            part = xs[flagged]
+            # pad to a pow2 bucket: a per-call exact size would recompile
+            # the full retry pipeline for every distinct flagged count
+            n2 = max(8, 1 << (int(flagged.size) - 1).bit_length())
+            part = np.zeros((n2,), np.int64)
+            part[:flagged.size] = xs[flagged]
             r2, f2 = rfn(jnp.asarray(part, dtype=jnp.uint32), w)
-            res[flagged] = np.array(r2)
-            f2 = np.array(f2)
+            res[flagged] = np.array(r2)[:flagged.size]
+            f2 = np.array(f2)[:flagged.size]
             for lane in flagged[np.nonzero(f2)[0]]:
                 row = self._host_raw(ruleno, int(xs[lane]), result_max,
                                      dev_weights)
-                res[lane] = row
+                res[lane] = row[:res.shape[1]]
         return res
 
     # -- host dust (scalar exact fallback) ------------------------------

@@ -1,0 +1,304 @@
+"""Fused Pallas TPU kernel for the CRUSH bucket descent.
+
+The XLA formulation of the f32 certainty draw (device.py `_straw2_choose`
+/ `_descend`) materialises ~15 [L, S]-shaped f32/i32 temporaries per
+draw in HBM — measured ~37 KB of HBM traffic per PG for the bulk-map
+fast pass, which makes the 10M-PG remap bandwidth-bound (XLA cost
+analysis: ~37 GB written per 1M-lane chunk).  This kernel runs the whole
+multi-level descent — rjenkins hash, the f32 log approximation, the
+per-item certainty intervals, winner select, child-bucket walk
+(mapper.c:438-520 descent structure) — inside VMEM, so HBM traffic per
+descend drops to the lane vectors themselves (~20 B/lane).
+
+Layout: lanes ride the 128-wide lane axis in tiles of TL; bucket items
+ride the sublane axis ([S_d, TL] per level).  Per-lane bucket rows are
+fetched with one int8 one-hot MXU matmul per level from transposed limb
+tables ([R_d, n_pos*B] int8, the same 8-bit-limb packing as
+device.FlatMap) — gathers run at scalar rate on TPU, one-hot matmuls at
+MXU rate, and integer matmuls are exact.
+
+Semantics match device._descend with resolve=False bit-for-bit at the
+*logic* level; the f32 draw values may differ across backends by FMA /
+reassociation, which the doubled _G_DELTA headroom in the certainty
+bound absorbs — an uncertain winner is flagged either way and settled
+by the exact resolve pass, so end results stay bit-identical to the
+host engine (verified by tests/test_crush_device.py on golden vectors).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+GW = 512           # lanes per sublane group (128-multiple)
+TL = 8 * GW        # lanes per tile: 8 sublane rows of GW
+_MAX_TABLE_BYTES = 6 << 20   # VMEM budget for the per-level limb tables
+_S_BIG = 0x7FFF              # > any slot index; argmin-tiebreak sentinel
+
+
+def pallas_enabled() -> bool:
+    """Mosaic lowering needs a real TPU; tests force interpret mode via
+    CEPH_TPU_PALLAS_INTERPRET=1 to cover the kernel logic on CPU."""
+    if os.environ.get("CEPH_TPU_NO_PALLAS_CRUSH"):
+        return False
+    if os.environ.get("CEPH_TPU_PALLAS_INTERPRET"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _limb_planes(vals: np.ndarray, n_limbs: int, offset: int = 0
+                 ) -> np.ndarray:
+    """[B, S] int -> [n_limbs*S, B] int8 limb planes (limb-major blocks,
+    biased by -128), transposed for the [R, B] @ [B, TL] fetch."""
+    v = vals.astype(np.int64) - offset
+    assert (v >= 0).all() and (v < (1 << (8 * n_limbs))).all()
+    planes = [(((v >> (8 * j)) & 0xFF) - 128).astype(np.int8)
+              for j in range(n_limbs)]
+    return np.concatenate([p.T for p in planes], axis=0)
+
+
+def _unpack_rows(f, S: int, n_limbs: int, base: int, offset: int = 0):
+    """[R, TL] i32 matmul result -> [S, TL] i32 from limb-plane rows
+    starting at `base`."""
+    acc = f[base:base + S, :] + 128
+    for j in range(1, n_limbs):
+        acc = acc + ((f[base + j * S:base + (j + 1) * S, :] + 128)
+                     << (8 * j))
+    if offset:
+        acc = acc + offset
+    return acc
+
+
+class _LevelTables:
+    """Static per-level fetch tables for one (fm, depth_sizes) pair."""
+
+    def __init__(self, fm, depth_sizes):
+        self.nl = nl = fm.nl_id
+        self.dup = dup = 0 if fm.ids_equal_items else nl
+        self.n_pos = n_pos = fm.n_pos
+        self.B = B = fm.B
+        self.tables = []
+        nbytes = 0
+        for S_d in depth_sizes:
+            blocks = []
+            ids = np.tile(fm._ids_np[:, :S_d], (n_pos, 1))
+            blocks.append(_limb_planes(ids, nl, fm.id_offset))
+            if dup:
+                items = np.tile(fm._items_np[:, :S_d], (n_pos, 1))
+                blocks.append(_limb_planes(items, nl, fm.id_offset))
+            rb = fm._recipbits_np.reshape(n_pos * B, -1)[:, :S_d]
+            blocks.append(_limb_planes(rb, 3))
+            size = np.tile(fm._size_np[:, None], (n_pos, 1))
+            blocks.append(_limb_planes(size, 2))
+            tbl = np.concatenate(blocks, axis=0)
+            nbytes += tbl.nbytes
+            self.tables.append(tbl)
+        # [4, B]: rows = [size limb0, size limb1, btype limb0, limb1]
+        self.meta = np.concatenate(
+            [_limb_planes(fm._size_np[:, None], 2),
+             _limb_planes(fm._btype_np[:, None], 2)], axis=0)
+        self.nbytes = nbytes + self.meta.nbytes
+
+    def row_count(self, S_d: int) -> int:
+        return (self.nl + self.dup + 3) * S_d + 2
+
+
+def _hash_mix(a, b, c):
+    u = np.uint32
+    a = a - b; a = a - c; a = a ^ (c >> u(13))
+    b = b - c; b = b - a; b = b ^ (a << u(8))
+    c = c - a; c = c - b; c = c ^ (b >> u(13))
+    a = a - b; a = a - c; a = a ^ (c >> u(12))
+    b = b - c; b = b - a; b = b ^ (a << u(16))
+    c = c - a; c = c - b; c = c ^ (b >> u(5))
+    a = a - b; a = a - c; a = a ^ (c >> u(3))
+    b = b - c; b = b - a; b = b ^ (a << u(10))
+    c = c - a; c = c - b; c = c ^ (b >> u(15))
+    return a, b, c
+
+
+def _hash32_3(a, b, c, seed):
+    u = np.uint32
+    h = u(seed) ^ a ^ b ^ c
+    x, y = u(231232), u(1232)
+    a, b, h = _hash_mix(a, b, h)
+    c, x, h = _hash_mix(c, x, h)
+    y, a, h = _hash_mix(y, a, h)
+    b, x, h = _hash_mix(b, x, h)
+    y, c, h = _hash_mix(y, c, h)
+    return h
+
+
+def _g_poly(u, coef):
+    """f32 approximation of 2^48 - crush_ln(u); mirrors device._g_f32."""
+    x = (u + 1).astype(jnp.int32)
+    xf = x.astype(jnp.float32)
+    b = jax.lax.bitcast_convert_type(xf, jnp.int32)
+    e = ((b >> 23) - 127).astype(jnp.float32)
+    mm = jax.lax.bitcast_convert_type(
+        (b & 0x7FFFFF) | 0x3F800000, jnp.float32) - np.float32(1.0)
+    acc = jnp.full_like(mm, np.float32(coef[-1]))
+    for c in coef[-2::-1]:
+        acc = acc * mm + np.float32(c)
+    return np.float32(2.0 ** 44) * ((np.float32(16.0) - e) - acc)
+
+
+def make_descend_kernel(fm, depth_sizes: tuple, want_type: int):
+    """Compiled fused descent: fn(x, r, bid, pos) -> (item, status) with
+    x/r/bid/pos int32 [L] (L % TL == 0) and status bits
+    ok=1 | perm=2 | flag=4.  Returns None when the map doesn't fit the
+    kernel's budget (caller falls back to the XLA path)."""
+    from jax.experimental import pallas as pl
+    from . import device as dev
+    from ...models.crushmap import ITEM_NONE
+
+    lt = _LevelTables(fm, depth_sizes)
+    if lt.nbytes > _MAX_TABLE_BYTES or lt.n_pos * lt.B > 4096:
+        return None
+    nl, dup, n_pos, B = lt.nl, lt.dup, lt.n_pos, lt.B
+    max_devices = int(fm.max_devices)
+    coef = dev._LOG2_COEF
+    g_delta = float(dev._G_DELTA)
+    eps_q = float(dev._EPS_Q)
+    e_const = float(dev._E_CONST)
+    big = float(3.0e38)
+    seed = dev.HASH_SEED
+    i8, i32, f32, u32 = jnp.int8, jnp.int32, jnp.float32, jnp.uint32
+    c32, cf32, cu32 = np.int32, np.float32, np.uint32
+    # keep tables as host numpy: make_descend_kernel is lazily reached
+    # inside jit traces, where jnp.asarray would bind the constant to
+    # the live trace and leak it into later traces (cf. FlatMap row
+    # cache) — numpy inputs become ordinary jit constants instead
+    tbls = [np.asarray(t) for t in lt.tables]
+    meta_t = np.asarray(lt.meta)
+    n_lvl = len(depth_sizes)
+
+    def group(d, S_d, tbl_ref, meta_ref, xg, rg, posg, st):
+        """One level advance for one GW-lane sublane group.
+        xg/rg/posg [1, GW]; st = (cur, done, ok, perm, flag, item)."""
+        cur, done, ok, perm, flag, item = st
+        col = cur if n_pos == 1 else posg * c32(B) + cur
+        iota_b = jax.lax.broadcasted_iota(i32, (n_pos * B, GW), 0)
+        oh = (iota_b == col).astype(i8)
+        f = jax.lax.dot_general(
+            tbl_ref[...], oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=i32)            # [R_d, GW]
+        ids = _unpack_rows(f, S_d, nl, 0, fm.id_offset)
+        if dup:
+            items_a = _unpack_rows(f, S_d, nl, nl * S_d, fm.id_offset)
+        else:
+            items_a = ids
+        rbits = _unpack_rows(f, S_d, 3, (nl + dup) * S_d)
+        recipf = jax.lax.bitcast_convert_type(rbits << 8, f32)
+        size = _unpack_rows(f, 1, 2, (nl + dup + 3) * S_d)   # [1, GW]
+        iota_s = jax.lax.broadcasted_iota(i32, (S_d, GW), 0)
+        valid = (iota_s < size) & (recipf > 0)
+        u = (_hash32_3(xg, ids.astype(u32), rg, seed)
+             & cu32(0xFFFF)).astype(i32)
+        g = _g_poly(u, coef)
+        q = jnp.where(valid, g * recipf, cf32(big))
+        E = cf32(g_delta) * recipf + q * cf32(eps_q) + cf32(e_const)
+        hi = jnp.where(valid, q + E, cf32(big))
+        low = jnp.where(valid, q - E, cf32(big))
+        min_hi = jnp.min(hi, axis=0, keepdims=True)
+        contend = valid & (low <= min_hi)
+        ncont = jnp.sum(contend.astype(i32), axis=0, keepdims=True,
+                        dtype=i32)
+        certain = ncont <= 1
+        minq = jnp.min(q, axis=0, keepdims=True)
+        i1 = jnp.min(jnp.where(q == minq, iota_s, c32(_S_BIG)),
+                     axis=0, keepdims=True)
+        winc = jnp.min(jnp.where(contend, iota_s, c32(_S_BIG)),
+                       axis=0, keepdims=True)
+        win = jnp.where(ncont == 1, winc, i1)
+        chosen = jnp.sum(jnp.where(iota_s == win, items_a, c32(0)),
+                         axis=0, keepdims=True, dtype=i32)
+        if d == 0:
+            done = size == 0            # empty start bucket: retryable
+        flag = flag | ((~done) & (~certain))
+        is_bucket = chosen < 0
+        cbid = jnp.where(is_bucket, c32(-1) - chosen, c32(0))
+        iota_mb = jax.lax.broadcasted_iota(i32, (B, GW), 0)
+        ohc = (iota_mb == cbid).astype(i8)
+        fm2 = jax.lax.dot_general(
+            meta_ref[...], ohc, (((1,), (0,)), ((), ())),
+            preferred_element_type=i32)            # [4, GW]
+        csize = _unpack_rows(fm2, 1, 2, 0)
+        cbtype = _unpack_rows(fm2, 1, 2, 2)
+        ctype = jnp.where(is_bucket, cbtype, c32(0))
+        oob = (~is_bucket) & (chosen >= c32(max_devices))
+        reach = (~done) & (ctype == c32(want_type)) & (~oob)
+        wrongdev = (~done) & (~reach) & ((~is_bucket) | oob)
+        empty_next = (~done) & (~reach) & is_bucket & (csize == 0)
+        item = jnp.where(reach, chosen, item)
+        ok = ok | reach
+        perm = perm | wrongdev
+        done = done | reach | wrongdev | empty_next
+        cur = jnp.where((~done) & is_bucket, cbid, cur)
+        return cur, done, ok, perm, flag, item
+
+    def kern(x_ref, r_ref, bid_ref, pos_ref, *refs):
+        item_ref, status_ref = refs[n_lvl + 1], refs[n_lvl + 2]
+        tbl_refs = refs[:n_lvl]
+        meta_ref = refs[n_lvl]
+        x = x_ref[...].astype(u32)                  # [8, GW]
+        r = r_ref[...].astype(u32)
+        bid = bid_ref[...]
+        pos = (jnp.minimum(pos_ref[...], c32(n_pos - 1))
+               if n_pos > 1 else bid)
+        z = jnp.zeros((1, GW), jnp.bool_)
+        states = [
+            (bid[s:s + 1, :], z, z, z, z,
+             jnp.full((1, GW), ITEM_NONE, i32))
+            for s in range(8)
+        ]
+        for d, S_d in enumerate(depth_sizes):
+            for s in range(8):
+                states[s] = group(d, S_d, tbl_refs[d], meta_ref,
+                                  x[s:s + 1, :], r[s:s + 1, :],
+                                  pos[s:s + 1, :], states[s])
+        item_ref[...] = jnp.concatenate([st[5] for st in states],
+                                        axis=0)
+        status_ref[...] = jnp.concatenate(
+            [st[2].astype(i32) | (st[3].astype(i32) << 1)
+             | (st[4].astype(i32) << 2) for st in states], axis=0)
+
+    interp = _interpret()
+
+    @jax.jit
+    def run(x, r, bid, pos):
+        L = x.shape[0]
+        G = L // TL
+        W = L // 8
+        # index maps must yield int32 — under x64 plain ints trace as
+        # i64, which mosaic cannot legalize (cf. ec/kernels.py)
+        z2 = lambda i: (jnp.int32(0), jnp.int32(0))  # noqa: E731
+        shp = jax.ShapeDtypeStruct((8, W), jnp.int32)
+        lane = pl.BlockSpec((8, GW),
+                            lambda i: (jnp.int32(0), jnp.int32(i)))
+        full = [pl.BlockSpec(t.shape, z2) for t in tbls]
+        mspec = pl.BlockSpec(meta_t.shape, z2)
+        item, status = pl.pallas_call(
+            kern,
+            grid=(G,),
+            in_specs=[lane, lane, lane, lane] + full + [mspec],
+            out_specs=(lane, lane),
+            out_shape=(shp, shp),
+            interpret=interp,
+        )(x.reshape(8, W).astype(jnp.int32),
+          r.reshape(8, W).astype(jnp.int32),
+          bid.reshape(8, W).astype(jnp.int32),
+          pos.reshape(8, W).astype(jnp.int32),
+          *tbls, meta_t)
+        return item.reshape(L), status.reshape(L)
+
+    return run
