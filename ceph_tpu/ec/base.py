@@ -126,6 +126,95 @@ class ErasureCode(ErasureCodeInterface):
         encoded = self.encode_chunks(prepared)
         return {i: encoded[i] for i in want_to_encode}
 
+    # -- device offload (TPU path) ------------------------------------
+
+    def _device_matrix(self):
+        """(matrix, w) when this codec is a plain GF(2^w) matrix code
+        whose encode is a region matmul — the shape the device batcher
+        offloads.  None keeps the sync host path (layered/shingled
+        codes, bit-search codes)."""
+        return None
+
+    @staticmethod
+    def _word_dtype(w: int):
+        import numpy as np
+        return {8: np.uint8, 16: "<u2", 32: "<u4"}[w]
+
+    async def encode_async(self, want_to_encode: set[int],
+                           data: bytes) -> dict[int, bytes]:
+        """encode() with the GF matmul batched onto the device across
+        concurrent callers (ECBackend's hot call,
+        src/osd/ECTransaction.cc:56 -> encode_chunks).  Falls back to
+        the sync host path when offload is disabled or the codec has
+        no plain matrix form."""
+        from .batcher import DeviceBatcher, device_offload_enabled
+        dm = self._device_matrix()
+        if dm is None or len(data) == 0 or not device_offload_enabled():
+            return self.encode(want_to_encode, data)
+        import numpy as np
+        matrix, w = dm
+        prepared = self.encode_prepare(data)
+        arr = np.stack([
+            np.frombuffer(prepared[self.chunk_index(i)],
+                          dtype=self._word_dtype(w))
+            for i in range(self.get_data_chunk_count())])
+        parity = await DeviceBatcher.get().encode(matrix, w, arr)
+        out = dict(prepared)
+        for i in range(len(matrix)):
+            out[self.chunk_index(
+                self.get_data_chunk_count() + i)] = parity[i].tobytes()
+        return {i: out[i] for i in want_to_encode}
+
+    async def decode_async(self, want_to_read: set[int],
+                           chunks: Mapping[int, bytes],
+                           ) -> dict[int, bytes]:
+        """decode() with the reconstruction matmul batched onto the
+        device (the ECBackend degraded-read/recovery call,
+        src/osd/ECUtil.cc:12-121).  Reconstruction is an encode with
+        the inverted-survivor matrix, so it shares the encode queue."""
+        from .batcher import (DeviceBatcher, device_offload_enabled,
+                              reconstruct_matrix)
+        dm = self._device_matrix()
+        if (dm is None or not device_offload_enabled()
+                or self.chunk_mapping
+                or want_to_read <= set(chunks)
+                or any(len(c) == 0 for c in chunks.values())):
+            return self.decode(want_to_read, chunks)
+        if len(chunks) < self.get_data_chunk_count():
+            raise IOError(
+                "cannot decode: %d chunks available, %d needed"
+                % (len(chunks), self.get_data_chunk_count()))
+        lengths = {len(c) for c in chunks.values()}
+        if len(lengths) != 1:
+            raise ValueError(
+                "surviving chunks have differing sizes %s" % lengths)
+        import numpy as np
+        matrix, w = dm
+        k = self.get_data_chunk_count()
+        have = tuple(sorted(chunks))
+        erased = tuple(i for i in sorted(want_to_read)
+                       if i not in chunks)
+        rows, chosen = reconstruct_matrix(k, w, matrix, erased, have)
+        arr = np.stack([
+            np.frombuffer(chunks[c], dtype=self._word_dtype(w))
+            for c in chosen])
+        words = await DeviceBatcher.get().encode(rows, w, arr)
+        out = {}
+        for j, e in enumerate(erased):
+            out[e] = words[j].tobytes()
+        for i in want_to_read:
+            if i in chunks:
+                out[i] = bytes(chunks[i])
+        return out
+
+    async def decode_concat_async(self, chunks: Mapping[int, bytes],
+                                  ) -> bytes:
+        k = self.get_data_chunk_count()
+        want = {self.chunk_index(i) for i in range(k)}
+        decoded = await self.decode_async(want, chunks)
+        return b"".join(decoded[self.chunk_index(i)]
+                        for i in range(k))
+
     # Locality-aware codes (LRC, SHEC) can repair from FEWER than k
     # chunks (a local group / shingle window); they clear this flag so
     # _decode skips the k-chunk floor while keeping the size check.

@@ -97,6 +97,9 @@ class ErasureCodeIsa(ErasureCode):
     def get_alignment(self) -> int:
         return EC_ISA_ADDRESS_ALIGNMENT
 
+    def _device_matrix(self):
+        return self.matrix, 8
+
     def get_chunk_size(self, object_size: int) -> int:
         chunk = -(-object_size // self.k)
         mod = chunk % self.get_alignment()

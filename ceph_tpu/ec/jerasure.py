@@ -88,6 +88,9 @@ class _MatrixTechnique(ErasureCodeJerasure):
         super().__init__()
         self.matrix: list[list[int]] = []
 
+    def _device_matrix(self):
+        return self.matrix, self.w
+
     def get_alignment(self) -> int:
         if self.per_chunk_alignment:
             return self.w * LARGEST_VECTOR_WORDSIZE

@@ -137,7 +137,10 @@ def compile(text: str) -> CrushMap:  # noqa: A001 (reference name)
             if words[0] == "id":
                 bid = int(words[1])
             elif words[0] == "item":
-                weight = 1.0
+                # None = unspecified: devices default to 1.0, bucket
+                # children to their computed subtree weight — an
+                # EXPLICIT "weight 1.00" on a bucket child must stick
+                weight = None
                 if "weight" in words:
                     weight = float(words[words.index("weight") + 1])
                 items.append((words[1], weight))
@@ -156,12 +159,12 @@ def compile(text: str) -> CrushMap:  # noqa: A001 (reference name)
                 raise CompileError("line %d: unknown item %r"
                                    % (lineno, iname))
             cid = names[iname]
-            if cid < 0:
-                # bucket child: weight is its subtree weight unless
-                # overridden
-                sub = next((p for p in parsed if p[2] == cid), None)
-                if w == 1.0 and sub is not None:
-                    w = None  # filled after children resolve
+            if w is None:
+                if cid < 0 and any(p[2] == cid for p in parsed):
+                    pass     # bucket child: subtree weight, filled
+                             # after children resolve
+                else:
+                    w = 1.0  # device default
             child_ids.append(cid)
             weights.append(w)
         parsed_w = []

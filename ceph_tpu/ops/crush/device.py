@@ -139,7 +139,9 @@ _LOG2_COEF = (
     -0.05415186285972595, 0.00885970052331686,
 )
 _G_DELTA = 825135650.0 * 2.0
-_EPS_Q = 2.0 ** -14      # covers recip truncation (2^-16) + f32 rounding
+_EPS_Q = 2.0 ** -21      # q = g*recipf relative error: recipf is the
+                         # correctly-rounded f32 of 1/w (2^-24) plus one
+                         # product rounding (2^-24), with 4x margin
 _E_CONST = 4.0           # floor slack + crumbs
 _BIG = jnp.float32(3.0e38)
 
@@ -317,7 +319,7 @@ class _ConstRow:
     def __init__(self, ids, items, recipf, w, size):
         self.ids = ids          # np [S] int32
         self.items = items      # np [S] int32
-        self.recipf = recipf    # np [S] f32 (24-bit-truncated reciprocal)
+        self.recipf = recipf    # np [S] f32 (correctly-rounded 1/w)
         self.w = w              # np [S] int32
         self.size = size        # python int
 
@@ -380,22 +382,21 @@ class FlatMap:
         self.n_pos = n_pos
         self.rules = dict(m.rules)
 
-        # 24-bit-truncated f32 reciprocals of the 16.16 weights: enough
-        # mantissa that the recip truncation term stays inside _EPS_Q
+        # correctly-rounded f32 reciprocals of the 16.16 weights: full
+        # mantissa keeps the q-product error inside the tight _EPS_Q
         with np.errstate(divide="ignore"):
             recipf = np.where(
                 pos_w > 0,
                 (np.float32(1.0)
                  / np.maximum(pos_w, 1).astype(np.float32)),
                 np.float32(0.0)).astype(np.float32)
-        self._recipbits_np = (recipf.view(np.uint32) >> 8).astype(np.int64)
-        self._recipf_np = ((recipf.view(np.uint32) >> 8) << 8
-                           ).astype(np.uint32).view(np.float32)
+        self._recipbits_np = recipf.view(np.uint32).astype(np.int64)
+        self._recipf_np = recipf
         self._w_np = pos_w
 
         # -- gather-free lookup tables -----------------------------------
         # per-(pos,bucket) row: for each item slot s, limbs
-        # [ids(nl) | items(nl) | recip(3)], then size(2) + btype(2) at
+        # [ids(nl) | items(nl) | recip(4)], then size(2) + btype(2) at
         # the tail.  Fetched with ONE one-hot matmul per bucket visit.
         # Tables are built per requested item capacity S'
         # (row_limbs_for) so each descent level only pays for the
@@ -415,6 +416,7 @@ class FlatMap:
         self._btype_np = btype
         self._row_cache: dict[int, np.ndarray] = {}
         self._roww_cache: dict[int, np.ndarray] = {}
+        self._wpair_cache: dict[int, np.ndarray] = {}
         # per-bucket metadata fetch for arbitrary bucket ids (the child
         # bucket chosen during descent): size(2) + btype(2)
         meta = np.zeros((B, 4), np.int8)
@@ -431,7 +433,7 @@ class FlatMap:
             return tbl
         B, n_pos, nl = self.B, self.n_pos, self.nl_id
         dup = 0 if self.ids_equal_items else nl
-        pi = nl + dup + 3
+        pi = nl + dup + 4
         rows = np.zeros((n_pos * B, pi * S + 4), np.int8)
         for p in range(n_pos):
             for bi in range(B):
@@ -442,7 +444,7 @@ class FlatMap:
                     row[:, nl:2 * nl] = pack_limbs(
                         self._items_np[bi, :S], nl, self.id_offset)
                 row[:, nl + dup:pi] = pack_limbs(
-                    self._recipbits_np[p, bi, :S], 3)
+                    self._recipbits_np[p, bi, :S], 4)
                 r = rows[p * B + bi]
                 r[:pi * S] = row.reshape(-1)
                 r[pi * S:pi * S + 2] = pack_limbs(
@@ -468,6 +470,21 @@ class FlatMap:
                     self._w_np[p, bi, :S], 4).reshape(-1)
         self._roww_cache[S] = rows
         return rows
+
+    def wpair_limbs_for(self, S: int) -> np.ndarray | None:
+        """[n_pos*B*S, 4] int8 per-(bucket,slot) weight limbs: lets the
+        resolve path fetch just the top-3 candidates' weights instead of
+        unpacking [L, S] int64 rows.  None when the flattened table is
+        too large for a one-hot fetch."""
+        if self.n_pos * self.B * S > 65536:
+            return None
+        tbl = self._wpair_cache.get(S)
+        if tbl is None:
+            w = np.ascontiguousarray(
+                self._w_np[:, :, :S]).reshape(-1)
+            tbl = pack_limbs(w, 4)
+            self._wpair_cache[S] = tbl
+        return tbl
 
     def const_row(self, bucket_id: int, S: int) -> _ConstRow | None:
         """Host row of a single static bucket (level-0 fetch skip);
@@ -497,7 +514,7 @@ def _fetch_row(fm: FlatMap, bid, pos, S: int):
         idx = jnp.minimum(pos, fm.n_pos - 1) * fm.B + bid
     nl = fm.nl_id
     dup = 0 if fm.ids_equal_items else nl
-    pi = nl + dup + 3
+    pi = nl + dup + 4
     r = onehot_fetch(idx, fm.row_limbs_for(S))       # [L, pi*S+4] int32
     per = r[..., :pi * S].reshape(*bid.shape, S, pi)
     ids = unpack_limbs32(per[..., 0:nl], nl, fm.id_offset)
@@ -505,8 +522,8 @@ def _fetch_row(fm: FlatMap, bid, pos, S: int):
         items = unpack_limbs32(per[..., nl:nl + dup], nl, fm.id_offset)
     else:
         items = ids
-    rb = unpack_limbs32(per[..., nl + dup:pi], 3)
-    recipf = jax.lax.bitcast_convert_type(rb << 8, jnp.float32)
+    rb = unpack_limbs32(per[..., nl + dup:pi], 4)
+    recipf = jax.lax.bitcast_convert_type(rb, jnp.float32)
     size = unpack_limbs32(r[..., pi * S:pi * S + 2], 2)
     return ids, items, recipf, size
 
@@ -535,7 +552,40 @@ def _pick(arr, sel):
     return jnp.sum(jnp.where(sel, arr, jnp.zeros_like(arr)), axis=1)
 
 
-def _straw2_choose(fm: FlatMap, bid, x, r, pos, S: int, resolve: bool,
+def _straw2_choose_exact(fm: FlatMap, bid, x, r, pos, S: int,
+                         crow: _ConstRow | None = None):
+    """Fully exact straw2 draw: integer q for every slot (no f32
+    shortcut, no flags).  Used for the dust lanes whose top-3 interval
+    resolution stays ambiguous — replaces the scalar host fallback so
+    the whole mapping pipeline can stay device-resident."""
+    if crow is not None:
+        ids = jnp.asarray(crow.ids)[None, :]
+        items_a = jnp.asarray(crow.items)[None, :]
+        recipf = jnp.asarray(crow.recipf)[None, :]
+        size = jnp.int32(crow.size)
+        valid = (jnp.arange(S) < size)[None, :] & (recipf > 0)
+        wv = jnp.asarray(crow.w)[None, :] * jnp.ones(
+            (x.shape[0], 1), jnp.int64)
+    else:
+        ids, items_a, recipf, size = _fetch_row(fm, bid, pos, S)
+        valid = (jnp.arange(S)[None, :] < size[:, None]) & (recipf > 0)
+        wv = _fetch_w(fm, bid, pos, S)
+    u = (hash32_3_j(x[:, None], ids, r[:, None])
+         & _u32(0xFFFF)).astype(jnp.int64)
+    neg = neg_ln_mxu(u, jnp.asarray(_RHLH_LIMBS_NP),
+                     jnp.asarray(_LL_LIMBS_NP))
+    w = wv & 0xFFFFFFFF
+    wsafe = jnp.maximum(w, 1)
+    rf = jnp.float32(1.0) / wsafe.astype(jnp.float32)
+    q = _exact_floordiv(neg, wsafe, rf)
+    q = jnp.where(valid & (w > 0), q, jnp.int64(S64_MAX))
+    win = jnp.argmin(q, axis=1).astype(jnp.int32)  # first-slot ties
+    selw = jnp.arange(S)[None, :] == win[:, None]
+    item = jnp.sum(jnp.where(selw, items_a, 0), axis=1).astype(jnp.int32)
+    return item, jnp.zeros((x.shape[0],), bool)
+
+
+def _straw2_choose(fm: FlatMap, bid, x, r, pos, S: int, resolve,
                    crow: _ConstRow | None = None):
     """Winning item per lane via the f32 certainty draw.
 
@@ -543,11 +593,13 @@ def _straw2_choose(fm: FlatMap, bid, x, r, pos, S: int, resolve: bool,
     output positions (selects the choose_args weight-set,
     CrushWrapper.h:1500).  S = item capacity for this level.
 
-    Returns (item [L] int32, flag [L] bool): in fast mode flag marks
-    lanes whose winner is not certain (caller re-runs them in resolve
-    mode); in resolve mode the winner is exact and flag marks only the
-    top-3-inside-bound dust that must go to the host engine.
+    resolve: False = fast mode (flag marks lanes whose winner is not
+    certain, caller re-runs them in resolve mode); True = exact top-3
+    resolution (flag marks only top-3-inside-bound dust); "all" =
+    fully exact integer draw for every slot (never flags).
     """
+    if resolve == "all":
+        return _straw2_choose_exact(fm, bid, x, r, pos, S, crow)
     if crow is not None:
         ids = jnp.asarray(crow.ids)[None, :]
         items_a = jnp.asarray(crow.items)[None, :]
@@ -591,15 +643,35 @@ def _straw2_choose(fm: FlatMap, bid, x, r, pos, S: int, resolve: bool,
         u1 = _pick(u, sel1)
         u2 = _pick(u, sel2)
         u3 = _pick(u, sel3)
-        if crow is not None:
-            wvalid = jnp.where(valid, jnp.asarray(crow.w)[None, :],
-                               jnp.int64(0))
+        wp = fm.wpair_limbs_for(S)
+        if wp is not None:
+            # per-(bucket,slot) pair fetch for just the three
+            # candidates — the [L,S] int64 row unpack the old path did
+            # dominated resolve-mode HBM traffic
+            if fm.n_pos == 1:
+                base = bid * S
+            else:
+                base = (jnp.minimum(pos, fm.n_pos - 1) * fm.B + bid) * S
+
+            def _wfetch(slot, sel):
+                wl = onehot_fetch(base + slot, wp)          # [L, 4]
+                wv = unpack_limbs(wl, 4, 0, jnp.int64)
+                return jnp.where(jnp.any(valid & sel, axis=1), wv,
+                                 jnp.int64(0))
+
+            w1 = _wfetch(i1, sel1)
+            w2 = _wfetch(i2, sel2)
+            w3 = _wfetch(i3, sel3)
         else:
-            wv = _fetch_w(fm, bid, pos, S)
-            wvalid = jnp.where(valid, wv, jnp.int64(0))
-        w1 = _pick(wvalid, sel1)
-        w2 = _pick(wvalid, sel2)
-        w3 = _pick(wvalid, sel3)
+            if crow is not None:
+                wvalid = jnp.where(valid, jnp.asarray(crow.w)[None, :],
+                                   jnp.int64(0))
+            else:
+                wv = _fetch_w(fm, bid, pos, S)
+                wvalid = jnp.where(valid, wv, jnp.int64(0))
+            w1 = _pick(wvalid, sel1)
+            w2 = _pick(wvalid, sel2)
+            w3 = _pick(wvalid, sel3)
         win3 = _exact3_winner(fm, (u1, u2, u3), (w1, w2, w3),
                               (i1, i2, i3))
         win = jnp.where(certain, win1, win3)
@@ -720,8 +792,10 @@ def _is_out(dev_weights, item, x):
 # ---------------------------------------------------------------------------
 
 # optimistic retries fused into the full-width attempt pass; lanes
-# still failing after these land in the pass-2 resolve set
-_ATTEMPT_TRIES = 2
+# still failing after these land in the pass-2 resolve set, which the
+# device-resident resolve chain settles cheaply — three rounds balance
+# full-width dense cost against resolve-set size
+_ATTEMPT_TRIES = 3
 
 # below this lane count the optimistic attempt + compacted tail isn't
 # worth its bookkeeping; run the full retry loops directly
@@ -1100,6 +1174,115 @@ def _post_process(raw, seeds, exists_b, isup_b, aff, can_shift: bool,
 # ---------------------------------------------------------------------------
 
 
+class MapState:
+    """Device-resident result of a whole-pool mapping pass: the raw
+    (pre-filter) rows, the up rows and primaries, plus the host-side
+    inputs needed to validate incremental remaps.
+
+    Incremental validity (remap): with the crush map fixed, a lane's
+    draw sequence depends only on (x, r) and the reweight rejections
+    (mapper.c:402-416).  A rejection outcome changes only for OSDs
+    whose reweight changed; under a DECREASE every lane that ever
+    accepted the OSD carries it in a raw result slot (a pick either
+    lands in the row or collides with an earlier slot holding the same
+    OSD), so lanes without a changed OSD in their raw row replay the
+    identical sequence.  Up/down/affinity changes only affect the
+    post-CRUSH filter, which also reads the raw row.  Reweight
+    INCREASES flip previously-hash-rejected lanes that are not
+    identifiable from the rows — those fall back to a full pass."""
+
+    __slots__ = ("dm", "ruleno", "result_max", "pg_num", "pgp_num",
+                 "pgp_mask", "pool_id", "hashps", "can_shift",
+                 "use_aff", "raw", "up_full", "prim_full", "w_np",
+                 "ex_np", "iu_np", "af_np", "npg")
+
+    def __init__(self, dm, ruleno, result_max, pg_num, pgp_num,
+                 pgp_mask, pool_id, hashps, can_shift, use_aff, raw,
+                 up_full, prim_full, w_np, ex_np, iu_np, af_np, npg):
+        self.dm = dm
+        self.ruleno = ruleno
+        self.result_max = result_max
+        self.pg_num = pg_num
+        self.pgp_num = pgp_num
+        self.pgp_mask = pgp_mask
+        self.pool_id = pool_id
+        self.hashps = hashps
+        self.can_shift = can_shift
+        self.use_aff = use_aff
+        self.raw = raw
+        self.up_full = up_full
+        self.prim_full = prim_full
+        self.w_np = w_np
+        self.ex_np = ex_np
+        self.iu_np = iu_np
+        self.af_np = af_np
+        self.npg = npg
+
+    @property
+    def up(self):
+        return self.up_full[:self.pg_num]
+
+    @property
+    def prim(self):
+        return self.prim_full[:self.pg_num]
+
+    def remap(self, dev_weights, exists, isup, aff=None) -> "MapState":
+        """New MapState after a cluster-state change, recomputing only
+        the affected lanes when the change qualifies (see class doc);
+        otherwise a full pass."""
+        use_aff = aff is not None
+        w_np = np.asarray(dev_weights, dtype=np.int32)
+        ex_np = np.asarray(exists, dtype=bool)
+        iu_np = np.asarray(isup, dtype=bool)
+        af_np = (np.asarray(aff, dtype=np.int32) if use_aff
+                 else np.zeros((ex_np.shape[0],), np.int32))
+
+        def full():
+            return self.dm.map_pool_state(
+                self.ruleno, self.result_max, self.pg_num,
+                self.pgp_num, self.pgp_mask, self.pool_id, self.hashps,
+                w_np, ex_np, iu_np, aff, self.can_shift)
+
+        if (use_aff != self.use_aff
+                or w_np.shape != self.w_np.shape):
+            return full()
+        changed = ((w_np != self.w_np) | (ex_np != self.ex_np)
+                   | (iu_np != self.iu_np) | (af_np != self.af_np))
+        if not changed.any():
+            return self
+        if (w_np > self.w_np).any():
+            return full()        # reweight increase: not incremental
+        w, ex = jnp.asarray(w_np), jnp.asarray(ex_np)
+        iu, af = jnp.asarray(iu_np), jnp.asarray(af_np)
+        cm = jnp.asarray(changed)
+        KA = max(64, min(1 << 19,
+                         1 << (max(1, self.pg_num - 1)).bit_length()))
+        K1 = max(8, min(1 << 13, KA))
+        K2 = max(8, min(1 << 11, K1))
+        K3 = max(8, min(1 << 10, K2))
+        while True:
+            rm = self.dm._compiled_remap(
+                self.ruleno, self.result_max, self.can_shift,
+                self.use_aff, self.pgp_num, self.pgp_mask,
+                self.pool_id, self.hashps, KA, K1, K2, K3, self.npg,
+                self.pg_num)
+            raw2, up2, prim2, counts = rm(self.raw, self.up_full,
+                                          self.prim_full, w, ex, iu,
+                                          af, cm)
+            nA, nf, n2, n3 = (int(v) for v in np.asarray(counts))
+            if nA <= KA and nf <= K1 and n2 <= K2 and n3 <= K3:
+                break
+            KA = max(KA, 1 << (max(1, nA - 1)).bit_length())
+            K1 = max(K1, min(1 << (max(1, nf - 1)).bit_length(), KA))
+            K2 = max(K2, min(1 << (max(1, n2 - 1)).bit_length(), K1))
+            K3 = max(K3, min(1 << (max(1, n3 - 1)).bit_length(), K2))
+        return MapState(
+            self.dm, self.ruleno, self.result_max, self.pg_num,
+            self.pgp_num, self.pgp_mask, self.pool_id, self.hashps,
+            self.can_shift, self.use_aff, raw2, up2, prim2, w_np,
+            ex_np, iu_np, af_np, self.npg)
+
+
 class DeviceMapper:
     """Bulk do_rule on device for straw2 maps with single-choose rules.
 
@@ -1234,17 +1417,8 @@ class DeviceMapper:
 
     # per-dispatch PG cap: bounds live [L, S] f32/int32 temps in HBM
     CHUNK = 1 << 20
-    # resolve-pass chunk: flagged lanes are a few % of pass 1; one
-    # dispatch usually covers them all
-    CHUNK2 = 1 << 19
 
     # -- whole-pool mapping with device-side pps -------------------------
-
-    def _pps_host_np(self, ps, pgp_num: int, pgp_mask: int,
-                     pool_id: int, hashps: bool) -> np.ndarray:
-        """Host-side pps seeds (used only for the flagged minority)."""
-        from .hashes import pps_seed_v
-        return pps_seed_v(ps, pgp_num, pgp_mask, pool_id, hashps)
 
     @functools.lru_cache(maxsize=None)
     def _compiled_pool(self, ruleno: int, result_max: int,
@@ -1272,160 +1446,239 @@ class DeviceMapper:
                 xs = masked + _u32(pool_id)
             return xs
 
+        def post(raw, xs, exists_b, isup_b, aff):
+            if not use_aff:
+                from . import pallas_draw
+                if (pallas_draw.pallas_enabled()
+                        and raw.shape[0] % pallas_draw.TL == 0):
+                    pk = self._post_kernel(int(exists_b.shape[0]),
+                                           int(raw.shape[1]),
+                                           can_shift)
+                    return pk(raw, exists_b & isup_b)
+            return _post_process(raw, xs, exists_b, isup_b, aff,
+                                 can_shift, use_aff)
+
         @jax.jit
         def run(dev_weights, exists_b, isup_b, aff):
             def body(_, start):
                 xs = chunk(start)
                 raw, flag = core(xs, dev_weights)
-                up, prim = _post_process(raw, xs, exists_b, isup_b,
-                                         aff, can_shift, use_aff)
-                # flags ride back as packed bits: tunnel readback is
-                # the scarce resource, not device compute
-                packed = jnp.sum(
-                    flag.reshape(-1, 8).astype(jnp.int32)
-                    * (1 << jnp.arange(8, dtype=jnp.int32)),
-                    axis=1).astype(jnp.uint8)
-                return 0, (up, prim, packed)
+                up, prim = post(raw, xs, exists_b, isup_b, aff)
+                return 0, (raw, up, prim, flag)
 
             starts = (jnp.arange(n_chunks, dtype=jnp.uint32)
                       * _u32(n))
-            _, (ups, prims, packs) = jax.lax.scan(body, 0, starts)
+            _, (raws, ups, prims, flags) = jax.lax.scan(body, 0, starts)
             S = ups.shape[2]
-            return (ups.reshape(-1, S), prims.reshape(-1),
-                    packs.reshape(-1))
+            return (raws.reshape(-1, S), ups.reshape(-1, S),
+                    prims.reshape(-1), flags.reshape(-1))
 
         return run
+
+    def _post_kernel(self, D: int, S: int, can_shift: bool):
+        """Cached fused post-CRUSH kernel (non-affinity path)."""
+        from . import pallas_draw
+        cache = self.__dict__.setdefault("_post_kernel_cache", {})
+        key = (D, S, can_shift)
+        if key not in cache:
+            cache[key] = pallas_draw.make_post_kernel(D, S, can_shift)
+        return cache[key]
+
+    def _resolve_chain_parts(self, ruleno: int, result_max: int,
+                             can_shift: bool, use_aff: bool,
+                             pgp_num: int, pgp_mask: int, pool_id: int,
+                             hashps: bool, K1: int, K2: int, K3: int):
+        """Shared pieces of the device-resident resolve chain: the
+        device pps seed computation, the settle-and-scatter helper and
+        the three-stage compact/resolve cascade (exact-top3 attempt
+        structure -> full retry loops -> fully exact integer draw).
+        Used by both the full-map resolve and the incremental remap so
+        the pad-masking subtleties live in one place."""
+        acore_a = self._compile(ruleno, result_max, True, full=False)
+        rcore = self._compile(ruleno, result_max, True, True)
+        acore = self._compile(ruleno, result_max, "all", True)
+
+        def pps(idx):
+            ps = idx.astype(jnp.uint32)
+            masked = jnp.where((ps & _u32(pgp_mask)) < _u32(pgp_num),
+                               ps & _u32(pgp_mask),
+                               ps & _u32(pgp_mask >> 1))
+            if hashps:
+                return hash32_2_j(masked, _u32(pool_id))
+            return masked + _u32(pool_id)
+
+        def settle(core_fn, raw_t, up, prim, lanes, w, ex, iu, af):
+            xs = pps(lanes)
+            rr, f = core_fn(xs, w)
+            u2, p2 = _post_process(rr, xs, ex, iu, af, can_shift,
+                                   use_aff)
+            raw_t = raw_t.at[lanes].set(rr.astype(jnp.int32))
+            up = up.at[lanes].set(u2.astype(jnp.int32))
+            prim = prim.at[lanes].set(p2.astype(jnp.int32))
+            return raw_t, up, prim, f
+
+        def chain(raw_t, up, prim, flag, nflag, to_lane, w, ex, iu,
+                  af):
+            """flag: bool over the caller's index space; to_lane maps
+            compacted positions to global lane ids.  Padding positions
+            compact to index 0 whose resolved row is exact anyway, but
+            their FLAGS must be masked (pads mirror position 0 — if it
+            flags, every pad copy would flag with it)."""
+            pos = jnp.nonzero(flag, size=K1, fill_value=0)[0]
+            idx = to_lane(pos)
+            # stage A: exact draws through the bounded attempt
+            # structure (covers the f32-uncertainty majority)
+            raw_t, up, prim, f2 = settle(acore_a, raw_t, up, prim,
+                                         idx, w, ex, iu, af)
+            f2 = f2 & (jnp.arange(K1, dtype=jnp.int32) < nflag)
+            n2 = jnp.sum(f2, dtype=jnp.int32)
+            # stage B: stragglers (unfinished retries + dust) through
+            # the full retry loops, on a compacted subset
+            lanesB = idx[jnp.nonzero(f2, size=K2, fill_value=0)[0]]
+            raw_t, up, prim, f3 = settle(rcore, raw_t, up, prim,
+                                         lanesB, w, ex, iu, af)
+            f3 = f3 & (jnp.arange(K2, dtype=jnp.int32) < n2)
+            n3 = jnp.sum(f3, dtype=jnp.int32)
+            # stage C: residual top-3-ambiguous dust, fully exact
+            lanesC = lanesB[jnp.nonzero(f3, size=K3, fill_value=0)[0]]
+            raw_t, up, prim, _ = settle(acore, raw_t, up, prim,
+                                        lanesC, w, ex, iu, af)
+            return raw_t, up, prim, n2, n3
+
+        return pps, settle, chain
 
     @functools.lru_cache(maxsize=None)
-    def _compiled_resolve_rows(self, ruleno: int, result_max: int,
-                               can_shift: bool, use_aff: bool,
-                               full: bool, n: int):
-        """Resolve pass over n flagged lanes: returns exact rows to be
-        applied as host-side sparse patches (the Ceph way — exception
-        tables over a dense base mapping — and far cheaper than TPU
-        scatter, which runs at scalar rate)."""
-        core = self._compile(ruleno, result_max, True, full)
+    def _compiled_device_resolve(self, ruleno: int, result_max: int,
+                                 can_shift: bool, use_aff: bool,
+                                 pgp_num: int, pgp_mask: int,
+                                 pool_id: int, hashps: bool,
+                                 K1: int, K2: int, K3: int, npg: int,
+                                 pg_num: int):
+        """Device-resident resolve for the full-map pass: compact the
+        flagged lanes, settle them through the three-stage chain, and
+        scatter back — the only host traffic is the overflow-guard
+        counters (essential on a remote-chip tunnel that moves ~5 MB/s
+        with ~100ms latency per readback)."""
+        _pps, _settle, chain = self._resolve_chain_parts(
+            ruleno, result_max, can_shift, use_aff, pgp_num, pgp_mask,
+            pool_id, hashps, K1, K2, K3)
 
         @jax.jit
-        def run(xs, dev_weights, exists_b, isup_b, aff):
-            raw, flag = core(xs, dev_weights)
-            u2, p2 = _post_process(raw, xs, exists_b, isup_b, aff,
-                                   can_shift, use_aff)
-            packed = jnp.sum(
-                flag.reshape(-1, 8).astype(jnp.int32)
-                * (1 << jnp.arange(8, dtype=jnp.int32)),
-                axis=1).astype(jnp.uint8)
-            return u2, p2, packed
+        def run(raw_t, up, prim, flag, w, ex, iu, af):
+            flag = flag & (jnp.arange(npg, dtype=jnp.int32) < pg_num)
+            nflag = jnp.sum(flag, dtype=jnp.int32)
+            raw_t, up, prim, n2, n3 = chain(
+                raw_t, up, prim, flag, nflag, lambda p: p, w, ex, iu,
+                af)
+            return raw_t, up, prim, jnp.stack([nflag, n2, n3])
 
         return run
-
-    def _resolve_rows(self, ruleno, result_max, lanes, pps_f, C2, full,
-                      can_shift, use_aff, w, ex, iu, af):
-        """Run flagged lanes through a resolve pass in C2-sized
-        dispatches; returns (rows, prims, still_flagged_mask) numpy."""
-        res = self._compiled_resolve_rows(
-            ruleno, result_max, can_shift, use_aff, full, C2)
-        rows = None
-        prims = np.empty((lanes.size,), np.int32)
-        still = np.zeros((lanes.size,), bool)
-        for off in range(0, lanes.size, C2):
-            part = pps_f[off:off + C2]
-            nv = part.shape[0]
-            if nv < C2:
-                part = np.pad(part, (0, C2 - nv))
-            u2, p2, f2 = res(jnp.asarray(part, dtype=jnp.uint32),
-                             w, ex, iu, af)
-            if rows is None:
-                rows = np.full((lanes.size, int(u2.shape[1])),
-                               ITEM_NONE, np.int32)
-            rows[off:off + nv] = np.asarray(u2[:nv])
-            prims[off:off + nv] = np.asarray(p2[:nv])
-            still[off:off + nv] = np.unpackbits(
-                np.asarray(f2), bitorder="little")[:nv]
-        return rows, prims, still
 
     def map_pool_batch(self, ruleno: int, result_max: int, pg_num: int,
                        pgp_num: int, pgp_num_mask: int, pool_id: int,
                        hashpspool: bool, dev_weights, exists, isup,
-                       aff=None, can_shift: bool = True,
-                       return_device: bool = False):
-        """Whole-pool pg->up pipeline: pps seeds computed on device
-        (raw_pg_to_pps), one scanned dispatch for the fast pass, and
-        the flagged minority resolved into host-side sparse patches.
+                       aff=None, can_shift: bool = True):
+        """Whole-pool pg->up pipeline as dense numpy arrays; thin
+        wrapper over map_pool_state (which keeps everything
+        device-resident for consumers that chain incremental
+        remaps)."""
+        state = self.map_pool_state(
+            ruleno, result_max, pg_num, pgp_num, pgp_num_mask, pool_id,
+            hashpspool, dev_weights, exists, isup, aff, can_shift)
+        return np.array(state.up), np.array(state.prim)
 
-        return_device=False: patches are folded in and dense numpy
-        arrays come back.  return_device=True: returns
-        (up_dev [pg,S], prim_dev [pg], patches) with patches =
-        (lanes, rows, prims) numpy arrays — the rows that supersede
-        the device arrays (the consumers compose them exactly like
-        pg_temp/upmap exception tables)."""
+    def map_pool_state(self, ruleno: int, result_max: int, pg_num: int,
+                       pgp_num: int, pgp_num_mask: int, pool_id: int,
+                       hashpspool: bool, dev_weights, exists, isup,
+                       aff=None, can_shift: bool = True) -> "MapState":
+        """Full device pass returning a MapState (device-resident
+        raw/up/prim + the host-side inputs needed to validate later
+        incremental remaps)."""
         use_aff = aff is not None
-        w = jnp.asarray(np.asarray(dev_weights, dtype=np.int32))
-        ex = jnp.asarray(np.asarray(exists, dtype=bool))
-        iu = jnp.asarray(np.asarray(isup, dtype=bool))
-        af = (jnp.asarray(np.asarray(aff, dtype=np.int32)) if use_aff
-              else jnp.zeros((ex.shape[0],), jnp.int32))
+        w_np = np.asarray(dev_weights, dtype=np.int32)
+        ex_np = np.asarray(exists, dtype=bool)
+        iu_np = np.asarray(isup, dtype=bool)
+        af_np = (np.asarray(aff, dtype=np.int32) if use_aff
+                 else np.zeros((ex_np.shape[0],), np.int32))
+        w, ex = jnp.asarray(w_np), jnp.asarray(ex_np)
+        iu, af = jnp.asarray(iu_np), jnp.asarray(af_np)
         C = min(self.CHUNK, max(8, -(-pg_num // 8) * 8))
         n_chunks = -(-pg_num // C)
+        npg = C * n_chunks
         fn = self._compiled_pool(ruleno, result_max, bool(can_shift),
                                  use_aff, int(pgp_num),
                                  int(pgp_num_mask), int(pool_id),
                                  bool(hashpspool), C, n_chunks)
-        up, prim, packed = fn(w, ex, iu, af)
-        flag = np.unpackbits(np.asarray(packed),
-                             bitorder="little")[:pg_num]
-        flagged = np.nonzero(flag)[0]
-        lanes_np = np.empty((0,), np.int64)
-        rows_np = np.empty((0, result_max), np.int32)
-        prims_np = np.empty((0,), np.int32)
-        if flagged.size:
-            pps_f = (self._pps_host_np(flagged, pgp_num, pgp_num_mask,
-                                       pool_id, hashpspool)
-                     & 0xFFFFFFFF)
-            # dispatch shapes derive from the pass-1 flagged count so
-            # the churned-remap call reuses the map call's compiles
-            # (a per-call pow2 of the straggler count would recompile
-            # mid-benchmark whenever it crossed a bucket)
-            if flagged.size > self.CHUNK2 // 4:
-                c2a = self.CHUNK2
+        raw, up, prim, flag = fn(w, ex, iu, af)
+        K1 = max(64, min(1 << 16,
+                         1 << (max(1, pg_num - 1)).bit_length()))
+        K2 = max(8, min(1 << 13, K1))
+        K3 = max(8, min(2048, K1))
+        while True:
+            res = self._compiled_device_resolve(
+                ruleno, result_max, bool(can_shift), use_aff,
+                int(pgp_num), int(pgp_num_mask), int(pool_id),
+                bool(hashpspool), K1, K2, K3, npg, pg_num)
+            raw2, up2, prim2, counts = res(raw, up, prim, flag,
+                                           w, ex, iu, af)
+            nflag, n2, ndust = (int(v) for v in np.asarray(counts))
+            if nflag <= K1 and n2 <= K2 and ndust <= K3:
+                break
+            K1 = max(K1, 1 << (max(1, nflag - 1)).bit_length())
+            K2 = max(K2, min(1 << (max(1, n2 - 1)).bit_length(), K1))
+            K3 = max(K3, min(1 << (max(1, ndust - 1)).bit_length(),
+                             K1))
+        return MapState(
+            self, ruleno, result_max, pg_num, pgp_num, pgp_num_mask,
+            pool_id, bool(hashpspool), bool(can_shift), use_aff,
+            raw2, up2, prim2, w_np, ex_np, iu_np, af_np, npg)
+
+    @functools.lru_cache(maxsize=None)
+    def _compiled_remap(self, ruleno: int, result_max: int,
+                        can_shift: bool, use_aff: bool, pgp_num: int,
+                        pgp_mask: int, pool_id: int, hashps: bool,
+                        KA: int, K1: int, K2: int, K3: int, npg: int,
+                        pg_num: int):
+        """Incremental remap: find the lanes whose raw row touches a
+        changed OSD (a hit-scan kernel over the stored raw rows),
+        recompute only those through the fast pass, and settle their
+        flagged residue through the shared resolve chain — all
+        device-resident.  Sound because a lane's draw/rejection
+        sequence is bit-identical under reweight DECREASES and
+        up/down/affinity changes unless one of its raw result slots
+        held a changed OSD (see MapState's validity argument)."""
+        from . import pallas_draw
+        core = self._compile(ruleno, result_max, False, full=False)
+        _pps, settle, chain = self._resolve_chain_parts(
+            ruleno, result_max, can_shift, use_aff, pgp_num, pgp_mask,
+            pool_id, hashps, K1, K2, K3)
+
+        @jax.jit
+        def run(raw_t, up, prim, w, ex, iu, af, changed):
+            D = changed.shape[0]
+            if (pallas_draw.pallas_enabled()
+                    and raw_t.shape[0] % pallas_draw.TL == 0):
+                hs = pallas_draw.make_hitscan_kernel(
+                    D, int(raw_t.shape[1]))
+                hit = hs(raw_t, changed)
             else:
-                c2a = max(8, 1 << (int(flagged.size) - 1).bit_length())
-            c2b = max(8, min(1 << 15, c2a // 8))
-            # pass 2a: exact draws through the fast attempt structure
-            rows_np, prims_np, still = self._resolve_rows(
-                ruleno, result_max, flagged, pps_f, c2a, False,
-                bool(can_shift), use_aff, w, ex, iu, af)
-            lanes_np = flagged.astype(np.int64)
-            # pass 2b: stragglers through the full retry loops
-            again = np.nonzero(still)[0]
-            if again.size:
-                r2, p2, still2 = self._resolve_rows(
-                    ruleno, result_max, flagged[again], pps_f[again],
-                    c2b, True, bool(can_shift), use_aff,
-                    w, ex, iu, af)
-                rows_np[again] = r2
-                prims_np[again] = p2
-                # dust: top-3-ambiguous lanes -> scalar host engine
-                dust = again[np.nonzero(still2)[0]]
-                if dust.size:
-                    u_h = np.full((dust.size, rows_np.shape[1]),
-                                  ITEM_NONE, np.int32)
-                    p_h = np.full((dust.size,), -1, np.int32)
-                    self._host_map_rows(ruleno, pps_f[dust],
-                                        range(dust.size), result_max,
-                                        dev_weights, exists, isup, aff,
-                                        can_shift, u_h, p_h)
-                    rows_np[dust] = u_h
-                    prims_np[dust] = p_h
-        if return_device:
-            return (up[:pg_num], prim[:pg_num],
-                    (lanes_np, rows_np, prims_np))
-        up = np.array(up[:pg_num])
-        prim = np.array(prim[:pg_num])
-        if lanes_np.size:
-            up[lanes_np] = rows_np
-            prim[lanes_np] = prims_np
-        return up, prim
+                idxc = jnp.clip(raw_t, 0, D - 1)
+                cb = small_fetch(changed.astype(jnp.int32), idxc, 1)
+                hit = jnp.any((raw_t != ITEM_NONE) & (raw_t < D)
+                              & (cb > 0), axis=1)
+            hit = hit & (jnp.arange(npg, dtype=jnp.int32) < pg_num)
+            nA = jnp.sum(hit, dtype=jnp.int32)
+            idxA = jnp.nonzero(hit, size=KA, fill_value=0)[0]
+            raw_t, up, prim, flag = settle(core, raw_t, up, prim,
+                                           idxA, w, ex, iu, af)
+            flag = flag & (jnp.arange(KA, dtype=jnp.int32) < nA)
+            nflag = jnp.sum(flag, dtype=jnp.int32)
+            raw_t, up, prim, n2, n3 = chain(
+                raw_t, up, prim, flag, nflag, lambda p: idxA[p],
+                w, ex, iu, af)
+            return raw_t, up, prim, jnp.stack([nA, nflag, n2, n3])
+
+        return run
 
     def do_rule_batch(self, ruleno: int, xs, result_max: int,
                       dev_weights) -> np.ndarray:
@@ -1466,51 +1719,3 @@ class DeviceMapper:
         row = np.full((result_max,), ITEM_NONE, np.int32)
         row[:len(raw)] = raw[:result_max]
         return row
-
-    def _host_map_rows(self, ruleno: int, pps, lanes, result_max: int,
-                       dev_weights, exists, isup, aff, can_shift,
-                       up, prim) -> None:
-        """Exact scalar pipeline for dust lanes: host do_rule + a host
-        mirror of _post_process."""
-        from .hashes import hash32_2 as h2  # host scalar hash
-        exists = np.asarray(exists, dtype=bool)
-        isup = np.asarray(isup, dtype=bool)
-        aff_a = (np.asarray(aff, dtype=np.int64)
-                 if aff is not None else None)
-        D = exists.shape[0]
-        for lane in lanes:
-            x = int(pps[lane])
-            raw = [int(v) for v in
-                   self._host_raw(ruleno, x, result_max, dev_weights)]
-            keep = [(o != ITEM_NONE and 0 <= o < D
-                     and bool(exists[o]) and bool(isup[o])) for o in raw]
-            if can_shift:
-                row = [o for o, k in zip(raw, keep) if k]
-            else:
-                row = [o if k else ITEM_NONE for o, k in zip(raw, keep)]
-            nonnone = [i for i, o in enumerate(row) if o != ITEM_NONE]
-            p = row[nonnone[0]] if nonnone else -1
-            if aff_a is not None and nonnone:
-                applies = any(
-                    aff_a[row[i]] != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
-                    for i in nonnone)
-                if applies:
-                    pos = None
-                    for i in nonnone:
-                        o = row[i]
-                        a = int(aff_a[o])
-                        hh = (h2(x, o) & 0xFFFFFFFF) >> 16
-                        if not (a < CEPH_OSD_MAX_PRIMARY_AFFINITY
-                                and hh >= a):
-                            pos = i
-                            break
-                    if pos is None:
-                        pos = nonnone[0]
-                    p = row[pos]
-                    if can_shift:
-                        row = [p] + row[:pos] + row[pos + 1:]
-            out_row = np.full((up.shape[1],), ITEM_NONE, np.int32)
-            out_row[:min(len(row), up.shape[1])] = \
-                row[:up.shape[1]]
-            up[lane] = out_row
-            prim[lane] = p

@@ -96,7 +96,7 @@ class _LevelTables:
                 items = np.tile(fm._items_np[:, :S_d], (n_pos, 1))
                 blocks.append(_limb_planes(items, nl, fm.id_offset))
             rb = fm._recipbits_np.reshape(n_pos * B, -1)[:, :S_d]
-            blocks.append(_limb_planes(rb, 3))
+            blocks.append(_limb_planes(rb, 4))
             size = np.tile(fm._size_np[:, None], (n_pos, 1))
             blocks.append(_limb_planes(size, 2))
             tbl = np.concatenate(blocks, axis=0)
@@ -109,7 +109,7 @@ class _LevelTables:
         self.nbytes = nbytes + self.meta.nbytes
 
     def row_count(self, S_d: int) -> int:
-        return (self.nl + self.dup + 3) * S_d + 2
+        return (self.nl + self.dup + 4) * S_d + 2
 
 
 def _hash_mix(a, b, c):
@@ -182,7 +182,77 @@ def make_descend_kernel(fm, depth_sizes: tuple, want_type: int):
     meta_t = np.asarray(lt.meta)
     n_lvl = len(depth_sizes)
 
-    def group(d, S_d, tbl_ref, meta_ref, xg, rg, posg, st):
+    # -- refine tables: crush_ln's own RH/LH/LL tables (mapper.c:226-268)
+    # RH as three 16-bit limbs (for the exact 64-bit x2*rh product) and
+    # f32(LH)/f32(LL) as bit-limbs.  The poly error bound is dominated
+    # by the ln table's quantization noise (~2^30); evaluating the real
+    # table in f32 brings the bound down to REF_DELTA ~ 2^25, settling
+    # ~95% of poly-uncertain draws in-kernel instead of in the resolve
+    # pass.
+    rh_np = dev._RH_NP.astype(np.int64)                    # [129] <2^48
+    lh_np = dev._LH_NP.astype(np.int64)
+    ll_np = dev._LL_NP.astype(np.int64)
+    rh16 = np.stack([(rh_np >> (16 * k)) & 0xFFFF
+                     for k in range(3)], axis=0)           # [3, 129]
+    lh_bits = lh_np.astype(np.float32).view(np.uint32).astype(np.int64)
+    ll_bits = ll_np.astype(np.float32).view(np.uint32).astype(np.int64)
+    refp_t = np.concatenate(
+        [_limb_planes(rh16.T, 2),                          # rows 0..5
+         _limb_planes(lh_bits[:, None], 4)], axis=0)       # rows 6..9
+    refl_t = _limb_planes(ll_bits[:, None], 4)             # [4, 256]
+    # error budget: f32 rounding of LH, LL (2^24 each at 2^48 scale),
+    # their sum, and the final subtraction, plus floor slack — ~2^26;
+    # doubled for margin
+    REF_DELTA = float(2 ** 27)
+    REF_EPS = float(2.0 ** -21)
+
+    def refine(u, rf, refp_ref, refl_ref):
+        """f32 evaluation of the EXACT crush_ln tables for one
+        candidate: u [1,GW] i32 hash, rf [1,GW] f32 reciprocal.
+        Returns q_ref with |q_ref - q_exact| <= REF_DELTA*rf +
+        q*REF_EPS + const (mirrors neg_ln_mxu's structure,
+        mapper.c:226-268)."""
+        x = u + c32(1)
+        bl = jnp.full(x.shape, c32(1), i32)
+        for kbit in range(1, 17):
+            bl = bl + (x >= c32(1 << kbit)).astype(i32)
+        need = (x & c32(0x18000)) == 0
+        bits = jnp.maximum(c32(16) - bl, c32(0))
+        x2 = jnp.where(need, x << bits, x)
+        iexp = jnp.where(need, c32(15) - bits, c32(15))
+        p = (x2 >> 8) - c32(128)                     # [0, 128]
+        iota_p = jax.lax.broadcasted_iota(i32, (129, GW), 0)
+        ohp = (iota_p == p).astype(i8)
+        fr = jax.lax.dot_general(
+            refp_ref[...], ohp, (((1,), (0,)), ((), ())),
+            preferred_element_type=i32)              # [10, GW]
+        rh = _unpack_rows(fr, 3, 2, 0)               # [3, GW] 16b limbs
+        lhf = jax.lax.bitcast_convert_type(
+            _unpack_rows(fr, 1, 4, 6), f32)
+        # exact bits 48..55 of x2*rh via 16-bit limb products (each
+        # < 2^32: x2 <= 2^16, limbs <= 2^16-1)
+        x2u = x2.astype(u32)
+        t0 = x2u * rh[0:1, :].astype(u32)
+        t1 = x2u * rh[1:2, :].astype(u32)
+        t2 = x2u * rh[2:3, :].astype(u32)
+        s1 = (t0 >> cu32(16)) + t1
+        c1 = (s1 < t1).astype(u32)
+        s2 = (s1 >> cu32(16)) + (c1 << cu32(16)) + t2
+        i2x = ((s2 >> cu32(16)) & cu32(0xFF)).astype(i32)
+        iota_l = jax.lax.broadcasted_iota(i32, (256, GW), 0)
+        ohl = (iota_l == i2x).astype(i8)
+        fl = jax.lax.dot_general(
+            refl_ref[...], ohl, (((1,), (0,)), ((), ())),
+            preferred_element_type=i32)              # [4, GW]
+        llf = jax.lax.bitcast_convert_type(
+            _unpack_rows(fl, 1, 4, 0), f32)
+        neg = ((cf32(float(1 << 48))
+                - iexp.astype(f32) * cf32(float(1 << 44)))
+               - (lhf + llf) * cf32(1.0 / 16.0))
+        return neg * rf
+
+    def group(d, S_d, tbl_ref, meta_ref, refp_ref, refl_ref, xg, rg,
+              posg, st):
         """One level advance for one GW-lane sublane group.
         xg/rg/posg [1, GW]; st = (cur, done, ok, perm, flag, item)."""
         cur, done, ok, perm, flag, item = st
@@ -197,9 +267,9 @@ def make_descend_kernel(fm, depth_sizes: tuple, want_type: int):
             items_a = _unpack_rows(f, S_d, nl, nl * S_d, fm.id_offset)
         else:
             items_a = ids
-        rbits = _unpack_rows(f, S_d, 3, (nl + dup) * S_d)
-        recipf = jax.lax.bitcast_convert_type(rbits << 8, f32)
-        size = _unpack_rows(f, 1, 2, (nl + dup + 3) * S_d)   # [1, GW]
+        rbits = _unpack_rows(f, S_d, 4, (nl + dup) * S_d)
+        recipf = jax.lax.bitcast_convert_type(rbits, f32)
+        size = _unpack_rows(f, 1, 2, (nl + dup + 4) * S_d)   # [1, GW]
         iota_s = jax.lax.broadcasted_iota(i32, (S_d, GW), 0)
         valid = (iota_s < size) & (recipf > 0)
         u = (_hash32_3(xg, ids.astype(u32), rg, seed)
@@ -219,12 +289,66 @@ def make_descend_kernel(fm, depth_sizes: tuple, want_type: int):
                      axis=0, keepdims=True)
         winc = jnp.min(jnp.where(contend, iota_s, c32(_S_BIG)),
                        axis=0, keepdims=True)
-        win = jnp.where(ncont == 1, winc, i1)
+        # refined top-3 resolution for uncertain draws: pick the three
+        # smallest poly draws, re-evaluate them against the exact ln
+        # tables (f32, REF_DELTA error), and accept when one candidate's
+        # upper bound beats both others' lower bounds and no contender
+        # lies outside the top-3.  Floor ties stay flagged (the exact
+        # resolve pass settles slot tie-breaks).
+        sel1 = iota_s == i1
+        qm = jnp.where(sel1, cf32(big), q)
+        minq2 = jnp.min(qm, axis=0, keepdims=True)
+        i2 = jnp.min(jnp.where(qm == minq2, iota_s, c32(_S_BIG)),
+                     axis=0, keepdims=True)
+        sel2 = iota_s == i2
+        qm2 = jnp.where(sel2, cf32(big), qm)
+        minq3 = jnp.min(qm2, axis=0, keepdims=True)
+        i3 = jnp.min(jnp.where(qm2 == minq3, iota_s, c32(_S_BIG)),
+                     axis=0, keepdims=True)
+        sel3 = iota_s == i3
+
+        def pick_i(a, sel):
+            return jnp.sum(jnp.where(sel, a, c32(0)), axis=0,
+                           keepdims=True, dtype=i32)
+
+        def pick_f(a, sel):
+            return jnp.sum(jnp.where(sel, a, cf32(0.0)), axis=0,
+                           keepdims=True)
+
+        v2 = minq2 < cf32(big)
+        v3 = minq3 < cf32(big)
+        qr1 = refine(pick_i(u, sel1), pick_f(recipf, sel1),
+                     refp_ref, refl_ref)
+        qr2 = refine(pick_i(u, sel2), pick_f(recipf, sel2),
+                     refp_ref, refl_ref)
+        qr3 = refine(pick_i(u, sel3), pick_f(recipf, sel3),
+                     refp_ref, refl_ref)
+
+        def bounds(qr, rfk, vk):
+            Ek = (cf32(REF_DELTA) * rfk + qr * cf32(REF_EPS)
+                  + cf32(e_const))
+            return (jnp.where(vk, qr + Ek, cf32(big)),
+                    jnp.where(vk, qr - Ek, cf32(big)))
+
+        ub1, lb1 = bounds(qr1, pick_f(recipf, sel1),
+                          jnp.ones_like(v2))
+        ub2, lb2 = bounds(qr2, pick_f(recipf, sel2), v2)
+        ub3, lb3 = bounds(qr3, pick_f(recipf, sel3), v3)
+        w1 = (ub1 < lb2) & (ub1 < lb3)
+        w2 = (ub2 < lb1) & (ub2 < lb3)
+        w3 = (ub3 < lb1) & (ub3 < lb2)
+        outside = contend & ~(sel1 | sel2 | sel3)
+        n_out = jnp.sum(outside.astype(i32), axis=0, keepdims=True,
+                        dtype=i32)
+        ref_ok = (w1 | w2 | w3) & (n_out == 0)
+        ref_win = jnp.where(w1, i1, jnp.where(w2, i2, i3))
+        win = jnp.where(ncont == 1, winc,
+                        jnp.where(ref_ok, ref_win, i1))
         chosen = jnp.sum(jnp.where(iota_s == win, items_a, c32(0)),
                          axis=0, keepdims=True, dtype=i32)
         if d == 0:
             done = size == 0            # empty start bucket: retryable
-        flag = flag | ((~done) & (~certain))
+        flag = flag | ((~done) & (~certain) & (~ref_ok))
         is_bucket = chosen < 0
         cbid = jnp.where(is_bucket, c32(-1) - chosen, c32(0))
         iota_mb = jax.lax.broadcasted_iota(i32, (B, GW), 0)
@@ -247,9 +371,10 @@ def make_descend_kernel(fm, depth_sizes: tuple, want_type: int):
         return cur, done, ok, perm, flag, item
 
     def kern(x_ref, r_ref, bid_ref, pos_ref, *refs):
-        item_ref, status_ref = refs[n_lvl + 1], refs[n_lvl + 2]
         tbl_refs = refs[:n_lvl]
         meta_ref = refs[n_lvl]
+        refp_ref, refl_ref = refs[n_lvl + 1], refs[n_lvl + 2]
+        item_ref, status_ref = refs[n_lvl + 3], refs[n_lvl + 4]
         x = x_ref[...].astype(u32)                  # [8, GW]
         r = r_ref[...].astype(u32)
         bid = bid_ref[...]
@@ -264,6 +389,7 @@ def make_descend_kernel(fm, depth_sizes: tuple, want_type: int):
         for d, S_d in enumerate(depth_sizes):
             for s in range(8):
                 states[s] = group(d, S_d, tbl_refs[d], meta_ref,
+                                  refp_ref, refl_ref,
                                   x[s:s + 1, :], r[s:s + 1, :],
                                   pos[s:s + 1, :], states[s])
         item_ref[...] = jnp.concatenate([st[5] for st in states],
@@ -287,10 +413,13 @@ def make_descend_kernel(fm, depth_sizes: tuple, want_type: int):
                             lambda i: (jnp.int32(0), jnp.int32(i)))
         full = [pl.BlockSpec(t.shape, z2) for t in tbls]
         mspec = pl.BlockSpec(meta_t.shape, z2)
+        rpspec = pl.BlockSpec(refp_t.shape, z2)
+        rlspec = pl.BlockSpec(refl_t.shape, z2)
         item, status = pl.pallas_call(
             kern,
             grid=(G,),
-            in_specs=[lane, lane, lane, lane] + full + [mspec],
+            in_specs=[lane, lane, lane, lane] + full
+                     + [mspec, rpspec, rlspec],
             out_specs=(lane, lane),
             out_shape=(shp, shp),
             interpret=interp,
@@ -298,7 +427,146 @@ def make_descend_kernel(fm, depth_sizes: tuple, want_type: int):
           r.reshape(8, W).astype(jnp.int32),
           bid.reshape(8, W).astype(jnp.int32),
           pos.reshape(8, W).astype(jnp.int32),
-          *tbls, meta_t)
+          *tbls, meta_t, refp_t, refl_t)
         return item.reshape(L), status.reshape(L)
+
+    return run
+
+
+def make_post_kernel(D: int, S: int, can_shift: bool):
+    """Fused post-CRUSH pass (no primary-affinity form): up-filter
+    against the exists&up bit per device + stable compaction + primary
+    pick (OSDMap.cc:2626-2744) as one kernel over [L] lanes.
+
+    Returns fn(raw [L, S] i32, keep [D] bool) -> (up [L, S] i32,
+    prim [L] i32); the affinity path stays on the XLA `_post_process`.
+    """
+    from jax.experimental import pallas as pl
+    from ...models.crushmap import ITEM_NONE
+
+    HI = -(-D // 16)
+    i8, i32 = jnp.int8, jnp.int32
+    c32 = np.int32
+    interp = _interpret()
+
+    def kern(kp_ref, *refs):
+        raw_refs = refs[:S]
+        up_refs = refs[S:2 * S]
+        prim_ref = refs[2 * S]
+        iota_hi = jax.lax.broadcasted_iota(i32, (HI, GW), 0)
+        iota_16 = jax.lax.broadcasted_iota(i32, (16, GW), 0)
+        for s in range(8):
+            rows = [r_ref[s:s + 1, :] for r_ref in raw_refs]
+            keeps = []
+            for rj in rows:
+                idx = jnp.clip(rj, c32(0), c32(D - 1))
+                oh = (iota_hi == (idx >> 4)).astype(i8)
+                kf = jax.lax.dot_general(
+                    kp_ref[...], oh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=i32)       # [16, GW]
+                klo = jnp.sum(
+                    jnp.where(iota_16 == (idx & 15), kf, c32(0)),
+                    axis=0, keepdims=True, dtype=i32) + 128
+                keeps.append((rj != c32(ITEM_NONE)) & (rj < c32(D))
+                             & (klo > 0))
+            if can_shift:
+                ups = [jnp.full((1, GW), ITEM_NONE, i32)
+                       for _ in range(S)]
+                cnt = jnp.zeros((1, GW), i32)
+                for j in range(S):
+                    for t in range(j + 1):
+                        put = keeps[j] & (cnt == c32(t))
+                        ups[t] = jnp.where(put, rows[j], ups[t])
+                    cnt = cnt + keeps[j].astype(i32)
+            else:
+                ups = [jnp.where(keeps[j], rows[j], c32(ITEM_NONE))
+                       for j in range(S)]
+            prim = jnp.full((1, GW), c32(-1), i32)
+            for j in range(S - 1, -1, -1):
+                prim = jnp.where(ups[j] != c32(ITEM_NONE), ups[j], prim)
+            for j in range(S):
+                up_refs[j][s:s + 1, :] = ups[j]
+            prim_ref[s:s + 1, :] = prim
+
+    @jax.jit
+    def run(raw, keep):
+        L = raw.shape[0]
+        G = L // TL
+        W = L // 8
+        kp = ((keep.astype(jnp.int32) - 128).astype(jnp.int8))
+        kp = jnp.pad(kp, (0, HI * 16 - D)).reshape(HI, 16).T
+        z2 = lambda i: (jnp.int32(0), jnp.int32(0))  # noqa: E731
+        lane = pl.BlockSpec((8, GW),
+                            lambda i: (jnp.int32(0), jnp.int32(i)))
+        shp = jax.ShapeDtypeStruct((8, W), jnp.int32)
+        cols = [raw[:, j].reshape(8, W) for j in range(S)]
+        outs = pl.pallas_call(
+            kern,
+            grid=(G,),
+            in_specs=[pl.BlockSpec((16, HI), z2)] + [lane] * S,
+            out_specs=tuple([lane] * S + [lane]),
+            out_shape=tuple([shp] * S + [shp]),
+            interpret=interp,
+        )(kp, *cols)
+        up = jnp.stack([o.reshape(L) for o in outs[:S]], axis=1)
+        return up, outs[S].reshape(L)
+
+    return run
+
+
+def make_hitscan_kernel(D: int, S: int):
+    """hit[l] = any slot of raw[l] holds an OSD in the changed set —
+    the incremental-remap affected-lane scan, as one fused pass over
+    the stored raw rows.  Returns fn(raw [L,S] i32, changed [D] bool)
+    -> hit [L] bool."""
+    from jax.experimental import pallas as pl
+    from ...models.crushmap import ITEM_NONE
+
+    HI = -(-D // 16)
+    i8, i32 = jnp.int8, jnp.int32
+    c32 = np.int32
+    interp = _interpret()
+
+    def kern(cp_ref, *refs):
+        raw_refs = refs[:S]
+        hit_ref = refs[S]
+        iota_hi = jax.lax.broadcasted_iota(i32, (HI, GW), 0)
+        iota_16 = jax.lax.broadcasted_iota(i32, (16, GW), 0)
+        for s in range(8):
+            acc = jnp.zeros((1, GW), jnp.bool_)
+            for r_ref in raw_refs:
+                rj = r_ref[s:s + 1, :]
+                idx = jnp.clip(rj, c32(0), c32(D - 1))
+                oh = (iota_hi == (idx >> 4)).astype(i8)
+                kf = jax.lax.dot_general(
+                    cp_ref[...], oh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=i32)       # [16, GW]
+                klo = jnp.sum(
+                    jnp.where(iota_16 == (idx & 15), kf, c32(0)),
+                    axis=0, keepdims=True, dtype=i32) + 128
+                acc = acc | ((rj != c32(ITEM_NONE)) & (rj < c32(D))
+                             & (klo > 0))
+            hit_ref[s:s + 1, :] = acc.astype(i32)
+
+    @jax.jit
+    def run(raw, changed):
+        L = raw.shape[0]
+        G = L // TL
+        W = L // 8
+        cp = ((changed.astype(jnp.int32) - 128).astype(jnp.int8))
+        cp = jnp.pad(cp, (0, HI * 16 - D)).reshape(HI, 16).T
+        z2 = lambda i: (jnp.int32(0), jnp.int32(0))  # noqa: E731
+        lane = pl.BlockSpec((8, GW),
+                            lambda i: (jnp.int32(0), jnp.int32(i)))
+        cols = [raw[:, j].reshape(8, W) for j in range(S)]
+        out = pl.pallas_call(
+            kern,
+            grid=(G,),
+            in_specs=[pl.BlockSpec((16, HI), z2)] + [lane] * S,
+            out_specs=lane,
+            out_shape=jax.ShapeDtypeStruct((8, W), jnp.int32),
+            interpret=interp,
+        )(cp, *cols)
+        return out.reshape(L) != 0
 
     return run

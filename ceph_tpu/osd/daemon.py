@@ -506,15 +506,13 @@ class OSD:
             pg.waiting_for_active.append((conn, msg))
             return
         if pool.is_erasure():
-            from .ecbackend import _EC_WRITE_OPS
-            ec_writes = any(o["op"] in _EC_WRITE_OPS for o in msg.ops)
-            if ec_writes and not self._write_quorum(pg, pool):
+            if not self._min_size_ok(pg, pool):
                 pg.waiting_for_active.append((conn, msg))
                 return
             self.msgr.spawn(self.ec.handle_op(pg, conn, msg))
             return
         writes = any(o["op"] in _WRITE_OPS for o in msg.ops)
-        if writes and not self._write_quorum(pg, pool):
+        if not self._min_size_ok(pg, pool):
             pg.waiting_for_active.append((conn, msg))
             return
         oid = msg.oid
@@ -530,12 +528,12 @@ class OSD:
                                   outs=outs, epoch=self.osdmap.epoch,
                                   version=0))
 
-    def _write_quorum(self, pg: PG, pool) -> bool:
-        """min_size write gating (PeeringState is_active checks: the
-        reference blocks I/O while |acting| < pool.min_size).  EC
-        additionally requires k live shards — acking a write persisted
-        on fewer than k shards would make the object durable but
-        unreadable."""
+    def _min_size_ok(self, pg: PG, pool) -> bool:
+        """min_size gating for ALL I/O (PeeringState is_active checks:
+        the reference keeps a PG inactive, blocking reads and writes,
+        while |acting| < pool.min_size).  EC additionally requires k
+        live shards — acking a write persisted on fewer than k shards
+        would make the object durable but unreadable."""
         live = sum(1 for o in pg.acting
                    if o >= 0 and self.osdmap.is_up(o))
         need = pool.min_size

@@ -240,10 +240,15 @@ class ECPGBackend:
 
     # -- write path --------------------------------------------------------
 
-    def _encode_shards(self, pg: PG, data: bytes) -> dict[int, bytes]:
+    async def _encode_shards(self, pg: PG, data: bytes
+                             ) -> dict[int, bytes]:
+        """Shard encode for the write path — the device-batched analog
+        of ECTransaction::generate_transactions -> ECUtil::encode:
+        concurrent writes across PGs aggregate into one TPU dispatch
+        (ceph_tpu.ec.batcher)."""
         codec = self.codec(self.osd.osdmap.pools[pg.pool_id])
         n = codec.get_chunk_count()
-        return codec.encode(set(range(n)), data)
+        return await codec.encode_async(set(range(n)), data)
 
     def _shard_txn(self, pg: PG, ho: hobject_t, shard: bytes, j: int,
                    size: int, version, xattrs: dict | None
@@ -277,7 +282,8 @@ class ECPGBackend:
         pg.missing.pop(oid, None)
         for pm in pg.peer_missing.values():
             pm.pop(oid, None)
-        shards = None if is_delete else self._encode_shards(pg, data)
+        shards = (None if is_delete
+                  else await self._encode_shards(pg, data))
         ho = hobject_t(oid)
 
         self._tid += 1
@@ -318,6 +324,19 @@ class ECPGBackend:
             # it missing so recovery (or the next peering) repairs it
             for osd_id in st["waiting"]:
                 pg.peer_missing.setdefault(osd_id, {})[oid] = entry.op
+            # the write IS durable once >= k shards persisted (the
+            # object decodes and the pg log advanced); failing it
+            # would make a durable write look failed and a client
+            # retry would double-log it.  Only report failure when
+            # fewer than k shards landed — genuinely unreadable.
+            codec = self.codec(self.osd.osdmap.pools[pg.pool_id])
+            applied = sum(
+                1 for j, osd_id in enumerate(pg.acting)
+                if osd_id != ITEM_NONE and osd_id >= 0
+                and osd_id not in st["waiting"])
+            if applied >= codec.get_data_chunk_count():
+                self.osd._kick_recovery(pg)
+                return True
             return False
         return True
 
@@ -371,9 +390,17 @@ class ECPGBackend:
 
     async def read_object(self, pg: PG, oid: str):
         """Reconstructing whole-object read; returns (data, version)
-        or (None, None).  Fetches the minimum member set first and
-        widens on shortfall; only shards stamped with the newest
-        observed version are mixed (ec_ver)."""
+        or (None, None)."""
+        data, ver, _attrs = await self.read_object_attrs(pg, oid)
+        return data, ver
+
+    async def read_object_attrs(self, pg: PG, oid: str):
+        """Reconstructing whole-object read; returns
+        (data, version, attrs) or (None, None, None).  Fetches the
+        minimum member set first and widens on shortfall; only shards
+        stamped with the newest observed version are mixed (ec_ver);
+        attrs come from any shard of the winning version (user xattrs
+        are written identically to every shard)."""
         pool = self.osd.osdmap.pools[pg.pool_id]
         codec = self.codec(pool)
         k = codec.get_data_chunk_count()
@@ -385,11 +412,13 @@ class ECPGBackend:
                 members.append(osd_id)
         # per-version shard pools: {ver: {j: (bytes, size)}}
         by_ver: dict[tuple, dict[int, tuple]] = {}
+        attrs_by_ver: dict[tuple, dict] = {}
         local = self._local_shard(pg, ho) \
             if self.osd.whoami in members else None
         if local is not None:
-            j, buf, size, ver, _ = local
+            j, buf, size, ver, lattrs = local
             by_ver.setdefault(ver, {})[j] = (buf, size)
+            attrs_by_ver.setdefault(ver, dict(lattrs))
         remote = [o for o in members if o != self.osd.whoami]
         # ask the minimum first: enough members for k distinct shards
         have = 1 if local is not None else 0
@@ -400,21 +429,24 @@ class ECPGBackend:
                 continue
             for sender, rows in \
                     (await self._sub_read(pg, oid, batch)).items():
-                for (j, buf, sz, verw, _attrs) in rows:
+                for (j, buf, sz, verw, rattrs) in rows:
                     ver = tuple(verw)
                     by_ver.setdefault(ver, {}).setdefault(
                         j, (buf, sz))
+                    if rattrs:
+                        attrs_by_ver.setdefault(ver, dict(rattrs))
             best = self._best_version(codec, k, by_ver)
             if best is not None:
                 chunks = {j: b for j, (b, _s) in
                           by_ver[best].items()}
                 size = next(iter(by_ver[best].values()))[1]
                 try:
-                    data = codec.decode_concat(chunks)
+                    data = await codec.decode_concat_async(chunks)
                 except (IOError, OSError):
                     continue  # widen to the remaining members
-                return data[:size], best
-        return None, None
+                return (data[:size], best,
+                        attrs_by_ver.get(best, {}))
+        return None, None, None
 
     def _best_version(self, codec, k, by_ver):
         """Newest version with a decodable shard set, else None.
@@ -558,18 +590,21 @@ class ECPGBackend:
                 if op == LogEntry.DELETE:
                     pushes.append({"oid": oid, "delete": True})
                     continue
-                data, ver = await self.read_object(pg, oid)
+                data, ver, rattrs = await self.read_object_attrs(
+                    pg, oid)
                 if data is None:
                     pushes.append({"oid": oid, "delete": True})
                     continue
                 n = codec.get_chunk_count()
-                shards = codec.encode(set(range(n)), data)
-                attrs = {}
+                shards = await codec.encode_async(set(range(n)), data)
+                # user xattrs: local shard first, else the attrs the
+                # surviving shards returned with the read replies (the
+                # primary's own shard may be missing too)
                 try:
                     attrs = dict(self.osd.store.getattrs(
                         pg.cid, hobject_t(oid)))
                 except NotFound:
-                    pass
+                    attrs = dict(rattrs or {})
                 attrs[SIZE_XATTR] = b"%d" % len(data)
                 attrs[SHARD_XATTR] = b"%d" % j
                 attrs[VER_XATTR] = _ver_bytes(ver)
@@ -607,7 +642,8 @@ class ECPGBackend:
                     codec = self.codec(
                         self.osd.osdmap.pools[pg.pool_id])
                     n = codec.get_chunk_count()
-                    shards = codec.encode(set(range(n)), data)
+                    shards = await codec.encode_async(
+                        set(range(n)), data)
                     t = self._shard_txn(pg, ho, shards[j], j,
                                         len(data), ver, None)
                 pg.missing.pop(oid, None)

@@ -455,3 +455,46 @@ def test_osd_restart_rejoins_and_backfills():
             await c.stop()
 
     run(main(), timeout=120)
+
+
+def test_ec_pool_with_device_offload(monkeypatch):
+    """The same EC cluster flow with the device codec batcher active
+    (CEPH_TPU_EC_OFFLOAD=1): writes, degraded reads and recovery all
+    route their GF matmuls through ceph_tpu.ec.batcher, and stored
+    bytes stay bit-identical to the host path."""
+    monkeypatch.setenv("CEPH_TPU_EC_OFFLOAD", "1")
+
+    async def main():
+        from ceph_tpu.ec.batcher import DeviceBatcher
+
+        c = await Cluster(4).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="ecdev", pg_num=8,
+                pool_type="erasure")
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("ecdev")
+            batcher = DeviceBatcher.get()
+            payloads = {}
+            await asyncio.gather(*[
+                io.write_full("d-%d" % i, bytes([i]) * (300 + 37 * i))
+                for i in range(12)])
+            for i in range(12):
+                payloads["d-%d" % i] = bytes([i]) * (300 + 37 * i)
+            assert batcher.items_encoded >= 12
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+            # degraded read: kill one shard holder
+            m = c.client.osdmap
+            pool = m.pools[pid]
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg("d-0", pid))
+            up, _, acting, _ = m.pg_to_up_acting_osds(pgid)
+            await c.kill_osd(acting[0])
+            assert await io.read("d-0") == payloads["d-0"]
+        finally:
+            await c.stop()
+
+    run(main())

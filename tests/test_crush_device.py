@@ -312,3 +312,70 @@ class TestLargeBatch:
                 assert got[i].tolist() == expect, (i, int(xs[i]))
         finally:
             D._ATTEMPT_MIN_L = old
+
+
+class TestMapStateRemap:
+    """map_pool_state + MapState.remap: the incremental path must be
+    bit-identical to a full pass for qualifying changes (reweight
+    decreases, up/down flips) and must fall back for increases."""
+
+    def _mk(self, hosts=6, per_host=5, pg_num=4096):
+        from ceph_tpu.models.crushmap import (CHOOSELEAF_FIRSTN, EMIT,
+                                              STRAW2, TAKE, CrushMap)
+        from ceph_tpu.ops.crush.device import DeviceMapper
+
+        m = CrushMap()
+        host_ids = []
+        for h in range(hosts):
+            items = list(range(h * per_host, (h + 1) * per_host))
+            b = m.add_bucket(STRAW2, 1, items, [0x10000] * per_host,
+                             id=-(h + 2))
+            host_ids.append(b.id)
+        m.add_bucket(STRAW2, 2, host_ids,
+                     [m.buckets[h].weight for h in host_ids], id=-1)
+        m.add_rule([(TAKE, -1, 0), (CHOOSELEAF_FIRSTN, 0, 1),
+                    (EMIT, 0, 0)], id=0)
+        return DeviceMapper(m), hosts * per_host, pg_num
+
+    def _state(self, dm, pg_num, w, ex, iu):
+        return dm.map_pool_state(0, 3, pg_num, pg_num, pg_num - 1, 1,
+                                 True, w, ex, iu, None, True)
+
+    def test_incremental_matches_full(self):
+        import numpy as np
+
+        dm, n_osds, pg_num = self._mk()
+        w0 = np.full(n_osds, 0x10000, np.int32)
+        ex = np.ones(n_osds, bool)
+        iu0 = np.ones(n_osds, bool)
+        st0 = self._state(dm, pg_num, w0, ex, iu0)
+        w1 = w0.copy()
+        iu1 = iu0.copy()
+        for o in (2, 11, 23):
+            w1[o] = 0
+            iu1[o] = False
+        w1[17] = 0x8000          # partial decrease
+        st1 = st0.remap(w1, ex, iu1, None)
+        stf = self._state(dm, pg_num, w1, ex, iu1)
+        np.testing.assert_array_equal(np.asarray(st1.up),
+                                      np.asarray(stf.up))
+        np.testing.assert_array_equal(np.asarray(st1.prim),
+                                      np.asarray(stf.prim))
+        np.testing.assert_array_equal(np.asarray(st1.raw),
+                                      np.asarray(stf.raw))
+        # chained incremental stays exact
+        w2 = w1.copy()
+        w2[5] = 0
+        st2 = st1.remap(w2, ex, iu1, None)
+        stf2 = self._state(dm, pg_num, w2, ex, iu1)
+        np.testing.assert_array_equal(np.asarray(st2.up),
+                                      np.asarray(stf2.up))
+        # reweight increase falls back to a full pass, still exact
+        w3 = w2.copy()
+        w3[2] = 0x10000
+        iu2 = iu1.copy()
+        iu2[2] = True
+        st3 = st2.remap(w3, ex, iu2, None)
+        stf3 = self._state(dm, pg_num, w3, ex, iu2)
+        np.testing.assert_array_equal(np.asarray(st3.up),
+                                      np.asarray(stf3.up))

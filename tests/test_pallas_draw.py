@@ -69,22 +69,31 @@ def test_descend_parity_outer_and_inner():
     it_x, ok_x, pm_x, fl_x = _xla_descend(fm, bid, x, r, 1, pos, (11,))
     fn = pd.make_descend_kernel(fm, (11,), 1)
     it_p, st = fn(x.astype(jnp.int32), r, bid, pos)
-    np.testing.assert_array_equal(np.asarray(it_x), np.asarray(it_p))
-    np.testing.assert_array_equal(np.asarray(ok_x),
-                                  np.asarray((st & 1) != 0))
-    np.testing.assert_array_equal(np.asarray(fl_x),
-                                  np.asarray((st & 4) != 0))
+    fl_p = np.asarray((st & 4) != 0)
+    # the kernel's table-refined top-3 pass settles most draws the
+    # poly-only XLA path flags: kernel flags must be a subset, and
+    # items must agree wherever neither side is uncertain
+    fl_x = np.asarray(fl_x)
+    assert not (fl_p & ~fl_x).any()
+    agree = ~(fl_x | fl_p)
+    np.testing.assert_array_equal(np.asarray(it_x)[agree],
+                                  np.asarray(it_p)[agree])
+    np.testing.assert_array_equal(np.asarray(ok_x)[agree],
+                                  np.asarray((st & 1) != 0)[agree])
     # inner: per-lane host bucket -> device (want_type 0)
     bid2 = jnp.asarray(rng.integers(1, 12, L, dtype=np.int64)).astype(
         jnp.int32)
     it_x, ok_x, pm_x, fl_x = _xla_descend(fm, bid2, x, r, 0, pos, (7,))
     fn2 = pd.make_descend_kernel(fm, (7,), 0)
     it_p, st2 = fn2(x.astype(jnp.int32), r, bid2, pos)
-    np.testing.assert_array_equal(np.asarray(it_x), np.asarray(it_p))
-    np.testing.assert_array_equal(np.asarray(ok_x),
-                                  np.asarray((st2 & 1) != 0))
-    np.testing.assert_array_equal(np.asarray(pm_x),
-                                  np.asarray((st2 & 2) != 0))
+    fl_x = np.asarray(fl_x)
+    fl_p = np.asarray((st2 & 4) != 0)
+    assert not (fl_p & ~fl_x).any()
+    agree = ~(fl_x | fl_p)
+    np.testing.assert_array_equal(np.asarray(it_x)[agree],
+                                  np.asarray(it_p)[agree])
+    np.testing.assert_array_equal(np.asarray(pm_x)[agree],
+                                  np.asarray((st2 & 2) != 0)[agree])
 
 
 def test_descend_parity_multi_level():
@@ -115,9 +124,11 @@ def test_descend_parity_multi_level():
     it_x, ok_x, pm_x, fl_x = _xla_descend(fm, bid, x, r, 0, pos, ds)
     fn = pd.make_descend_kernel(fm, ds, 0)
     it_p, st = fn(x.astype(jnp.int32), r, bid, pos)
-    np.testing.assert_array_equal(np.asarray(it_x), np.asarray(it_p))
-    np.testing.assert_array_equal(np.asarray(ok_x),
-                                  np.asarray((st & 1) != 0))
+    agree = ~(np.asarray(fl_x) | np.asarray((st & 4) != 0))
+    np.testing.assert_array_equal(np.asarray(it_x)[agree],
+                                  np.asarray(it_p)[agree])
+    np.testing.assert_array_equal(np.asarray(ok_x)[agree],
+                                  np.asarray((st & 1) != 0)[agree])
 
 
 def test_do_rule_batch_uses_kernel_and_matches_host():
