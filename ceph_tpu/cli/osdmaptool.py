@@ -6,11 +6,15 @@ Analog of src/tools/osdmaptool.cc:
     python -m ceph_tpu.cli.osdmaptool map.bin --print
     python -m ceph_tpu.cli.osdmaptool map.bin --test-map-pgs \\
         [--pool N] [--bulk]
+    python -m ceph_tpu.cli.osdmaptool map.bin --upmap out.bin \\
+        [--upmap-deviation D] [--upmap-max N]
 
 --test-map-pgs maps every PG of the pool(s) and prints the placement
 histogram (the reference's per-osd count table); --bulk routes through
 the vectorized device mapper (OSDMapMapping) instead of the scalar
-pipeline — the ParallelPGMapper analog.
+pipeline — the ParallelPGMapper analog.  --upmap runs the upmap
+balancer (calc_pg_upmaps, the reference's osdmaptool --upmap) and
+writes the balanced map.
 """
 
 from __future__ import annotations
@@ -99,6 +103,10 @@ def main(argv=None) -> int:
     p.add_argument("--pool", type=int, action="append")
     p.add_argument("--bulk", action="store_true",
                    help="use the vectorized bulk mapper")
+    p.add_argument("--upmap", metavar="OUTFILE",
+                   help="run the upmap balancer, write the result")
+    p.add_argument("--upmap-deviation", type=float, default=1.0)
+    p.add_argument("--upmap-max", type=int, default=100)
     args = p.parse_args(argv)
 
     if args.createsimple:
@@ -129,6 +137,18 @@ def main(argv=None) -> int:
         pools = args.pool or sorted(m.pools)
         print(json.dumps(test_map_pgs(m, pools, bulk=args.bulk),
                          indent=1))
+        return 0
+    if args.upmap:
+        from ..osd.balancer import calc_pg_upmaps
+
+        inc = m.new_incremental()
+        n = calc_pg_upmaps(m, inc, args.upmap_deviation,
+                           args.upmap_max, args.pool)
+        m.apply_incremental(inc)
+        with open(args.upmap, "wb") as f:
+            f.write(m.encode())
+        print("calc_pg_upmaps: %d changes, %d pg_upmap_items; wrote %s"
+              % (n, len(m.pg_upmap_items), args.upmap))
         return 0
     p.error("nothing to do")
     return 2

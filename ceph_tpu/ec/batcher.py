@@ -64,8 +64,6 @@ class DeviceBatcher:
     stream but shares the flush machinery.
     """
 
-    _instances: dict[int, "DeviceBatcher"] = {}
-
     def __init__(self, window_us: int = 300,
                  max_batch_bytes: int = 8 << 20):
         self.window_us = window_us
@@ -76,11 +74,15 @@ class DeviceBatcher:
 
     @classmethod
     def get(cls) -> "DeviceBatcher":
+        """Per-event-loop instance, stored ON the loop object so its
+        lifetime tracks the loop's (an id(loop)-keyed registry would
+        hand a recycled address a stale instance whose dead timer
+        blocks the deadline flush forever)."""
         loop = asyncio.get_event_loop()
-        inst = cls._instances.get(id(loop))
+        inst = getattr(loop, "_ceph_tpu_ec_batcher", None)
         if inst is None:
             inst = cls()
-            cls._instances[id(loop)] = inst
+            loop._ceph_tpu_ec_batcher = inst
         return inst
 
     @staticmethod

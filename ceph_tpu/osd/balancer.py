@@ -2,20 +2,126 @@
 
 Condensed analog of OSDMap::calc_pg_upmaps (src/osd/OSDMap.cc:5159) —
 the flagship consumer of bulk mapping (the mgr balancer module drives
-it): compute every PG's up set, measure per-OSD deviation from the
-weight-proportional target, and emit pg_upmap_items exceptions that
-move PGs from overfull to underfull OSDs until the deviation is within
-max_deviation or no further progress is possible.
+it): compute every PG's up set through the device bulk mapper, measure
+per-OSD deviation from the weight-proportional target, and emit
+pg_upmap_items exceptions that move PGs from overfull to underfull
+OSDs until the deviation is within max_deviation or no further
+progress is possible.
 
-Placement correctness is preserved the way the reference's
-try_pg_upmap path does: a remap target must not already appear in the
-PG's up set (no duplicate OSDs), must be up+in, and existing upmap
-exceptions for a PG are replaced, not stacked.
+Placement correctness mirrors the reference's candidate validation
+(try_pg_upmap + _choose_type_stack cleaning, CrushWrapper.h:1529):
+
+* a move must not put two up-set members into the same failure domain
+  (the rule's chooseleaf type), validated against the crush tree;
+* the remap target must be up+in and absent from the PG's up set;
+* item rewrites are computed against the RAW (pre-upmap) mapping: an
+  existing (X -> over) exception is rewritten to (X -> under), never
+  stacked as (over -> under) — the raw set does not contain `over`,
+  so a stacked item would be a no-op and removing the old one would
+  silently bounce the PG back (OSDMap::calc_pg_upmaps does the same
+  raw-vs-up bookkeeping).
 """
 
 from __future__ import annotations
 
+from ..models.crushmap import (CHOOSE_FIRSTN, CHOOSE_INDEP,
+                               CHOOSELEAF_FIRSTN, CHOOSELEAF_INDEP,
+                               ITEM_NONE)
 from .osdmap import Incremental, OSDMap, pg_t
+
+
+def _failure_domains(osdmap: OSDMap, ruleno: int) -> dict[int, int] | None:
+    """osd -> failure-domain bucket id for the rule's chooseleaf type,
+    or None when the rule spreads over devices directly (type 0) or
+    has no single choose step (validation then only blocks duplicate
+    OSDs, like the reference's type-0 stack)."""
+    rule = osdmap.crush.rules.get(ruleno)
+    if rule is None:
+        return None
+    want_type = None
+    for op, arg1, arg2 in rule.steps:
+        if op in (CHOOSELEAF_FIRSTN, CHOOSELEAF_INDEP,
+                  CHOOSE_FIRSTN, CHOOSE_INDEP):
+            if want_type is not None:
+                return None          # multi-step: no single domain
+            want_type = arg2
+    if not want_type:
+        return None
+    domains: dict[int, int] = {}
+
+    def walk(bid: int, domain: int | None) -> None:
+        b = osdmap.crush.buckets.get(bid)
+        if b is None:
+            return
+        d = bid if b.type == want_type else domain
+        for child in b.items:
+            if child < 0:
+                walk(child, d)
+            elif d is not None:
+                domains[child] = d
+
+    children = {c for b in osdmap.crush.buckets.values()
+                for c in b.items if c < 0}
+    for bid in osdmap.crush.buckets:
+        if bid not in children:
+            walk(bid, None)
+    return domains
+
+
+def _apply_items(osdmap: OSDMap, raw: list[int],
+                 items: list[tuple[int, int]]) -> list[int]:
+    """Mirror of OSDMap._apply_upmap's pg_upmap_items pass: an item
+    applies only when its target is absent from the row, its source
+    present, and the target not weighted out."""
+    row = list(raw)
+    for osd_from, osd_to in items or ():
+        if osd_to in row:
+            continue
+        if (osd_to != ITEM_NONE and 0 <= osd_to < osdmap.max_osd
+                and osdmap.osd_weight[osd_to] == 0):
+            continue
+        for i, o in enumerate(row):
+            if o == osd_from:
+                row[i] = osd_to
+                break
+    return row
+
+
+def _effective_up(osdmap: OSDMap, raw: list[int],
+                  items: list[tuple[int, int]]) -> list[int]:
+    row = _apply_items(osdmap, raw, items)
+    return [o for o in row
+            if o != ITEM_NONE and osdmap.exists(o) and osdmap.is_up(o)]
+
+
+def _pool_raw(osdmap: OSDMap, pool) -> list[list[int]]:
+    """Pre-upmap raw rows (down OSDs included, like
+    _pg_to_raw_osds) for every PG, via the device bulk mapper's
+    MapState when in scope."""
+    import numpy as np
+
+    try:
+        from .osdmap import FLAG_HASHPSPOOL, OSD_EXISTS, OSD_UP
+
+        dm = osdmap.device_mapper()
+        state = np.asarray(osdmap.osd_state, dtype=np.int32)
+        st = dm.map_pool_state(
+            pool.crush_rule, pool.size, pool.pg_num, pool.pgp_num,
+            pool.pgp_num_mask, pool.id,
+            bool(pool.flags & FLAG_HASHPSPOOL), osdmap.osd_weight,
+            (state & OSD_EXISTS) != 0, (state & OSD_UP) != 0, None,
+            pool.can_shift_osds())
+        raw_np = np.array(st.raw[:pool.pg_num])
+        return [[o for o in row if o != ITEM_NONE]
+                for row in raw_np.tolist()]
+    except ValueError:
+        # outside device scope (non-straw2, multi-choose): scalar path
+        rows = []
+        for ps in range(pool.pg_num):
+            pg = pg_t(pool.id, ps)
+            raw, _pps = osdmap._pg_to_raw_osds(pool, pg)
+            rows.append([o for o in raw if o != ITEM_NONE])
+        return rows
 
 
 def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
@@ -29,14 +135,19 @@ def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
     if not pool_ids:
         return 0
 
-    # current mapping + per-osd load
+    pg_raw: dict[pg_t, list[int]] = {}
     pg_up: dict[pg_t, list[int]] = {}
+    pg_domains: dict[int, dict[int, int] | None] = {}
     for pid in pool_ids:
         pool = osdmap.pools[pid]
+        raw_rows = _pool_raw(osdmap, pool)
+        pg_domains[pid] = _failure_domains(osdmap, pool.crush_rule)
         for ps in range(pool.pg_num):
             pg = pg_t(pid, ps)
-            up, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
-            pg_up[pg] = up
+            pg_raw[pg] = raw_rows[ps]
+            pg_up[pg] = _effective_up(
+                osdmap, raw_rows[ps],
+                osdmap.pg_upmap_items.get(pg, []))
 
     # weight-proportional target over up+in osds
     weights = {o: osdmap.osd_weight[o] / 0x10000
@@ -55,11 +166,19 @@ def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
             if o in counts:
                 counts[o] += 1
 
-    # existing exceptions for these pools are re-derived from scratch
     existing = {pg: items for pg, items in osdmap.pg_upmap_items.items()
                 if pg.pool in set(pool_ids)}
     new_items: dict[pg_t, list[tuple[int, int]]] = {
         pg: list(items) for pg, items in existing.items()}
+
+    def row_valid(pg: pg_t, row: list[int]) -> bool:
+        if len(set(row)) != len(row):
+            return False
+        domains = pg_domains.get(pg.pool)
+        if domains is None:
+            return True
+        doms = [domains.get(o) for o in row]
+        return None not in doms and len(set(doms)) == len(doms)
 
     changes = 0
     for _ in range(max_iterations):
@@ -72,19 +191,46 @@ def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
         for pg, up in pg_up.items():
             if over not in up:
                 continue
+            raw = pg_raw[pg]
             for under in under_sorted:
                 if deviations[under] >= -0.0001:
                     break  # nobody meaningfully underfull
                 if under in up:
                     continue
-                # move pg's replica from `over` to `under`
+                # rewrite against the RAW mapping: if `over` is a raw
+                # member, add (over, under); else an existing item
+                # (X -> over) must exist — rewrite it to (X -> under),
+                # never stack (over -> under) no-ops
                 items = [t for t in new_items.get(pg, [])
-                         if t[0] != over and t[1] != over]
-                items.append((over, under))
+                         if t[1] != over]
+                if over in raw:
+                    items = [t for t in items if t[0] != over]
+                    items.append((over, under))
+                else:
+                    src = next((f for f, t in new_items.get(pg, [])
+                                if t == over), None)
+                    if src is None or src not in raw:
+                        continue
+                    items = [t for t in items if t[0] != src]
+                    items.append((src, under))
+                # the REAL effect of the new item list (replayed via
+                # _apply_upmap semantics over the raw row) is what
+                # must be validated and accounted — dropping an item
+                # can silently restore its source, so the old up row
+                # is not a reliable base
+                new_row = _effective_up(osdmap, raw, items)
+                if over in new_row or not row_valid(pg, new_row):
+                    continue
+                if sum(1 for o in new_row if o == under) != 1:
+                    continue
                 new_items[pg] = items
-                pg_up[pg] = [under if o == over else o for o in up]
-                counts[over] -= 1
-                counts[under] += 1
+                for o in up:
+                    if o in counts:
+                        counts[o] -= 1
+                for o in new_row:
+                    if o in counts:
+                        counts[o] += 1
+                pg_up[pg] = new_row
                 changes += 1
                 moved = True
                 break

@@ -79,3 +79,81 @@ def test_balancer_idempotent_when_balanced():
                              max_iterations=50)
     assert changes == 0
     assert not inc2.new_pg_upmap_items
+
+
+def build_host_cluster(hosts=5, per_host=4, pg_num=128, size=3,
+                       skew=None):
+    """Two-level map with chooseleaf over hosts — the failure-domain
+    profile the validator must respect."""
+    from ceph_tpu.models.crushmap import CHOOSELEAF_FIRSTN
+
+    n_osds = hosts * per_host
+    crush = CrushMap()
+    host_ids = []
+    for h in range(hosts):
+        items = list(range(h * per_host, (h + 1) * per_host))
+        b = crush.add_bucket(STRAW2, 1, items, [0x10000] * per_host,
+                             id=-(h + 2))
+        host_ids.append(b.id)
+    crush.add_bucket(STRAW2, 2, host_ids,
+                     [crush.buckets[h].weight for h in host_ids], id=-1)
+    crush.add_rule([(TAKE, -1, 0), (CHOOSELEAF_FIRSTN, 0, 1),
+                    (EMIT, 0, 0)], id=0)
+    m = OSDMap()
+    inc = Incremental(epoch=1)
+    inc.new_max_osd = n_osds
+    inc.new_crush = crush
+    inc.new_pools[1] = PGPool(id=1, name="p", pg_num=pg_num, size=size,
+                              crush_rule=0)
+    m.apply_incremental(inc)
+    inc = m.new_incremental()
+    for o in range(n_osds):
+        inc.new_state[o] = OSD_EXISTS | OSD_UP
+        inc.new_weight[o] = (skew(o) if skew else 0x10000)
+    m.apply_incremental(inc)
+    return m
+
+
+def test_balancer_respects_failure_domains():
+    """Emitted upmaps must never place two up-set members on the same
+    host (the rule's chooseleaf domain) — the reference validates
+    candidates through the rule's type stack (OSDMap.cc:5159,
+    CrushWrapper.h:1529)."""
+    per_host = 4
+    m = build_host_cluster(hosts=5, per_host=per_host, pg_num=128,
+                           skew=lambda o: 0x8000 if o % 7 == 0
+                           else 0x10000)
+    inc = m.new_incremental()
+    n = calc_pg_upmaps(m, inc, max_deviation=0.5, max_iterations=50)
+    assert n > 0
+    m.apply_incremental(inc)
+    pool = m.pools[1]
+    for ps in range(pool.pg_num):
+        up, _, _, _ = m.pg_to_up_acting_osds(pg_t(1, ps))
+        hosts_used = [o // per_host for o in up]
+        assert len(set(hosts_used)) == len(hosts_used), \
+            (ps, up, hosts_used)
+        assert len(set(up)) == len(up)
+
+
+def test_balancer_rewrites_items_against_raw_mapping():
+    """Re-balancing a map that already carries upmap items must
+    rewrite the existing (raw_from -> to) entries, not stack
+    (old_to -> new_to) no-ops (advisor finding: OSDMap::calc_pg_upmaps
+    rewrites 'from' against the raw mapping)."""
+    m = build_host_cluster(hosts=5, per_host=4, pg_num=128,
+                           skew=lambda o: 0x6000 if o < 4 else 0x10000)
+    inc = m.new_incremental()
+    calc_pg_upmaps(m, inc, max_deviation=0.5, max_iterations=40)
+    m.apply_incremental(inc)
+    # second round from the already-upmapped state
+    inc2 = m.new_incremental()
+    calc_pg_upmaps(m, inc2, max_deviation=0.5, max_iterations=40)
+    m.apply_incremental(inc2)
+    for pg, items in m.pg_upmap_items.items():
+        pool = m.pools[pg.pool]
+        raw, _ = m._pg_to_raw_osds(pool, pg)
+        for f, t in items:
+            assert f in raw, (pg, items, raw)   # no stacked no-ops
+        up, _, _, _ = m.pg_to_up_acting_osds(pg)
+        assert len(set(up)) == len(up)
