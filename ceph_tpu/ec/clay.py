@@ -1,0 +1,432 @@
+"""CLAY (Coupled LAYer) MSR regenerating codes.
+
+Behavioral re-derivation of src/erasure-code/clay/ErasureCodeClay.{h,cc}
+(the Clay-codes FAST'18 construction): an (k+m, k) scalar MDS code is
+lifted onto a q x t node grid (q = d-k+1, t = (k+m+nu)/q) whose chunks
+split into q^t sub-chunks ("planes"); pairwise coupling between a node
+(x, y) in plane z and its partner (z_y, y) in the plane with digit y
+swapped to x makes single-node repair read only q^(t-1) sub-chunks from
+each of d helpers — repair bandwidth d/(d-k+1) sub-chunks per chunk
+instead of k whole chunks.
+
+Structure mirrored from the reference (cited by line where the
+semantics are pinned):
+
+* parameters/layout: q, t, nu padding, sub_chunk_no = q^t
+  (ErasureCodeClay.cc:271-296); chunk alignment sub_chunk_no*k*pft
+  (:93);
+* encode = decode_layered with the parity nodes erased (:140-152);
+* decode_layered: planes ordered by intersection score (erased
+  hole-dot count, :762-773), per plane the surviving nodes' uncoupled
+  symbols come from pairwise transforms of the coupled pairs
+  (decode_erasures, :712-739), the erased nodes' uncoupled symbols from
+  the scalar MDS decode (decode_uncoupled, :741-760), and the coupled
+  symbols back out of the pair relations (recover_type1_erasure /
+  get_coupled_from_uncoupled, :775-838);
+* the pairwise transform IS a (4, 2) instance of the same scalar MDS
+  code over [C_xy, C_sw, U_xy, U_sw] with the lower-x symbol first
+  (the i0..i3 swap, :848-855) — byte-compat therefore follows from the
+  k=2,m=2 coding matrix of the chosen scalar_mds plugin;
+* single-node repair reads only the repair planes {z : z_{y_lost} =
+  x_lost} from every helper (minimum_to_decode sub-chunk ranges,
+  :310-392); implemented here for the no-aloof case (d = #survivors,
+  e.g. the default d = k+m-1 with one failure) — other layouts fall
+  back to the full-chunk layered decode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf
+from .base import ErasureCode
+from .plugin import ErasureCodePluginRegistry
+
+
+class ErasureCodeClay(ErasureCode):
+    DEFAULT_K = 4
+    DEFAULT_M = 2
+
+    def __init__(self):
+        super().__init__()
+        self.d = 0
+        self.q = 0
+        self.t = 0
+        self.nu = 0
+        self.sub_chunk_no = 1
+        self.scalar_mds = "jerasure"
+        self.technique = "reed_sol_van"
+
+    # -- profile -----------------------------------------------------------
+
+    def init(self, profile: dict) -> None:
+        profile.setdefault("plugin", "clay")
+        self.parse(profile)
+        self.prepare()
+        self._profile = profile
+
+    def parse(self, profile: dict) -> None:
+        self.k = self._to_int(profile, "k", self.DEFAULT_K)
+        self.m = self._to_int(profile, "m", self.DEFAULT_M)
+        self.d = self._to_int(profile, "d", self.k + self.m - 1)
+        if not (self.k + 1 <= self.d <= self.k + self.m - 1):
+            raise ValueError(
+                "clay: d=%d must satisfy k+1 <= d <= k+m-1" % self.d)
+        self.scalar_mds = profile.get("scalar_mds", "jerasure")
+        if self.scalar_mds not in ("jerasure", "isa"):
+            raise ValueError("clay: scalar_mds %r not supported"
+                             % self.scalar_mds)
+        self.technique = profile.get("technique", "reed_sol_van")
+        if self.technique not in ("reed_sol_van", "cauchy"):
+            raise ValueError("clay: technique %r not supported"
+                             % self.technique)
+        self._parse_mapping(profile)
+        self.sanity_check_k_m()
+
+    def prepare(self) -> None:
+        self.q = self.d - self.k + 1
+        self.nu = (self.q - (self.k + self.m) % self.q) % self.q
+        self.t = (self.k + self.m + self.nu) // self.q
+        self.sub_chunk_no = self.q ** self.t
+        if self.k + self.m + self.nu > 254:
+            raise ValueError("clay: k+m+nu too large for GF(256)")
+        reg = ErasureCodePluginRegistry.instance()
+
+        def mk(k, m):
+            prof = {"plugin": self.scalar_mds, "k": str(k),
+                    "m": str(m), "w": "8",
+                    "technique": self.technique}
+            return reg.factory(self.scalar_mds, prof)
+
+        self.mds = mk(self.k + self.nu, self.m)
+        self.pft = mk(2, 2)
+        # (4,2) pairwise-transform generator: rows 0,1 = identity
+        # (the coupled pair), rows 2,3 = the k=2,m=2 coding matrix
+        # (the uncoupled pair).  Symbol order is the reference's
+        # i0..i3 canonicalisation (ErasureCodeClay.cc:848-855):
+        # sym0/sym2 = C/U of the LARGER-x pair member, sym1/sym3 of
+        # the smaller.  Solves for any 2-of-4 are 2x2 GF inverts.
+        P = [list(r) for r in self.pft.matrix]
+        self._pft_gen = [[1, 0], [0, 1], list(P[0]), list(P[1])]
+        self._pft_solves: dict[tuple, tuple] = {}
+
+    # -- geometry ----------------------------------------------------------
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunk_no
+
+    def get_alignment(self) -> int:
+        return self.sub_chunk_no * self.k * self.pft.get_chunk_size(1)
+
+    def get_chunk_size(self, object_size: int) -> int:
+        a = self.get_alignment()
+        padded = object_size + (a - object_size % a) % a
+        return padded // self.k
+
+    def _zvec(self, z: int) -> list[int]:
+        v = [0] * self.t
+        for i in range(self.t):
+            v[self.t - 1 - i] = z % self.q
+            z //= self.q
+        return v
+
+    def _zsw(self, z: int, zv: list[int], x: int, y: int) -> int:
+        return z + (x - zv[y]) * (self.q ** (self.t - 1 - y))
+
+    # -- pairwise transform -------------------------------------------------
+
+    def _pft_solve(self, known: tuple[int, int]):
+        """(A, B): unknowns = A @ [known0, known1] where unknowns are
+        the complementary pair in index order."""
+        key = known
+        cached = self._pft_solves.get(key)
+        if cached is not None:
+            return cached
+        G = self._pft_gen
+        i, j = known
+        unk = tuple(r for r in range(4) if r not in known)
+        # [g_i; g_j] @ [d0,d1]^T = [known0, known1]^T
+        inv = gf.matrix_invert([list(G[i]), list(G[j])], 8)
+        rows = gf.matrix_mul([list(G[u]) for u in unk], inv, 8)
+        self._pft_solves[key] = (unk, np.array(rows, dtype=np.uint8))
+        return self._pft_solves[key]
+
+    def _pair(self, a: np.ndarray, b: np.ndarray, known: tuple):
+        """Apply the 2-of-4 solve: returns the two unknown symbols (in
+        index order) from known symbols a, b (arrays)."""
+        _unk, rows = self._pft_solve(known)
+        data = np.stack([a, b])
+        out = gf.matmul_u8(rows, data)
+        return out[0], out[1]
+
+    # -- layered decode (the engine behind encode AND decode) --------------
+
+    def _decode_layered(self, erasures: set[int], C: list, sc: int):
+        """C: list of q*t numpy [sub_chunk_no, sc] uint8 arrays
+        (erased entries are written in place)."""
+        q, t = self.q, self.t
+        er = set(erasures)
+        for i in range(self.k + self.nu, q * t):
+            if len(er) >= self.m:
+                break
+            er.add(i)
+        assert len(er) == self.m
+        U = [np.zeros((self.sub_chunk_no, sc), np.uint8)
+             for _ in range(q * t)]
+        order = []
+        for z in range(self.sub_chunk_no):
+            zv = self._zvec(z)
+            order.append(sum(1 for i in er if i % q == zv[i // q]))
+        max_score = max(order) if order else 0
+        dec_rows = self._mds_decode_rows(er)
+        for score in range(max_score + 1):
+            planes = [z for z in range(self.sub_chunk_no)
+                      if order[z] == score]
+            for z in planes:
+                self._fill_uncoupled(er, z, C, U)
+                self._decode_uncoupled(er, z, U, dec_rows)
+            for z in planes:
+                zv = self._zvec(z)
+                for node in sorted(er):
+                    x, y = node % q, node // q
+                    sw = y * q + zv[y]
+                    if zv[y] == x:       # hole-dot: C = U
+                        C[node][z] = U[node][z]
+                    elif sw not in er:
+                        # type-1 (recover_type1_erasure): solve the
+                        # erased node's C from (partner C, own U)
+                        z_sw = self._zsw(z, zv, x, y)
+                        if x < zv[y]:
+                            # node is the smaller member (sym1):
+                            # knowns sym0=C_sw, sym3=U_node
+                            out = self._pair(C[sw][z_sw], U[node][z],
+                                             (0, 3))
+                            C[node][z] = out[0]     # sym1
+                        else:
+                            # node is the larger member (sym0):
+                            # knowns sym1=C_sw, sym2=U_node
+                            out = self._pair(C[sw][z_sw], U[node][z],
+                                             (1, 2))
+                            C[node][z] = out[0]     # sym0
+                    elif zv[y] < x:
+                        # both erased (get_coupled_from_uncoupled,
+                        # larger side drives): C pair from U pair
+                        z_sw = self._zsw(z, zv, x, y)
+                        c_hi, c_lo = self._pair(U[node][z],
+                                                U[sw][z_sw], (2, 3))
+                        C[node][z] = c_hi           # sym0 (larger)
+                        C[sw][z_sw] = c_lo          # sym1
+        return C
+
+    def _fill_uncoupled(self, er: set[int], z: int, C, U) -> None:
+        """decode_erasures' first pass: U for every surviving node of
+        plane z from the coupled pairs."""
+        q, t = self.q, self.t
+        zv = self._zvec(z)
+        for y in range(t):
+            for x in range(q):
+                node = q * y + x
+                if node in er:
+                    continue
+                sw = q * y + zv[y]
+                if zv[y] == x:
+                    U[node][z] = C[node][z]
+                elif zv[y] < x:
+                    # node is the larger member: compute both U from
+                    # the C pair (this also pre-fills the partner's U
+                    # at the later plane z_sw — planes iterate
+                    # ascending, and z_sw > z here; for an erased sw
+                    # its C at z_sw was recovered in an earlier
+                    # iscore round)
+                    z_sw = self._zsw(z, zv, x, y)
+                    u_hi, u_lo = self._pair(C[node][z], C[sw][z_sw],
+                                            (0, 1))
+                    U[node][z] = u_hi
+                    U[sw][z_sw] = u_lo
+                elif sw in er:
+                    # node smaller, partner erased: partner's C at
+                    # z_sw (< z, one fewer erased dot) is recovered
+                    z_sw = self._zsw(z, zv, x, y)
+                    u_hi, u_lo = self._pair(C[sw][z_sw], C[node][z],
+                                            (0, 1))
+                    U[sw][z_sw] = u_hi
+                    U[node][z] = u_lo
+
+    def _mds_decode_rows(self, er: set[int]):
+        """Decoding rows for the scalar MDS over the q*t grid: rows
+        that rebuild the erased nodes' uncoupled symbols from the
+        surviving ones (cached per erasure signature upstream)."""
+        from .batcher import reconstruct_matrix
+        n = self.q * self.t
+        have = tuple(i for i in range(n) if i not in er)
+        erased = tuple(sorted(er))
+        rows, chosen = reconstruct_matrix(
+            self.k + self.nu, 8, [list(r) for r in self.mds.matrix],
+            erased, have)
+        return erased, chosen, np.array(rows, dtype=np.uint8)
+
+    def _decode_uncoupled(self, er, z, U, dec_rows) -> None:
+        erased, chosen, rows = dec_rows
+        data = np.stack([U[c][z] for c in chosen])
+        out = gf.matmul_u8(rows, data)
+        for idx, node in enumerate(erased):
+            U[node][z] = out[idx]
+
+    # -- chunk API ----------------------------------------------------------
+
+    def _grid(self, chunks: dict[int, bytes], sc: int):
+        """chunks (logical external ids) -> grid arrays with the nu
+        zero nodes spliced in at k..k+nu-1."""
+        n = self.q * self.t
+        C = [np.zeros((self.sub_chunk_no, sc), np.uint8)
+             for _ in range(n)]
+        for i, buf in chunks.items():
+            node = i if i < self.k else i + self.nu
+            C[node] = np.frombuffer(buf, np.uint8).reshape(
+                self.sub_chunk_no, sc).copy()
+        return C
+
+    def encode_chunks(self, chunks: dict[int, bytes]) -> dict[int, bytes]:
+        chunk_size = len(chunks[self.chunk_index(0)])
+        assert chunk_size % self.sub_chunk_no == 0
+        sc = chunk_size // self.sub_chunk_no
+        logical = {i: chunks[self.chunk_index(i)]
+                   for i in range(self.k)}
+        C = self._grid(logical, sc)
+        parities = set(range(self.k + self.nu, self.q * self.t))
+        self._decode_layered(parities, C, sc)
+        out = dict(chunks)
+        for i in range(self.m):
+            out[self.chunk_index(self.k + i)] = \
+                C[self.k + self.nu + i].tobytes()
+        return out
+
+    def decode_chunks(self, want_to_read, chunks) -> dict[int, bytes]:
+        chunks = self._to_logical(chunks)
+        chunk_size = len(next(iter(chunks.values())))
+        assert chunk_size % self.sub_chunk_no == 0
+        sc = chunk_size // self.sub_chunk_no
+        n_ext = self.k + self.m
+        erased_ext = [i for i in range(n_ext) if i not in chunks]
+        C = self._grid(chunks, sc)
+        er = {i if i < self.k else i + self.nu for i in erased_ext}
+        self._decode_layered(er, C, sc)
+        out = {}
+        for i in erased_ext:
+            node = i if i < self.k else i + self.nu
+            out[i] = C[node].tobytes()
+        return self._from_logical(out)
+
+    # -- repair-bandwidth API ----------------------------------------------
+
+    def _repair_planes(self, lost: int) -> list[int]:
+        """Plane indices every helper must send to repair `lost`
+        (z with z_{y_lost} == x_lost), ascending."""
+        q, t = self.q, self.t
+        x, y = lost % q, lost // q
+        step = q ** (t - 1 - y)
+        planes = []
+        for z in range(self.sub_chunk_no):
+            if (z // step) % q == x:
+                planes.append(z)
+        return planes
+
+    def minimum_to_decode(self, want_to_read, available):
+        want = set(want_to_read)
+        avail = set(available)
+        # the sub-chunk repair plan applies only to the no-aloof
+        # layout repair() supports: a single loss with d = k+m-1, so
+        # the d helpers ARE every surviving node
+        if (len(want - avail) == 1 and not self.chunk_mapping
+                and self.d == self.k + self.m - 1):
+            lost_ext = next(iter(want - avail))
+            helpers = avail - want
+            if helpers == set(range(self.k + self.m)) - want:
+                lost = (lost_ext if lost_ext < self.k
+                        else lost_ext + self.nu)
+                planes = self._repair_planes(lost)
+                # contiguous (offset, count) runs in sub-chunk units
+                runs = []
+                for z in planes:
+                    if runs and runs[-1][0] + runs[-1][1] == z:
+                        runs[-1] = (runs[-1][0], runs[-1][1] + 1)
+                    else:
+                        runs.append((z, 1))
+                chosen = sorted(helpers)[:self.d]
+                return {c: list(runs) for c in chosen}
+        return super().minimum_to_decode(want_to_read, available)
+
+    def repair(self, lost_ext: int,
+               helper_subchunks: dict[int, bytes]) -> bytes:
+        """Rebuild chunk `lost_ext` from d helpers' repair sub-chunks
+        (each helper contributes only the q^(t-1) repair planes —
+        bandwidth d/(d-k+1) sub-chunks vs k*sub_chunk_no for a full
+        decode).  Helpers must be every other node (no aloof nodes);
+        otherwise use decode()."""
+        q, t = self.q, self.t
+        lost = lost_ext if lost_ext < self.k else lost_ext + self.nu
+        x_l, y_l = lost % q, lost // q
+        planes = self._repair_planes(lost)
+        plane_ind = {z: i for i, z in enumerate(planes)}
+        sc = len(next(iter(helper_subchunks.values()))) // len(planes)
+        n = q * t
+        H: dict[int, np.ndarray] = {}
+        for ext, buf in helper_subchunks.items():
+            node = ext if ext < self.k else ext + self.nu
+            H[node] = np.frombuffer(buf, np.uint8).reshape(
+                len(planes), sc)
+        for i in range(self.k, self.k + self.nu):   # zero nodes help
+            H[i] = np.zeros((len(planes), sc), np.uint8)
+        missing_helpers = set(range(n)) - set(H) - {lost}
+        if missing_helpers:
+            raise IOError("clay repair needs every surviving node "
+                          "(aloof nodes unsupported; use decode)")
+        U = {node: np.zeros((len(planes), sc), np.uint8)
+             for node in range(n)}
+        # the erased row for the uncoupled decode: lost's whole y-row
+        er = {y_l * q + xx for xx in range(q)}
+        dec = self._mds_decode_rows(er)
+        out = np.zeros((self.sub_chunk_no, sc), np.uint8)
+        for z in planes:
+            zi = plane_ind[z]
+            zv = self._zvec(z)
+            for y in range(t):
+                for x in range(q):
+                    node = y * q + x
+                    if node in er:
+                        continue
+                    sw = y * q + zv[y]
+                    if zv[y] == x:
+                        U[node][zi] = H[node][zi]
+                    elif zv[y] < x:
+                        z_sw = self._zsw(z, zv, x, y)
+                        u_hi, u_lo = self._pair(
+                            H[node][zi], H[sw][plane_ind[z_sw]],
+                            (0, 1))
+                        U[node][zi] = u_hi
+                        U[sw][plane_ind[z_sw]] = u_lo
+            # MDS-decode the lost row's uncoupled symbols
+            erased, chosen, rows = dec
+            data = np.stack([U[c][zi] for c in chosen])
+            dec_out = gf.matmul_u8(rows, data)
+            for idx, node in enumerate(erased):
+                U[node][zi] = dec_out[idx]
+            # back to coupled: the dot gives lost's own plane, the
+            # other row members give lost's swapped planes
+            out[z] = U[lost][zi]
+            for xx in range(q):
+                if xx == x_l:
+                    continue
+                node = y_l * q + xx
+                z_sw = self._zsw(z, zv, xx, y_l)
+                if xx < x_l:
+                    # helper is the smaller member: knowns sym1=C,
+                    # sym3=U; lost (larger) C is sym0
+                    o = self._pair(H[node][zi], U[node][zi], (1, 3))
+                    out[z_sw] = o[0]       # sym0
+                else:
+                    # helper larger: knowns sym0=C, sym2=U; lost
+                    # (smaller) C is sym1
+                    o = self._pair(H[node][zi], U[node][zi], (0, 2))
+                    out[z_sw] = o[0]       # sym1
+        return out.tobytes()

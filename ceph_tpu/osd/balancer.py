@@ -137,6 +137,7 @@ def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
 
     pg_raw: dict[pg_t, list[int]] = {}
     pg_up: dict[pg_t, list[int]] = {}
+    pinned: dict[pg_t, list[int]] = {}
     pg_domains: dict[int, dict[int, int] | None] = {}
     for pid in pool_ids:
         pool = osdmap.pools[pid]
@@ -144,6 +145,13 @@ def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
         pg_domains[pid] = _failure_domains(osdmap, pool.crush_rule)
         for ps in range(pool.pg_num):
             pg = pg_t(pid, ps)
+            if pg in osdmap.pg_upmap:
+                # explicit pg_upmap pins override items entirely
+                # (OSDMap._apply_upmap); count their real placement
+                # but never try to move them
+                up, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
+                pinned[pg] = up
+                continue
             pg_raw[pg] = raw_rows[ps]
             pg_up[pg] = _effective_up(
                 osdmap, raw_rows[ps],
@@ -156,7 +164,8 @@ def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
     total_w = sum(weights.values())
     if total_w <= 0:
         return 0
-    total_placements = sum(len(up) for up in pg_up.values())
+    total_placements = (sum(len(up) for up in pg_up.values())
+                        + sum(len(up) for up in pinned.values()))
     target = {o: total_placements * w / total_w
               for o, w in weights.items()}
 
@@ -165,11 +174,28 @@ def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
         for o in up:
             if o in counts:
                 counts[o] += 1
+    for up in pinned.values():
+        for o in up:
+            if o in counts:
+                counts[o] += 1
 
     existing = {pg: items for pg, items in osdmap.pg_upmap_items.items()
                 if pg.pool in set(pool_ids)}
-    new_items: dict[pg_t, list[tuple[int, int]]] = {
-        pg: list(items) for pg, items in existing.items()}
+    # retire no-op entries up front (source left the raw set or the
+    # item no longer applies) — the reference's clean_pg_upmaps pass
+    new_items: dict[pg_t, list[tuple[int, int]]] = {}
+    for pg, items in existing.items():
+        if pg in pinned:
+            new_items[pg] = list(items)   # masked by pg_upmap: keep
+            continue
+        raw = pg_raw.get(pg, [])
+        row = list(raw)
+        kept = []
+        for f, t in items:
+            if f in row and t not in row:
+                row = [t if o == f else o for o in row]
+                kept.append((f, t))
+        new_items[pg] = kept
 
     def row_valid(pg: pg_t, row: list[int]) -> bool:
         if len(set(row)) != len(row):

@@ -37,6 +37,9 @@ PROFILES = [
     ("lrc", {"k": "4", "m": "2", "l": "3"}),
     ("shec", {"k": "4", "m": "3", "c": "2"}),
     ("shec", {"k": "6", "m": "4", "c": "3"}),
+    ("clay", {"k": "4", "m": "2", "d": "5"}),
+    ("clay", {"k": "3", "m": "3", "d": "5"}),
+    ("clay", {"k": "4", "m": "3", "d": "6", "scalar_mds": "isa"}),
 ]
 
 OUT = os.path.join(os.path.dirname(__file__), "ec_corpus.json")

@@ -157,3 +157,44 @@ def test_balancer_rewrites_items_against_raw_mapping():
             assert f in raw, (pg, items, raw)   # no stacked no-ops
         up, _, _, _ = m.pg_to_up_acting_osds(pg)
         assert len(set(up)) == len(up)
+
+
+def test_balancer_skips_pg_upmap_pinned_pgs():
+    """Explicit pg_upmap pins override items entirely in
+    _apply_upmap; the balancer must count their real placement but
+    never emit items for them (emitted items would be no-ops)."""
+    m = build_host_cluster(hosts=5, per_host=4, pg_num=64,
+                           skew=lambda o: 0x8000 if o < 4 else 0x10000)
+    pin = pg_t(1, 3)
+    inc = m.new_incremental()
+    inc.new_pg_upmap[pin] = [0, 4, 8]
+    m.apply_incremental(inc)
+    inc = m.new_incremental()
+    calc_pg_upmaps(m, inc, max_deviation=0.5, max_iterations=40)
+    assert pin not in inc.new_pg_upmap_items
+    m.apply_incremental(inc)
+    up, _, _, _ = m.pg_to_up_acting_osds(pin)
+    assert up == [0, 4, 8]
+
+
+def test_balancer_retires_noop_items():
+    """An existing item whose source left the raw mapping is retired
+    (the reference's clean_pg_upmaps), not preserved forever."""
+    m = build_host_cluster(hosts=5, per_host=4, pg_num=64)
+    pool = m.pools[1]
+    # fabricate a no-op item: source not in the pg's raw set
+    victim = None
+    for ps in range(pool.pg_num):
+        raw, _ = m._pg_to_raw_osds(pool, pg_t(1, ps))
+        absent = next(o for o in range(20) if o not in raw)
+        victim = (pg_t(1, ps), absent, raw)
+        break
+    pg, absent, raw = victim
+    inc = m.new_incremental()
+    inc.new_pg_upmap_items[pg] = [(absent, raw[0])]  # never applies
+    m.apply_incremental(inc)
+    inc = m.new_incremental()
+    calc_pg_upmaps(m, inc, max_deviation=0.5, max_iterations=10)
+    m.apply_incremental(inc)
+    items = m.pg_upmap_items.get(pg, [])
+    assert all(f in raw for f, _ in items), items

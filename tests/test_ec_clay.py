@@ -1,0 +1,108 @@
+"""CLAY coupled-layer MSR code: MDS property, sub-chunking, and the
+repair-bandwidth advantage (ErasureCodeClay.cc analog; mirrors
+src/test/erasure-code/TestErasureCodeClay.cc coverage)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+
+
+def _codec(**profile):
+    prof = {k: str(v) for k, v in profile.items()}
+    return ErasureCodePluginRegistry.instance().factory("clay", prof)
+
+
+def _payload(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+@pytest.mark.parametrize("k,m,d", [
+    (4, 2, 5),      # q=2, t=3, sub=8 (the VERDICT's pinned profile)
+    (3, 3, 5),      # q=3, t=2, sub=9
+    (2, 2, 3),      # q=2, t=2, sub=4
+    (6, 3, 8),      # q=3, t=3, sub=27
+    (4, 3, 5),      # nu=1 padding case: q=2, k+m odd
+])
+def test_roundtrip_and_mds(k, m, d):
+    codec = _codec(k=k, m=m, d=d)
+    assert codec.get_sub_chunk_count() == \
+        (d - k + 1) ** codec.t
+    data = _payload(3000 + 17 * k, seed=k * 31 + m)
+    n = k + m
+    enc = codec.encode(set(range(n)), data)
+    assert len(enc) == n
+    # every m-subset of erasures decodes
+    import itertools
+
+    for erased in itertools.combinations(range(n), m):
+        chunks = {i: enc[i] for i in range(n) if i not in erased}
+        dec = codec.decode(set(erased), chunks)
+        for e in erased:
+            assert dec[e] == enc[e], (erased, e)
+    assert codec.decode_concat(
+        {i: enc[i] for i in range(n) if i != 1})[:len(data)] == data
+
+
+def test_repair_reads_fewer_subchunks():
+    """Single-node repair reads q^(t-1) of q^t sub-chunks per helper:
+    total d/(d-k+1) sub-chunks vs k*q^t for a conventional decode."""
+    k, m, d = 4, 2, 5
+    codec = _codec(k=k, m=m, d=d)
+    sub = codec.get_sub_chunk_count()
+    data = _payload(4096, seed=7)
+    n = k + m
+    enc = codec.encode(set(range(n)), data)
+    for lost in range(n):
+        avail = set(range(n)) - {lost}
+        minimum = codec.minimum_to_decode({lost}, avail)
+        assert len(minimum) == d
+        repair_sub = sum(c for _, c in next(iter(minimum.values())))
+        assert repair_sub == sub // (d - k + 1)
+        # total bytes read: d * sub/q vs k * sub for full decode
+        assert d * repair_sub < k * sub
+        # gather exactly those sub-chunks and repair
+        sc = len(enc[0]) // sub
+        helpers = {}
+        for node, runs in minimum.items():
+            buf = b"".join(
+                enc[node][off * sc:(off + cnt) * sc]
+                for off, cnt in runs)
+            helpers[node] = buf
+        rebuilt = codec.repair(lost, helpers)
+        assert rebuilt == enc[lost], lost
+
+
+def test_repair_bytes_match_decode():
+    """Repair and full decode agree for a parity and a data chunk."""
+    codec = _codec(k=3, m=3, d=5)
+    data = _payload(2222, seed=3)
+    n = 6
+    enc = codec.encode(set(range(n)), data)
+    sub = codec.get_sub_chunk_count()
+    sc = len(enc[0]) // sub
+    for lost in (0, 4):
+        avail = set(range(n)) - {lost}
+        minimum = codec.minimum_to_decode({lost}, avail)
+        helpers = {}
+        for node, runs in minimum.items():
+            helpers[node] = b"".join(
+                enc[node][off * sc:(off + cnt) * sc]
+                for off, cnt in runs)
+        assert codec.repair(lost, helpers) == enc[lost]
+        dec = codec.decode({lost},
+                           {i: enc[i] for i in avail})
+        assert dec[lost] == enc[lost]
+
+
+def test_double_failure_falls_back_to_whole_chunks():
+    codec = _codec(k=4, m=2, d=5)
+    data = _payload(1024, seed=9)
+    enc = codec.encode(set(range(6)), data)
+    avail = set(range(6)) - {0, 5}
+    minimum = codec.minimum_to_decode({0, 5}, avail)
+    whole = [(0, codec.get_sub_chunk_count())]
+    assert all(runs == whole for runs in minimum.values())
+    dec = codec.decode({0, 5}, {i: enc[i] for i in avail})
+    assert dec[0] == enc[0] and dec[5] == enc[5]
