@@ -27,10 +27,45 @@ FAST_CONF = {
 }
 
 
+def _free_ports(n):
+    import socket
+
+    socks = []
+    for _ in range(n):
+        so = socket.socket()
+        so.bind(("127.0.0.1", 0))
+        socks.append(so)
+    ports = [so.getsockname()[1] for so in socks]
+    for so in socks:
+        so.close()
+    return ports
+
+
 async def run(args) -> int:
-    mon = Monitor(Context("mon", conf_overrides=FAST_CONF))
-    addr = await mon.start()
-    print("mon.0 at %s" % addr)
+    mons = []
+    if args.mons > 1:
+        monmap = [("mon.%d" % i, "127.0.0.1:%d" % po)
+                  for i, po in enumerate(_free_ports(args.mons))]
+        for name, _a in monmap:
+            mon = Monitor(Context(name, conf_overrides=FAST_CONF),
+                          name=name, monmap=monmap)
+            await mon.start()
+            mons.append(mon)
+            print("%s at %s" % (name, mon.addr))
+        # wait for a leader before using the cluster
+        import asyncio as _aio
+
+        for _ in range(200):
+            if any(m.is_leader() and m.mpaxos.active for m in mons):
+                break
+            await _aio.sleep(0.05)
+        addr = [a for _n, a in monmap]
+        mon = mons[0]
+    else:
+        mon = Monitor(Context("mon", conf_overrides=FAST_CONF))
+        addr = await mon.start()
+        mons = [mon]
+        print("mon.0 at %s" % addr)
     osds = []
     for i in range(args.osds):
         osd = OSD(i, addr, Context("osd.%d" % i,
@@ -80,13 +115,15 @@ async def run(args) -> int:
     await client.shutdown()
     for osd in osds:
         await osd.shutdown()
-    await mon.shutdown()
+    for m in mons:
+        await m.shutdown()
     return rc
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="vstart")
     p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--mons", type=int, default=1)
     p.add_argument("--pool", action="append")
     p.add_argument("--pg-num", type=int, default=32)
     p.add_argument("--smoke", action="store_true")

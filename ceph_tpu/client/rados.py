@@ -51,10 +51,14 @@ class _InFlight:
 class RadosClient:
     """Cluster handle (librados::Rados / RadosClient)."""
 
-    def __init__(self, mon_addr: str, ctx: Context | None = None,
+    def __init__(self, mon_addr, ctx: Context | None = None,
                  name: str = "client.0"):
         self.ctx = ctx or Context(name)
-        self.mon_addr = mon_addr
+        # mon_addr: one address or the monmap address list; commands
+        # and subscriptions fail over across them (MonClient hunting)
+        self.mon_addrs = ([mon_addr] if isinstance(mon_addr, str)
+                          else list(mon_addr))
+        self._mon_i = 0
         self.msgr = Messenger(name)
         self.msgr.add_dispatcher(self)
         # epoch-0 empty map is the universal incremental base
@@ -64,12 +68,29 @@ class RadosClient:
         self._inflight: dict[int, _InFlight] = {}
         self._cmd_futures: dict[int, asyncio.Future] = {}
 
+    @property
+    def mon_addr(self) -> str:
+        return self.mon_addrs[self._mon_i % len(self.mon_addrs)]
+
+    def _next_mon(self) -> None:
+        self._mon_i = (self._mon_i + 1) % len(self.mon_addrs)
+
     # -- lifecycle ---------------------------------------------------------
 
     async def connect(self, timeout: float = 10.0) -> None:
-        self.msgr.send_to(self.mon_addr, MMonSubscribe(start=1),
-                          entity_hint="mon.0")
-        await asyncio.wait_for(self._map_event.wait(), timeout)
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            self.msgr.send_to(self.mon_addr, MMonSubscribe(start=1),
+                              entity_hint="mon.0")
+            left = deadline - asyncio.get_running_loop().time()
+            if left <= 0:
+                raise asyncio.TimeoutError("no monitor reachable")
+            try:
+                await asyncio.wait_for(self._map_event.wait(),
+                                       min(2.0, left))
+                return
+            except asyncio.TimeoutError:
+                self._next_mon()
 
     async def shutdown(self) -> None:
         await self.msgr.shutdown()
@@ -103,7 +124,9 @@ class RadosClient:
         reference's kick_requests-on-reset + wait-for-map behavior).
         A reset of the MON link also dropped our subscription on the
         mon side, so renew it."""
-        if conn.peer_addr == self.mon_addr:
+        if conn.peer_addr in self.mon_addrs:
+            if conn.peer_addr == self.mon_addr:
+                self._next_mon()
             self.msgr.send_to(self.mon_addr,
                               MMonSubscribe(start=self.osdmap.epoch + 1),
                               entity_hint="mon.0")
@@ -114,8 +137,10 @@ class RadosClient:
     def _handle_map(self, msg: MOSDMapMsg) -> None:
         self.osdmap, changed = consume_map_payload(
             self.osdmap, msg.full, msg.incrementals)
+        # any map receipt (even the pre-boot epoch-0 one) proves the
+        # mon link is up — connect() must not hang on a fresh cluster
+        self._map_event.set()
         if changed and self.osdmap.epoch > 0:
-            self._map_event.set()
             self._scan_requests()
 
     def _scan_requests(self) -> None:
@@ -178,21 +203,47 @@ class RadosClient:
 
     async def mon_command(self, prefix: str, timeout: float = 10.0,
                           **args) -> dict:
-        self._tid += 1
-        tid = self._tid
-        fut = asyncio.get_running_loop().create_future()
-        self._cmd_futures[tid] = fut
+        """Send to the current mon; on -EHOSTDOWN (a peon's redirect,
+        possibly carrying the leader's address) or a timeout, hunt
+        through the monmap until the leader answers."""
         cmd = {"prefix": prefix}
         cmd.update(args)
-        self.msgr.send_to(self.mon_addr, MMonCommand(tid=tid, cmd=cmd),
-                          entity_hint="mon.0")
-        try:
-            result, out = await asyncio.wait_for(fut, timeout)
-        finally:
-            self._cmd_futures.pop(tid, None)
-        if result != 0:
-            raise RadosError(result, out)
-        return out
+        deadline = asyncio.get_running_loop().time() + timeout
+        last_exc = None
+        for _attempt in range(4 * len(self.mon_addrs)):
+            left = deadline - asyncio.get_running_loop().time()
+            if left <= 0:
+                break
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_running_loop().create_future()
+            self._cmd_futures[tid] = fut
+            self.msgr.send_to(self.mon_addr,
+                              MMonCommand(tid=tid, cmd=cmd),
+                              entity_hint="mon.0")
+            try:
+                result, out = await asyncio.wait_for(
+                    fut, min(2.0, left))
+            except asyncio.TimeoutError as e:
+                last_exc = e
+                self._next_mon()
+                continue
+            finally:
+                self._cmd_futures.pop(tid, None)
+            if result == -112:          # peon redirect
+                leader = (out or {}).get("leader")
+                if leader and leader in self.mon_addrs:
+                    self._mon_i = self.mon_addrs.index(leader)
+                else:
+                    self._next_mon()
+                await asyncio.sleep(0.2)
+                continue
+            if result != 0:
+                raise RadosError(result, out)
+            return out
+        if last_exc is not None:
+            raise RadosError(-110, {"error": "mon command timed out"})
+        raise RadosError(-110, {"error": "no quorum"})
 
     async def wait_for_epoch(self, epoch: int,
                              timeout: float = 10.0) -> None:

@@ -337,9 +337,10 @@ class ErasureCodeClay(ErasureCode):
         # the sub-chunk repair plan applies only to the no-aloof
         # layout repair() supports: a single loss with d = k+m-1, so
         # the d helpers ARE every surviving node
-        if (len(want - avail) == 1 and not self.chunk_mapping
+        if (len(want) == 1 and not (want & avail)
+                and not self.chunk_mapping
                 and self.d == self.k + self.m - 1):
-            lost_ext = next(iter(want - avail))
+            lost_ext = next(iter(want))
             helpers = avail - want
             if helpers == set(range(self.k + self.m)) - want:
                 lost = (lost_ext if lost_ext < self.k

@@ -1,0 +1,165 @@
+"""Monitor leader election (classic strategy).
+
+Analog of src/mon/Elector.h + ElectionLogic.cc's CLASSIC mode: the
+lowest-ranked monitor that can reach a majority wins.  Epochs are odd
+while electing and even when stable (ElectionLogic::bump_epoch
+semantics); every PROPOSE carries the proposer's epoch so stale rounds
+are ignored, DEFER (ack) goes to the lowest-ranked proposer seen this
+round, and a proposer declares VICTORY once a majority (including
+itself) has deferred.  Losing contact with the leader (or a victory
+timeout) restarts the election with a bumped epoch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+ELECTING = "electing"
+LEADER = "leader"
+PEON = "peon"
+
+PROPOSE = "propose"
+DEFER = "defer"
+VICTORY = "victory"
+
+
+class Elector:
+    def __init__(self, mon, timeout: float = 2.0):
+        self.mon = mon                  # Monitor: rank, peers, send
+        self.timeout = timeout
+        self.epoch = 1
+        self.state = ELECTING
+        self.leader: int | None = None
+        self.quorum: set[int] = set()
+        self.deferred_to: int | None = None
+        self._defers: set[int] = set()
+        self._timer: asyncio.TimerHandle | None = None
+
+    # -- helpers -----------------------------------------------------------
+
+    def _majority(self) -> int:
+        return len(self.mon.monmap) // 2 + 1
+
+    def _bump(self, to_epoch: int | None = None, electing=True) -> None:
+        e = max(self.epoch + 1, to_epoch or 0)
+        if electing and e % 2 == 0:
+            e += 1
+        if not electing and e % 2 == 1:
+            e += 1
+        self.epoch = e
+
+    def _arm_timer(self) -> None:
+        self._cancel_timer()
+        loop = asyncio.get_event_loop()
+        self._timer = loop.call_later(self.timeout, self._on_timeout)
+
+    def _cancel_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    # -- rounds ------------------------------------------------------------
+
+    def start_election(self) -> None:
+        self._bump(electing=True)
+        self.state = ELECTING
+        self.leader = None
+        self.quorum = set()
+        self.deferred_to = self.mon.rank
+        self._defers = {self.mon.rank}
+        self.mon.ctx.log.debug(
+            "mon", "%s election epoch %d: proposing"
+            % (self.mon.name, self.epoch))
+        self.mon.send_election(PROPOSE, self.epoch)
+        self._arm_timer()
+        self._maybe_win()
+
+    def _on_timeout(self) -> None:
+        if self.state == ELECTING:
+            self.start_election()
+        elif self.state == PEON and self.leader is not None:
+            # leader lease lapsed: force a new round
+            self.start_election()
+
+    def _maybe_win(self) -> None:
+        if (self.state == ELECTING
+                and self.deferred_to == self.mon.rank
+                and len(self._defers) >= self._majority()):
+            self._declare_victory()
+
+    def _declare_victory(self) -> None:
+        self._bump(electing=False)
+        self.state = LEADER
+        self.leader = self.mon.rank
+        self.quorum = set(self._defers)
+        self._cancel_timer()
+        self.mon.ctx.log.info(
+            "mon", "%s won election epoch %d quorum %s"
+            % (self.mon.name, self.epoch, sorted(self.quorum)))
+        self.mon.send_election(VICTORY, self.epoch,
+                               quorum=sorted(self.quorum))
+        self.mon.on_win(self.epoch, self.quorum)
+
+    # -- message handlers ---------------------------------------------------
+
+    def handle(self, src_rank: int, op: str, epoch: int,
+               quorum=None) -> None:
+        if op == PROPOSE:
+            if epoch < self.epoch and self.state != ELECTING:
+                # stale proposer (e.g. rejoining): poke it to catch up
+                # by starting a fresh round it will see
+                self.start_election()
+                return
+            if epoch > self.epoch:
+                # a fresh round supersedes any stale defer state —
+                # keeping it would suppress re-proposing and block
+                # defers to higher-ranked proposers at the new epoch
+                self.epoch = epoch if epoch % 2 else epoch + 1
+                self.state = ELECTING
+                self.deferred_to = None
+                self._defers = set()
+            if self.state != ELECTING:
+                return
+            if src_rank < self.mon.rank:
+                # defer to the better-ranked proposer
+                if self.deferred_to is None \
+                        or src_rank <= self.deferred_to:
+                    self.deferred_to = src_rank
+                    self.mon.send_election(DEFER, self.epoch,
+                                           to_rank=src_rank)
+                    self._arm_timer()
+            else:
+                # outrank them: (re)propose ourselves
+                if self.deferred_to != self.mon.rank:
+                    self.deferred_to = self.mon.rank
+                    self._defers = {self.mon.rank}
+                    self.mon.send_election(PROPOSE, self.epoch)
+                    self._arm_timer()
+        elif op == DEFER:
+            if epoch != self.epoch or self.state != ELECTING:
+                return
+            if self.deferred_to == self.mon.rank:
+                self._defers.add(src_rank)
+                self._maybe_win()
+        elif op == VICTORY:
+            if epoch < self.epoch:
+                return
+            self.epoch = epoch
+            self.state = PEON
+            self.leader = src_rank
+            self.quorum = set(quorum or [])
+            self._cancel_timer()
+            self.mon.ctx.log.info(
+                "mon", "%s: mon.%d leads epoch %d"
+                % (self.mon.name, src_rank, epoch))
+            self.mon.on_lose(src_rank, self.epoch)
+
+    def peer_lost(self, rank: int) -> None:
+        """A quorum member became unreachable: re-elect if it matters
+        (the leader died, or we are the leader and lost majority)."""
+        if self.state == PEON and rank == self.leader:
+            self.start_election()
+        elif self.state == LEADER and rank in self.quorum:
+            self.quorum.discard(rank)
+            if len(self.quorum) < self._majority():
+                self.start_election()

@@ -32,16 +32,18 @@ import time
 from ..models.crushmap import (CHOOSE_FIRSTN, CHOOSE_INDEP, EMIT, STRAW2,
                                TAKE, CrushMap)
 from ..msg import Messenger
-from ..msg.messages import (MMonCommand, MMonCommandAck, MMonGetMap,
-                            MMonSubscribe, MOSDAlive, MOSDBoot,
-                            MOSDFailure, MOSDMapMsg, MOSDOp)
+from ..msg.messages import (MMonCommand, MMonCommandAck, MMonElection,
+                            MMonGetMap, MMonPaxos, MMonSubscribe,
+                            MOSDAlive, MOSDBoot, MOSDFailure,
+                            MOSDMapMsg, MOSDOp)
 from ..osd.osdmap import (CEPH_OSD_OUT, OSD_EXISTS, OSD_UP,
                           POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED,
                           Incremental, OSDMap, PGPool)
 from ..store.kv import KeyValueDB, MemKV
 from ..utils import denc
 from ..utils.context import Context
-from .paxos import Paxos
+from .elector import LEADER, Elector
+from .paxos import MultiPaxos, Paxos
 
 DEFAULT_EC_PROFILE = {"plugin": "jerasure", "k": "2", "m": "1",
                       "technique": "reed_sol_van"}
@@ -57,14 +59,31 @@ class FailureReport:
 
 
 class Monitor:
+    """One monitor daemon.  monmap is the fixed list of
+    (name, "host:port") pairs defining ranks (MonMap.h: rank = index);
+    a single-entry (or omitted) monmap runs the synchronous
+    quorum-of-one paxos, a larger one runs the full
+    collect/begin/accept/commit/lease exchange with elections."""
+
     def __init__(self, ctx: Context | None = None, name: str = "mon.0",
-                 store: KeyValueDB | None = None, fsid: str = "tpu"):
+                 store: KeyValueDB | None = None, fsid: str = "tpu",
+                 monmap: list[tuple[str, str]] | None = None):
         self.ctx = ctx or Context("mon")
         self.name = name
         self.fsid = fsid
         self.store = store or MemKV()
         self.store.open()
-        self.paxos = Paxos(self.store)
+        self.monmap = monmap or [(name, "")]
+        self.rank = next((i for i, (n, _a) in enumerate(self.monmap)
+                          if n == name), 0)
+        self.paxos = Paxos(self.store, rank=self.rank)
+        self.multi = len(self.monmap) > 1
+        self.elector = Elector(self) if self.multi else None
+        self.mpaxos = (MultiPaxos(self, self.paxos) if self.multi
+                       else None)
+        self._proposal_wake = asyncio.Event() if self.multi else None
+        self._proposal_waiters: list = []
+        self._last_proposal = None
         self.msgr = Messenger(name)
         self.msgr.add_dispatcher(self)
         self.osdmap = OSDMap()
@@ -89,9 +108,13 @@ class Monitor:
                 self.osdmap = OSDMap.decode(full)
         # a crash between paxos commit and map apply leaves a committed
         # blob the map never reflected: recover() replays it through
-        # the same apply+persist path as a live commit
+        # the same apply+persist path as a live commit.  Quorum-of-one
+        # only: in a multi-mon cluster a locally-pending value may
+        # never have been chosen — it must go through leader_collect's
+        # OP_LAST exchange, not be self-committed.
         self.paxos.on_commit.append(self._on_paxos_commit)
-        self.paxos.recover()
+        if not self.multi:
+            self.paxos.recover()
 
     def _on_paxos_commit(self, version: int, blob: bytes) -> None:
         payload = denc.decode(blob)
@@ -103,6 +126,7 @@ class Monitor:
             return  # already reflected in the stored full map
         self.osdmap.apply_incremental(inc)
         self._store_map(inc)
+        self._publish()   # peons push replicated epochs to their subs
 
     def _store_map(self, inc: Incremental) -> None:
         tx = self.store.get_transaction()
@@ -116,8 +140,15 @@ class Monitor:
 
     async def start(self, host: str = "127.0.0.1",
                     port: int = 0) -> str:
+        if self.multi:
+            maddr = self.monmap[self.rank][1]
+            host, p = maddr.rsplit(":", 1)
+            port = int(p)
         addr = await self.msgr.bind(host, port)
         self._tick_task = self.msgr.spawn(self._tick_loop())
+        if self.multi:
+            self.msgr.spawn(self._proposal_loop())
+            self.elector.start_election()
         self.ctx.log.info("mon", "%s serving at %s epoch %d"
                           % (self.name, addr, self.osdmap.epoch))
         return addr
@@ -130,6 +161,64 @@ class Monitor:
     def addr(self) -> str:
         return self.msgr.addr
 
+    # -- quorum plumbing (election + paxos transport) ----------------------
+
+    def is_leader(self) -> bool:
+        return (not self.multi) or self.elector.state == LEADER
+
+    def quorum_ranks(self) -> list[int]:
+        return list(range(len(self.monmap)))
+
+    def _rank_addr(self, rank: int) -> str:
+        return self.monmap[rank][1]
+
+    def send_election(self, op: str, epoch: int, to_rank=None,
+                      quorum=None) -> None:
+        msg = MMonElection(op=op, epoch=epoch, rank=self.rank,
+                           quorum=quorum)
+        targets = ([to_rank] if to_rank is not None else
+                   [r for r in self.quorum_ranks() if r != self.rank])
+        for r in targets:
+            self.msgr.send_to(self._rank_addr(r), msg,
+                              entity_hint="mon.%d" % r)
+
+    def send_paxos(self, rank: int, op: str, **fields) -> None:
+        self.msgr.send_to(
+            self._rank_addr(rank),
+            MMonPaxos(op=op, rank=self.rank, **fields),
+            entity_hint="mon.%d" % rank)
+
+    def request_catchup(self, rank: int) -> None:
+        self.send_paxos(rank, "catchup",
+                        last_committed=self.paxos.last_committed)
+
+    def on_win(self, epoch: int, quorum: set[int]) -> None:
+        async def lead():
+            try:
+                await self.mpaxos.leader_collect()
+            except (IOError, asyncio.TimeoutError) as e:
+                self.ctx.log.info("mon", "%s collect failed: %s"
+                                  % (self.name, e))
+                self.mpaxos.active = False
+                self.elector.start_election()
+                return
+            self._publish()
+            self._proposal_wake.set()
+
+        self.msgr.spawn(lead())
+
+    def on_lose(self, leader: int, epoch: int) -> None:
+        self.mpaxos.active = False
+
+    def readable(self) -> bool:
+        """Consistent reads require leadership or a live lease
+        (Paxos.h lease semantics) — a partitioned minority refuses."""
+        if not self.multi:
+            return True
+        if self.is_leader():
+            return self.mpaxos.active
+        return self.mpaxos.lease_valid()
+
     # -- pending incremental / commit -------------------------------------
 
     def _pending(self) -> Incremental:
@@ -139,7 +228,16 @@ class Monitor:
 
     def _propose_pending(self) -> None:
         """PaxosService::propose_pending: commit the pending Incremental
-        through paxos, apply it, persist, publish."""
+        through paxos, apply it, persist, publish.  Multi-mon: wake the
+        serialized proposal loop (a second mutation arriving while a
+        round is in flight folds into the next pending Incremental)."""
+        if self.multi:
+            if self.pending_inc is not None:
+                fut = asyncio.get_event_loop().create_future()
+                self._proposal_waiters.append(fut)
+                self._last_proposal = fut
+                self._proposal_wake.set()
+            return
         inc = self.pending_inc
         if inc is None:
             return
@@ -150,6 +248,41 @@ class Monitor:
         self.ctx.log.debug("mon", "committed epoch %d"
                            % self.osdmap.epoch)
         self._publish()
+
+    async def _proposal_loop(self) -> None:
+        """Leader-side serialized proposer: one paxos round in flight;
+        the pending Incremental is re-stamped against the current map
+        just before encoding (mutations that landed during the
+        previous round fold into one epoch)."""
+        while True:
+            await self._proposal_wake.wait()
+            self._proposal_wake.clear()
+            if self.pending_inc is None:
+                continue
+            if not (self.is_leader() and self.mpaxos.active):
+                continue    # re-woken after the next election win
+            inc = self.pending_inc
+            waiters = self._proposal_waiters
+            self.pending_inc = None
+            self._proposal_waiters = []
+            inc.epoch = self.osdmap.epoch + 1
+            blob = denc.encode({"osdmap_inc": inc.to_dict()})
+            try:
+                await self.mpaxos.propose(blob)
+            except (IOError, asyncio.TimeoutError) as e:
+                self.ctx.log.info("mon", "%s proposal failed: %s"
+                                  % (self.name, e))
+                for w in waiters:
+                    if not w.done():
+                        w.set_exception(IOError("no quorum"))
+                self.elector.start_election()
+                continue
+            for w in waiters:
+                if not w.done():
+                    w.set_result(None)
+            self.ctx.log.debug("mon", "committed epoch %d"
+                               % self.osdmap.epoch)
+            self._publish()
 
     def _publish(self) -> None:
         """Push incrementals to every subscriber past its known epoch."""
@@ -186,6 +319,21 @@ class Monitor:
     # -- dispatch ----------------------------------------------------------
 
     def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MMonElection):
+            if self.elector is not None:
+                self.elector.handle(msg.rank, msg.op, msg.epoch,
+                                    msg.quorum)
+            return True
+        if isinstance(msg, MMonPaxos):
+            if self.mpaxos is not None:
+                self.mpaxos.handle(msg.rank, msg.op, {
+                    f: getattr(msg, f)
+                    for f in ("pn", "version", "blob",
+                              "last_committed", "first_committed",
+                              "lease_until", "uncommitted")})
+            return True
+        if isinstance(msg, (MOSDBoot, MOSDFailure, MOSDAlive))                 and self.multi and not self.is_leader():
+            return True   # OSDs broadcast to every mon; leader acts
         if isinstance(msg, MMonGetMap):
             self._send_map(conn, msg.have)
         elif isinstance(msg, MMonSubscribe):
@@ -207,6 +355,13 @@ class Monitor:
 
     def ms_handle_reset(self, conn) -> None:
         self.subscribers.pop(conn, None)
+        if self.multi and conn.peer_entity.startswith("mon."):
+            try:
+                rank = int(conn.peer_entity.split(".", 1)[1])
+            except ValueError:
+                return
+            if rank != self.rank:
+                self.elector.peer_lost(rank)
 
     # -- boot --------------------------------------------------------------
 
@@ -319,8 +474,42 @@ class Monitor:
     def _handle_command(self, conn, msg: MMonCommand) -> None:
         cmd = msg.cmd or {}
         prefix = cmd.get("prefix", "")
+        if self.multi and not self.is_leader():
+            # peons redirect to the leader (the reference forwards;
+            # redirect keeps the routing stateless).  -EHOSTDOWN tells
+            # the client to retry elsewhere; a live lease could serve
+            # pure reads, but commands are rare enough to centralise.
+            leader = self.elector.leader
+            out = {"leader": (self._rank_addr(leader)
+                              if leader is not None else None)}
+            conn.send(MMonCommandAck(tid=msg.tid, result=-112,
+                                     out=out))
+            return
+        if self.multi and not self.mpaxos.active:
+            conn.send(MMonCommandAck(tid=msg.tid, result=-112,
+                                     out={"leader": None}))
+            return
+        if self.multi:
+            # mutating commands must ack only after the paxos commit
+            # lands (the single-mon path commits synchronously)
+            self.msgr.spawn(self._command_async(conn, msg, prefix,
+                                                cmd))
+            return
         try:
             out = self._run_command(prefix, cmd)
+            conn.send(MMonCommandAck(tid=msg.tid, result=0, out=out))
+        except Exception as e:
+            conn.send(MMonCommandAck(tid=msg.tid, result=-22,
+                                     out={"error": str(e)}))
+
+    async def _command_async(self, conn, msg, prefix, cmd) -> None:
+        try:
+            self._last_proposal = None
+            out = self._run_command(prefix, cmd)
+            fut = self._last_proposal
+            self._last_proposal = None
+            if fut is not None:
+                await asyncio.wait_for(fut, 15.0)
             conn.send(MMonCommandAck(tid=msg.tid, result=0, out=out))
         except Exception as e:
             conn.send(MMonCommandAck(tid=msg.tid, result=-22,

@@ -21,6 +21,7 @@ be layered on without changing the storage contract or callers.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Callable
 
 from ..store.kv import KeyValueDB, KVTransaction
@@ -132,3 +133,269 @@ class Paxos:
         tx.set(_k("first_committed"), denc.encode(floor))
         self.store.submit_transaction(tx)
         self.first_committed = floor
+
+    # -- storage steps shared with the multi-mon protocol ------------------
+
+    def store_pending(self, version: int, pn: int, blob: bytes) -> None:
+        """OP_BEGIN's durable step on every quorum member."""
+        tx = self.store.get_transaction()
+        tx.set(_k("accepted_pn"), denc.encode(pn))
+        tx.set(_k("pending_v"), denc.encode(version))
+        tx.set(_k("pending_pn"), denc.encode(pn))
+        tx.set(_kv(version), blob)
+        self.store.submit_transaction(tx)
+        self.accepted_pn = pn
+
+    def store_commit(self, version: int, blob: bytes) -> None:
+        """OP_COMMIT's durable step; fires the service refresh hook."""
+        if version <= self.last_committed:
+            return
+        tx = self.store.get_transaction()
+        tx.set(_kv(version), blob)
+        tx.set(_k("last_committed"), denc.encode(version))
+        if self.first_committed == 0:
+            self.first_committed = 1
+            tx.set(_k("first_committed"), denc.encode(1))
+        tx.rmkey(_k("pending_v"))
+        tx.rmkey(_k("pending_pn"))
+        self.store.submit_transaction(tx)
+        self.last_committed = version
+        for cb in self.on_commit:
+            cb(version, blob)
+
+    def store_accepted_pn(self, pn: int) -> None:
+        tx = self.store.get_transaction()
+        tx.set(_k("accepted_pn"), denc.encode(pn))
+        self.store.submit_transaction(tx)
+        self.accepted_pn = pn
+
+    def uncommitted(self) -> tuple[int, int, bytes] | None:
+        """(version, pn, blob) of a pending-but-uncommitted value."""
+        raw = self.store.get(_k("pending_v"))
+        if raw is None:
+            return None
+        version = denc.decode(raw)
+        if version != self.last_committed + 1:
+            return None
+        blob = self.get_version(version)
+        if blob is None:
+            return None
+        pn = self._get_int("pending_pn", self.accepted_pn)
+        return version, pn, blob
+
+
+class PaxosRound:
+    """Leader-side bookkeeping for one collect or begin phase."""
+
+    __slots__ = ("pn", "acks", "done", "uncommitted", "peer_max_lc")
+
+    def __init__(self, pn: int):
+        self.pn = pn
+        self.acks: set[int] = set()
+        self.done = asyncio.Future()
+        self.uncommitted: tuple[int, int, bytes] | None = None
+        self.peer_max_lc = 0
+
+
+class MultiPaxos:
+    """The OP_COLLECT/OP_LAST/OP_BEGIN/OP_ACCEPT/OP_COMMIT/OP_LEASE
+    exchange (Paxos.h:24-104) over a quorum, layered on the durable
+    Paxos storage contract.
+
+    The Monitor drives it: `mon` supplies rank, quorum membership and
+    send_paxos(rank, op, **fields).  Only the elected leader proposes;
+    peons answer collects/begins and learn commits.  The leader extends
+    a read lease to the quorum (OP_LEASE); a monitor without a live
+    lease (and not the leader) refuses consistent reads, which is what
+    makes a partitioned minority unusable (Paxos.h lease comments)."""
+
+    LEASE = 5.0
+    LEASE_RENEW = 2.0
+
+    def __init__(self, mon, paxos: Paxos):
+        self.mon = mon
+        self.px = paxos
+        self.active = False          # leader: recovery done
+        self.lease_until = 0.0       # peon: leader's lease
+        self._round: PaxosRound | None = None
+        self._lease_task = None
+        self._lock = asyncio.Lock()
+
+    # -- quorum helpers ----------------------------------------------------
+
+    def _peers(self):
+        return [r for r in self.mon.quorum_ranks()
+                if r != self.mon.rank]
+
+    def _majority(self) -> int:
+        return len(self.mon.monmap) // 2 + 1
+
+    # -- leader ------------------------------------------------------------
+
+    async def leader_collect(self) -> None:
+        """Recovery phase after winning an election."""
+        async with self._lock:
+            pn = (max(self.px.accepted_pn, 0) // 100 + 1) * 100 \
+                + self.mon.rank
+            self.px.store_accepted_pn(pn)
+            rnd = PaxosRound(pn)
+            rnd.acks.add(self.mon.rank)
+            self._round = rnd
+            for r in self._peers():
+                self.mon.send_paxos(
+                    r, "collect", pn=pn,
+                    last_committed=self.px.last_committed,
+                    first_committed=self.px.first_committed)
+            if len(rnd.acks) < self._majority():
+                await asyncio.wait_for(rnd.done, timeout=10.0)
+            # a peer ahead of us means a previous reign committed past
+            # our log: its OP_LAST triggered a catch-up; wait for those
+            # commits to land before taking over (otherwise we would
+            # re-propose a stale value at an already-taken version and
+            # livelock in election churn)
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while self.px.last_committed < rnd.peer_max_lc:
+                if asyncio.get_event_loop().time() > deadline:
+                    self._round = None
+                    raise IOError("paxos: catch-up from peers "
+                                  "timed out")
+                await asyncio.sleep(0.05)
+            # re-propose any uncommitted value from the previous reign
+            unc = rnd.uncommitted or self.px.uncommitted()
+            self._round = None
+            self.active = True
+            if unc is not None and unc[0] == self.px.last_committed + 1:
+                await self._begin(unc[2])
+            self._start_lease()
+
+    async def propose(self, blob: bytes) -> int:
+        """Leader-only: replicate one value; returns its version."""
+        async with self._lock:
+            if not self.active:
+                raise IOError("paxos: not active (no quorum)")
+            return await self._begin(blob)
+
+    async def _begin(self, blob: bytes) -> int:
+        pn = self.px.accepted_pn
+        version = self.px.last_committed + 1
+        self.px.store_pending(version, pn, blob)
+        rnd = PaxosRound(pn)
+        rnd.acks.add(self.mon.rank)
+        self._round = rnd
+        for r in self._peers():
+            self.mon.send_paxos(r, "begin", pn=pn, version=version,
+                                blob=blob)
+        if len(rnd.acks) < self._majority():
+            try:
+                await asyncio.wait_for(rnd.done, timeout=10.0)
+            except asyncio.TimeoutError:
+                self._round = None
+                self.active = False
+                raise IOError("paxos: lost quorum during begin")
+        self._round = None
+        self.px.store_commit(version, blob)
+        for r in self._peers():
+            self.mon.send_paxos(r, "commit", version=version,
+                                blob=blob)
+        return version
+
+    def _start_lease(self) -> None:
+        if self._lease_task is None or self._lease_task.done():
+            self._lease_task = self.mon.msgr.spawn(self._lease_loop())
+
+    async def _lease_loop(self) -> None:
+        while self.active and self.mon.is_leader():
+            until = asyncio.get_event_loop().time() + self.LEASE
+            self.lease_until = until
+            for r in self._peers():
+                self.mon.send_paxos(r, "lease", lease_until=until,
+                                    last_committed=self.px.last_committed)
+            await asyncio.sleep(self.LEASE_RENEW)
+
+    # -- peon --------------------------------------------------------------
+
+    def _send_commits_since(self, rank: int, peer_lc: int) -> None:
+        """Share committed values a lagging peer is missing (the
+        reference's share_state), in version order."""
+        for v in range(peer_lc + 1, self.px.last_committed + 1):
+            blob = self.px.get_version(v)
+            if blob is not None:
+                self.mon.send_paxos(rank, "commit", version=v,
+                                    blob=blob)
+
+    def handle(self, src_rank: int, op: str, f: dict) -> None:
+        if op == "collect":
+            pn = f["pn"]
+            if pn > self.px.accepted_pn:
+                self.px.store_accepted_pn(pn)
+                unc = self.px.uncommitted()
+                self.mon.send_paxos(
+                    src_rank, "last", pn=pn,
+                    last_committed=self.px.last_committed,
+                    uncommitted=(list(unc[:2]) + [unc[2]]
+                                 if unc else None))
+        elif op == "last":
+            rnd = self._round
+            if rnd is None or f["pn"] != rnd.pn:
+                return
+            rnd.acks.add(src_rank)
+            unc = f.get("uncommitted")
+            if unc is not None:
+                v, pn_u, blob = unc[0], unc[1], unc[2]
+                cur = rnd.uncommitted
+                if v == self.px.last_committed + 1 and (
+                        cur is None or pn_u > cur[1]):
+                    rnd.uncommitted = (v, pn_u, blob)
+            peer_lc = f.get("last_committed", 0)
+            if peer_lc > self.px.last_committed:
+                # the peer's reign committed past us: pull its commits
+                # before we act as leader (leader_collect waits)
+                rnd.peer_max_lc = max(rnd.peer_max_lc, peer_lc)
+                self.mon.request_catchup(src_rank)
+            else:
+                # catch a lagging peon up with committed values
+                self._send_commits_since(src_rank, peer_lc)
+            if len(rnd.acks) >= self._majority() \
+                    and not rnd.done.done():
+                rnd.done.set_result(None)
+        elif op == "begin":
+            if f["pn"] >= self.px.accepted_pn:
+                # catch up any gap first (commits may have been lost
+                # with a dead connection)
+                if f["version"] > self.px.last_committed + 1:
+                    self.mon.request_catchup(src_rank)
+                    return
+                if f["version"] == self.px.last_committed + 1:
+                    self.px.store_pending(f["version"], f["pn"],
+                                          f["blob"])
+                    self.mon.send_paxos(src_rank, "accept",
+                                        pn=f["pn"],
+                                        version=f["version"])
+        elif op == "accept":
+            rnd = self._round
+            if rnd is None or f["pn"] != rnd.pn:
+                return
+            rnd.acks.add(src_rank)
+            if len(rnd.acks) >= self._majority() \
+                    and not rnd.done.done():
+                rnd.done.set_result(None)
+        elif op == "commit":
+            if f["version"] > self.px.last_committed + 1:
+                # gap (a commit broadcast overtook a lost one): pull
+                # the missing range instead of skipping versions —
+                # store_commit would advance last_committed past the
+                # hole and the osdmap would freeze at the gap epoch
+                self.mon.request_catchup(src_rank)
+                return
+            self.px.store_commit(f["version"], f["blob"])
+        elif op == "lease":
+            self.lease_until = max(self.lease_until, f["lease_until"])
+            if f.get("last_committed", 0) > self.px.last_committed:
+                self.mon.request_catchup(src_rank)
+        elif op == "catchup":
+            # a peer asks for commits it is missing
+            self._send_commits_since(src_rank,
+                                     f.get("last_committed", 0))
+
+    def lease_valid(self) -> bool:
+        return (asyncio.get_event_loop().time() < self.lease_until)

@@ -27,6 +27,25 @@ class MPong(Message):
     FIELDS = ("stamp",)
 
 
+# -- monitor <-> monitor ---------------------------------------------------
+
+
+@register
+class MMonElection(Message):
+    """Elector rounds (MMonElection.h): op = propose|defer|victory."""
+    TYPE = "mon_election"
+    FIELDS = ("op", "epoch", "rank", "quorum")
+
+
+@register
+class MMonPaxos(Message):
+    """Paxos phases (MMonPaxos.h): op = collect|last|begin|accept|
+    commit|lease|catchup."""
+    TYPE = "mon_paxos"
+    FIELDS = ("op", "rank", "pn", "version", "blob", "last_committed",
+              "first_committed", "lease_until", "uncommitted")
+
+
 # -- monitor <-> anyone ----------------------------------------------------
 
 

@@ -51,11 +51,16 @@ from .pg import (PG, STATE_ACTIVE, STATE_PEERING, STATE_REPLICA,
 
 
 class OSD:
-    def __init__(self, whoami: int, mon_addr: str,
+    def __init__(self, whoami: int, mon_addr,
                  ctx: Context | None = None,
                  store: ObjectStore | None = None):
         self.whoami = whoami
-        self.mon_addr = mon_addr
+        # one address or the monmap list: maps are subscribed from one
+        # mon (rotating on faults), state reports (boot/failure/alive)
+        # are broadcast to all so the current leader always sees them
+        self.mon_addrs = ([mon_addr] if isinstance(mon_addr, str)
+                          else list(mon_addr))
+        self._mon_i = whoami % max(1, len(self.mon_addrs))
         self.ctx = ctx or Context("osd.%d" % whoami)
         self.store = store or MemStore()
         self.msgr = Messenger("osd.%d" % whoami)
@@ -84,6 +89,7 @@ class OSD:
         self._load_pgs()
         mon = self.msgr.connect_to(self.mon_addr, entity_hint="mon.0")
         mon.send(MMonSubscribe(start=1))
+        self._tasks.append(self.msgr.spawn(self._mon_watchdog()))
         self._tasks.append(self.msgr.spawn(self._heartbeat_loop()))
         return addr
 
@@ -98,6 +104,23 @@ class OSD:
         self.stopping = True
         await self.msgr.shutdown()
         self.store.umount()
+
+    @property
+    def mon_addr(self) -> str:
+        return self.mon_addrs[self._mon_i % len(self.mon_addrs)]
+
+    def _send_mons(self, msg) -> None:
+        for i, addr in enumerate(self.mon_addrs):
+            self.msgr.send_to(addr, msg, entity_hint="mon.%d" % i)
+
+    async def _mon_watchdog(self) -> None:
+        """A peon that stops leading (or a dead mon) leaves our boot
+        unacknowledged: while unbooted, periodically re-broadcast."""
+        while not self.stopping:
+            await asyncio.sleep(1.0)
+            if not self.booted and self._boot_sent_epoch >= 0:
+                self._boot_sent_epoch = -1
+                self._send_boot()
 
     def _load_pgs(self) -> None:
         """Recreate PG objects from on-disk collections (OSD::load_pgs)."""
@@ -114,7 +137,9 @@ class OSD:
     def ms_handle_reset(self, conn) -> None:
         """A lossy fault on the monitor link drops our subscription on
         the mon side: re-subscribe from our current epoch."""
-        if conn.peer_addr == self.mon_addr and not self.stopping:
+        if conn.peer_addr in self.mon_addrs and not self.stopping:
+            if conn.peer_addr == self.mon_addr:
+                self._mon_i = (self._mon_i + 1) % len(self.mon_addrs)
             self.msgr.send_to(self.mon_addr,
                               MMonSubscribe(start=self.osdmap.epoch + 1),
                               entity_hint="mon.0")
@@ -169,10 +194,8 @@ class OSD:
             # re-boot (OSD "wrongly marked me down" flow)
             self.booted = False
             self._boot_sent_epoch = -1
-            self.msgr.send_to(self.mon_addr,
-                              MOSDAlive(osd=self.whoami,
-                                        epoch=self.osdmap.epoch),
-                              entity_hint="mon.0")
+            self._send_mons(MOSDAlive(osd=self.whoami,
+                                      epoch=self.osdmap.epoch))
             self._send_boot()
         if not changed or self.osdmap.epoch == 0:
             return
@@ -189,10 +212,8 @@ class OSD:
         if self._boot_sent_epoch >= 0 and epoch <= self._boot_sent_epoch:
             return  # already asked; wait for a newer epoch
         self._boot_sent_epoch = epoch
-        self.msgr.send_to(
-            self.mon_addr,
-            MOSDBoot(osd=self.whoami, addr=self.msgr.addr, epoch=epoch),
-            entity_hint="mon.0")
+        self._send_mons(MOSDBoot(osd=self.whoami, addr=self.msgr.addr,
+                                 epoch=epoch))
 
     def _advance_pgs(self) -> None:
         """Recompute mappings; create/advance PGs (OSD::advance_map)."""
@@ -715,9 +736,9 @@ class OSD:
                 if last is None:
                     self.hb_last_rx[osd] = now
                 elif now - last > grace:
-                    self.msgr.send_to(self.mon_addr, MOSDFailure(
+                    self._send_mons(MOSDFailure(
                         target=osd, failed_for=now - last,
-                        epoch=self.osdmap.epoch), entity_hint="mon.0")
+                        epoch=self.osdmap.epoch))
 
     def _handle_ping(self, conn, msg: MOSDPing) -> None:
         if msg.op == "ping":

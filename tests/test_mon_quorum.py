@@ -1,0 +1,249 @@
+"""Multi-monitor quorum: elections, replicated paxos commits, leader
+failover, and minority lockout (src/mon/Paxos.h:24-104 exchange +
+Elector classic strategy)."""
+
+import asyncio
+import socket
+
+import pytest
+
+from ceph_tpu.mon.monitor import Monitor
+from ceph_tpu.utils.context import Context
+
+FAST_CONF = {
+    "heartbeat_interval": 0.1,
+    "heartbeat_grace": 0.6,
+    "mon_osd_down_out_interval": 1.0,
+    "mon_osd_min_down_reporters": 1,
+    "osd_pool_default_pg_num": 8,
+}
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _monmap(n=3):
+    return [("mon.%d" % i, "127.0.0.1:%d" % p)
+            for i, p in enumerate(_free_ports(n))]
+
+
+async def _start_mons(monmap, ranks=None):
+    mons = []
+    for i, (name, _addr) in enumerate(monmap):
+        if ranks is not None and i not in ranks:
+            mons.append(None)
+            continue
+        mon = Monitor(Context(name, conf_overrides=FAST_CONF),
+                      name=name, monmap=monmap)
+        await mon.start()
+        mons.append(mon)
+    return mons
+
+
+async def _wait_leader(mons, timeout=10.0):
+    t0 = asyncio.get_event_loop().time()
+    while True:
+        for m in mons:
+            if m is not None and m.is_leader() and m.mpaxos.active:
+                return m
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise TimeoutError("no leader elected")
+        await asyncio.sleep(0.05)
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_three_mon_quorum_commits_and_replicates():
+    async def main():
+        monmap = _monmap(3)
+        mons = await _start_mons(monmap)
+        try:
+            leader = await _wait_leader(mons)
+            assert leader.rank == 0       # classic: lowest rank wins
+            from ceph_tpu.client.rados import RadosClient
+
+            client = RadosClient([a for _n, a in monmap])
+            await client.connect()
+            out = await client.mon_command(
+                "osd pool create", pool="p1", pg_num=8)
+            assert out["pool_id"] >= 1
+            # the committed epoch replicates to every mon's paxos log
+            for _ in range(100):
+                if all(m.osdmap.epoch == leader.osdmap.epoch
+                       and m.paxos.last_committed
+                       == leader.paxos.last_committed
+                       for m in mons):
+                    break
+                await asyncio.sleep(0.05)
+            for m in mons:
+                assert m.osdmap.epoch == leader.osdmap.epoch
+                assert "p1" in [p.name for p in m.osdmap.pools.values()]
+            await client.shutdown()
+        finally:
+            for m in mons:
+                if m is not None:
+                    await m.shutdown()
+
+    run(main())
+
+
+def test_leader_death_reelects_and_mutations_continue():
+    async def main():
+        monmap = _monmap(3)
+        mons = await _start_mons(monmap)
+        client = None
+        try:
+            leader = await _wait_leader(mons)
+            from ceph_tpu.client.rados import RadosClient
+
+            client = RadosClient([a for _n, a in monmap])
+            await client.connect()
+            await client.mon_command("osd pool create", pool="before",
+                                     pg_num=8)
+            # kill the leader
+            dead = leader.rank
+            await mons[dead].shutdown()
+            mons[dead] = None
+            survivor = await _wait_leader(mons, timeout=15.0)
+            assert survivor.rank != dead
+            # mutations continue through the new leader
+            out = await client.mon_command(
+                "osd pool create", pool="after", pg_num=8,
+                timeout=20.0)
+            assert out["pool_id"] >= 1
+            names = [p.name for p in survivor.osdmap.pools.values()]
+            assert "before" in names and "after" in names
+        finally:
+            if client is not None:
+                await client.shutdown()
+            for m in mons:
+                if m is not None:
+                    await m.shutdown()
+
+    run(main())
+
+
+def test_minority_refuses_writes():
+    async def main():
+        monmap = _monmap(3)
+        # only rank 2 runs: 1 of 3 can never reach majority
+        mons = await _start_mons(monmap, ranks={2})
+        try:
+            from ceph_tpu.client.rados import RadosError
+            from ceph_tpu.client.rados import RadosClient
+
+            client = RadosClient([monmap[2][1]])
+            # subscription may serve the (empty) committed map, but a
+            # mutating command must be refused — no quorum
+            with pytest.raises((RadosError, asyncio.TimeoutError)):
+                await client.connect(timeout=2.0)
+                await client.mon_command(
+                    "osd pool create", pool="nope", pg_num=8,
+                    timeout=3.0)
+            assert mons[2].paxos.last_committed == 0
+            assert not mons[2].is_leader() or not mons[2].mpaxos.active
+            await client.shutdown()
+        finally:
+            for m in mons:
+                if m is not None:
+                    await m.shutdown()
+
+    run(main())
+
+
+def test_lagging_mon_catches_up_on_rejoin():
+    async def main():
+        monmap = _monmap(3)
+        mons = await _start_mons(monmap, ranks={0, 1})
+        try:
+            leader = await _wait_leader(mons)
+            from ceph_tpu.client.rados import RadosClient
+
+            client = RadosClient([monmap[0][1], monmap[1][1]])
+            await client.connect()
+            for i in range(3):
+                await client.mon_command("osd pool create",
+                                         pool="pool%d" % i, pg_num=8)
+            lc = leader.paxos.last_committed
+            assert lc >= 3
+            # rank 2 joins late: collect/lease catchup replays commits
+            late = Monitor(Context("mon.2", conf_overrides=FAST_CONF),
+                           name="mon.2", monmap=monmap)
+            await late.start()
+            mons.append(late)
+            for _ in range(200):
+                if late.paxos.last_committed >= lc:
+                    break
+                await asyncio.sleep(0.05)
+            assert late.paxos.last_committed >= lc
+            assert late.osdmap.epoch == leader.osdmap.epoch
+            await client.shutdown()
+        finally:
+            for m in mons:
+                if m is not None:
+                    await m.shutdown()
+
+    run(main())
+
+
+def test_full_cluster_survives_leader_failover():
+    """3 mons + 3 OSDs + client: I/O keeps working across a monitor
+    leader death (the control-plane SPOF the single-mon round had)."""
+    async def main():
+        from ceph_tpu.client.rados import RadosClient
+        from ceph_tpu.osd.daemon import OSD
+
+        monmap = _monmap(3)
+        mons = await _start_mons(monmap)
+        osds = []
+        client = None
+        try:
+            leader = await _wait_leader(mons)
+            addrs = [a for _n, a in monmap]
+            for i in range(3):
+                osd = OSD(i, addrs,
+                          Context("osd.%d" % i,
+                                  conf_overrides=FAST_CONF))
+                await osd.start()
+                osds.append(osd)
+            for osd in osds:
+                await osd.wait_for_boot()
+            client = RadosClient(addrs)
+            await client.connect()
+            await client.mon_command("osd pool create", pool="data",
+                                     pg_num=8)
+            await client.wait_for_epoch(leader.osdmap.epoch)
+            io = client.io_ctx("data")
+            await io.write_full("obj-a", b"A" * 500)
+            # kill the mon leader; I/O and mutations must continue
+            dead = leader.rank
+            await mons[dead].shutdown()
+            mons[dead] = None
+            await _wait_leader(mons, timeout=15.0)
+            await io.write_full("obj-b", b"B" * 500)
+            assert await io.read("obj-a") == b"A" * 500
+            assert await io.read("obj-b") == b"B" * 500
+            out = await client.mon_command("status", timeout=20.0)
+            assert out["num_up_osds"] == 3
+        finally:
+            if client is not None:
+                await client.shutdown()
+            for osd in osds:
+                if not osd.stopping:
+                    await osd.shutdown()
+            for m in mons:
+                if m is not None:
+                    await m.shutdown()
+
+    run(main())
