@@ -27,6 +27,7 @@ class Elector:
     def __init__(self, mon, timeout: float = 2.0):
         self.mon = mon                  # Monitor: rank, peers, send
         self.timeout = timeout
+        self.stopped = False
         self.epoch = 1
         self.state = ELECTING
         self.leader: int | None = None
@@ -60,7 +61,26 @@ class Elector:
 
     # -- rounds ------------------------------------------------------------
 
+    def stop(self) -> None:
+        """Shutdown: a dead monitor must not keep proposing (a zombie
+        lowest-rank proposer would collect defers it can never see and
+        livelock the survivors)."""
+        self.stopped = True
+        self._cancel_timer()
+
+    def note_leader_alive(self) -> None:
+        """Peon liveness watchdog: each lease receipt re-arms a timer;
+        if leases stop (a wedged-but-connected leader that never
+        triggers peer_lost), the timeout forces a new election."""
+        if self.state == PEON and not self.stopped:
+            self._cancel_timer()
+            loop = asyncio.get_event_loop()
+            self._timer = loop.call_later(3 * self.timeout,
+                                          self._on_timeout)
+
     def start_election(self) -> None:
+        if self.stopped:
+            return
         self._bump(electing=True)
         self.state = ELECTING
         self.leader = None
@@ -75,6 +95,8 @@ class Elector:
         self._maybe_win()
 
     def _on_timeout(self) -> None:
+        if self.stopped:
+            return
         if self.state == ELECTING:
             self.start_election()
         elif self.state == PEON and self.leader is not None:
@@ -152,6 +174,7 @@ class Elector:
             self.mon.ctx.log.info(
                 "mon", "%s: mon.%d leads epoch %d"
                 % (self.mon.name, src_rank, epoch))
+            self.note_leader_alive()
             self.mon.on_lose(src_rank, self.epoch)
 
     def peer_lost(self, rank: int) -> None:

@@ -154,6 +154,8 @@ class Monitor:
         return addr
 
     async def shutdown(self) -> None:
+        if self.elector is not None:
+            self.elector.stop()
         await self.msgr.shutdown()
         self.store.close()
 
@@ -511,6 +513,11 @@ class Monitor:
             if fut is not None:
                 await asyncio.wait_for(fut, 15.0)
             conn.send(MMonCommandAck(tid=msg.tid, result=0, out=out))
+        except (IOError, asyncio.TimeoutError):
+            # transient quorum loss mid-round: retryable — the client
+            # hunts to the next leader (-112, like the peon redirect)
+            conn.send(MMonCommandAck(tid=msg.tid, result=-112,
+                                     out={"leader": None}))
         except Exception as e:
             conn.send(MMonCommandAck(tid=msg.tid, result=-22,
                                      out={"error": str(e)}))

@@ -187,10 +187,12 @@ class Paxos:
 class PaxosRound:
     """Leader-side bookkeeping for one collect or begin phase."""
 
-    __slots__ = ("pn", "acks", "done", "uncommitted", "peer_max_lc")
+    __slots__ = ("pn", "version", "acks", "done", "uncommitted",
+                 "peer_max_lc")
 
-    def __init__(self, pn: int):
+    def __init__(self, pn: int, version: int | None = None):
         self.pn = pn
+        self.version = version
         self.acks: set[int] = set()
         self.done = asyncio.Future()
         self.uncommitted: tuple[int, int, bytes] | None = None
@@ -235,8 +237,7 @@ class MultiPaxos:
     async def leader_collect(self) -> None:
         """Recovery phase after winning an election."""
         async with self._lock:
-            pn = (max(self.px.accepted_pn, 0) // 100 + 1) * 100 \
-                + self.mon.rank
+            pn = self.px._next_pn()
             self.px.store_accepted_pn(pn)
             rnd = PaxosRound(pn)
             rnd.acks.add(self.mon.rank)
@@ -279,7 +280,7 @@ class MultiPaxos:
         pn = self.px.accepted_pn
         version = self.px.last_committed + 1
         self.px.store_pending(version, pn, blob)
-        rnd = PaxosRound(pn)
+        rnd = PaxosRound(pn, version)
         rnd.acks.add(self.mon.rank)
         self._round = rnd
         for r in self._peers():
@@ -373,7 +374,10 @@ class MultiPaxos:
                                         version=f["version"])
         elif op == "accept":
             rnd = self._round
-            if rnd is None or f["pn"] != rnd.pn:
+            if rnd is None or f["pn"] != rnd.pn \
+                    or f.get("version") != rnd.version:
+                # a delayed accept from an earlier begin (same reign,
+                # same pn) must not count toward this round's majority
                 return
             rnd.acks.add(src_rank)
             if len(rnd.acks) >= self._majority() \
@@ -390,6 +394,8 @@ class MultiPaxos:
             self.px.store_commit(f["version"], f["blob"])
         elif op == "lease":
             self.lease_until = max(self.lease_until, f["lease_until"])
+            if self.mon.elector is not None:
+                self.mon.elector.note_leader_alive()
             if f.get("last_committed", 0) > self.px.last_committed:
                 self.mon.request_catchup(src_rank)
         elif op == "catchup":

@@ -180,6 +180,22 @@ class MOSDPGLog(Message):
 
 
 @register
+class MOSDRepScrub(Message):
+    """Primary -> replica: build a scrub map for these objects
+    (MOSDRepScrub.h); fetch=True also returns the bytes (the repair
+    pull)."""
+    TYPE = "rep_scrub"
+    FIELDS = ("pool", "ps", "tid", "oids", "fetch")
+
+
+@register
+class MOSDRepScrubMap(Message):
+    """Replica -> primary: the chunk's ScrubMap (MOSDRepScrubMap.h)."""
+    TYPE = "rep_scrub_map"
+    FIELDS = ("pool", "ps", "tid", "objects")
+
+
+@register
 class MOSDPGPush(Message):
     """Recovery push (MOSDPGPush.h): full-object pushes
     [{oid fields, data, attrs, omap, version}...]."""

@@ -38,7 +38,8 @@ from ..msg.messages import (MMonSubscribe, MOSDAlive, MOSDBoot,
                             MOSDFailure, MOSDMapMsg, MOSDOp,
                             MOSDOpReply, MOSDPGLog, MOSDPGPush,
                             MOSDPGPushReply, MOSDPGQuery, MOSDPing,
-                            MOSDRepOp, MOSDRepOpReply)
+                            MOSDRepOp, MOSDRepOpReply, MOSDRepScrub,
+                            MOSDRepScrubMap)
 from ..models.crushmap import ITEM_NONE
 from ..store.memstore import MemStore
 from ..store.objectstore import (NotFound, ObjectStore, Transaction,
@@ -67,8 +68,10 @@ class OSD:
         self.msgr.peer_policy["osd"] = Policy.lossless_peer()
         self.msgr.add_dispatcher(self)
         from .ecbackend import ECPGBackend
+        from .scrubber import Scrubber
 
         self.ec = ECPGBackend(self)
+        self.scrubber = Scrubber(self)
         # epoch-0 empty map is the universal incremental base
         self.osdmap: OSDMap = OSDMap()
         self.pgs: dict[pg_t, PG] = {}
@@ -163,6 +166,10 @@ class OSD:
             self._handle_pg_push_reply(msg)
         elif isinstance(msg, MOSDPing):
             self._handle_ping(conn, msg)
+        elif isinstance(msg, MOSDRepScrub):
+            self.scrubber.handle_rep_scrub(conn, msg)
+        elif isinstance(msg, MOSDRepScrubMap):
+            self.scrubber.handle_rep_scrub_map(msg)
         elif isinstance(msg, MOSDECSubOpWrite):
             self.ec.handle_sub_write(conn, msg)
         elif isinstance(msg, MOSDECSubOpWriteReply):

@@ -52,6 +52,16 @@ from .pg import PG, LogEntry
 SIZE_XATTR = "ec_size"
 SHARD_XATTR = "ec_shard"
 VER_XATTR = "ec_ver"
+HINFO_XATTR = "ec_hinfo"   # crc32 of every shard, comma-joined (the
+                           # role ECUtil::HashInfo plays: deep scrub
+                           # identifies a rotted shard by its crc)
+
+
+def hinfo_bytes(shards: dict[int, bytes]) -> bytes:
+    import zlib
+
+    return b",".join(b"%d" % (zlib.crc32(shards[j]) & 0xFFFFFFFF)
+                     for j in sorted(shards))
 
 
 def _ver_bytes(version: tuple[int, int]) -> bytes:
@@ -251,8 +261,8 @@ class ECPGBackend:
         return await codec.encode_async(set(range(n)), data)
 
     def _shard_txn(self, pg: PG, ho: hobject_t, shard: bytes, j: int,
-                   size: int, version, xattrs: dict | None
-                   ) -> Transaction:
+                   size: int, version, xattrs: dict | None,
+                   hinfo: bytes | None = None) -> Transaction:
         t = Transaction()
         # touch+truncate(0)+write replaces any older (possibly longer)
         # shard without knowing remote existence
@@ -262,6 +272,8 @@ class ECPGBackend:
         t.setattr(pg.cid, ho, SIZE_XATTR, b"%d" % size)
         t.setattr(pg.cid, ho, SHARD_XATTR, b"%d" % j)
         t.setattr(pg.cid, ho, VER_XATTR, _ver_bytes(version))
+        if hinfo is not None:
+            t.setattr(pg.cid, ho, HINFO_XATTR, hinfo)
         for k, v in (xattrs or {}).items():
             t.setattr(pg.cid, ho, k, v)
         return t
@@ -284,6 +296,7 @@ class ECPGBackend:
             pm.pop(oid, None)
         shards = (None if is_delete
                   else await self._encode_shards(pg, data))
+        hinfo = None if shards is None else hinfo_bytes(shards)
         ho = hobject_t(oid)
 
         self._tid += 1
@@ -300,7 +313,7 @@ class ECPGBackend:
                 t.remove(pg.cid, ho)
             else:
                 t = self._shard_txn(pg, ho, shards[j], j, len(data),
-                                    version, xattrs)
+                                    version, xattrs, hinfo)
             if osd_id == self.osd.whoami:
                 entryt = Transaction()
                 entryt.append(t)
@@ -608,6 +621,7 @@ class ECPGBackend:
                 attrs[SIZE_XATTR] = b"%d" % len(data)
                 attrs[SHARD_XATTR] = b"%d" % j
                 attrs[VER_XATTR] = _ver_bytes(ver)
+                attrs[HINFO_XATTR] = hinfo_bytes(shards)
                 pushes.append({"oid": oid, "delete": False,
                                "data": shards[j], "attrs": attrs,
                                "omap": {}})
@@ -645,7 +659,8 @@ class ECPGBackend:
                     shards = await codec.encode_async(
                         set(range(n)), data)
                     t = self._shard_txn(pg, ho, shards[j], j,
-                                        len(data), ver, None)
+                                        len(data), ver, None,
+                                        hinfo_bytes(shards))
                 pg.missing.pop(oid, None)
                 pg.persist_meta(t)
                 self.osd.store.apply_transaction(t)

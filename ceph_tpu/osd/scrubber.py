@@ -1,0 +1,362 @@
+"""PG scrub: chunked cross-replica/shard integrity checking + repair.
+
+Condensed analog of src/osd/scrubber/ (scrub_machine.cc state flow,
+scrub_backend.cc compare logic, PrimaryLogScrub): the primary walks the
+PG's objects in chunks, asks every acting member for a scrub map of the
+chunk (MOSDRepScrub -> MOSDRepScrubMap: per-object size/digest/attrs
+digest — ScrubMap in osd_types.h), compares, and repairs from an
+authoritative copy when asked (the `repair` flag of
+do_scrub_operation).
+
+* replicated pools — byte digests must match across replicas; the
+  authoritative copy is the digest held by the majority with the
+  primary breaking ties (scrub_backend.cc select_auth_object); repair
+  pushes the authoritative bytes over the divergent replicas (and can
+  heal the primary itself by fetching them first).
+* EC pools — shards differ by construction, so integrity is checked at
+  the stripe level: shallow scrub compares shard metadata
+  (ec_ver/ec_size agreement); deep scrub fetches every stored shard,
+  searches for a decode of k shards whose re-encode agrees with the
+  most stored shards (the role hinfo_t crcs play in ECBackend's
+  scrub), and flags the disagreeing shards; repair rewrites them from
+  the consistent re-encode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import zlib
+
+from ..msg.messages import MOSDPGPush, MOSDRepScrub, MOSDRepScrubMap
+from ..store.objectstore import NotFound, Transaction, hobject_t
+from .pg import PG
+
+
+def _digest(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _attrs_digest(attrs: dict) -> int:
+    blob = b"\0".join(b"%s=%s" % (k.encode(), v)
+                      for k, v in sorted(attrs.items()))
+    return zlib.crc32(blob) & 0xFFFFFFFF
+
+
+class Scrubber:
+    """Per-daemon scrub engine (the primary drives its PGs)."""
+
+    def __init__(self, osd):
+        self.osd = osd
+        self._tid = 0
+        self._waiting: dict[int, dict] = {}
+
+    # -- scrub maps ---------------------------------------------------------
+
+    def build_scrub_map(self, pg: PG, oids: list[str],
+                        fetch: bool = False) -> dict:
+        """{oid: {size, digest, attrs_digest, attrs[, data]}} for the
+        local objects (ScrubMap::objects)."""
+        out = {}
+        for oid in oids:
+            ho = hobject_t(oid)
+            try:
+                data = self.osd.store.read(pg.cid, ho)
+                attrs = dict(self.osd.store.getattrs(pg.cid, ho))
+            except NotFound:
+                continue
+            entry = {
+                "size": len(data),
+                "digest": _digest(data),
+                "attrs_digest": _attrs_digest(attrs),
+                "attrs": attrs,
+            }
+            if fetch:
+                entry["data"] = data
+            out[oid] = entry
+        return out
+
+    def handle_rep_scrub(self, conn, msg: MOSDRepScrub) -> None:
+        """Replica side: build and return the chunk's scrub map."""
+        from .osdmap import pg_t
+
+        pg = self.osd.pgs.get(pg_t(msg.pool, msg.ps))
+        objects = {} if pg is None else self.build_scrub_map(
+            pg, msg.oids, fetch=bool(msg.fetch))
+        conn.send(MOSDRepScrubMap(pool=msg.pool, ps=msg.ps,
+                                  tid=msg.tid, objects=objects))
+
+    def handle_rep_scrub_map(self, msg: MOSDRepScrubMap) -> None:
+        st = self._waiting.get(msg.tid)
+        if st is None:
+            return
+        try:
+            osd_id = int(msg.src.split(".", 1)[1])
+        except (ValueError, IndexError):
+            return
+        st["maps"][osd_id] = msg.objects
+        st["waiting"].discard(osd_id)
+        if not st["waiting"]:
+            st["event"].set()
+
+    async def _gather_maps(self, pg: PG, oids: list[str],
+                           fetch: bool = False,
+                           members=None) -> dict:
+        """Scrub maps from the acting members (self included)."""
+        maps = {self.osd.whoami:
+                self.build_scrub_map(pg, oids, fetch=fetch)}
+        self._tid += 1
+        tid = self._tid
+        waiting: set[int] = set()
+        ev = asyncio.Event()
+        self._waiting[tid] = {"maps": maps, "waiting": waiting,
+                              "event": ev}
+        targets = members if members is not None else pg.acting
+        for osd_id in targets:
+            if osd_id < 0 or osd_id == self.osd.whoami:
+                continue
+            if not self.osd.osdmap.is_up(osd_id):
+                continue
+            addr = self.osd.osdmap.osd_addrs.get(osd_id)
+            if not addr:
+                continue
+            waiting.add(osd_id)
+            self.osd.msgr.send_to(addr, MOSDRepScrub(
+                pool=pg.pool_id, ps=pg.ps, tid=tid, oids=oids,
+                fetch=fetch), entity_hint="osd.%d" % osd_id)
+        if waiting:
+            try:
+                await asyncio.wait_for(ev.wait(), 5.0)
+            except asyncio.TimeoutError:
+                pass
+        self._waiting.pop(tid, None)
+        return maps
+
+    # -- scrub driver -------------------------------------------------------
+
+    async def scrub_pg(self, pg: PG, deep: bool = False,
+                       repair: bool = False,
+                       chunk: int = 25) -> dict:
+        """Primary-side scrub of one PG; returns
+        {"errors": n, "inconsistent": [oid...], "repaired": n}."""
+        pool = self.osd.osdmap.pools.get(pg.pool_id)
+        result = {"errors": 0, "inconsistent": [], "repaired": 0}
+        if pool is None or not pg.is_primary():
+            return result
+        oids = sorted({h.name for h in
+                       self.osd.store.collection_list(pg.cid)})
+        for e in pg.log.entries:      # replica-only objects
+            if e.oid not in oids:
+                oids.append(e.oid)
+        for i in range(0, len(oids), chunk):
+            batch = oids[i:i + chunk]
+            maps = await self._gather_maps(pg, batch)
+            if pool.is_erasure():
+                await self._compare_ec(pg, pool, batch, maps, deep,
+                                       repair, result)
+            else:
+                await self._compare_replicated(pg, batch, maps,
+                                              repair, result)
+        return result
+
+    # -- replicated compare -------------------------------------------------
+
+    async def _compare_replicated(self, pg: PG, oids, maps, repair,
+                                  result) -> None:
+        live = [o for o in pg.acting if o >= 0 and o in maps]
+        for oid in oids:
+            present = {o: maps[o][oid] for o in live
+                       if oid in maps[o]}
+            if not present:
+                continue
+            digests: dict[tuple, list[int]] = {}
+            for o, r in present.items():
+                digests.setdefault(
+                    (r["size"], r["digest"]), []).append(o)
+            if len(digests) == 1 and len(present) == len(live):
+                continue
+            # authoritative = the majority digest, primary tiebreak
+            auth_key = max(
+                digests,
+                key=lambda k: (len(digests[k]),
+                               self.osd.whoami in digests[k]))
+            bad = [o for o in live if o not in digests[auth_key]]
+            result["errors"] += len(bad)
+            result["inconsistent"].append(oid)
+            self.osd.ctx.log.info(
+                "osd", "scrub %d.%x %s: inconsistent on %s"
+                % (pg.pool_id, pg.ps, oid, bad))
+            if not repair:
+                continue
+            auth_osd = (self.osd.whoami
+                        if self.osd.whoami in digests[auth_key]
+                        else digests[auth_key][0])
+            data = await self._auth_bytes(pg, oid, auth_osd)
+            if data is None:
+                continue
+            attrs = present[auth_osd]["attrs"]
+            repaired = 0
+            for osd_id in bad:
+                if osd_id == self.osd.whoami:
+                    t = Transaction()
+                    ho = hobject_t(oid)
+                    t.write(pg.cid, ho, 0, len(data), data)
+                    t.truncate(pg.cid, ho, len(data))
+                    t.setattrs(pg.cid, ho, dict(attrs))
+                    self.osd.store.apply_transaction(t)
+                    repaired += 1
+                else:
+                    self.osd._send_osd(osd_id, MOSDPGPush(
+                        pool=pg.pool_id, ps=pg.ps,
+                        epoch=self.osd.osdmap.epoch,
+                        pushes=[{"oid": oid, "delete": False,
+                                 "data": data,
+                                 "attrs": dict(attrs), "omap": {}}]))
+                    repaired += 1
+            result["repaired"] += repaired
+
+    async def _auth_bytes(self, pg: PG, oid: str,
+                          auth_osd: int) -> bytes | None:
+        if auth_osd == self.osd.whoami:
+            try:
+                return self.osd.store.read(pg.cid, hobject_t(oid))
+            except NotFound:
+                return None
+        maps = await self._gather_maps(pg, [oid], fetch=True,
+                                       members=[auth_osd])
+        row = maps.get(auth_osd, {}).get(oid)
+        return None if row is None else bytes(row["data"])
+
+    # -- EC compare ---------------------------------------------------------
+
+    async def _compare_ec(self, pg: PG, pool, oids, maps, deep,
+                          repair, result) -> None:
+        from .ecbackend import SIZE_XATTR, VER_XATTR
+
+        codec = self.osd.ec.codec(pool)
+        live = [o for o in pg.acting if o >= 0 and o in maps]
+        for oid in oids:
+            present = {o: maps[o][oid] for o in live
+                       if oid in maps[o]}
+            if not present:
+                continue
+            vers = {r["attrs"].get(VER_XATTR)
+                    for r in present.values()}
+            sizes = {r["attrs"].get(SIZE_XATTR)
+                     for r in present.values()}
+            meta_bad = len(vers) > 1 or len(sizes) > 1
+            byte_bad: dict[int, bytes] = {}
+            if deep and not meta_bad:
+                byte_bad = await self._deep_verify_ec(
+                    pg, codec, oid, present)
+            if not meta_bad and not byte_bad:
+                continue
+            result["errors"] += int(meta_bad) + len(byte_bad)
+            result["inconsistent"].append(oid)
+            self.osd.ctx.log.info(
+                "osd", "scrub %d.%x %s: EC inconsistency "
+                "(meta=%s shards=%s)"
+                % (pg.pool_id, pg.ps, oid, meta_bad,
+                   sorted(byte_bad)))
+            if repair and byte_bad:
+                result["repaired"] += self._repair_ec(
+                    pg, oid, present, byte_bad)
+
+    async def _deep_verify_ec(self, pg: PG, codec, oid: str,
+                              present: dict) -> dict[int, bytes]:
+        """{bad_osd: expected_shard_bytes}: every shard carries the
+        crc vector of ALL shards (ec_hinfo, written at encode time —
+        ECUtil::HashInfo's role); the majority vector identifies
+        rotted shards exactly, even with a single parity (where a
+        decode-subset vote cannot — each decode reproduces its own
+        inputs).  Objects without hinfo fall back to the subset vote
+        (sound for m >= 2)."""
+        maps = await self._gather_maps(pg, [oid], fetch=True,
+                                       members=list(present))
+        shards: dict[int, tuple[int, bytes, dict]] = {}
+        for osd_id, m in maps.items():
+            row = m.get(oid)
+            if row is None:
+                continue
+            try:
+                j = int(row["attrs"].get("ec_shard"))
+            except (TypeError, ValueError):
+                continue
+            shards[osd_id] = (j, bytes(row["data"]), row["attrs"])
+        by_j: dict[int, tuple[int, bytes]] = {}
+        for osd_id, (j, buf, _a) in shards.items():
+            by_j.setdefault(j, (osd_id, buf))
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        if len(by_j) < k:
+            return {}
+        # majority hinfo vector
+        votes: dict[bytes, int] = {}
+        for _o, (_j, _b, attrs) in shards.items():
+            hv = attrs.get("ec_hinfo")
+            if hv:
+                votes[bytes(hv)] = votes.get(bytes(hv), 0) + 1
+        expect = None
+        if votes:
+            hv = max(votes, key=votes.get)
+            crcs = [int(x) for x in hv.split(b",")]
+            bad_j = [j for j, (_o, buf) in by_j.items()
+                     if j < len(crcs) and _digest(buf) != crcs[j]]
+            # a rotted-shorter shard keeps its prefix crc-mismatched
+            # too, so the crc test covers truncation as well
+            good = {j: by_j[j][1] for j in by_j if j not in bad_j}
+            if not bad_j:
+                return {}
+            if len(good) >= k:
+                try:
+                    expect = codec.encode(
+                        set(range(n)), codec.decode_concat(good))
+                except (IOError, ValueError):
+                    expect = None
+        if expect is None:
+            # legacy objects: decode-subset vote
+            best = None
+            for subset in itertools.combinations(sorted(by_j), k):
+                chunks = {j: by_j[j][1] for j in subset}
+                try:
+                    cand = codec.encode(
+                        set(range(n)),
+                        codec.decode_concat(chunks))
+                except Exception:
+                    continue
+                agree = sum(1 for j, (_o, buf) in by_j.items()
+                            if cand.get(j, b"") == buf)
+                if best is None or agree > best[0]:
+                    best = (agree, cand)
+                if agree == len(by_j):
+                    break
+            if best is None:
+                return {}
+            expect = best[1]
+        bad = {}
+        for osd_id, (j, buf, _a) in shards.items():
+            if j in expect and expect[j] != buf:
+                bad[osd_id] = expect[j]
+        return bad
+
+    def _repair_ec(self, pg: PG, oid: str, present: dict,
+                   bad: dict[int, bytes]) -> int:
+        repaired = 0
+        for osd_id, expected in bad.items():
+            attrs = dict(present[osd_id]["attrs"])
+            if osd_id == self.osd.whoami:
+                t = Transaction()
+                ho = hobject_t(oid)
+                t.write(pg.cid, ho, 0, len(expected), expected)
+                t.truncate(pg.cid, ho, len(expected))
+                t.setattrs(pg.cid, ho, attrs)
+                self.osd.store.apply_transaction(t)
+            else:
+                self.osd._send_osd(osd_id, MOSDPGPush(
+                    pool=pg.pool_id, ps=pg.ps,
+                    epoch=self.osd.osdmap.epoch,
+                    pushes=[{"oid": oid, "delete": False,
+                             "data": expected, "attrs": attrs,
+                             "omap": {}}]))
+            repaired += 1
+        return repaired
