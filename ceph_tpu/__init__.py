@@ -13,12 +13,12 @@ Layer map (mirrors the reference's architecture, see SURVEY.md §1):
   ops/       L1 compute kernels: CRUSH (host + JAX), GF(2^8) EC (host + JAX)
   ec/        L1 erasure-code plugin framework + plugins
   models/    cluster map models: CrushMap, OSDMap, pools
-  parallel/  device-mesh bulk mapping, sharding helpers, striper math
+  parallel/  device-mesh bulk mapping and sharding helpers
   store/     L2 ObjectStore: Transaction, MemStore, KStore
   msg/       L3 async messenger (framed DCN transport)
   mon/       L4 control plane: paxos-replicated map store, elections
   osd/       L5 data plane: PGs, replicated/EC backends, peering, recovery
-  client/    L6 librados-style client: Objecter, striper
+  client/    L6 librados-style client: Objecter, libradosstriper
   cli/       L8 tools: crushtool/osdmaptool/rados analogs, vstart
 
 Bit-exactness: CRUSH mapping is bit-identical to the reference semantics

@@ -289,6 +289,11 @@ class IoCtx:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "setxattr", "name": name, "value": bytes(value)}])
 
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        outs = await self.client.submit_op(self.pool_id, oid, [
+            {"op": "getxattr", "name": name}])
+        return outs[0]["value"]
+
     async def omap_set(self, oid: str, kv: dict) -> None:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "omap-set", "kv": dict(kv)}])

@@ -514,10 +514,14 @@ class Monitor:
                 await asyncio.wait_for(fut, 15.0)
             conn.send(MMonCommandAck(tid=msg.tid, result=0, out=out))
         except (IOError, asyncio.TimeoutError):
-            # transient quorum loss mid-round: retryable — the client
-            # hunts to the next leader (-112, like the peon redirect)
-            conn.send(MMonCommandAck(tid=msg.tid, result=-112,
-                                     out={"leader": None}))
+            # quorum lost mid-round: the proposal MAY still commit
+            # under a later reign, so a retryable redirect would make
+            # clients re-run possibly-committed (non-idempotent)
+            # commands — report ETIMEDOUT and let the caller decide
+            conn.send(MMonCommandAck(
+                tid=msg.tid, result=-110,
+                out={"error": "proposal timed out; may have "
+                              "committed"}))
         except Exception as e:
             conn.send(MMonCommandAck(tid=msg.tid, result=-22,
                                      out={"error": str(e)}))

@@ -103,8 +103,11 @@ class Scrubber:
                            fetch: bool = False,
                            members=None) -> dict:
         """Scrub maps from the acting members (self included)."""
-        maps = {self.osd.whoami:
-                self.build_scrub_map(pg, oids, fetch=fetch)}
+        targets0 = members if members is not None else pg.acting
+        maps = {}
+        if members is None or self.osd.whoami in targets0:
+            maps[self.osd.whoami] = self.build_scrub_map(
+                pg, oids, fetch=fetch)
         self._tid += 1
         tid = self._tid
         waiting: set[int] = set()
@@ -172,7 +175,8 @@ class Scrubber:
             digests: dict[tuple, list[int]] = {}
             for o, r in present.items():
                 digests.setdefault(
-                    (r["size"], r["digest"]), []).append(o)
+                    (r["size"], r["digest"], r["attrs_digest"]),
+                    []).append(o)
             if len(digests) == 1 and len(present) == len(live):
                 continue
             # authoritative = the majority digest, primary tiebreak
@@ -229,126 +233,153 @@ class Scrubber:
 
     # -- EC compare ---------------------------------------------------------
 
+    @staticmethod
+    def _majority_hinfo(rows: dict) -> list[int] | None:
+        """The crc vector most shards agree on, or None (legacy or
+        unparseable hinfo — corrupted metadata must degrade to the
+        fetch-based vote, not crash the scrub)."""
+        votes: dict[bytes, int] = {}
+        for r in rows.values():
+            hv = r["attrs"].get("ec_hinfo")
+            if hv:
+                votes[bytes(hv)] = votes.get(bytes(hv), 0) + 1
+        for hv, _n in sorted(votes.items(), key=lambda kv: -kv[1]):
+            try:
+                return [int(x) for x in hv.split(b",")]
+            except ValueError:
+                continue
+        return None
+
     async def _compare_ec(self, pg: PG, pool, oids, maps, deep,
                           repair, result) -> None:
         from .ecbackend import SIZE_XATTR, VER_XATTR
 
         codec = self.osd.ec.codec(pool)
         live = [o for o in pg.acting if o >= 0 and o in maps]
+        pos_of = {o: j for j, o in enumerate(pg.acting)}
         for oid in oids:
             present = {o: maps[o][oid] for o in live
                        if oid in maps[o]}
             if not present:
                 continue
-            vers = {r["attrs"].get(VER_XATTR)
-                    for r in present.values()}
-            sizes = {r["attrs"].get(SIZE_XATTR)
-                     for r in present.values()}
-            meta_bad = len(vers) > 1 or len(sizes) > 1
-            byte_bad: dict[int, bytes] = {}
-            if deep and not meta_bad:
-                byte_bad = await self._deep_verify_ec(
-                    pg, codec, oid, present)
+            # authoritative metadata = the (ver, size) group most
+            # shards carry (newest version breaks ties)
+            groups: dict[tuple, list[int]] = {}
+            for o, r in present.items():
+                key = (r["attrs"].get(VER_XATTR),
+                       r["attrs"].get(SIZE_XATTR))
+                groups.setdefault(key, []).append(o)
+            auth_key = max(groups,
+                           key=lambda k: (len(groups[k]), k[0] or b""))
+            auth = {o: present[o] for o in groups[auth_key]}
+            meta_bad = [o for o in present if o not in auth]
+            # byte rot among the metadata-consistent shards: compare
+            # each shard's shallow crc against the voted hinfo vector
+            # (no byte fetch needed); legacy objects without hinfo go
+            # through the fetch-based decode vote
+            byte_bad: list[int] = []
+            crcs = self._majority_hinfo(auth) if deep else None
+            legacy = deep and crcs is None
+            if deep and crcs is not None:
+                for o, r in auth.items():
+                    j = pos_of.get(o)
+                    if j is not None and j < len(crcs) \
+                            and r["digest"] != crcs[j]:
+                        byte_bad.append(o)
+            if legacy:
+                byte_bad = await self._legacy_byte_vote(
+                    pg, codec, oid, auth, pos_of)
             if not meta_bad and not byte_bad:
                 continue
-            result["errors"] += int(meta_bad) + len(byte_bad)
+            result["errors"] += len(meta_bad) + len(byte_bad)
             result["inconsistent"].append(oid)
             self.osd.ctx.log.info(
                 "osd", "scrub %d.%x %s: EC inconsistency "
                 "(meta=%s shards=%s)"
                 % (pg.pool_id, pg.ps, oid, meta_bad,
                    sorted(byte_bad)))
-            if repair and byte_bad:
-                result["repaired"] += self._repair_ec(
-                    pg, oid, present, byte_bad)
+            if repair:
+                result["repaired"] += await self._repair_ec(
+                    pg, codec, oid, auth, pos_of,
+                    sorted(set(meta_bad) | set(byte_bad)))
 
-    async def _deep_verify_ec(self, pg: PG, codec, oid: str,
-                              present: dict) -> dict[int, bytes]:
-        """{bad_osd: expected_shard_bytes}: every shard carries the
-        crc vector of ALL shards (ec_hinfo, written at encode time —
-        ECUtil::HashInfo's role); the majority vector identifies
-        rotted shards exactly, even with a single parity (where a
-        decode-subset vote cannot — each decode reproduces its own
-        inputs).  Objects without hinfo fall back to the subset vote
-        (sound for m >= 2)."""
+    async def _legacy_byte_vote(self, pg: PG, codec, oid: str, auth,
+                                pos_of) -> list[int]:
+        """No hinfo: fetch the shard bytes and vote decode subsets —
+        each decode reproduces its inputs, so the re-encode agreeing
+        with the most stored shards wins (sound for m >= 2)."""
+        shards = await self._fetch_shards(pg, oid, list(auth), pos_of)
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        by_j = {j: buf for _o, (j, buf) in shards.items()}
+        if len(by_j) < k:
+            return []
+        best = None
+        for subset in itertools.combinations(sorted(by_j), k):
+            try:
+                cand = codec.encode(
+                    set(range(n)),
+                    codec.decode_concat(
+                        {j: by_j[j] for j in subset}))
+            except Exception:
+                continue
+            agree = sum(1 for j, buf in by_j.items()
+                        if cand.get(j, b"") == buf)
+            if best is None or agree > best[0]:
+                best = (agree, cand)
+            if agree == len(by_j):
+                break
+        if best is None:
+            return []
+        expect = best[1]
+        return [o for o, (j, buf) in shards.items()
+                if j in expect and expect[j] != buf]
+
+    async def _fetch_shards(self, pg: PG, oid: str, members,
+                            pos_of) -> dict:
+        """{osd: (shard_index, bytes)} for the given members."""
         maps = await self._gather_maps(pg, [oid], fetch=True,
-                                       members=list(present))
-        shards: dict[int, tuple[int, bytes, dict]] = {}
+                                       members=members)
+        out = {}
         for osd_id, m in maps.items():
             row = m.get(oid)
             if row is None:
                 continue
-            try:
-                j = int(row["attrs"].get("ec_shard"))
-            except (TypeError, ValueError):
-                continue
-            shards[osd_id] = (j, bytes(row["data"]), row["attrs"])
-        by_j: dict[int, tuple[int, bytes]] = {}
-        for osd_id, (j, buf, _a) in shards.items():
-            by_j.setdefault(j, (osd_id, buf))
+            j = pos_of.get(osd_id)
+            if j is not None:
+                out[osd_id] = (j, bytes(row["data"]))
+        return out
+
+    async def _repair_ec(self, pg: PG, codec, oid: str, auth,
+                         pos_of, bad: list[int]) -> int:
+        """Rebuild every divergent shard (metadata or bytes) from a
+        decode of the clean authoritative shards and rewrite it with
+        the authoritative attrs (its own shard index substituted)."""
+        good = [o for o in auth if o not in bad]
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
-        if len(by_j) < k:
-            return {}
-        # majority hinfo vector
-        votes: dict[bytes, int] = {}
-        for _o, (_j, _b, attrs) in shards.items():
-            hv = attrs.get("ec_hinfo")
-            if hv:
-                votes[bytes(hv)] = votes.get(bytes(hv), 0) + 1
-        expect = None
-        if votes:
-            hv = max(votes, key=votes.get)
-            crcs = [int(x) for x in hv.split(b",")]
-            bad_j = [j for j, (_o, buf) in by_j.items()
-                     if j < len(crcs) and _digest(buf) != crcs[j]]
-            # a rotted-shorter shard keeps its prefix crc-mismatched
-            # too, so the crc test covers truncation as well
-            good = {j: by_j[j][1] for j in by_j if j not in bad_j}
-            if not bad_j:
-                return {}
-            if len(good) >= k:
-                try:
-                    expect = codec.encode(
-                        set(range(n)), codec.decode_concat(good))
-                except (IOError, ValueError):
-                    expect = None
-        if expect is None:
-            # legacy objects: decode-subset vote
-            best = None
-            for subset in itertools.combinations(sorted(by_j), k):
-                chunks = {j: by_j[j][1] for j in subset}
-                try:
-                    cand = codec.encode(
-                        set(range(n)),
-                        codec.decode_concat(chunks))
-                except Exception:
-                    continue
-                agree = sum(1 for j, (_o, buf) in by_j.items()
-                            if cand.get(j, b"") == buf)
-                if best is None or agree > best[0]:
-                    best = (agree, cand)
-                if agree == len(by_j):
-                    break
-            if best is None:
-                return {}
-            expect = best[1]
-        bad = {}
-        for osd_id, (j, buf, _a) in shards.items():
-            if j in expect and expect[j] != buf:
-                bad[osd_id] = expect[j]
-        return bad
-
-    def _repair_ec(self, pg: PG, oid: str, present: dict,
-                   bad: dict[int, bytes]) -> int:
+        if len(good) < k:
+            return 0
+        shards = await self._fetch_shards(pg, oid, good, pos_of)
+        chunks = {j: buf for _o, (j, buf) in shards.items()}
+        try:
+            expect = codec.encode(set(range(n)),
+                                  codec.decode_concat(chunks))
+        except (IOError, ValueError):
+            return 0
+        auth_attrs = dict(next(iter(auth.values()))["attrs"])
         repaired = 0
-        for osd_id, expected in bad.items():
-            attrs = dict(present[osd_id]["attrs"])
+        for osd_id in bad:
+            j = pos_of.get(osd_id)
+            if j is None or j not in expect:
+                continue
+            attrs = dict(auth_attrs)
+            attrs["ec_shard"] = b"%d" % j
             if osd_id == self.osd.whoami:
                 t = Transaction()
                 ho = hobject_t(oid)
-                t.write(pg.cid, ho, 0, len(expected), expected)
-                t.truncate(pg.cid, ho, len(expected))
+                t.write(pg.cid, ho, 0, len(expect[j]), expect[j])
+                t.truncate(pg.cid, ho, len(expect[j]))
                 t.setattrs(pg.cid, ho, attrs)
                 self.osd.store.apply_transaction(t)
             else:
@@ -356,7 +387,7 @@ class Scrubber:
                     pool=pg.pool_id, ps=pg.ps,
                     epoch=self.osd.osdmap.epoch,
                     pushes=[{"oid": oid, "delete": False,
-                             "data": expected, "attrs": attrs,
+                             "data": expect[j], "attrs": attrs,
                              "omap": {}}]))
             repaired += 1
         return repaired
