@@ -167,7 +167,9 @@ class MOSDPGQuery(Message):
     (MOSDPGQuery.h)."""
 
     TYPE = "pg_query"
-    FIELDS = ("pool", "ps", "epoch")
+    # query: "info" (peer state only) or "log" (entries since `since` —
+    # the bounded GetLog fetch; full logs never ride info rounds)
+    FIELDS = ("pool", "ps", "epoch", "query", "since")
 
 
 @register

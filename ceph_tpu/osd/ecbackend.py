@@ -318,6 +318,7 @@ class ECPGBackend:
                 entryt = Transaction()
                 entryt.append(t)
                 pg.persist_log_entry(entryt, entry)
+                pg.maybe_trim_log(entryt)
                 pg.persist_meta(entryt)
                 self.osd.store.apply_transaction(entryt)
             else:
@@ -369,6 +370,7 @@ class ECPGBackend:
         pg.info.last_update = entry.version
         pg.missing.pop(entry.oid, None)  # the write heals the object
         pg.persist_log_entry(t, entry)
+        pg.maybe_trim_log(t)
         pg.persist_meta(t)
         self.osd.store.apply_transaction(t)
         conn.send(MOSDECSubOpWriteReply(

@@ -170,6 +170,41 @@ class PG:
             b"log." + ev_key(e.version): denc.encode(e.to_wire()),
         })
 
+    def maybe_trim_log(self, t: Transaction) -> None:
+        """Bound the log after appending a WRITE entry (never call
+        from the bulk merge/adopt persist loops — trimming under an
+        iteration over pg.log.entries would re-persist dropped rows).
+        Peers that fall behind the trimmed tail are backfilled.  On
+        replicas the same policy keeps the in-memory log in lockstep
+        with the primary's replicated omap trims."""
+        limit = self.osd.ctx.conf["osd_max_pg_log_entries"]
+        if len(self.log.entries) <= limit:
+            return
+        keep = self.log.entries[-(limit // 2):]
+        cut = keep[0].version
+        for d in self.log.trim((cut[0], cut[1] - 1)):
+            t.omap_rmkeys(self.cid, PGMETA_OID,
+                          [b"log." + ev_key(d.version)])
+        self.info.log_tail = self.log.tail
+
+    def replace_log(self, t: Transaction, entries, tail) -> None:
+        """Wholesale log replacement (full adoption / backfill):
+        removes EVERY persisted log row first — leftover rows from the
+        replaced history would resurrect dead entries on the next
+        load()."""
+        try:
+            old = self.osd.store.omap_get(self.cid, PGMETA_OID)
+            stale = [k for k in old if k.startswith(b"log.")]
+            if stale:
+                t.omap_rmkeys(self.cid, PGMETA_OID, stale)
+        except Exception:
+            pass
+        self.log.entries = list(entries)
+        self.log.tail = tuple(tail)
+        self.info.log_tail = self.log.tail
+        for e in self.log.entries:
+            self.persist_log_entry(t, e)
+
     def load(self) -> bool:
         """Restore info+log from the pgmeta omap; False if absent."""
         store = self.osd.store

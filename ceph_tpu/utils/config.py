@@ -219,6 +219,9 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_op_num_shards", OPT_INT, 4, "op queue shards per osd"),
     Option("osd_recovery_max_active", OPT_INT, 8,
            "max concurrent recovery ops per osd"),
+    Option("osd_max_pg_log_entries", OPT_INT, 2000,
+           "pg log length before trimming (peers that fall behind the"
+           " trimmed tail are backfilled instead of log-recovered)"),
     Option("ec_batch_max_stripes", OPT_INT, 4096,
            "max stripes aggregated into one device EC dispatch"),
     Option("ec_batch_flush_us", OPT_INT, 200,
