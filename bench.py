@@ -16,10 +16,18 @@ the previous parity into the next input, forcing serial execution —
 and throughput is taken from the slope between a short and a long run
 (single final readback), which cancels fixed tunnel latency.
 
-vs_baseline divides by 100 GiB/s — a deliberately generous stand-in
-for the reference's ISA-L encode on a 64-core host (~1.5-6 GiB/s/core
-published by intel, memory-bandwidth-bound in aggregate), since
-BASELINE.json carries no published figure.
+vs_baseline divides by a MEASURED host baseline: bench_host/
+ec_host_bench.c reimplements ISA-L's core technique (per-coefficient
+nibble-split GF(2^8) multiply via PSHUFB over AVX2 lanes — the
+gf_vect_mul pattern ec_encode_data runs per region,
+src/erasure-code/isa/ErasureCodeIsa.cc:129) and measures 7.7 GiB/s
+per core for k=8,m=3 at 4 KiB chunks on this image's Xeon @2.1GHz.
+BASELINE.md's target host is 64-core; scaling linearly (optimistic
+for the host — real chips saturate memory bandwidth first) gives
+493 GiB/s.  One v5e chip is itself HBM-bound on this workload
+((k+m)/k of payload traffic at ~819 GB/s), so parity with the scaled
+64-core figure is the single-chip roofline; the >=10x north star is a
+multi-chip (sharded stripe batch) target.
 """
 
 import json
@@ -28,7 +36,9 @@ import time
 
 import numpy as np
 
-BASELINE_GIBPS = 100.0  # ISA-L k=8,m=3 on 64-core host (documented proxy)
+# measured 7.706 GiB/s/core (bench_host/ec_host_bench 8 3 4096 60000)
+# x 64 cores, linear scaling — see module docstring for provenance
+BASELINE_GIBPS = 7.706 * 64
 
 # north-star #2 (BASELINE.json): full 10M-PG remap < 1 s on one chip
 CRUSH_N_PGS = 10_000_000
@@ -180,21 +190,42 @@ def bench_decode() -> dict:
         __import__("numpy").array(bm, dtype=__import__("numpy").int8),
         tile)
     rng = np.random.default_rng(2)
-    surv_planes = jnp.asarray(rng.integers(
-        0, 256, size=(k * 64, P), dtype=np.uint8))
-    fn = jax.jit(dec)
-    out = fn(surv_planes)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    iters = 20
-    for _ in range(iters):
-        out = fn(surv_planes)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
+    host = rng.integers(0, 256, size=(k * 64, P), dtype=np.uint8)
+    d0 = jax.device_put(jnp.asarray(host))
+    clone = jax.jit(lambda d: d + jnp.uint8(0))
+
+    # chained slope timing, like the encode leg: each step folds the
+    # reconstructed shard back into the survivors so dispatches
+    # serialize, and the short/long-run slope cancels tunnel latency
+    def step_fn(d):
+        rebuilt = dec(d)               # [64, P]
+        return jax.lax.dynamic_update_slice(
+            d, rebuilt[0:8, 0:128] ^ d[0:8, 0:128], (0, 0))
+
+    step = jax.jit(step_fn, donate_argnums=0)
+
+    def chained(iters):
+        d = clone(d0)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            d = step(d)
+        np.asarray(d[0:1, 0:1])
+        return time.perf_counter() - t0
+
+    chained(2)
+    estimates = []
+    for _ in range(3):
+        t1 = chained(3)
+        t2 = chained(23)
+        if t2 > t1:
+            estimates.append((t2 - t1) / 20)
+    if not estimates:
+        return {}
+    per = sorted(estimates)[len(estimates) // 2]
     payload = k * 64 * P  # survivor bytes read per reconstruct
     return {
         "ec_reconstruct_1shard_gibps": round(
-            payload / dt / (1 << 30), 1),
+            payload / per / (1 << 30), 1),
     }
 
 
@@ -213,7 +244,9 @@ def bench_backend_path() -> dict:
 
     k, m = 8, 3
     matrix = matrices.isa_rs_vandermonde_matrix(k, m)
-    enc = kernels.DeviceEncoder(matrix, 8)
+    # the batcher's configuration: pallas tile kernel, VMEM-resident
+    # bit-plane expansion
+    enc = kernels.DeviceEncoder(matrix, 8, use_pallas=True, tile=4096)
     rng = np.random.default_rng(7)
     N = 32 << 20                      # 32 MiB per chunk row
     host = rng.integers(0, 256, size=(k, N), dtype=np.uint8)

@@ -88,9 +88,16 @@ class DeviceBatcher:
     @staticmethod
     @functools.lru_cache(maxsize=256)
     def _encoder(matrix_key: tuple, w: int):
+        import jax
+
         from .kernels import DeviceEncoder
         matrix = [list(row) for row in matrix_key]
-        return DeviceEncoder(matrix, w)
+        # the pallas path keeps the w-fold bit-plane expansion in VMEM
+        # (HBM traffic stays (k+m)/k of payload); w=8 only — wider
+        # words use the XLA path
+        use_pallas = jax.default_backend() == "tpu" and w == 8
+        return DeviceEncoder(matrix, w, use_pallas=use_pallas,
+                             tile=4096)
 
     async def encode(self, matrix: list[list[int]], w: int,
                      data: np.ndarray) -> np.ndarray:

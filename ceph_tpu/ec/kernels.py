@@ -115,12 +115,13 @@ def _encode_pallas(bitmatrix: np.ndarray, w: int, k: int, m: int,
     @jax.jit
     def run(data: jax.Array) -> jax.Array:
         n = data.shape[1]
-        if n % tile:
-            raise ValueError(
-                "column count %d must be a multiple of tile %d" % (n, tile))
-        grid = (n // tile,)
+        pad = (-n) % tile
+        if pad:
+            data = jnp.pad(data, ((0, 0), (0, pad)))
+        np_ = n + pad
+        grid = (np_ // tile,)
         kern = functools.partial(_ec_tile_kernel, w=w, k=k, m=m)
-        return pl.pallas_call(
+        out = pl.pallas_call(
             kern,
             grid=grid,
             in_specs=[
@@ -128,9 +129,10 @@ def _encode_pallas(bitmatrix: np.ndarray, w: int, k: int, m: int,
                 pl.BlockSpec((k, tile), lambda i: (i32(0), i32(i))),
             ],
             out_specs=pl.BlockSpec((m, tile), lambda i: (i32(0), i32(i))),
-            out_shape=jax.ShapeDtypeStruct((m, n), data.dtype),
+            out_shape=jax.ShapeDtypeStruct((m, np_), data.dtype),
             interpret=interpret,
         )(bm, data)
+        return out[:, :n] if pad else out
 
     return run
 

@@ -1,0 +1,65 @@
+"""Native (C) host kernels, loaded via ctypes.
+
+The reference keeps its hot host loops in C/C++ (the crush core is
+kernel-compatible C, EC rides isa-l assembly); this package is the
+analog: small C sources compiled on first use into a per-checkout
+shared object and exposed through ctypes, with every caller keeping a
+pure-Python/numpy fallback (CEPH_TPU_NO_NATIVE=1 forces it).  Outputs
+are bit-identical to the fallbacks by construction and pinned by
+tests."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB = None
+_TRIED = False
+
+
+def _build(src: str, out: str) -> bool:
+    flags = ["-O3", "-shared", "-fPIC"]
+    # AVX2 when the host has it (the scalar path compiles regardless)
+    try:
+        with open("/proc/cpuinfo") as f:
+            if "avx2" in f.read():
+                flags.append("-mavx2")
+    except OSError:
+        pass
+    try:
+        subprocess.run(["gcc", *flags, src, "-o", out], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def lib():
+    """The loaded libgfec, or None (missing compiler, forced off)."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("CEPH_TPU_NO_NATIVE"):
+        return None
+    so = os.path.join(_DIR, "libgfec.so")
+    src = os.path.join(_DIR, "gfec.c")
+    if not os.path.exists(so) or \
+            os.path.getmtime(so) < os.path.getmtime(src):
+        if not _build(src, so):
+            return None
+    try:
+        L = ctypes.CDLL(so)
+        L.gfec_init()
+        L.gfec_matmul.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+        L.gfec_region_mad.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_ubyte,
+            ctypes.c_size_t]
+        _LIB = L
+    except OSError:
+        _LIB = None
+    return _LIB
