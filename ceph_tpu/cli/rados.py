@@ -1,0 +1,101 @@
+"""rados: object-level CLI against a live cluster.
+
+Analog of src/tools/rados (rados put/get/ls/rm/stat/df/bench):
+
+    python -m ceph_tpu.cli.rados -m HOST:PORT[,HOST:PORT...] \\
+        -p POOL put NAME FILE | get NAME FILE | ls | rm NAME \\
+        | stat NAME | df | bench SECONDS write [--size N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+
+from ..client.rados import RadosClient
+
+
+async def _run(args) -> int:
+    client = RadosClient(args.mon.split(","))
+    await client.connect()
+    try:
+        if args.cmd == "df":
+            out = await client.mon_command("status")
+            print("pools: %s  osds: %d up: %d in: %d (epoch %d)"
+                  % (out["pools"], out["num_osds"],
+                     out["num_up_osds"], out["num_in_osds"],
+                     out["epoch"]))
+            return 0
+        io = client.io_ctx(args.pool)
+        if args.cmd == "put":
+            with open(args.args[1], "rb") as f:
+                data = f.read()
+            await io.write_full(args.args[0], data)
+            print("wrote %d bytes to %s" % (len(data), args.args[0]))
+        elif args.cmd == "get":
+            data = await io.read(args.args[0])
+            with open(args.args[1], "wb") as f:
+                f.write(data)
+            print("read %d bytes from %s" % (len(data), args.args[0]))
+        elif args.cmd == "ls":
+            for name in await client.list_objects(io.pool_id):
+                print(name)
+        elif args.cmd == "rm":
+            await io.remove(args.args[0])
+        elif args.cmd == "stat":
+            size = await io.stat(args.args[0])
+            print("%s size %d" % (args.args[0], size))
+        elif args.cmd == "bench":
+            seconds = int(args.args[0])
+            size = args.size
+            payload = bytes(size)
+            deadline = time.perf_counter() + seconds
+            n = 0
+            lat = []
+            inflight = []
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                inflight.append((t0, asyncio.ensure_future(
+                    io.write_full("bench_%d" % n, payload))))
+                n += 1
+                if len(inflight) >= 16:
+                    t0w, fut = inflight.pop(0)
+                    await fut
+                    lat.append(time.perf_counter() - t0w)
+            for t0w, fut in inflight:
+                await fut
+                lat.append(time.perf_counter() - t0w)
+            dur = seconds
+            print("wrote %d x %dB objects in %ds: %.1f op/s, "
+                  "%.2f MiB/s, avg lat %.1f ms"
+                  % (n, size, dur, n / dur,
+                     n * size / dur / (1 << 20),
+                     1000 * sum(lat) / max(1, len(lat))))
+            # cleanup
+            await asyncio.gather(*[io.remove("bench_%d" % i)
+                                   for i in range(n)],
+                                 return_exceptions=True)
+        else:
+            print("unknown command %r" % args.cmd, file=sys.stderr)
+            return 2
+        return 0
+    finally:
+        await client.shutdown()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rados")
+    p.add_argument("-m", "--mon", required=True,
+                   help="monitor address(es), comma separated")
+    p.add_argument("-p", "--pool", default="rbd")
+    p.add_argument("--size", type=int, default=4096)
+    p.add_argument("cmd")
+    p.add_argument("args", nargs="*")
+    args = p.parse_args(argv)
+    return asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

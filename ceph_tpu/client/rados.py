@@ -18,7 +18,7 @@ import asyncio
 from ..msg import Messenger
 from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
                             MOSDMapMsg, MOSDOp, MOSDOpReply)
-from ..osd.osdmap import OSDMap, consume_map_payload
+from ..osd.osdmap import OSDMap, consume_map_payload, pg_t
 from ..utils.context import Context
 
 
@@ -149,6 +149,8 @@ class RadosClient:
         replica death aborts the primary's in-flight repops, so the op
         must be resent even when the primary itself is unchanged."""
         for op in list(self._inflight.values()):
+            if not op.oid:
+                continue    # pg-targeted ops (pgls) are fire-once
             primary, pgid, acting = self._calc_target(op.pool, op.oid)
             if (primary != op.target or pgid != op.pgid
                     or acting != op.acting):
@@ -172,6 +174,40 @@ class RadosClient:
         self._inflight[self._tid] = op
         self._send_op(op)
         return fut
+
+    async def list_objects(self, pool_id: int) -> list[str]:
+        """Enumerate every object in the pool by walking its PGs with
+        pgls ops (rados ls / Objecter pool nlist)."""
+        pool = self.osdmap.pools[pool_id]
+        names: list[str] = []
+        for ps in range(pool.pg_num):
+            pgid = pool.raw_pg_to_pg(
+                __import__("ceph_tpu.osd.osdmap",
+                           fromlist=["pg_t"]).pg_t(pool_id, ps))
+            up, upp, acting, actingp =                 self.osdmap.pg_to_up_acting_osds(pgid)
+            if actingp < 0:
+                continue
+            addr = self.osdmap.osd_addrs.get(actingp)
+            if not addr:
+                continue
+            self._tid += 1
+            fut = asyncio.get_running_loop().create_future()
+            op = _InFlight(self._tid, pool_id, "", [{"op": "pgls"}],
+                           fut)
+            op.target = actingp
+            op.pgid = pgid
+            op.acting = acting
+            self._inflight[self._tid] = op
+            self.msgr.send_to(addr, MOSDOp(
+                tid=op.tid, pool=pool_id, ps=pgid.ps, oid="",
+                snapc=None, ops=op.ops, epoch=self.osdmap.epoch,
+                flags=0), entity_hint="osd.%d" % actingp)
+            try:
+                outs = await asyncio.wait_for(fut, 10.0)
+                names.extend(outs[0].get("names", []))
+            except asyncio.TimeoutError:
+                self._inflight.pop(op.tid, None)
+        return sorted(set(names))
 
     def _send_op(self, op: _InFlight) -> None:
         primary, pgid, acting = self._calc_target(op.pool, op.oid)
@@ -285,6 +321,10 @@ class IoCtx:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "delete"}])
 
+    async def truncate(self, oid: str, length: int) -> None:
+        await self.client.submit_op(self.pool_id, oid, [
+            {"op": "truncate", "length": int(length)}])
+
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "setxattr", "name": name, "value": bytes(value)}])
@@ -293,6 +333,10 @@ class IoCtx:
         outs = await self.client.submit_op(self.pool_id, oid, [
             {"op": "getxattr", "name": name}])
         return outs[0]["value"]
+
+    async def omap_rm(self, oid: str, keys: list[bytes]) -> None:
+        await self.client.submit_op(self.pool_id, oid, [
+            {"op": "omap-rm", "keys": [bytes(k) for k in keys]}])
 
     async def omap_set(self, oid: str, kv: dict) -> None:
         await self.client.submit_op(self.pool_id, oid, [

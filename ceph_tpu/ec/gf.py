@@ -177,6 +177,8 @@ def matmul_u8(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
     m, k = matrix.shape
     n = data.shape[1]
     if n >= 1024:
+        import ctypes
+
         from ..native import lib
 
         L = lib()
@@ -185,11 +187,9 @@ def matmul_u8(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
             dat = np.ascontiguousarray(data, dtype=np.uint8)
             out = np.zeros((m, n), dtype=np.uint8)
             L.gfec_matmul(
-                mat.ctypes.data_as(__import__("ctypes").c_char_p),
-                k, m,
-                dat.ctypes.data_as(__import__("ctypes").c_char_p),
-                out.ctypes.data_as(__import__("ctypes").c_char_p),
-                n)
+                mat.ctypes.data_as(ctypes.c_char_p), k, m,
+                dat.ctypes.data_as(ctypes.c_char_p),
+                out.ctypes.data_as(ctypes.c_char_p), n)
             return out
     out = np.zeros((m, n), dtype=np.uint8)
     for i in range(m):

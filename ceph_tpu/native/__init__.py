@@ -28,11 +28,20 @@ def _build(src: str, out: str) -> bool:
                 flags.append("-mavx2")
     except OSError:
         pass
+    # build to a process-unique temp and rename into place: concurrent
+    # processes racing the first compile must never dlopen a
+    # half-written .so
+    tmp = "%s.%d.tmp" % (out, os.getpid())
     try:
-        subprocess.run(["gcc", *flags, src, "-o", out], check=True,
+        subprocess.run(["gcc", *flags, src, "-o", tmp], check=True,
                        capture_output=True, timeout=120)
+        os.replace(tmp, out)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

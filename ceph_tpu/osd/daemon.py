@@ -782,6 +782,15 @@ class OSD:
                         pg.cid, ho, op["name"])})
                 elif name == "omap-get":
                     outs.append({"kv": self.store.omap_get(pg.cid, ho)})
+                elif name == "pgls":
+                    # PG object listing (the rados ls / pool
+                    # enumeration primitive, PrimaryLogPG do_pg_op
+                    # CEPH_OSD_OP_PGNLS)
+                    names = sorted(
+                        h.name for h in
+                        self.store.collection_list(pg.cid)
+                        if h.name != "__pgmeta__")
+                    outs.append({"names": names})
                 else:
                     outs.append({"error": "bad op %s" % name})
                     result = -22
@@ -818,14 +827,22 @@ class OSD:
                 t.write(pg.cid, ho, 0, len(data), data)
                 outs.append({})
             elif name == "delete":
-                t.remove(pg.cid, ho)
-                is_delete = True
-                outs.append({})
+                if self.store.exists(pg.cid, ho):
+                    t.remove(pg.cid, ho)
+                    is_delete = True
+                    outs.append({})
+                else:
+                    outs.append({"error": "not found"})
+                    result = -2
             elif name == "truncate":
                 t.truncate(pg.cid, ho, op["length"])
                 outs.append({})
             elif name == "setxattr":
                 t.setattr(pg.cid, ho, op["name"], op["value"])
+                outs.append({})
+            elif name == "omap-rm":
+                t.omap_rmkeys(pg.cid, ho,
+                              [bytes(k) for k in op["keys"]])
                 outs.append({})
             elif name == "omap-set":
                 t.omap_setkeys(pg.cid, ho, op["kv"])
@@ -958,4 +975,4 @@ class OSD:
 
 
 _WRITE_OPS = {"write", "writefull", "delete", "truncate", "setxattr",
-              "omap-set"}
+              "omap-set", "omap-rm"}

@@ -173,6 +173,12 @@ class ECPGBackend:
                                      if ln else data[off:]})
                     else:
                         outs.append({"size": len(data)})
+                elif name == "pgls":
+                    names = sorted(
+                        h.name for h in
+                        self.osd.store.collection_list(pg.cid)
+                        if h.name != "__pgmeta__")
+                    outs.append({"names": names})
                 elif name == "getxattr":
                     val = await self._fetch_xattr(pg, msg.oid,
                                                   op["name"])
@@ -223,6 +229,16 @@ class ECPGBackend:
                     current = current[:ln]
                 outs.append({})
             elif name == "delete":
+                # existence gate (mirrors the replicated path): a
+                # delete of a never-written object must return -2, not
+                # append a spurious DELETE log entry
+                probe, _v = await self.read_object(pg, msg.oid)
+                if probe is None:
+                    conn.send(MOSDOpReply(
+                        tid=msg.tid, result=-2,
+                        outs=[{"error": "not found"}],
+                        epoch=epoch, version=0))
+                    return
                 is_delete = True
                 current = None
                 loaded = True

@@ -1,0 +1,1 @@
+"""Services on RADOS (the reference's L7): RBD-lite block images."""

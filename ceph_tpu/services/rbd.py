@@ -1,0 +1,197 @@
+"""RBD-lite: block images striped over RADOS objects.
+
+Condensed analog of src/librbd (ImageCtx + the io/ dispatch layers)
+over the striper: an image is a header object
+(`rbd_header.<name>`: size + layout xattrs, the role rbd_header's
+omap plays) plus data objects `rbd_data.<name>.<objectno>` addressed
+by Striper::file_to_extents — the same object-map shape librbd uses
+(`rbd_data.<image id>.<object no>`).  Reads of unwritten extents
+return zeros (sparse images); writes allocate objects on demand.
+
+Surface: RBD.create/remove/list/open -> Image.read/write/size/resize/
+flatten-free sparse semantics.  Snapshots/clones/journaling are out of
+this slice (SURVEY build plan step 9: "thin block layer as first
+consumer")."""
+
+from __future__ import annotations
+
+from ..client.striper import FileLayout, file_to_extents
+
+HEADER_PREFIX = "rbd_header."
+DATA_PREFIX = "rbd_data."
+DIR_OID = "rbd_directory"
+SIZE_XATTR = "rbd.size"
+LAYOUT_XATTR = "rbd.layout"
+
+
+class RBDError(Exception):
+    pass
+
+
+class RBD:
+    """Pool-level image operations (librbd::RBD)."""
+
+    def __init__(self, ioctx):
+        self.io = ioctx
+
+    async def create(self, name: str, size: int,
+                     layout: FileLayout | None = None) -> None:
+        layout = layout or FileLayout(stripe_unit=1 << 22,
+                                      stripe_count=1,
+                                      object_size=1 << 22)
+        hdr = HEADER_PREFIX + name
+        try:
+            await self.io.stat(hdr)
+            raise RBDError("image %r exists" % name)
+        except RBDError:
+            raise
+        except Exception:
+            pass
+        await self.io.write_full(hdr, b"")
+        await self.io.setxattr(hdr, SIZE_XATTR, b"%d" % size)
+        await self.io.setxattr(hdr, LAYOUT_XATTR, layout.encode())
+        # image directory: one omap row per image (rbd_directory)
+        await self.io.omap_set(DIR_OID, {name.encode(): b"1"})
+
+    async def list(self) -> list[str]:
+        try:
+            kv = await self.io.omap_get(DIR_OID)
+        except Exception:
+            return []
+        return sorted(k.decode() for k in kv)
+
+    async def remove(self, name: str) -> None:
+        img = await self.open(name)
+        exts = file_to_extents(img.layout, 0, max(img._size, 1))
+        import asyncio
+
+        async def rm(o):
+            try:
+                await self.io.remove(img._data_name(o))
+            except Exception:
+                pass
+
+        await asyncio.gather(*[rm(o) for o in
+                               {e[0] for e in exts}])
+        try:
+            await self.io.remove(HEADER_PREFIX + name)
+        except Exception:
+            pass
+        await self.io.omap_rm(DIR_OID, [name.encode()])
+
+    async def open(self, name: str) -> "Image":
+        hdr = HEADER_PREFIX + name
+        try:
+            size = int(await self.io.getxattr(hdr, SIZE_XATTR))
+            layout = FileLayout.decode(
+                await self.io.getxattr(hdr, LAYOUT_XATTR))
+        except Exception:
+            raise RBDError("image %r does not exist" % name)
+        return Image(self.io, name, size, layout)
+
+
+class Image:
+    """One open image (librbd::Image): offset/length block I/O."""
+
+    def __init__(self, ioctx, name: str, size: int,
+                 layout: FileLayout):
+        self.io = ioctx
+        self.name = name
+        self._size = size
+        self.layout = layout
+
+    def _data_name(self, objectno: int) -> str:
+        return "%s%s.%016x" % (DATA_PREFIX, self.name, objectno)
+
+    def size(self) -> int:
+        return self._size
+
+    async def resize(self, new_size: int) -> None:
+        if new_size < self._size:
+            # librbd shrink: drop whole objects past the new end AND
+            # truncate the boundary object — a stale tail would
+            # resurface as old data after a later grow (sparse reads
+            # must see zeros)
+            import asyncio
+
+            old = file_to_extents(self.layout, new_size,
+                                  self._size - new_size)
+            keep = ({e[0] for e in
+                     file_to_extents(self.layout, 0, new_size)}
+                    if new_size > 0 else set())
+
+            async def rm(o):
+                try:
+                    await self.io.remove(self._data_name(o))
+                except Exception:
+                    pass
+
+            await asyncio.gather(*[
+                rm(o) for o in {e[0] for e in old} - keep])
+            for o, oo, _ln, fo in old:
+                if o in keep and fo == new_size:
+                    try:
+                        await self.io.truncate(self._data_name(o), oo)
+                    except Exception:
+                        pass
+                    break
+        self._size = new_size
+        await self.io.setxattr(HEADER_PREFIX + self.name, SIZE_XATTR,
+                               b"%d" % new_size)
+
+    async def write(self, offset: int, data: bytes) -> None:
+        if offset + len(data) > self._size:
+            raise RBDError("write past image end (%d > %d)"
+                           % (offset + len(data), self._size))
+        import asyncio
+
+        exts = file_to_extents(self.layout, offset, len(data))
+        await asyncio.gather(*[
+            self.io.write(self._data_name(o),
+                          data[fo - offset:fo - offset + ln], oo)
+            for o, oo, ln, fo in exts])
+
+    async def read(self, offset: int, length: int) -> bytes:
+        length = max(0, min(length, self._size - offset))
+        if length == 0:
+            return b""
+        import asyncio
+
+        exts = file_to_extents(self.layout, offset, length)
+
+        async def fetch(o, oo, ln):
+            try:
+                return await self.io.read(self._data_name(o), ln, oo)
+            except Exception:
+                return b""     # unwritten extent: sparse zeros
+
+        parts = await asyncio.gather(*[fetch(o, oo, ln)
+                                       for o, oo, ln, _fo in exts])
+        buf = bytearray(length)
+        for (o, oo, ln, fo), part in zip(exts, parts):
+            part = part[:ln]
+            buf[fo - offset:fo - offset + len(part)] = part
+        return bytes(buf)
+
+    async def discard(self, offset: int, length: int) -> None:
+        """Zero a range by dropping fully-covered objects and zeroing
+        partial ones (librbd discard)."""
+        import asyncio
+
+        exts = file_to_extents(self.layout, offset, length)
+        full, partial = [], []
+        osz = self.layout.object_size
+        for o, oo, ln, fo in exts:
+            (full if (oo == 0 and ln == osz) else partial).append(
+                (o, oo, ln))
+
+        async def rm(o):
+            try:
+                await self.io.remove(self._data_name(o))
+            except Exception:
+                pass
+
+        await asyncio.gather(*[rm(o) for o, _oo, _ln in full])
+        await asyncio.gather(*[
+            self.io.write(self._data_name(o), b"\0" * ln, oo)
+            for o, oo, ln in partial])

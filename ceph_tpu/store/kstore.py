@@ -134,7 +134,7 @@ class KStore(ObjectStore):
                 elif facet[:1] == b"x":
                     obj.xattrs[_unesc(facet[1:]).decode()] = v
                 elif facet[:1] == b"m":
-                    obj.omap[_unesc(facet[1:]).decode()] = v
+                    obj.omap[_unesc(facet[1:])] = v
                 continue
             if not k.endswith(b"\x00a"):
                 raise ValueError("kstore: orphan facet key %r" % (k,))
@@ -230,7 +230,8 @@ class KStore(ObjectStore):
         for name, val in o.xattrs.items():
             batch.set(base + b"x" + _esc(name.encode()), val)
         for key, val in o.omap.items():
-            batch.set(base + b"m" + _esc(key.encode()), val)
+            kb = key if isinstance(key, bytes) else key.encode()
+            batch.set(base + b"m" + _esc(kb), val)
 
     # -- reads: delegate to the mirror ------------------------------------
 

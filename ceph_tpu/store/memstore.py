@@ -158,10 +158,12 @@ class MemStore(ObjectStore):
                 else:
                     o.data.extend(b"\x00" * (length - len(o.data)))
             elif code == OP_REMOVE:
+                # idempotent: a replica may apply a replicated delete
+                # for an object it never held (sparse images, races
+                # with recovery) — the primary existence-gates the
+                # client-visible ENOENT
                 _, cid, oid = op
-                c = self._coll(cid)
-                if c.objects.pop(oid, None) is None:
-                    raise NotFound("object %s/%s" % (cid, oid))
+                self._coll(cid).objects.pop(oid, None)
             elif code == OP_SETATTR:
                 _, cid, oid, name, val = op
                 self._obj(cid, oid, create=True).xattrs[name] = val

@@ -229,12 +229,18 @@ class Transaction:
         self.ops.append((OP_OMAP_CLEAR, cid, oid))
 
     def omap_setkeys(self, cid: coll_t, oid: hobject_t, kv: dict):
+        # keys normalize to bytes here so MemStore and KStore agree
+        # across remounts (a str key would silently change type after
+        # a KStore reload)
         self.ops.append((OP_OMAP_SETKEYS, cid, oid,
-                         {k: bytes(v) for k, v in kv.items()}))
+                         {(k if isinstance(k, bytes) else k.encode()):
+                          bytes(v) for k, v in kv.items()}))
 
     def omap_rmkeys(self, cid: coll_t, oid: hobject_t,
-                    keys: Iterable[str]):
-        self.ops.append((OP_OMAP_RMKEYS, cid, oid, list(keys)))
+                    keys: Iterable):
+        self.ops.append((OP_OMAP_RMKEYS, cid, oid,
+                         [k if isinstance(k, bytes) else k.encode()
+                          for k in keys]))
 
     def omap_rmkeyrange(self, cid: coll_t, oid: hobject_t,
                         first: str, last: str):

@@ -1,0 +1,78 @@
+"""RBD-lite block images over a live cluster (librbd analog)."""
+
+import numpy as np
+
+from ceph_tpu.client.striper import FileLayout
+from ceph_tpu.services.rbd import RBD, RBDError
+from tests.test_cluster import Cluster, run
+
+
+def test_rbd_image_lifecycle_and_io():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await c.client.mon_command("osd pool create", pool="rbd",
+                                       pg_num=8)
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(
+                next(p.id for p in c.client.osdmap.pools.values()
+                     if p.name == "rbd"))
+            rbd = RBD(c.client.io_ctx("rbd"))
+            layout = FileLayout(stripe_unit=4096, stripe_count=2,
+                                object_size=16384)
+            await rbd.create("disk0", 1 << 20, layout)
+            await rbd.create("disk1", 1 << 16, layout)
+            assert await rbd.list() == ["disk0", "disk1"]
+            img = await rbd.open("disk0")
+            assert img.size() == 1 << 20
+
+            # sparse read of an unwritten image is zeros
+            assert await img.read(0, 8192) == b"\0" * 8192
+
+            rng = np.random.default_rng(4)
+            blob = rng.integers(0, 256, 200_000,
+                                dtype=np.uint8).tobytes()
+            await img.write(12345, blob)
+            assert await img.read(12345, len(blob)) == blob
+            # pre/post gap still zero
+            assert await img.read(12000, 345) == b"\0" * 345
+
+            # overwrite a sub-range crossing object boundaries
+            await img.write(16000, b"Q" * 40000)
+            want = bytearray(b"\0" * (1 << 20))
+            want[12345:12345 + len(blob)] = blob
+            want[16000:16000 + 40000] = b"Q" * 40000
+            got = await img.read(0, 1 << 20)
+            assert got == bytes(want)
+
+            # writes past the end are rejected
+            try:
+                await img.write((1 << 20) - 10, b"x" * 20)
+                assert False, "expected RBDError"
+            except RBDError:
+                pass
+
+            # discard zeroes a range
+            await img.discard(16000, 40000)
+            want[16000:16000 + 40000] = b"\0" * 40000
+            assert await img.read(0, 1 << 20) == bytes(want)
+
+            # shrink resize drops tail objects; grow extends sparsely
+            await img.resize(1 << 16)
+            assert img.size() == 1 << 16
+            img2 = await rbd.open("disk0")
+            assert img2.size() == 1 << 16
+            await img2.resize(1 << 21)
+            assert await img2.read((1 << 20), 4096) == b"\0" * 4096
+
+            await rbd.remove("disk1")
+            assert await rbd.list() == ["disk0"]
+            try:
+                await rbd.open("disk1")
+                assert False, "expected RBDError"
+            except RBDError:
+                pass
+        finally:
+            await c.stop()
+
+    run(main(), timeout=120)

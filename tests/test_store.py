@@ -124,19 +124,19 @@ class TestBasics:
         t = Transaction()
         t.touch(CID, oid)
         t.omap_setheader(CID, oid, b"hdr")
-        t.omap_setkeys(CID, oid, {"b": b"2", "a": b"1", "c": b"3"})
+        t.omap_setkeys(CID, oid, {b"b": b"2", b"a": b"1", b"c": b"3"})
         store.apply_transaction(t)
         assert store.omap_get_header(CID, oid) == b"hdr"
-        assert list(store.omap_get(CID, oid)) == ["a", "b", "c"]
-        assert store.omap_get_values(CID, oid, ["a", "zz"]) == {"a": b"1"}
+        assert list(store.omap_get(CID, oid)) == [b"a", b"b", b"c"]
+        assert store.omap_get_values(CID, oid, [b"a", b"zz"]) == {b"a": b"1"}
         t = Transaction()
-        t.omap_rmkeys(CID, oid, ["a"])
+        t.omap_rmkeys(CID, oid, [b"a"])
         store.apply_transaction(t)
-        assert "a" not in store.omap_get(CID, oid)
+        assert b"a" not in store.omap_get(CID, oid)
         t = Transaction()
-        t.omap_rmkeyrange(CID, oid, "b", "c")
+        t.omap_rmkeyrange(CID, oid, b"b", b"c")
         store.apply_transaction(t)
-        assert list(store.omap_get(CID, oid)) == ["c"]
+        assert list(store.omap_get(CID, oid)) == [b"c"]
 
     def test_clone(self, store):
         _mkcoll(store)
@@ -145,14 +145,14 @@ class TestBasics:
         t = Transaction()
         t.write(CID, a, 0, 4, b"data")
         t.setattr(CID, a, "_", b"x")
-        t.omap_setkeys(CID, a, {"k": b"v"})
+        t.omap_setkeys(CID, a, {b"k": b"v"})
         t.clone(CID, a, b)
         t.write(CID, a, 0, 4, b"DATA")
         store.apply_transaction(t)
         assert store.read(CID, b) == b"data"
         assert store.read(CID, a) == b"DATA"
         assert store.getattr(CID, b, "_") == b"x"
-        assert store.omap_get(CID, b) == {"k": b"v"}
+        assert store.omap_get(CID, b) == {b"k": b"v"}
 
     def test_collection_list_order_and_range(self, store):
         _mkcoll(store)
@@ -217,7 +217,7 @@ class TestKStoreDurability:
         t = Transaction()
         t.write(CID, oid, 0, 4, b"keep")
         t.setattr(CID, oid, "_", b"meta")
-        t.omap_setkeys(CID, oid, {"log.1": b"e1"})
+        t.omap_setkeys(CID, oid, {b"log.1": b"e1"})
         t.omap_setheader(CID, oid, b"H")
         s.apply_transaction(t)
         s.umount()
@@ -226,7 +226,7 @@ class TestKStoreDurability:
         s2.mount()
         assert s2.read(CID, oid) == b"keep"
         assert s2.getattr(CID, oid, "_") == b"meta"
-        assert s2.omap_get(CID, oid) == {"log.1": b"e1"}
+        assert s2.omap_get(CID, oid) == {b"log.1": b"e1"}
         assert s2.omap_get_header(CID, oid) == b"H"
         assert s2.collection_list(CID) == [oid]
         s2.umount()
