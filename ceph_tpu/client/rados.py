@@ -17,7 +17,8 @@ import asyncio
 
 from ..msg import Messenger
 from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
-                            MOSDMapMsg, MOSDOp, MOSDOpReply)
+                            MOSDMapMsg, MOSDOp, MOSDOpReply,
+                            MWatchNotify)
 from ..osd.osdmap import OSDMap, consume_map_payload, pg_t
 from ..utils.context import Context
 
@@ -67,6 +68,8 @@ class RadosClient:
         self._tid = 0
         self._inflight: dict[int, _InFlight] = {}
         self._cmd_futures: dict[int, asyncio.Future] = {}
+        # (pool, oid) -> callback(payload); re-registered on map change
+        self._watch_cbs: dict[tuple, object] = {}
 
     @property
     def mon_addr(self) -> str:
@@ -113,6 +116,18 @@ class RadosClient:
             fut = self._cmd_futures.pop(msg.tid, None)
             if fut is not None and not fut.done():
                 fut.set_result((msg.result, msg.out))
+        elif isinstance(msg, MWatchNotify):
+            cb = self._watch_cbs.get((msg.pool, msg.oid))
+            if cb is not None:
+                try:
+                    cb(bytes(msg.payload or b""))
+                except Exception:
+                    pass
+            # ack so the notifier completes
+            conn.send(MWatchNotify(pool=msg.pool, ps=msg.ps,
+                                   oid=msg.oid,
+                                   notify_id=msg.notify_id,
+                                   payload=None, ack=True))
         else:
             return False
         return True
@@ -130,6 +145,10 @@ class RadosClient:
             self.msgr.send_to(self.mon_addr,
                               MMonSubscribe(start=self.osdmap.epoch + 1),
                               entity_hint="mon.0")
+        else:
+            # an OSD session reset dropped our in-memory watches on
+            # that primary even if the map is unchanged: re-register
+            self._rewatch()
         self._scan_requests()
 
     # -- maps --------------------------------------------------------------
@@ -142,6 +161,14 @@ class RadosClient:
         self._map_event.set()
         if changed and self.osdmap.epoch > 0:
             self._scan_requests()
+            self._rewatch()
+
+    def _rewatch(self) -> None:
+        """Re-register every watch after a map change: a primary
+        migration dropped the in-memory registration on the old
+        primary (librados notify_resend / re-watch behavior)."""
+        for (pool_id, oid) in list(self._watch_cbs):
+            self.submit_op(pool_id, oid, [{"op": "watch"}])
 
     def _scan_requests(self) -> None:
         """Re-target in-flight ops; resend those whose interval changed
@@ -324,6 +351,29 @@ class IoCtx:
     async def truncate(self, oid: str, length: int) -> None:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "truncate", "length": int(length)}])
+
+    async def watch(self, oid: str, callback) -> None:
+        """Register interest: callback(payload) runs on every notify
+        (librados watch2).  The callback registers only after the
+        primary accepted the watch — a failed op (e.g. unsupported
+        pool type) must not leave a resend-forever stale entry."""
+        await self.client.submit_op(self.pool_id, oid,
+                                    [{"op": "watch"}])
+        self.client._watch_cbs[(self.pool_id, oid)] = callback
+
+    async def unwatch(self, oid: str) -> None:
+        self.client._watch_cbs.pop((self.pool_id, oid), None)
+        await self.client.submit_op(self.pool_id, oid,
+                                    [{"op": "unwatch"}])
+
+    async def notify(self, oid: str, payload: bytes = b"",
+                     timeout: float = 5.0) -> int:
+        """Deliver payload to every watcher; returns acked count
+        (librados notify2)."""
+        outs = await self.client.submit_op(self.pool_id, oid, [
+            {"op": "notify", "payload": bytes(payload),
+             "timeout": timeout}])
+        return outs[0]["acked"]
 
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         await self.client.submit_op(self.pool_id, oid, [

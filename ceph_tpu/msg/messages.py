@@ -134,6 +134,14 @@ class MOSDOpReply(Message):
     FIELDS = ("tid", "result", "outs", "epoch", "version")
 
 
+@register
+class MWatchNotify(Message):
+    """OSD -> watching client: a notify fired on a watched object
+    (MWatchNotify.h); the client acks by replying with ack=True."""
+    TYPE = "watch_notify"
+    FIELDS = ("pool", "ps", "oid", "notify_id", "payload", "ack")
+
+
 # -- osd <-> osd (replication / peering / recovery) ------------------------
 
 

@@ -39,7 +39,7 @@ from ..msg.messages import (MMonSubscribe, MOSDAlive, MOSDBoot,
                             MOSDOpReply, MOSDPGLog, MOSDPGPush,
                             MOSDPGPushReply, MOSDPGQuery, MOSDPing,
                             MOSDRepOp, MOSDRepOpReply, MOSDRepScrub,
-                            MOSDRepScrubMap)
+                            MOSDRepScrubMap, MWatchNotify)
 from ..models.crushmap import ITEM_NONE
 from ..store.memstore import MemStore
 from ..store.objectstore import (NotFound, ObjectStore, Transaction,
@@ -69,9 +69,11 @@ class OSD:
         self.msgr.add_dispatcher(self)
         from .ecbackend import ECPGBackend
         from .scrubber import Scrubber
+        from .watch import WatchRegistry
 
         self.ec = ECPGBackend(self)
         self.scrubber = Scrubber(self)
+        self.watches = WatchRegistry(self)
         # epoch-0 empty map is the universal incremental base
         self.osdmap: OSDMap = OSDMap()
         self.pgs: dict[pg_t, PG] = {}
@@ -140,6 +142,7 @@ class OSD:
     def ms_handle_reset(self, conn) -> None:
         """A lossy fault on the monitor link drops our subscription on
         the mon side: re-subscribe from our current epoch."""
+        self.watches.conn_reset(conn)
         if conn.peer_addr in self.mon_addrs and not self.stopping:
             if conn.peer_addr == self.mon_addr:
                 self._mon_i = (self._mon_i + 1) % len(self.mon_addrs)
@@ -166,6 +169,8 @@ class OSD:
             self._handle_pg_push_reply(msg)
         elif isinstance(msg, MOSDPing):
             self._handle_ping(conn, msg)
+        elif isinstance(msg, MWatchNotify):
+            self.watches.handle_ack(conn, msg)
         elif isinstance(msg, MOSDRepScrub):
             self.scrubber.handle_rep_scrub(conn, msg)
         elif isinstance(msg, MOSDRepScrubMap):
@@ -272,6 +277,9 @@ class OSD:
             return
         pg.info.same_interval_since = self.osdmap.epoch
         pg.in_flight.clear()
+        # registrations die with the interval; clients re-watch at the
+        # new primary when they see the map change
+        self.watches.pg_reset(pg.pool_id, pg.ps)
         pool = self.osdmap.pools.get(pg.pool_id)
         if pool is not None and pool.is_erasure():
             # a reshuffled acting set can leave this osd holding bytes
@@ -732,6 +740,10 @@ class OSD:
         if not self._min_size_ok(pg, pool):
             pg.waiting_for_active.append((conn, msg))
             return
+        if any(o["op"] in ("watch", "unwatch", "notify")
+               for o in msg.ops):
+            self.msgr.spawn(self._handle_watch_ops(pg, conn, msg))
+            return
         oid = msg.oid
         if oid in pg.missing:
             pg.waiting_for_active.append((conn, msg))
@@ -744,6 +756,30 @@ class OSD:
             conn.send(MOSDOpReply(tid=msg.tid, result=result,
                                   outs=outs, epoch=self.osdmap.epoch,
                                   version=0))
+
+    async def _handle_watch_ops(self, pg: PG, conn, msg) -> None:
+        """watch/unwatch/notify ops (PrimaryLogPG do_osd_ops
+        CEPH_OSD_OP_WATCH / NOTIFY)."""
+        outs = []
+        result = 0
+        for op in msg.ops:
+            name = op["op"]
+            if name == "watch":
+                self.watches.watch(pg, msg.oid, conn)
+                outs.append({})
+            elif name == "unwatch":
+                self.watches.unwatch(pg, msg.oid, conn)
+                outs.append({})
+            elif name == "notify":
+                acked = await self.watches.notify(
+                    pg, msg.oid, bytes(op.get("payload") or b""),
+                    timeout=float(op.get("timeout", 5.0)))
+                outs.append({"acked": acked})
+            else:
+                outs.append({"error": "bad op %s" % name})
+                result = -22
+        conn.send(MOSDOpReply(tid=msg.tid, result=result, outs=outs,
+                              epoch=self.osdmap.epoch, version=0))
 
     def _min_size_ok(self, pg: PG, pool) -> bool:
         """min_size gating for ALL I/O (PeeringState is_active checks:

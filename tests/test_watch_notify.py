@@ -1,0 +1,101 @@
+"""Watch/notify over a live cluster (Watch.cc / librados watch2+notify2
+analog), including re-watch across a primary migration."""
+
+import asyncio
+
+from ceph_tpu.client.rados import RadosClient
+from tests.test_cluster import FAST_CONF, Cluster, run
+from ceph_tpu.utils.context import Context
+
+
+def test_watch_notify_roundtrip():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await c.client.mon_command("osd pool create", pool="wn",
+                                       pg_num=8)
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(
+                next(p.id for p in c.client.osdmap.pools.values()
+                     if p.name == "wn"))
+            io = c.client.io_ctx("wn")
+            await io.write_full("bell", b"x")
+
+            # a second client watches
+            other = RadosClient(c.mon.addr, Context("client.1"),
+                                name="client.1")
+            await other.connect()
+            io2 = other.io_ctx("wn")
+            got = []
+            ev = asyncio.Event()
+
+            def on_notify(payload):
+                got.append(payload)
+                ev.set()
+
+            await io2.watch("bell", on_notify)
+            # the first client ALSO watches: both get the event and
+            # the notifier counts both acks
+            got1 = []
+            await io.watch("bell", lambda p: got1.append(p))
+            acked = await io.notify("bell", b"ding")
+            assert acked == 2
+            await asyncio.wait_for(ev.wait(), 5)
+            assert got == [b"ding"] and got1 == [b"ding"]
+
+            # unwatch drops delivery
+            await io2.unwatch("bell")
+            acked = await io.notify("bell", b"dong")
+            assert acked == 1
+            assert got == [b"ding"]
+
+            # notify with no watchers completes with 0
+            await io.unwatch("bell")
+            assert await io.notify("bell", b"silent") == 0
+            await other.shutdown()
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_watch_survives_primary_failover():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="wf", pg_num=8, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("wf")
+            await io.write_full("sig", b"x")
+            got = []
+            ev = asyncio.Event()
+
+            def cb(p):
+                got.append(p)
+                ev.set()
+
+            await io.watch("sig", cb)
+            # kill the watched object's primary
+            from ceph_tpu.osd.osdmap import pg_t
+
+            m = c.client.osdmap
+            pool = m.pools[pid]
+            pgid = pool.raw_pg_to_pg(m.object_locator_to_pg("sig", pid))
+            _up, _upp, _acting, primary = m.pg_to_up_acting_osds(pgid)
+            await c.kill_osd(primary)
+            while c.client.osdmap.is_up(primary):
+                await asyncio.sleep(0.05)
+            await c.wait_health(pid, timeout=30)
+            await asyncio.sleep(0.3)     # rewatch round trip
+            acked = await io.notify("sig", b"after-failover",
+                                    timeout=5.0)
+            assert acked >= 1
+            await asyncio.wait_for(ev.wait(), 5)
+            assert got[-1] == b"after-failover"
+        finally:
+            await c.stop()
+
+    run(main(), timeout=120)
