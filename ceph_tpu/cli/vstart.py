@@ -79,6 +79,14 @@ async def run(args) -> int:
     await client.connect()
     print("cluster up at epoch %d" % client.osdmap.epoch)
 
+    exporter = None
+    if args.exporter_port:
+        from ..utils.exporter import cluster_exporter
+
+        exporter = cluster_exporter(mon.ctx, mon)
+        eaddr = await exporter.start("127.0.0.1", args.exporter_port)
+        print("prometheus exporter at http://%s/metrics" % eaddr)
+
     for name in args.pool or []:
         out = await client.mon_command("osd pool create", pool=name,
                                        pg_num=args.pg_num,
@@ -112,6 +120,8 @@ async def run(args) -> int:
         except (KeyboardInterrupt, asyncio.CancelledError):
             pass
 
+    if exporter is not None:
+        await exporter.stop()
     await client.shutdown()
     for osd in osds:
         await osd.shutdown()
@@ -128,6 +138,8 @@ def main(argv=None) -> int:
     p.add_argument("--pg-num", type=int, default=32)
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--serve", action="store_true")
+    p.add_argument("--exporter-port", type=int, default=0,
+                   help="serve Prometheus metrics on this port")
     args = p.parse_args(argv)
     return asyncio.run(run(args))
 

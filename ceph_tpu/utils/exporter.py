@@ -1,0 +1,124 @@
+"""Prometheus metrics exporter.
+
+Analog of src/exporter/ (the standalone ceph-exporter scraping daemon
+perf counters) + the mgr prometheus module's text surface: an asyncio
+HTTP endpoint rendering the process's PerfCountersCollection — and any
+registered gauge callables (cluster state: osd counts, pg states,
+epoch) — in the Prometheus exposition format.
+
+    exp = PrometheusExporter(ctx)
+    exp.add_gauge("ceph_osd_up", lambda: n_up, "up osds")
+    await exp.start("127.0.0.1", 9283)     # the mgr module's port
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from typing import Callable
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(*parts: str) -> str:
+    return _NAME_RE.sub("_", "_".join(p for p in parts if p))
+
+
+class PrometheusExporter:
+    def __init__(self, ctx, prefix: str = "ceph_tpu"):
+        self.ctx = ctx
+        self.prefix = prefix
+        self._gauges: dict[str, tuple[Callable, str]] = {}
+        self._server: asyncio.AbstractServer | None = None
+
+    def add_gauge(self, name: str, fn: Callable[[], float],
+                  desc: str = "") -> None:
+        self._gauges[name] = (fn, desc)
+
+    def render(self) -> str:
+        """The exposition document (text format 0.0.4)."""
+        lines: list[str] = []
+        for name, (fn, desc) in sorted(self._gauges.items()):
+            try:
+                v = float(fn())
+            except Exception:
+                continue
+            if desc:
+                lines.append("# HELP %s %s" % (name, desc))
+            lines.append("# TYPE %s gauge" % name)
+            lines.append("%s %g" % (name, v))
+        dump = self.ctx.perf.dump()
+        for group, counters in sorted(dump.items()):
+            for cname, val in sorted(counters.items()):
+                base = _metric_name(self.prefix, group, cname)
+                if isinstance(val, dict):
+                    # avg/time counters dump {avgcount, sum, ...}
+                    for sub, sv in sorted(val.items()):
+                        if isinstance(sv, (int, float)):
+                            lines.append("# TYPE %s_%s counter"
+                                         % (base, sub))
+                            lines.append("%s_%s %g" % (base, sub, sv))
+                elif isinstance(val, (int, float)):
+                    lines.append("# TYPE %s counter" % base)
+                    lines.append("%s %g" % (base, val))
+        return "\n".join(lines) + "\n"
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            req = await asyncio.wait_for(reader.readline(), 5.0)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = req.split(b" ")[1] if len(req.split(b" ")) > 1 \
+                else b"/"
+            if path.rstrip(b"/") in (b"", b"/metrics"):
+                body = self.render().encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: text/plain; version=0.0.4\r\n"
+                    b"Content-Length: %d\r\n\r\n" % len(body))
+                writer.write(body)
+            else:
+                writer.write(b"HTTP/1.1 404 Not Found\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> str:
+        self._server = await asyncio.start_server(self._handle, host,
+                                                  port)
+        addr = self._server.sockets[0].getsockname()
+        return "%s:%d" % (addr[0], addr[1])
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+
+def cluster_exporter(ctx, mon) -> PrometheusExporter:
+    """Exporter pre-wired with the mgr prometheus module's core
+    cluster gauges, fed from a monitor's map."""
+    exp = PrometheusExporter(ctx)
+    exp.add_gauge("ceph_osdmap_epoch", lambda: mon.osdmap.epoch,
+                  "current osdmap epoch")
+    exp.add_gauge("ceph_osd_count", lambda: mon.osdmap.max_osd,
+                  "total osds")
+    exp.add_gauge(
+        "ceph_osd_up",
+        lambda: sum(1 for o in range(mon.osdmap.max_osd)
+                    if mon.osdmap.is_up(o)), "up osds")
+    exp.add_gauge(
+        "ceph_osd_in",
+        lambda: sum(1 for o in range(mon.osdmap.max_osd)
+                    if mon.osdmap.is_in(o)), "in osds")
+    exp.add_gauge("ceph_pool_count", lambda: len(mon.osdmap.pools),
+                  "pools")
+    return exp

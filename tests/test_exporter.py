@@ -1,0 +1,54 @@
+"""Prometheus exporter: exposition format over HTTP with cluster
+gauges + process perf counters (src/exporter + mgr prometheus
+analog)."""
+
+import asyncio
+import urllib.request
+
+from ceph_tpu.utils.exporter import cluster_exporter
+from tests.test_cluster import Cluster, run
+
+
+def test_exporter_serves_cluster_metrics():
+    async def main():
+        c = await Cluster(3).start()
+        exp = None
+        try:
+            await c.client.mon_command("osd pool create", pool="pm",
+                                       pg_num=8)
+            exp = cluster_exporter(c.mon.ctx, c.mon)
+            c.mon.ctx.perf.create("test_grp").add_u64("hits")
+            c.mon.ctx.perf.create("test_grp").inc("hits", 7)
+            addr = await exp.start("127.0.0.1", 0)
+
+            def fetch():
+                with urllib.request.urlopen(
+                        "http://%s/metrics" % addr, timeout=5) as r:
+                    assert r.status == 200
+                    assert "text/plain" in r.headers["Content-Type"]
+                    return r.read().decode()
+
+            body = await asyncio.get_event_loop().run_in_executor(
+                None, fetch)
+            assert "ceph_osd_up 3" in body
+            assert "ceph_osd_count 3" in body
+            assert "ceph_pool_count 1" in body
+            assert "ceph_osdmap_epoch" in body
+            assert "ceph_tpu_test_grp_hits 7" in body
+            # 404 for other paths
+            def fetch404():
+                try:
+                    urllib.request.urlopen(
+                        "http://%s/nope" % addr, timeout=5)
+                except urllib.error.HTTPError as e:
+                    return e.code
+                return 200
+
+            assert await asyncio.get_event_loop().run_in_executor(
+                None, fetch404) == 404
+        finally:
+            if exp is not None:
+                await exp.stop()
+            await c.stop()
+
+    run(main())
