@@ -232,11 +232,12 @@ def bench_decode() -> dict:
 def bench_backend_path() -> dict:
     """Throughput of the exact program the cluster EC write path
     dispatches: ceph_tpu.ec.batcher aggregates concurrent
-    encode_async calls and flushes them through DeviceEncoder
-    (encode_xla — on-device bit-plane unpack + int8 MXU matmul +
-    repack), so this leg times that program on a device-resident
-    batch (the tunnel's ~6 MB/s upload is a harness artifact; a real
-    TPU host feeds HBM over PCIe/NVLink-class links)."""
+    encode_async calls and flushes them through FusedEncoder — the
+    XOR-schedule kernel with the bytes<->planes8 bit transpose fused
+    in VMEM, byte layout in and out, exactly as shards are stored.
+    Timed on a device-resident batch (the tunnel's ~6 MB/s upload is
+    a harness artifact; a real TPU host feeds HBM over PCIe-class
+    links)."""
     import jax
     import jax.numpy as jnp
 
@@ -244,17 +245,18 @@ def bench_backend_path() -> dict:
 
     k, m = 8, 3
     matrix = matrices.isa_rs_vandermonde_matrix(k, m)
-    # the batcher's configuration: pallas tile kernel, VMEM-resident
-    # bit-plane expansion
-    enc = kernels.DeviceEncoder(matrix, 8, use_pallas=True, tile=4096)
+    # the batcher's TPU configuration (batcher._encoder): fused
+    # byte-layout kernel; same tile as batcher picks for k=8,m=3
+    enc = kernels.FusedEncoder(matrix, tile_bytes=262144)
     rng = np.random.default_rng(7)
     N = 32 << 20                      # 32 MiB per chunk row
-    host = rng.integers(0, 256, size=(k, N), dtype=np.uint8)
+    P = N // 4                        # uint32 lanes (byte view)
+    host = rng.integers(0, 2**32, size=(k, P), dtype=np.uint32)
     d0 = jax.device_put(jnp.asarray(host))
-    clone = jax.jit(lambda d: d + jnp.uint8(0))
+    clone = jax.jit(lambda d: d + jnp.uint32(0))
 
     def step_fn(d):
-        parity = enc(d)
+        parity = enc.run32(d)
         return jax.lax.dynamic_update_slice(
             d, parity[0:1, 0:128] ^ d[0:1, 0:128], (0, 0))
 

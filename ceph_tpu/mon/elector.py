@@ -151,8 +151,13 @@ class Elector:
                                            to_rank=src_rank)
                     self._arm_timer()
             else:
-                # outrank them: (re)propose ourselves
-                if self.deferred_to != self.mon.rank:
+                # outrank them: (re)propose ourselves — but only if we
+                # have not already deferred this round (deferred_to is
+                # either None, our own rank, or a better rank we acked;
+                # ElectionLogic ignores worse-ranked proposals after
+                # acking a better one — revoking the defer could hand
+                # two proposers disjoint majorities in the same epoch)
+                if self.deferred_to is None:
                     self.deferred_to = self.mon.rank
                     self._defers = {self.mon.rank}
                     self.mon.send_election(PROPOSE, self.epoch)

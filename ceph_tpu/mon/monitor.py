@@ -185,9 +185,10 @@ class Monitor:
                               entity_hint="mon.%d" % r)
 
     def send_paxos(self, rank: int, op: str, **fields) -> None:
+        epoch = self.elector.epoch if self.elector is not None else 0
         self.msgr.send_to(
             self._rank_addr(rank),
-            MMonPaxos(op=op, rank=self.rank, **fields),
+            MMonPaxos(op=op, rank=self.rank, epoch=epoch, **fields),
             entity_hint="mon.%d" % rank)
 
     def request_catchup(self, rank: int) -> None:
@@ -332,7 +333,7 @@ class Monitor:
                     f: getattr(msg, f)
                     for f in ("pn", "version", "blob",
                               "last_committed", "first_committed",
-                              "lease_until", "uncommitted")})
+                              "lease_until", "uncommitted", "epoch")})
             return True
         if isinstance(msg, (MOSDBoot, MOSDFailure, MOSDAlive))                 and self.multi and not self.is_leader():
             return True   # OSDs broadcast to every mon; leader acts

@@ -220,6 +220,7 @@ class MultiPaxos:
         self.active = False          # leader: recovery done
         self.lease_until = 0.0       # peon: leader's lease
         self._round: PaxosRound | None = None
+        self._reign_pn = 0           # pn latched by OUR collect phase
         self._lease_task = None
         self._lock = asyncio.Lock()
 
@@ -239,6 +240,12 @@ class MultiPaxos:
         async with self._lock:
             pn = self.px._next_pn()
             self.px.store_accepted_pn(pn)
+            # Latch this reign's pn: _begin proposes at exactly this pn
+            # and refuses if a rival collect has moved accepted_pn past
+            # it (Paxos.cc keeps begin at the collect-phase pn; a stale
+            # co-leader re-using a rival's pn could otherwise commit a
+            # different value at the same version — split brain).
+            self._reign_pn = pn
             rnd = PaxosRound(pn)
             rnd.acks.add(self.mon.rank)
             self._round = rnd
@@ -277,7 +284,14 @@ class MultiPaxos:
             return await self._begin(blob)
 
     async def _begin(self, blob: bytes) -> int:
-        pn = self.px.accepted_pn
+        pn = self._reign_pn
+        if self.px.accepted_pn != pn:
+            # a rival leader's collect superseded our reign between our
+            # collect and this begin: abdicate instead of proposing at
+            # a pn we no longer own
+            self.active = False
+            raise IOError("paxos: deposed (accepted_pn %d > reign %d)"
+                          % (self.px.accepted_pn, pn))
         version = self.px.last_committed + 1
         self.px.store_pending(version, pn, blob)
         rnd = PaxosRound(pn, version)
@@ -325,6 +339,23 @@ class MultiPaxos:
                                     blob=blob)
 
     def handle(self, src_rank: int, op: str, f: dict) -> None:
+        # Reign fencing (Paxos.cc checks mon->get_epoch() on every
+        # phase message): drop messages stamped with a stale election
+        # epoch, and leader-authority ops from anyone who is not the
+        # leader we acknowledged — a deposed leader that missed the new
+        # VICTORY cannot push begins/leases at a majority.
+        el = getattr(self.mon, "elector", None)
+        if el is not None:
+            epoch = f.get("epoch") or 0
+            # commit carries an already-chosen value (always safe to
+            # learn); catchup merely requests commits — both pass so a
+            # restarted mon with a stale epoch can still converge
+            if op not in ("commit", "catchup") and epoch < el.epoch:
+                return
+            if op in ("begin", "lease") and epoch == el.epoch \
+                    and el.leader is not None \
+                    and src_rank != el.leader:
+                return
         if op == "collect":
             pn = f["pn"]
             if pn > self.px.accepted_pn:

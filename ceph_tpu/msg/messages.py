@@ -43,7 +43,7 @@ class MMonPaxos(Message):
     commit|lease|catchup."""
     TYPE = "mon_paxos"
     FIELDS = ("op", "rank", "pn", "version", "blob", "last_committed",
-              "first_committed", "lease_until", "uncommitted")
+              "first_committed", "lease_until", "uncommitted", "epoch")
 
 
 # -- monitor <-> anyone ----------------------------------------------------

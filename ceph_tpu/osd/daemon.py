@@ -558,7 +558,11 @@ class OSD:
             pg.info.last_update = last_update
         elif since is not None:
             mine = pg.info.last_update
-            chains = (since == mine
+            # mirror the primary-side _merge_authoritative guard: the
+            # shipped delta only chains if our head is also at or past
+            # the primary's log tail — a replica below the tail has a
+            # gap the delta cannot cover and must take the full path
+            chains = (since == mine and tail <= mine
                       and (not entries
                            or entries[0].prior_version == mine))
             if not chains:

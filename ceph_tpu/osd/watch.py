@@ -68,7 +68,7 @@ class WatchRegistry:
         self._notify_id += 1
         nid = self._notify_id
         ev = asyncio.Event()
-        st = {"waiting": set(watchers), "event": ev}
+        st = {"waiting": set(watchers), "acked": set(), "event": ev}
         self._notifies[nid] = st
         for conn in watchers:
             conn.send(MWatchNotify(pool=pg.pool_id, ps=pg.ps, oid=oid,
@@ -79,12 +79,16 @@ class WatchRegistry:
         except asyncio.TimeoutError:
             pass
         self._notifies.pop(nid, None)
-        return len(watchers) - len(st["waiting"])
+        # count explicit acks only: a watcher whose connection died
+        # mid-notify is a timed-out returnee, not an ack (the reference
+        # reports such watchers in the notify timeout list)
+        return len(st["acked"])
 
     def handle_ack(self, conn, msg: MWatchNotify) -> None:
         st = self._notifies.get(msg.notify_id)
         if st is None:
             return
+        st["acked"].add(conn)
         st["waiting"].discard(conn)
         if not st["waiting"]:
             st["event"].set()
