@@ -4,7 +4,10 @@ Analog of src/tools/rados (rados put/get/ls/rm/stat/df/bench):
 
     python -m ceph_tpu.cli.rados -m HOST:PORT[,HOST:PORT...] \\
         -p POOL put NAME FILE | get NAME FILE | ls | rm NAME \\
-        | stat NAME | df | bench SECONDS write [--size N]
+        | stat NAME | df | bench SECONDS write [--size N] \\
+        | mksnap SNAP | rmsnap SNAP | lssnap
+
+    Reads honor -s/--snap SNAPNAME (rados -s, snapshot reads).
 """
 
 from __future__ import annotations
@@ -29,6 +32,32 @@ async def _run(args) -> int:
                      out["epoch"]))
             return 0
         io = client.io_ctx(args.pool)
+        if args.snap:
+            if args.cmd in ("put", "rm", "bench", "mksnap", "rmsnap"):
+                print("error: cannot write with -s (snapshots are "
+                      "read-only)", file=sys.stderr)
+                return 2
+            try:
+                io.set_read_snap(io.snap_lookup(args.snap))
+            except KeyError:
+                print("error: no snapshot %r in pool %r"
+                      % (args.snap, args.pool), file=sys.stderr)
+                return 2
+        if args.cmd == "mksnap":
+            sid = await io.snap_create(args.args[0])
+            print("created pool snapshot %r (snapid %d)"
+                  % (args.args[0], sid))
+            return 0
+        if args.cmd == "rmsnap":
+            await io.snap_remove(args.args[0])
+            print("removed pool snapshot %r" % args.args[0])
+            return 0
+        if args.cmd == "lssnap":
+            snaps = io.snap_list()
+            for sid in sorted(snaps):
+                print("%d\t%s" % (sid, snaps[sid]))
+            print("%d snaps" % len(snaps))
+            return 0
         if args.cmd == "put":
             with open(args.args[1], "rb") as f:
                 data = f.read()
@@ -90,6 +119,8 @@ def main(argv=None) -> int:
     p.add_argument("-m", "--mon", required=True,
                    help="monitor address(es), comma separated")
     p.add_argument("-p", "--pool", default="rbd")
+    p.add_argument("-s", "--snap", default=None,
+                   help="read from this pool snapshot")
     p.add_argument("--size", type=int, default=4096)
     p.add_argument("cmd")
     p.add_argument("args", nargs="*")

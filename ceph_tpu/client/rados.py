@@ -36,9 +36,10 @@ class RadosError(Exception):
 
 class _InFlight:
     __slots__ = ("tid", "pool", "oid", "ops", "future", "target",
-                 "pgid", "acting")
+                 "pgid", "acting", "snapc", "snapid")
 
-    def __init__(self, tid, pool, oid, ops, future):
+    def __init__(self, tid, pool, oid, ops, future, snapc=None,
+                 snapid=None):
         self.tid = tid
         self.pool = pool
         self.oid = oid
@@ -47,6 +48,8 @@ class _InFlight:
         self.target = -1        # osd the op was last sent to
         self.pgid = None
         self.acting: list = []  # acting set at send time
+        self.snapc = snapc      # (seq, [snapids desc]) on writes
+        self.snapid = snapid    # read-from-snapshot id
 
 
 class RadosClient:
@@ -193,11 +196,12 @@ class RadosClient:
             self.osdmap.pg_to_up_acting_osds(pgid)
         return actingp, pgid, acting
 
-    def submit_op(self, pool_id: int, oid: str,
-                  ops: list[dict]) -> asyncio.Future:
+    def submit_op(self, pool_id: int, oid: str, ops: list[dict],
+                  snapc=None, snapid=None) -> asyncio.Future:
         self._tid += 1
         fut = asyncio.get_running_loop().create_future()
-        op = _InFlight(self._tid, pool_id, oid, ops, fut)
+        op = _InFlight(self._tid, pool_id, oid, ops, fut,
+                       snapc=snapc, snapid=snapid)
         self._inflight[self._tid] = op
         self._send_op(op)
         return fut
@@ -248,7 +252,8 @@ class RadosClient:
             return
         self.msgr.send_to(addr, MOSDOp(
             tid=op.tid, pool=op.pool, ps=pgid.ps, oid=op.oid,
-            snapc=None, ops=op.ops, epoch=self.osdmap.epoch, flags=0),
+            snapc=op.snapc, snapid=op.snapid, ops=op.ops,
+            epoch=self.osdmap.epoch, flags=0),
             entity_hint="osd.%d" % primary)
 
     def _handle_reply(self, msg: MOSDOpReply) -> None:
@@ -318,39 +323,123 @@ class RadosClient:
 
 
 class IoCtx:
-    """Per-pool I/O context (librados::IoCtx)."""
+    """Per-pool I/O context (librados::IoCtx).
+
+    Snapshots (librados snap API): writes carry a SnapContext — the
+    pool's implicit one (pool snaps, Objecter::_op_submit) or a
+    selfmanaged one set via set_selfmanaged_snapc; reads honor
+    set_read_snap (IoCtx::snap_set_read)."""
 
     def __init__(self, client: RadosClient, pool_id: int):
         self.client = client
         self.pool_id = pool_id
+        self.read_snap: int | None = None    # snapid reads resolve at
+        self.selfmanaged_snapc: tuple[int, list[int]] | None = None
+
+    def _snapc(self):
+        if self.selfmanaged_snapc is not None:
+            return self.selfmanaged_snapc
+        pool = (self.client.osdmap.pools.get(self.pool_id)
+                if self.client.osdmap else None)
+        if pool is not None and pool.snap_seq:
+            return pool.snap_context()
+        return None
+
+    def set_read_snap(self, snapid: int | None) -> None:
+        """Route subsequent reads to a snapshot (None = head)."""
+        self.read_snap = snapid
+
+    def set_selfmanaged_snapc(self, seq: int,
+                              snaps: list[int] | None) -> None:
+        """Application-managed write SnapContext (librados
+        set_snap_write_context); snaps newest-first."""
+        self.selfmanaged_snapc = ((int(seq),
+                                   sorted(snaps or [], reverse=True))
+                                  if seq else None)
+
+    # -- pool snapshots (mon-managed ids) ---------------------------------
+
+    async def _wait_pool(self, pred, timeout: float = 10.0) -> None:
+        """Wait until the client's map reflects a pool mutation."""
+        t0 = asyncio.get_running_loop().time()
+        while not pred(self.client.osdmap.pools[self.pool_id]):
+            if asyncio.get_running_loop().time() - t0 > timeout:
+                raise TimeoutError("pool snap state never published")
+            await asyncio.sleep(0.02)
+
+    async def snap_create(self, name: str) -> int:
+        pool = self.client.osdmap.pools[self.pool_id]
+        res = await self.client.mon_command("osd pool mksnap",
+                                            pool=pool.name, snap=name)
+        sid = res["snapid"]
+        await self._wait_pool(lambda p: sid in p.snaps)
+        return sid
+
+    async def snap_remove(self, name: str) -> None:
+        pool = self.client.osdmap.pools[self.pool_id]
+        sid = self.snap_lookup(name)
+        await self.client.mon_command("osd pool rmsnap",
+                                      pool=pool.name, snap=name)
+        await self._wait_pool(lambda p: sid not in p.snaps)
+
+    def snap_list(self) -> dict[int, str]:
+        pool = self.client.osdmap.pools[self.pool_id]
+        return dict(pool.snaps)
+
+    def snap_lookup(self, name: str) -> int:
+        for sid, n in self.snap_list().items():
+            if n == name:
+                return sid
+        raise KeyError(name)
+
+    # -- selfmanaged snapshots --------------------------------------------
+
+    async def selfmanaged_snap_create(self) -> int:
+        pool = self.client.osdmap.pools[self.pool_id]
+        res = await self.client.mon_command("osd snap create",
+                                            pool=pool.name)
+        sid = res["snapid"]
+        await self._wait_pool(lambda p: p.snap_seq >= sid)
+        return sid
+
+    async def selfmanaged_snap_remove(self, snapid: int) -> None:
+        pool = self.client.osdmap.pools[self.pool_id]
+        await self.client.mon_command("osd snap rm", pool=pool.name,
+                                      snapid=int(snapid))
+
+    # -- object I/O --------------------------------------------------------
 
     async def write(self, oid: str, data: bytes,
                     offset: int = 0) -> None:
         await self.client.submit_op(self.pool_id, oid, [
-            {"op": "write", "offset": offset, "data": bytes(data)}])
+            {"op": "write", "offset": offset, "data": bytes(data)}],
+            snapc=self._snapc())
 
     async def write_full(self, oid: str, data: bytes) -> None:
         await self.client.submit_op(self.pool_id, oid, [
-            {"op": "writefull", "data": bytes(data)}])
+            {"op": "writefull", "data": bytes(data)}],
+            snapc=self._snapc())
 
     async def read(self, oid: str, length: int = 0,
                    offset: int = 0) -> bytes:
         outs = await self.client.submit_op(self.pool_id, oid, [
-            {"op": "read", "offset": offset, "length": length}])
+            {"op": "read", "offset": offset, "length": length}],
+            snapid=self.read_snap)
         return outs[0]["data"]
 
     async def stat(self, oid: str) -> int:
         outs = await self.client.submit_op(self.pool_id, oid, [
-            {"op": "stat"}])
+            {"op": "stat"}], snapid=self.read_snap)
         return outs[0]["size"]
 
     async def remove(self, oid: str) -> None:
         await self.client.submit_op(self.pool_id, oid, [
-            {"op": "delete"}])
+            {"op": "delete"}], snapc=self._snapc())
 
     async def truncate(self, oid: str, length: int) -> None:
         await self.client.submit_op(self.pool_id, oid, [
-            {"op": "truncate", "length": int(length)}])
+            {"op": "truncate", "length": int(length)}],
+            snapc=self._snapc())
 
     async def watch(self, oid: str, callback) -> None:
         """Register interest: callback(payload) runs on every notify
@@ -381,7 +470,7 @@ class IoCtx:
 
     async def getxattr(self, oid: str, name: str) -> bytes:
         outs = await self.client.submit_op(self.pool_id, oid, [
-            {"op": "getxattr", "name": name}])
+            {"op": "getxattr", "name": name}], snapid=self.read_snap)
         return outs[0]["value"]
 
     async def omap_rm(self, oid: str, keys: list[bytes]) -> None:

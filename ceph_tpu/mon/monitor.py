@@ -563,6 +563,14 @@ class Monitor:
                 self.down_pending_out[osd] = time.monotonic()
                 self._propose_pending()
             return {}
+        if prefix == "osd pool mksnap":
+            return self._cmd_pool_mksnap(cmd)
+        if prefix == "osd pool rmsnap":
+            return self._cmd_pool_rmsnap(cmd)
+        if prefix == "osd snap create":
+            return self._cmd_selfmanaged_snap_create(cmd)
+        if prefix == "osd snap rm":
+            return self._cmd_selfmanaged_snap_rm(cmd)
         if prefix == "status":
             up = sum(1 for o in range(self.osdmap.max_osd)
                      if self.osdmap.is_up(o))
@@ -621,6 +629,81 @@ class Monitor:
         inc.new_pools[pid] = pool
         self._propose_pending()
         return {"pool_id": pid}
+
+    # -- snapshots (OSDMonitor pool snap / selfmanaged snap commands,
+    # src/mon/OSDMonitor.cc prepare_command pool mksnap/rmsnap and
+    # blocked-by-pool-type checks; snapids are pool-global and shared
+    # between pool snaps and selfmanaged snaps, pg_pool_t::snap_seq) --
+
+    def _pool_pending_copy(self, pid: int):
+        """Deep copy of the pool folding in any not-yet-committed
+        pending mutation (two snap creates in one proposal window must
+        not hand out the same snapid)."""
+        import copy
+        base = None
+        if self.pending_inc is not None:
+            base = self.pending_inc.new_pools.get(pid)
+        if base is None:
+            base = self.osdmap.pools[pid]
+        return copy.deepcopy(base)
+
+    def _cmd_pool_mksnap(self, cmd: dict) -> dict:
+        pid = self._pool_id(cmd["pool"])
+        snapname = cmd["snap"]
+        pool = self._pool_pending_copy(pid)
+        if snapname in pool.snaps.values():
+            sid = next(s for s, n in pool.snaps.items()
+                       if n == snapname)
+            return {"snapid": sid}     # idempotent
+        sid = pool.snap_seq + 1
+        pool.snap_seq = sid
+        pool.snaps[sid] = snapname
+        pool.last_change = self.osdmap.epoch + 1
+        inc = self._pending()
+        inc.new_pools[pid] = pool
+        self._propose_pending()
+        return {"snapid": sid}
+
+    def _cmd_pool_rmsnap(self, cmd: dict) -> dict:
+        pid = self._pool_id(cmd["pool"])
+        snapname = cmd["snap"]
+        pool = self._pool_pending_copy(pid)
+        sid = next((s for s, n in pool.snaps.items()
+                    if n == snapname), None)
+        if sid is None:
+            raise ValueError("snap %r does not exist" % snapname)
+        del pool.snaps[sid]
+        pool.removed_snaps.append(sid)
+        pool.last_change = self.osdmap.epoch + 1
+        inc = self._pending()
+        inc.new_pools[pid] = pool
+        self._propose_pending()
+        return {}
+
+    def _cmd_selfmanaged_snap_create(self, cmd: dict) -> dict:
+        pid = self._pool_id(cmd["pool"])
+        pool = self._pool_pending_copy(pid)
+        sid = pool.snap_seq + 1
+        pool.snap_seq = sid
+        pool.last_change = self.osdmap.epoch + 1
+        inc = self._pending()
+        inc.new_pools[pid] = pool
+        self._propose_pending()
+        return {"snapid": sid}
+
+    def _cmd_selfmanaged_snap_rm(self, cmd: dict) -> dict:
+        pid = self._pool_id(cmd["pool"])
+        sid = int(cmd["snapid"])
+        pool = self._pool_pending_copy(pid)
+        if sid in pool.removed_snaps:
+            return {}
+        pool.removed_snaps.append(sid)
+        pool.snaps.pop(sid, None)
+        pool.last_change = self.osdmap.epoch + 1
+        inc = self._pending()
+        inc.new_pools[pid] = pool
+        self._propose_pending()
+        return {}
 
     def _cmd_pool_set(self, cmd: dict) -> dict:
         pid = self._pool_id(cmd["pool"])

@@ -124,8 +124,8 @@ class MOSDOp(Message):
     epoch = client's map epoch for gating."""
 
     TYPE = "osd_op"
-    FIELDS = ("tid", "pool", "ps", "oid", "snapc", "ops", "epoch",
-              "flags")
+    FIELDS = ("tid", "pool", "ps", "oid", "snapc", "snapid", "ops",
+              "epoch", "flags")
 
 
 @register

@@ -68,12 +68,15 @@ class OSD:
         self.msgr.peer_policy["osd"] = Policy.lossless_peer()
         self.msgr.add_dispatcher(self)
         from .ecbackend import ECPGBackend
+        from .scheduler import OpScheduler
         from .scrubber import Scrubber
         from .watch import WatchRegistry
 
         self.ec = ECPGBackend(self)
         self.scrubber = Scrubber(self)
         self.watches = WatchRegistry(self)
+        # sharded mClock op queue (ShardedOpWQ + mClockScheduler)
+        self.sched = OpScheduler(self.ctx)
         # epoch-0 empty map is the universal incremental base
         self.osdmap: OSDMap = OSDMap()
         self.pgs: dict[pg_t, PG] = {}
@@ -91,6 +94,7 @@ class OSD:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self.store.mount()
         addr = await self.msgr.bind(host, port)
+        self.sched.start(self.msgr.spawn)
         self._load_pgs()
         mon = self.msgr.connect_to(self.mon_addr, entity_hint="mon.0")
         mon.send(MMonSubscribe(start=1))
@@ -107,6 +111,7 @@ class OSD:
 
     async def shutdown(self) -> None:
         self.stopping = True
+        self.sched.stop()
         await self.msgr.shutdown()
         self.store.umount()
 
@@ -151,12 +156,27 @@ class OSD:
                               entity_hint="mon.0")
 
     def ms_dispatch(self, conn, msg) -> bool:
+        """Fast paths (map/peering/heartbeat/completion replies) run
+        inline; op-class work (client ops, rep/EC sub-ops, recovery
+        pushes, scrub chunks) goes through the sharded mClock queue
+        (OSD::ms_fast_dispatch -> enqueue_op -> ShardedOpWQ,
+        OSD.cc:7360,9554)."""
+        from .scheduler import K_CLIENT, K_RECOVERY, K_SCRUB
+
+        def q(key, klass, fn):
+            if self.sched.running:
+                self.sched.enqueue(key, klass, fn)
+            else:           # not started (unit-test direct dispatch)
+                fn()
+
         if isinstance(msg, MOSDMapMsg):
             self._handle_osd_map(msg)
         elif isinstance(msg, MOSDOp):
-            self._handle_op(conn, msg)
+            q((msg.pool, msg.ps), K_CLIENT,
+              lambda: self._handle_op(conn, msg))
         elif isinstance(msg, MOSDRepOp):
-            self._handle_repop(conn, msg)
+            q((msg.pool, msg.ps), K_CLIENT,
+              lambda: self._handle_repop(conn, msg))
         elif isinstance(msg, MOSDRepOpReply):
             self._handle_repop_reply(msg)
         elif isinstance(msg, MOSDPGQuery):
@@ -164,7 +184,8 @@ class OSD:
         elif isinstance(msg, MOSDPGLog):
             self._handle_pg_log(conn, msg)
         elif isinstance(msg, MOSDPGPush):
-            self._handle_pg_push(conn, msg)
+            q((msg.pool, msg.ps), K_RECOVERY,
+              lambda: self._handle_pg_push(conn, msg))
         elif isinstance(msg, MOSDPGPushReply):
             self._handle_pg_push_reply(msg)
         elif isinstance(msg, MOSDPing):
@@ -172,15 +193,18 @@ class OSD:
         elif isinstance(msg, MWatchNotify):
             self.watches.handle_ack(conn, msg)
         elif isinstance(msg, MOSDRepScrub):
-            self.scrubber.handle_rep_scrub(conn, msg)
+            q((msg.pool, msg.ps), K_SCRUB,
+              lambda: self.scrubber.handle_rep_scrub(conn, msg))
         elif isinstance(msg, MOSDRepScrubMap):
             self.scrubber.handle_rep_scrub_map(msg)
         elif isinstance(msg, MOSDECSubOpWrite):
-            self.ec.handle_sub_write(conn, msg)
+            q((msg.pool, msg.ps), K_CLIENT,
+              lambda: self.ec.handle_sub_write(conn, msg))
         elif isinstance(msg, MOSDECSubOpWriteReply):
             self.ec.handle_sub_write_reply(msg)
         elif isinstance(msg, MOSDECSubOpRead):
-            self.ec.handle_sub_read(conn, msg)
+            q((msg.pool, msg.ps), K_CLIENT,
+              lambda: self.ec.handle_sub_read(conn, msg))
         elif isinstance(msg, MOSDECSubOpReadReply):
             self.ec.handle_sub_read_reply(msg)
         else:
@@ -274,6 +298,8 @@ class OSD:
             if pg.state == STATE_ACTIVE and pg.waiting_for_active \
                     and pg.is_primary():
                 self._requeue_waiters(pg)
+            # the map may have added removed_snaps: start trimming
+            self._maybe_snap_trim(pg)
             return
         pg.info.same_interval_since = self.osdmap.epoch
         pg.in_flight.clear()
@@ -535,6 +561,7 @@ class OSD:
             "osd", "pg %s active on osd.%d acting=%s missing=%d"
             % (pg.pgid, self.whoami, pg.acting, len(pg.missing)))
         self._kick_recovery(pg)
+        self._maybe_snap_trim(pg)
         if not pg.missing:
             self._requeue_waiters(pg)
 
@@ -602,35 +629,65 @@ class OSD:
         if pool is not None and pool.is_erasure():
             self.msgr.spawn(self._ec_recover(pg))
             return
-        if pg.missing:
-            # pull what the primary lacks from a peer that has it
-            src = None
-            for osd, info in pg.peer_info.items():
-                if not pg.peer_missing.get(osd):
-                    src = osd
-                    break
-            if src is None:
-                for osd in pg.acting:
-                    if 0 <= osd != self.whoami and osd != ITEM_NONE:
+        self.msgr.spawn(self._replicated_recover(pg))
+
+    async def _replicated_recover(self, pg: PG) -> None:
+        """Paced replicated recovery: pull/push in chunks, each chunk
+        admitted through the mClock 'recovery' class so client I/O
+        keeps its reservation during a recovery storm (the reference
+        paces via osd_recovery_max_active + mClock op tags)."""
+        from .scheduler import K_RECOVERY
+        if getattr(pg, "_recovery_flow", False):
+            return
+        pg._recovery_flow = True
+        chunk = 16
+        acting0 = list(pg.acting)
+        try:
+            if pg.missing:
+                # pull what the primary lacks from a peer that has it
+                src = None
+                for osd, info in pg.peer_info.items():
+                    if not pg.peer_missing.get(osd):
                         src = osd
                         break
-            if src is not None:
-                oids = sorted(pg.missing)
-                pg.recovering.update(oids)
-                self._send_osd(src, MOSDPGPush(
-                    pool=pg.pool_id, ps=pg.ps, epoch=self.osdmap.epoch,
-                    pushes=[{"pull": True, "oids": oids}]))
-            return
-        # push to replicas missing objects
-        for osd, missing in list(pg.peer_missing.items()):
-            if not missing:
-                continue
-            pushes = []
-            for oid, op in sorted(missing.items()):
-                pushes.append(self._make_push(pg, oid, op))
-            self._send_osd(osd, MOSDPGPush(
-                pool=pg.pool_id, ps=pg.ps, epoch=self.osdmap.epoch,
-                pushes=pushes))
+                if src is None:
+                    for osd in pg.acting:
+                        if 0 <= osd != self.whoami and osd != ITEM_NONE:
+                            src = osd
+                            break
+                if src is not None:
+                    oids = sorted(pg.missing)
+                    pg.recovering.update(oids)
+                    for i in range(0, len(oids), chunk):
+                        part = oids[i:i + chunk]
+                        await self.sched.admit(
+                            K_RECOVERY, cost=len(part),
+                            key=(pg.pool_id, pg.ps))
+                        if pg.acting != acting0 or self.stopping:
+                            return      # interval changed: re-peer
+                        self._send_osd(src, MOSDPGPush(
+                            pool=pg.pool_id, ps=pg.ps,
+                            epoch=self.osdmap.epoch,
+                            pushes=[{"pull": True, "oids": part}]))
+                return
+            # push to replicas missing objects
+            for osd, missing in list(pg.peer_missing.items()):
+                if not missing:
+                    continue
+                items = sorted(missing.items())
+                for i in range(0, len(items), chunk):
+                    part = items[i:i + chunk]
+                    await self.sched.admit(K_RECOVERY, cost=len(part),
+                                           key=(pg.pool_id, pg.ps))
+                    if pg.acting != acting0 or self.stopping:
+                        return
+                    pushes = [self._make_push(pg, oid, op)
+                              for oid, op in part]
+                    self._send_osd(osd, MOSDPGPush(
+                        pool=pg.pool_id, ps=pg.ps,
+                        epoch=self.osdmap.epoch, pushes=pushes))
+        finally:
+            pg._recovery_flow = False
 
     async def _ec_recover(self, pg: PG) -> None:
         """EC recovery: reconstruct (never copy) shards
@@ -643,10 +700,11 @@ class OSD:
             self._requeue_waiters(pg)
 
     def _make_push(self, pg: PG, oid: str, op: str) -> dict:
+        from . import snaps as snapmod
         ho = hobject_t(oid)
         if op == LogEntry.DELETE or not self.store.exists(pg.cid, ho):
             return {"oid": oid, "delete": True}
-        return {
+        push = {
             "oid": oid,
             "delete": False,
             "data": self.store.read(pg.cid, ho),
@@ -654,6 +712,26 @@ class OSD:
                       self.store.getattrs(pg.cid, ho).items()},
             "omap": self.store.omap_get(pg.cid, ho),
         }
+        # snapshot clones travel with their head so a recovered
+        # replica can serve snap reads (the reference recovers clones
+        # as separate hobjects; whole-object pushes bundle them)
+        ss = snapmod.load_snapset(self.store, pg.cid, ho)
+        if ss and ss["clones"]:
+            clones = []
+            for c in ss["clones"]:
+                cho = hobject_t(oid, snap=c)
+                if not self.store.exists(pg.cid, cho):
+                    continue
+                clones.append({
+                    "snap": c,
+                    "data": self.store.read(pg.cid, cho),
+                    "attrs": {k: v for k, v in
+                              self.store.getattrs(pg.cid,
+                                                  cho).items()},
+                })
+            if clones:
+                push["clones"] = clones
+        return push
 
     def _handle_pg_push(self, conn, msg: MOSDPGPush) -> None:
         pg = self.pgs.get(pg_t(msg.pool, msg.ps))
@@ -669,11 +747,14 @@ class OSD:
             conn.send(MOSDPGPush(pool=msg.pool, ps=msg.ps,
                                  epoch=msg.epoch, pushes=pushes))
             return
-        # real pushes: apply objects
+        # real pushes: apply objects ("snap" targets a clone object —
+        # EC clone-shard recovery)
+        from ..store.objectstore import NOSNAP
         t = Transaction()
         done = []
         for push in msg.pushes:
-            ho = hobject_t(push["oid"])
+            ho = hobject_t(push["oid"],
+                           snap=push.get("snap", NOSNAP))
             if push.get("delete"):
                 if self.store.exists(pg.cid, ho):
                     t.remove(pg.cid, ho)
@@ -686,6 +767,15 @@ class OSD:
                     t.setattr(pg.cid, ho, k, v)
                 if push.get("omap"):
                     t.omap_setkeys(pg.cid, ho, push["omap"])
+                for cl in push.get("clones") or ():
+                    cho = hobject_t(push["oid"], snap=cl["snap"])
+                    if self.store.exists(pg.cid, cho):
+                        t.remove(pg.cid, cho)
+                    t.touch(pg.cid, cho)
+                    t.write(pg.cid, cho, 0, len(cl["data"]),
+                            cl["data"])
+                    for k, v in (cl.get("attrs") or {}).items():
+                        t.setattr(pg.cid, cho, k, v)
             done.append(push["oid"])
             pg.missing.pop(push["oid"], None)
             pg.recovering.discard(push["oid"])
@@ -756,7 +846,8 @@ class OSD:
         if writes:
             self._execute_write(pg, conn, msg)
         else:
-            outs, result = self._do_read_ops(pg, msg.oid, msg.ops)
+            outs, result = self._do_read_ops(
+                pg, msg.oid, msg.ops, getattr(msg, "snapid", None))
             conn.send(MOSDOpReply(tid=msg.tid, result=result,
                                   outs=outs, epoch=self.osdmap.epoch,
                                   version=0))
@@ -803,8 +894,22 @@ class OSD:
         return live >= need
 
     # read-side op interpreter (do_osd_ops read branch)
-    def _do_read_ops(self, pg: PG, oid: str, ops: list):
-        ho = hobject_t(oid)
+    def _do_read_ops(self, pg: PG, oid: str, ops: list,
+                     snapid: int | None = None):
+        from ..store.objectstore import NOSNAP
+        from . import snaps as snapmod
+        if snapid not in (None, NOSNAP):
+            # snapshot read: resolve to the covering clone or the
+            # unmodified head (find_object_context)
+            ho = snapmod.resolve_read_snap(self.store, pg, oid, snapid)
+            if ho is None and any(o["op"] != "pgls" for o in ops):
+                return ([{"error": "not found"}], -2)
+        else:
+            ho = hobject_t(oid)
+            if oid and snapmod.is_whiteout(self.store, pg.cid, ho):
+                ho = None
+                if any(o["op"] != "pgls" for o in ops):
+                    return ([{"error": "not found"}], -2)
         outs = []
         result = 0
         for op in ops:
@@ -825,11 +930,15 @@ class OSD:
                 elif name == "pgls":
                     # PG object listing (the rados ls / pool
                     # enumeration primitive, PrimaryLogPG do_pg_op
-                    # CEPH_OSD_OP_PGNLS)
+                    # CEPH_OSD_OP_PGNLS); clones and whiteout heads
+                    # are invisible to listing (PGNLS lists heads)
+                    from ..store.objectstore import NOSNAP as _NS
                     names = sorted(
                         h.name for h in
                         self.store.collection_list(pg.cid)
-                        if h.name != "__pgmeta__")
+                        if h.name != "__pgmeta__" and h.snap == _NS
+                        and not snapmod.is_whiteout(self.store,
+                                                    pg.cid, h))
                     outs.append({"names": names})
                 else:
                     outs.append({"error": "bad op %s" % name})
@@ -841,13 +950,18 @@ class OSD:
 
     def _execute_write(self, pg: PG, conn, msg: MOSDOp) -> None:
         """prepare_transaction + issue_repop (PrimaryLogPG.cc:8869,
-        11394)."""
+        11394).  Snapshot bookkeeping (make_writeable) runs first so
+        the clone ops ride the same replicated transaction."""
+        from . import snaps as snapmod
         epoch = self.osdmap.epoch
         ver = pg.info.last_update[1] + 1
         version = (epoch, ver)
         ho = hobject_t(msg.oid)
         t = Transaction()
         outs, result = [], 0
+        ss = snapmod.make_writeable(self.store, pg, ho,
+                                    getattr(msg, "snapc", None), t)
+        head_whiteout = snapmod.is_whiteout(self.store, pg.cid, ho)
         is_delete = False
         for op in msg.ops:
             name = op["op"]
@@ -856,20 +970,27 @@ class OSD:
                 off = op.get("offset", 0)
                 if not self.store.exists(pg.cid, ho):
                     t.touch(pg.cid, ho)
+                elif head_whiteout:
+                    # resurrecting a whiteout head: clear the tombstone
+                    t.setattr(pg.cid, ho, snapmod.WHITEOUT_ATTR, b"0")
                 t.write(pg.cid, ho, off, len(data), data)
                 outs.append({})
             elif name == "writefull":
                 data = op["data"]
                 if self.store.exists(pg.cid, ho):
                     t.truncate(pg.cid, ho, 0)
+                    if head_whiteout:
+                        t.setattr(pg.cid, ho, snapmod.WHITEOUT_ATTR,
+                                  b"0")
                 else:
                     t.touch(pg.cid, ho)
                 t.write(pg.cid, ho, 0, len(data), data)
                 outs.append({})
             elif name == "delete":
-                if self.store.exists(pg.cid, ho):
-                    t.remove(pg.cid, ho)
-                    is_delete = True
+                if self.store.exists(pg.cid, ho) and not head_whiteout:
+                    is_delete = snapmod.delete_head(self.store, pg,
+                                                    ho, ss, t)
+                    ss = None          # delete_head persisted it
                     outs.append({})
                 else:
                     outs.append({"error": "not found"})
@@ -897,6 +1018,7 @@ class OSD:
             conn.send(MOSDOpReply(tid=msg.tid, result=result, outs=outs,
                                   epoch=epoch, version=0))
             return
+        snapmod.persist_snapset(pg, ho, ss, t)
         entry = LogEntry(
             LogEntry.DELETE if is_delete else LogEntry.MODIFY,
             msg.oid, version, pg.info.last_update)
@@ -958,9 +1080,117 @@ class OSD:
         st["waiting"].discard(sender)
         if not st["waiting"]:
             del pg.in_flight[msg.tid]
-            st["conn"].send(MOSDOpReply(
-                tid=st["tid"], result=0, outs=st["outs"],
-                epoch=self.osdmap.epoch, version=st["version"]))
+            if st["conn"] is not None:     # internal txns (snap trim)
+                st["conn"].send(MOSDOpReply(
+                    tid=st["tid"], result=0, outs=st["outs"],
+                    epoch=self.osdmap.epoch, version=st["version"]))
+
+    # -- snapshot trim (PrimaryLogPG Trimming / SnapTrimEvent) -------------
+
+    def _maybe_snap_trim(self, pg: PG) -> None:
+        pool = self.osdmap.pools.get(pg.pool_id)
+        if (pool is None or not pool.removed_snaps
+                or not pg.is_primary() or pg.state != STATE_ACTIVE):
+            return
+        self.msgr.spawn(self._snap_trim(pg))
+
+    def _load_purged(self, pg: PG) -> set[int]:
+        from .pg import PGMETA_OID
+        try:
+            raw = self.store.omap_get(pg.cid, PGMETA_OID).get(
+                b"purged_snaps")
+        except Exception:
+            return set()
+        return set(denc.decode(raw)) if raw else set()
+
+    async def _snap_trim(self, pg: PG) -> None:
+        """Walk the SnapMapper rows for each removed-but-unpurged
+        snap; per object, drop the snap from its clone (deleting the
+        clone when its snap set empties) as a replicated, logged
+        transaction — paced through the mClock 'snaptrim' class."""
+        from . import snaps as snapmod
+        from .pg import PGMETA_OID
+        from .scheduler import K_SNAPTRIM
+        if getattr(pg, "_trim_flow", False):
+            return
+        pg._trim_flow = True
+        try:
+            purged = self._load_purged(pg)
+            pool = self.osdmap.pools.get(pg.pool_id)
+            if pool is None:
+                return
+            for sid in [s for s in pool.removed_snaps
+                        if s not in purged]:
+                for oid in snapmod.list_snap_objects(self.store, pg,
+                                                     sid):
+                    await self.sched.admit(K_SNAPTRIM,
+                                           key=(pg.pool_id, pg.ps))
+                    if (not pg.is_primary()
+                            or pg.state != STATE_ACTIVE
+                            or self.stopping):
+                        return
+                    self._submit_trim(pg, oid, sid)
+                purged.add(sid)
+                t = Transaction()
+                t.omap_setkeys(pg.cid, PGMETA_OID, {
+                    b"purged_snaps": denc.encode(sorted(purged))})
+                self.store.apply_transaction(t)
+        finally:
+            pg._trim_flow = False
+
+    def _submit_trim(self, pg: PG, oid: str, sid: int) -> None:
+        """One object's trim as a logged replicated transaction (the
+        same wire path as a client write, no reply connection)."""
+        from . import snaps as snapmod
+        t = Transaction()
+        snapmod.trim_object(self.store, pg, oid, sid, t)
+        epoch = self.osdmap.epoch
+        version = (epoch, pg.info.last_update[1] + 1)
+        entry = LogEntry(LogEntry.MODIFY, oid, version,
+                         pg.info.last_update)
+        pg.info.last_update = version
+        pg.log.append(entry)
+        pool = self.osdmap.pools.get(pg.pool_id)
+        if pool is not None and pool.is_erasure():
+            # EC peers speak the EC sub-write channel; ship the BARE
+            # trim txn (clone removal + snapset attr — identical on
+            # every shard): handle_sub_write appends each shard's own
+            # log/meta rows, matching submit_write's contract
+            bare_wire = denc.encode(t.to_wire())
+            self.ec._tid += 1
+            for j, osd in enumerate(pg.acting):
+                if osd < 0 or osd == self.whoami:
+                    continue
+                self._send_osd(osd, MOSDECSubOpWrite(
+                    pool=pg.pool_id, ps=pg.ps, shard=j,
+                    tid=self.ec._tid, txn=bare_wire,
+                    log_entry=entry.to_wire(), epoch=epoch))
+            pg.persist_log_entry(t, entry)
+            pg.maybe_trim_log(t)
+            pg.persist_meta(t)
+            self.store.apply_transaction(t)
+            return
+        pg.persist_log_entry(t, entry)
+        pg.maybe_trim_log(t)
+        pg.persist_meta(t)
+        txn_wire = denc.encode(t.to_wire())
+        self._rep_tid += 1
+        rep_tid = self._rep_tid
+        waiting = set()
+        for osd in pg.acting:
+            if osd < 0 or osd == self.whoami:
+                continue
+            waiting.add(osd)
+            self._send_osd(osd, MOSDRepOp(
+                pool=pg.pool_id, ps=pg.ps, tid=rep_tid, txn=txn_wire,
+                log_entry=entry.to_wire(), epoch=epoch,
+                min_epoch=pg.info.same_interval_since,
+                pg_trim_to=None))
+        self.store.apply_transaction(t)
+        if waiting:
+            pg.in_flight[rep_tid] = {
+                "waiting": waiting, "conn": None, "tid": 0,
+                "outs": [], "version": version[1]}
 
     # -- heartbeats --------------------------------------------------------
 

@@ -150,18 +150,71 @@ class ECPGBackend:
                     tid=msg.tid, result=-5, outs=[{"error": repr(e)}],
                     epoch=self.osd.osdmap.epoch, version=0))
 
+    async def _get_snapset(self, pg: PG, oid: str):
+        """SnapSet from the local shard's attr, else any member's
+        (identical on every shard)."""
+        from . import snaps as snapmod
+        ss = snapmod.load_snapset(self.osd.store, pg.cid,
+                                  hobject_t(oid))
+        if ss is not None:
+            return ss
+        raw = await self._fetch_xattr(pg, oid, snapmod.SNAPSET_ATTR)
+        if raw is None:
+            return None
+        ss = denc.decode(raw)
+        ss["clone_size"] = {int(k): v
+                            for k, v in ss["clone_size"].items()}
+        ss["clone_snaps"] = {int(k): list(v)
+                             for k, v in ss["clone_snaps"].items()}
+        return ss
+
+    async def _head_state(self, pg: PG, oid: str):
+        """(exists, whiteout) of the head object, judged from the
+        local shard when present, else a peer's attrs."""
+        from . import snaps as snapmod
+        ho = hobject_t(oid)
+        local = self._local_shard(pg, ho)
+        if local is not None:
+            return True, local[4].get(snapmod.WHITEOUT_ATTR) == b"1"
+        raw = await self._fetch_xattr(pg, oid, SHARD_XATTR)
+        if raw is None:
+            return False, False
+        wo = await self._fetch_xattr(pg, oid, snapmod.WHITEOUT_ATTR)
+        return True, wo == b"1"
+
     async def _do_op(self, pg: PG, conn, msg) -> None:
+        from ..store.objectstore import NOSNAP
+        from . import snaps as snapmod
         writes = any(o["op"] in _EC_WRITE_OPS for o in msg.ops)
         epoch = self.osd.osdmap.epoch
         if not writes:
             outs, result = [], 0
             data = None
             fetched = False
+            # snapshot read: resolve the serving clone up front
+            read_snap = None
+            snapid = getattr(msg, "snapid", None)
+            if snapid not in (None, NOSNAP):
+                ss = await self._get_snapset(pg, msg.oid)
+                c = snapmod.choose_clone(ss, snapid)
+                if c is None:
+                    conn.send(MOSDOpReply(
+                        tid=msg.tid, result=-2,
+                        outs=[{"error": "not found"}],
+                        epoch=epoch, version=0))
+                    return
+                if c != "head":
+                    read_snap = c
             for op in msg.ops:
                 name = op["op"]
                 if name in ("read", "stat"):
                     if not fetched:
-                        data, _ = await self.read_object(pg, msg.oid)
+                        data, _v, rattrs = await self.read_object_attrs(
+                            pg, msg.oid, snap=read_snap)
+                        if (data is not None and read_snap is None
+                                and (rattrs or {}).get(
+                                    snapmod.WHITEOUT_ATTR) == b"1"):
+                            data = None     # whiteout head: ENOENT
                         fetched = True
                     if data is None:
                         outs.append({"error": "not found"})
@@ -174,10 +227,13 @@ class ECPGBackend:
                     else:
                         outs.append({"size": len(data)})
                 elif name == "pgls":
+                    from ..store.objectstore import NOSNAP as _NS
                     names = sorted(
                         h.name for h in
                         self.osd.store.collection_list(pg.cid)
-                        if h.name != "__pgmeta__")
+                        if h.name != "__pgmeta__" and h.snap == _NS
+                        and not snapmod.is_whiteout(self.osd.store,
+                                                    pg.cid, h))
                     outs.append({"names": names})
                 elif name == "getxattr":
                     val = await self._fetch_xattr(pg, msg.oid,
@@ -230,10 +286,12 @@ class ECPGBackend:
                 outs.append({})
             elif name == "delete":
                 # existence gate (mirrors the replicated path): a
-                # delete of a never-written object must return -2, not
-                # append a spurious DELETE log entry
-                probe, _v = await self.read_object(pg, msg.oid)
-                if probe is None:
+                # delete of a never-written OR already-whiteouted
+                # object must return -2, not append a spurious DELETE
+                # log entry (a whiteout head reads back as b"", so the
+                # probe alone cannot tell)
+                h_exists, h_white = await self._head_state(pg, msg.oid)
+                if not h_exists or h_white:
                     conn.send(MOSDOpReply(
                         tid=msg.tid, result=-2,
                         outs=[{"error": "not found"}],
@@ -257,8 +315,47 @@ class ECPGBackend:
             current = current or b""
         xattrs = {op["name"]: op["value"] for op in msg.ops
                   if op["op"] == "setxattr"}
+        # snapshot bookkeeping (make_writeable on shards): first write
+        # under a newer SnapContext clones every shard object inside
+        # the same shard transactions
+        clone_to = None
+        snapset_b = None
+        sna_snaps: list[int] = []
+        whiteout = False
+        snapc = getattr(msg, "snapc", None)
+        if snapc:
+            seq = int(snapc[0])
+            snap_ids = [int(s) for s in snapc[1]]
+            ss = await self._get_snapset(pg, msg.oid)
+            head_exists, head_white = await self._head_state(pg,
+                                                             msg.oid)
+            if ss is None:
+                ss = snapmod.new_snapset()
+            newer = [s for s in snap_ids if s > ss["seq"]]
+            if head_exists and not head_white and newer \
+                    and seq > ss["seq"]:
+                clone_to = seq
+                try:
+                    szb = await self._fetch_xattr(pg, msg.oid,
+                                                  SIZE_XATTR)
+                    size = int(szb or 0)
+                except Exception:
+                    size = 0
+                ss["clones"].append(clone_to)
+                ss["clones"].sort()
+                ss["clone_size"][clone_to] = size
+                ss["clone_snaps"][clone_to] = sorted(newer)
+                sna_snaps = sorted(newer)
+            if seq > ss["seq"]:
+                ss["seq"] = seq
+            if is_delete and ss["clones"]:
+                whiteout = True
+            snapset_b = snapmod.snapset_bytes(ss)
         ok = await self.submit_write(pg, msg.oid, current, is_delete,
-                                     xattrs)
+                                     xattrs, clone_to=clone_to,
+                                     snapset_b=snapset_b,
+                                     sna_snaps=sna_snaps,
+                                     whiteout=whiteout)
         ver = pg.info.last_update[1]
         conn.send(MOSDOpReply(tid=msg.tid, result=0 if ok else -11,
                               outs=outs, epoch=self.osd.osdmap.epoch,
@@ -296,9 +393,21 @@ class ECPGBackend:
 
     async def submit_write(self, pg: PG, oid: str,
                            data: bytes | None, is_delete: bool,
-                           xattrs: dict | None = None) -> bool:
+                           xattrs: dict | None = None,
+                           clone_to: int | None = None,
+                           snapset_b: bytes | None = None,
+                           sna_snaps: list | None = None,
+                           whiteout: bool = False) -> bool:
         """Encode + distribute one object write; True when every live
-        shard acked (ECBackend::try_reads_to_commit)."""
+        shard acked (ECBackend::try_reads_to_commit).
+
+        Snapshot args: clone_to clones each member's shard object to
+        hobject(oid, snap=clone_to) before the write applies;
+        snapset_b is the updated SnapSet attr; sna_snaps index the new
+        clone in the SnapMapper rows; whiteout turns a delete into a
+        zero-length tombstone that keeps the SnapSet (clones alive)."""
+        from . import snaps as snapmod
+        from .pg import PGMETA_OID
         epoch = self.osd.osdmap.epoch
         version = (epoch, pg.info.last_update[1] + 1)
         entry = LogEntry(
@@ -324,12 +433,27 @@ class ECPGBackend:
         for j, osd_id in enumerate(pg.acting):
             if osd_id == ITEM_NONE or osd_id < 0:
                 continue
-            if is_delete:
-                t = Transaction()
+            t = Transaction()
+            if clone_to is not None:
+                t.clone(pg.cid, ho, hobject_t(oid, snap=clone_to))
+            if is_delete and whiteout:
+                t.truncate(pg.cid, ho, 0)
+                t.setattr(pg.cid, ho, snapmod.WHITEOUT_ATTR, b"1")
+                t.setattr(pg.cid, ho, VER_XATTR, _ver_bytes(version))
+            elif is_delete:
                 t.remove(pg.cid, ho)
             else:
-                t = self._shard_txn(pg, ho, shards[j], j, len(data),
-                                    version, xattrs, hinfo)
+                t.append(self._shard_txn(pg, ho, shards[j], j,
+                                         len(data), version, xattrs,
+                                         hinfo))
+                if snapset_b is not None:
+                    t.setattr(pg.cid, ho, snapmod.WHITEOUT_ATTR, b"0")
+            if snapset_b is not None and not (is_delete
+                                              and not whiteout):
+                t.setattr(pg.cid, ho, snapmod.SNAPSET_ATTR, snapset_b)
+            for s in (sna_snaps or ()):
+                t.omap_setkeys(pg.cid, PGMETA_OID,
+                               {snapmod.sna_key(s, oid): b"1"})
             if osd_id == self.osd.whoami:
                 entryt = Transaction()
                 entryt.append(t)
@@ -419,13 +543,15 @@ class ECPGBackend:
         except (NotFound, KeyError, ValueError):
             return None
 
-    async def read_object(self, pg: PG, oid: str):
+    async def read_object(self, pg: PG, oid: str, snap: int = None):
         """Reconstructing whole-object read; returns (data, version)
         or (None, None)."""
-        data, ver, _attrs = await self.read_object_attrs(pg, oid)
+        data, ver, _attrs = await self.read_object_attrs(pg, oid,
+                                                        snap=snap)
         return data, ver
 
-    async def read_object_attrs(self, pg: PG, oid: str):
+    async def read_object_attrs(self, pg: PG, oid: str,
+                                snap: int = None):
         """Reconstructing whole-object read; returns
         (data, version, attrs) or (None, None, None).  Fetches the
         minimum member set first and widens on shortfall; only shards
@@ -435,7 +561,8 @@ class ECPGBackend:
         pool = self.osd.osdmap.pools[pg.pool_id]
         codec = self.codec(pool)
         k = codec.get_data_chunk_count()
-        ho = hobject_t(oid)
+        ho = (hobject_t(oid) if snap is None
+              else hobject_t(oid, snap=snap))
         members = []
         for osd_id in pg.acting:
             if osd_id != ITEM_NONE and osd_id >= 0 \
@@ -459,7 +586,8 @@ class ECPGBackend:
             if not batch:
                 continue
             for sender, rows in \
-                    (await self._sub_read(pg, oid, batch)).items():
+                    (await self._sub_read(pg, oid, batch,
+                                          snap=snap)).items():
                 for (j, buf, sz, verw, rattrs) in rows:
                     ver = tuple(verw)
                     by_ver.setdefault(ver, {}).setdefault(
@@ -495,9 +623,10 @@ class ECPGBackend:
         return None
 
     async def _sub_read(self, pg: PG, oid: str,
-                        members: list) -> dict:
+                        members: list, snap: int = None) -> dict:
         """One round of MOSDECSubOpRead to `members`; returns
-        {sender: [(j, bytes, size, ver), ...]}."""
+        {sender: [(j, bytes, size, ver), ...]}.  snap targets a clone
+        shard object (hobject snap field on the wire row)."""
         self._tid += 1
         tid = self._tid
         ev = asyncio.Event()
@@ -507,7 +636,7 @@ class ECPGBackend:
         for osd_id in members:
             self.osd._send_osd(osd_id, MOSDECSubOpRead(
                 pool=pg.pool_id, ps=pg.ps, shard=-1, tid=tid,
-                reads=[[oid, -1]], epoch=self.osd.osdmap.epoch))
+                reads=[[oid, -1, snap]], epoch=self.osd.osdmap.epoch))
         try:
             await asyncio.wait_for(ev.wait(), 10.0)
         except asyncio.TimeoutError:
@@ -543,10 +672,13 @@ class ECPGBackend:
         errors = []
         for row in msg.reads:
             oid = row[0]
+            snap = row[2] if len(row) > 2 else None
             if pg is None:
                 errors.append([oid, -2])
                 continue
-            local = self._local_shard(pg, hobject_t(oid))
+            ho = (hobject_t(oid) if snap is None
+                  else hobject_t(oid, snap=snap))
+            local = self._local_shard(pg, ho)
             if local is None:
                 errors.append([oid, -2])
                 continue
@@ -615,6 +747,11 @@ class ECPGBackend:
         codec = self.codec(pool)
         pushes = []
         for oid, op in sorted(missing.items()):
+            # per-object mClock admission: reconstruction yields to
+            # client I/O (mClockScheduler background_recovery class)
+            from .scheduler import K_RECOVERY
+            await self.osd.sched.admit(K_RECOVERY,
+                                       key=(pg.pool_id, pg.ps))
             async with self.oid_lock(pg, oid):
                 if oid not in pg.peer_missing.get(osd_id, {}):
                     continue  # superseded by a newer write
@@ -643,6 +780,27 @@ class ECPGBackend:
                 pushes.append({"oid": oid, "delete": False,
                                "data": shards[j], "attrs": attrs,
                                "omap": {}})
+                # clone shards travel too (snap reads after recovery)
+                from . import snaps as snapmod
+                ssraw = attrs.get(snapmod.SNAPSET_ATTR)
+                if ssraw:
+                    ss = denc.decode(ssraw)
+                    for c in ss.get("clones", []):
+                        cd, cver, cattrs = await self.read_object_attrs(
+                            pg, oid, snap=int(c))
+                        if cd is None:
+                            continue
+                        cshards = await codec.encode_async(
+                            set(range(n)), cd)
+                        ca = dict(cattrs or {})
+                        ca[SIZE_XATTR] = b"%d" % len(cd)
+                        ca[SHARD_XATTR] = b"%d" % j
+                        ca[VER_XATTR] = _ver_bytes(cver)
+                        ca[HINFO_XATTR] = hinfo_bytes(cshards)
+                        pushes.append({"oid": oid, "snap": int(c),
+                                       "delete": False,
+                                       "data": cshards[j],
+                                       "attrs": ca, "omap": {}})
         if pushes:
             self.osd._send_osd(osd_id, MOSDPGPush(
                 pool=pg.pool_id, ps=pg.ps,
@@ -658,6 +816,9 @@ class ECPGBackend:
         if j is None:
             return
         for oid, op in sorted(pg.missing.items()):
+            from .scheduler import K_RECOVERY
+            await self.osd.sched.admit(K_RECOVERY,
+                                       key=(pg.pool_id, pg.ps))
             async with self.oid_lock(pg, oid):
                 if oid not in pg.missing:
                     continue  # superseded by a newer write
@@ -682,6 +843,26 @@ class ECPGBackend:
                 pg.missing.pop(oid, None)
                 pg.persist_meta(t)
                 self.osd.store.apply_transaction(t)
+                # rebuild local clone shards listed by the snapset
+                from . import snaps as snapmod
+                ss = snapmod.load_snapset(self.osd.store, pg.cid, ho)
+                for c in (ss or {}).get("clones", []):
+                    cho = hobject_t(oid, snap=int(c))
+                    if self.osd.store.exists(pg.cid, cho):
+                        continue
+                    cd, cver = await self.read_object(pg, oid,
+                                                      snap=int(c))
+                    if cd is None:
+                        continue
+                    codec = self.codec(
+                        self.osd.osdmap.pools[pg.pool_id])
+                    n = codec.get_chunk_count()
+                    cshards = await codec.encode_async(
+                        set(range(n)), cd)
+                    ct = self._shard_txn(pg, cho, cshards[j], j,
+                                         len(cd), cver, None,
+                                         hinfo_bytes(cshards))
+                    self.osd.store.apply_transaction(ct)
 
 
 _EC_WRITE_OPS = {"write", "writefull", "delete", "truncate",

@@ -83,10 +83,25 @@ class PGPool:
     erasure_code_profile: str = ""
     object_hash: str = "rjenkins"  # only rjenkins supported
     last_change: int = 0
+    # snapshot state (pg_pool_t snap_seq/snaps/removed_snaps,
+    # src/osd/osd_types.h): snap_seq is the newest snapid ever issued
+    # for this pool (pool snaps AND selfmanaged share the space);
+    # snaps maps pool-snapshot ids to names; removed_snaps lists
+    # deleted snapids until every PG reports them purged
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)       # snapid -> name
+    removed_snaps: list = field(default_factory=list)
 
     def __post_init__(self):
         if not self.pgp_num:
             self.pgp_num = self.pg_num
+
+    def snap_context(self) -> tuple[int, list[int]]:
+        """Implicit pool-snap SnapContext: (seq, snapids desc) — what
+        the Objecter attaches to writes when the app did not supply a
+        selfmanaged snapc (Objecter::_op_submit pool snapc)."""
+        live = sorted((s for s in self.snaps), reverse=True)
+        return (self.snap_seq, live)
 
     @property
     def pg_num_mask(self) -> int:
@@ -134,10 +149,18 @@ class PGPool:
             "erasure_code_profile": self.erasure_code_profile,
             "object_hash": self.object_hash,
             "last_change": self.last_change,
+            "snap_seq": self.snap_seq,
+            "snaps": {str(k): v for k, v in self.snaps.items()},
+            "removed_snaps": list(self.removed_snaps),
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "PGPool":
+        d = dict(d)
+        d["snaps"] = {int(k): v
+                      for k, v in (d.get("snaps") or {}).items()}
+        d.setdefault("snap_seq", 0)
+        d.setdefault("removed_snaps", [])
         return cls(**d)
 
 

@@ -1,0 +1,218 @@
+"""mClock-style op scheduler with sharded queues.
+
+Analog of the reference's ShardedOpWQ + OpScheduler stack
+(src/osd/OSD.cc:2351,3528-3533; src/osd/scheduler/mClockScheduler.h:75
+over the vendored dmclock library, src/dmclock/): every message-driven
+unit of OSD work is tagged with a service class and drained from
+per-shard queues by a dmClock arbiter, so background work (recovery,
+scrub, snap trim) cannot starve client I/O and client bursts cannot
+starve recovery below its reservation.
+
+dmClock per class keeps three virtual tags (dmclock's RWL model):
+
+  reservation tag  r += 1/(res_fraction * capacity)   — guaranteed rate
+  proportional tag p += 1/weight                      — excess sharing
+  limit tag        l += 1/(lim_fraction * capacity)   — hard ceiling
+
+Schedule: any class whose reservation tag is in the past runs first
+(by earliest r); otherwise the earliest proportional tag among classes
+whose limit tag is in the past; otherwise sleep until the nearest tag
+matures.  Tags are clamped to `now` when a class goes idle->busy so
+an idle class cannot bank credit (the standard dmClock idle rule).
+
+Shards: `osd_op_num_shards` independent queues, PG-affine (shard =
+hash(pgid) % n), each drained by one asyncio worker — per-PG op order
+is preserved per class, matching the reference's shard mapping.
+
+Two entry points:
+  enqueue(key, klass, fn)  — queue a work item (fn may be sync or
+                             return an awaitable); used for message
+                             dispatch (client ops, rep ops, EC subops).
+  admit(klass, cost)       — awaitable admission ticket used by
+                             long-running background flows (recovery
+                             push loops, scrub chunks, snap trim) to
+                             pace themselves through the same arbiter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+K_CLIENT = "client"
+K_RECOVERY = "recovery"
+K_SCRUB = "scrub"
+K_SNAPTRIM = "snaptrim"
+
+# (reservation fraction, weight, limit fraction) of osd capacity —
+# mirrors the balanced mclock profile (mClockScheduler.cc profiles:
+# client gets half the capacity reserved, background recovery a
+# quarter, best-effort classes ride the excess)
+DEFAULT_PROFILE = {
+    K_CLIENT: (0.50, 4.0, 1.00),
+    K_RECOVERY: (0.25, 2.0, 0.75),
+    K_SCRUB: (0.05, 1.0, 0.50),
+    K_SNAPTRIM: (0.05, 1.0, 0.50),
+}
+
+
+class _ClassQ:
+    __slots__ = ("res", "wgt", "lim", "r_tag", "p_tag", "l_tag",
+                 "items")
+
+    def __init__(self, res_rate: float, weight: float,
+                 lim_rate: float):
+        self.res = max(res_rate, 1e-9)
+        self.wgt = max(weight, 1e-9)
+        self.lim = max(lim_rate, 1e-9)
+        self.r_tag = 0.0
+        self.p_tag = 0.0
+        self.l_tag = 0.0
+        self.items: list = []          # FIFO of (fn, cost)
+
+
+class _Shard:
+    def __init__(self, profile: dict, capacity: float):
+        self.classes = {
+            k: _ClassQ(res * capacity, wgt, lim * capacity)
+            for k, (res, wgt, lim) in profile.items()}
+        self.wake = asyncio.Event()
+        self.size = 0
+
+    def push(self, klass: str, fn, cost: float) -> None:
+        q = self.classes[klass]
+        if not q.items:
+            # idle -> busy: no banked credit
+            now = time.monotonic()
+            q.r_tag = max(q.r_tag, now)
+            q.l_tag = max(q.l_tag, now)
+            busy_p = [c.p_tag for c in self.classes.values() if c.items]
+            q.p_tag = max(q.p_tag, min(busy_p) if busy_p else q.p_tag)
+        q.items.append((fn, cost))
+        self.size += 1
+        self.wake.set()
+
+    def _pick(self) -> tuple[str, float] | None:
+        """(class, 0) to run now, or (None, delay) to sleep."""
+        now = time.monotonic()
+        busy = [(k, q) for k, q in self.classes.items() if q.items]
+        if not busy:
+            return None
+        # 1. reservation phase
+        ready = [(q.r_tag, k) for k, q in busy if q.r_tag <= now]
+        if ready:
+            return ("R", min(ready)[1])
+        # 2. proportional phase under limit
+        under = [(q.p_tag, k) for k, q in busy if q.l_tag <= now]
+        if under:
+            return ("P", min(under)[1])
+        # 3. everything limited: sleep till the nearest tag matures
+        horizon = min(min(q.r_tag for _, q in busy),
+                      min(q.l_tag for _, q in busy))
+        return ("S", max(horizon - now, 0.0005))
+
+    def pop(self, klass: str, phase: str):
+        q = self.classes[klass]
+        fn, cost = q.items.pop(0)
+        self.size -= 1
+        now = time.monotonic()
+        if phase == "R":
+            q.r_tag = max(q.r_tag, now) + cost / q.res
+            # the proportional/limit books still advance: a
+            # reservation-phase grant consumes budget everywhere
+            q.p_tag += cost / q.wgt
+            q.l_tag = max(q.l_tag, now) + cost / q.lim
+        else:
+            q.p_tag += cost / q.wgt
+            q.l_tag = max(q.l_tag, now) + cost / q.lim
+            q.r_tag = max(q.r_tag, now) + cost / q.res
+        return fn
+
+
+class OpScheduler:
+    """Sharded dmClock arbiter; one per OSD."""
+
+    def __init__(self, ctx=None, num_shards: int | None = None,
+                 capacity_iops: float | None = None,
+                 profile: dict | None = None):
+        conf = getattr(ctx, "conf", None)
+        if num_shards is None:
+            num_shards = int(conf["osd_op_num_shards"]) if conf else 4
+        if capacity_iops is None:
+            capacity_iops = (float(conf["osd_mclock_capacity_iops"])
+                             if conf else 10000.0)
+        self.profile = dict(profile or DEFAULT_PROFILE)
+        self.capacity = capacity_iops
+        self.shards = [_Shard(self.profile, capacity_iops)
+                       for _ in range(max(1, num_shards))]
+        self._workers: list[asyncio.Task] = []
+        self.running = False
+        # perf visibility
+        self.dispatched = {k: 0 for k in self.profile}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, spawn) -> None:
+        """spawn: task factory (Messenger.spawn) so worker lifetimes
+        track the daemon's."""
+        if self.running:
+            return
+        self.running = True
+        for sh in self.shards:
+            self._workers.append(spawn(self._worker(sh)))
+
+    def stop(self) -> None:
+        self.running = False
+        for sh in self.shards:
+            sh.wake.set()
+
+    async def _worker(self, sh: _Shard) -> None:
+        while self.running:
+            if sh.size == 0:
+                sh.wake.clear()
+                await sh.wake.wait()
+                continue
+            pick = sh._pick()
+            if pick is None:
+                continue
+            phase, val = pick
+            if phase == "S":
+                try:
+                    await asyncio.wait_for(sh.wake.wait(), timeout=val)
+                    sh.wake.clear()
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            fn = sh.pop(val, phase)
+            self.dispatched[val] += 1
+            try:
+                r = fn()
+                if asyncio.iscoroutine(r) or isinstance(r, asyncio.Future):
+                    await r
+            except Exception:       # worker must survive op failures
+                import traceback
+                traceback.print_exc()
+
+    # -- entry points ------------------------------------------------------
+
+    def shard_of(self, key) -> int:
+        return hash(key) % len(self.shards)
+
+    def enqueue(self, key, klass: str, fn, cost: float = 1.0) -> None:
+        self.shards[self.shard_of(key)].push(klass, fn, cost)
+
+    async def admit(self, klass: str, cost: float = 1.0,
+                    key=0) -> None:
+        """Admission ticket for background flows: resolves when the
+        arbiter grants `cost` units to `klass`."""
+        if not self.running:
+            return
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+
+        def grant():
+            if not fut.done():
+                fut.set_result(None)
+
+        self.shards[self.shard_of(key)].push(klass, grant, cost)
+        await fut

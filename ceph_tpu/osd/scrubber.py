@@ -153,6 +153,11 @@ class Scrubber:
                 oids.append(e.oid)
         for i in range(0, len(oids), chunk):
             batch = oids[i:i + chunk]
+            # each chunk passes the mClock 'scrub' class so scrubbing
+            # yields to client I/O and recovery under load
+            from .scheduler import K_SCRUB
+            await self.osd.sched.admit(K_SCRUB, cost=len(batch),
+                                       key=(pg.pool_id, pg.ps))
             maps = await self._gather_maps(pg, batch)
             if pool.is_erasure():
                 await self._compare_ec(pg, pool, batch, maps, deep,

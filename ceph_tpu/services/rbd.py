@@ -8,20 +8,25 @@ by Striper::file_to_extents — the same object-map shape librbd uses
 (`rbd_data.<image id>.<object no>`).  Reads of unwritten extents
 return zeros (sparse images); writes allocate objects on demand.
 
-Surface: RBD.create/remove/list/open -> Image.read/write/size/resize/
-flatten-free sparse semantics.  Snapshots/clones/journaling are out of
-this slice (SURVEY build plan step 9: "thin block layer as first
-consumer")."""
+Surface: RBD.create/remove/list/open -> Image.read/write/size/resize +
+snapshots (snap_create/remove/list/set/rollback on RADOS selfmanaged
+snaps — the librbd snapshot model: every image snapshot is a
+selfmanaged pool snapid recorded in the header, writes carry the
+image's SnapContext so data objects clone on first write,
+librbd::Operations<I>::snap_create / snap_rollback).  Clones /
+journaling / mirroring remain out of this slice."""
 
 from __future__ import annotations
 
 from ..client.striper import FileLayout, file_to_extents
+from ..utils import denc
 
 HEADER_PREFIX = "rbd_header."
 DATA_PREFIX = "rbd_data."
 DIR_OID = "rbd_directory"
 SIZE_XATTR = "rbd.size"
 LAYOUT_XATTR = "rbd.layout"
+SNAPS_XATTR = "rbd.snaps"
 
 
 class RBDError(Exception):
@@ -87,24 +92,124 @@ class RBD:
                 await self.io.getxattr(hdr, LAYOUT_XATTR))
         except Exception:
             raise RBDError("image %r does not exist" % name)
-        return Image(self.io, name, size, layout)
+        snaps = {}
+        try:
+            snaps = denc.decode(await self.io.getxattr(hdr,
+                                                       SNAPS_XATTR))
+        except Exception:
+            pass
+        # each image gets its OWN IoCtx: snap context and read-snap
+        # state are per-image (a shared ioctx would let one image's
+        # _apply_snapc clobber another's write snapc)
+        from ..client.rados import IoCtx
+        img_io = IoCtx(self.io.client, self.io.pool_id)
+        img = Image(img_io, name, size, layout, snaps)
+        img._apply_snapc()
+        return img
 
 
 class Image:
     """One open image (librbd::Image): offset/length block I/O."""
 
     def __init__(self, ioctx, name: str, size: int,
-                 layout: FileLayout):
+                 layout: FileLayout, snaps: dict | None = None):
         self.io = ioctx
         self.name = name
         self._size = size
         self.layout = layout
+        # name -> {"id": selfmanaged snapid, "size": image size then}
+        self.snaps: dict = snaps or {}
 
     def _data_name(self, objectno: int) -> str:
         return "%s%s.%016x" % (DATA_PREFIX, self.name, objectno)
 
     def size(self) -> int:
         return self._size
+
+    # -- snapshots (librbd snap_create/rollback over selfmanaged
+    # RADOS snaps; every data-object write carries the image snapc) --
+
+    def _apply_snapc(self) -> None:
+        ids = sorted((int(s["id"]) for s in self.snaps.values()),
+                     reverse=True)
+        self.io.set_selfmanaged_snapc(ids[0] if ids else 0, ids)
+
+    async def _persist_snaps(self) -> None:
+        await self.io.setxattr(HEADER_PREFIX + self.name, SNAPS_XATTR,
+                               denc.encode(self.snaps))
+
+    def snap_list(self) -> dict[str, dict]:
+        return dict(self.snaps)
+
+    async def snap_create(self, snapname: str) -> int:
+        if snapname in self.snaps:
+            raise RBDError("snap %r exists" % snapname)
+        sid = await self.io.selfmanaged_snap_create()
+        self.snaps[snapname] = {"id": sid, "size": self._size}
+        await self._persist_snaps()
+        self._apply_snapc()
+        return sid
+
+    async def snap_remove(self, snapname: str) -> None:
+        rec = self.snaps.get(snapname)
+        if rec is None:
+            raise RBDError("no snap %r" % snapname)
+        # cluster-side removal FIRST: if the mon command fails the
+        # header still records the snapid and removal can be retried
+        # (dropping the record first would leak the clones forever)
+        await self.io.selfmanaged_snap_remove(int(rec["id"]))
+        self.snaps.pop(snapname, None)
+        await self._persist_snaps()
+        self._apply_snapc()
+
+    def set_snap(self, snapname: str | None) -> None:
+        """Route reads to a snapshot (librbd snap_set); None = head."""
+        if snapname is None:
+            self.io.set_read_snap(None)
+            return
+        rec = self.snaps.get(snapname)
+        if rec is None:
+            raise RBDError("no snap %r" % snapname)
+        self.io.set_read_snap(int(rec["id"]))
+
+    async def snap_rollback(self, snapname: str) -> None:
+        """Restore head contents from a snapshot
+        (librbd::Operations::snap_rollback): every data object is
+        rewritten from its state at the snap (absent then = removed
+        now), then the size reverts."""
+        import asyncio
+
+        rec = self.snaps.get(snapname)
+        if rec is None:
+            raise RBDError("no snap %r" % snapname)
+        sid = int(rec["id"])
+        snap_size = int(rec["size"])
+        span = max(self._size, snap_size)
+        objs = ({e[0] for e in file_to_extents(self.layout, 0, span)}
+                if span else set())
+        osz = self.layout.object_size
+
+        async def roll(o):
+            name = self._data_name(o)
+            self.io.set_read_snap(sid)
+            try:
+                old = await self.io.read(name, osz, 0)
+            except Exception:
+                old = b""
+            finally:
+                self.io.set_read_snap(None)
+            if old:
+                await self.io.write_full(name, old)
+            else:
+                try:
+                    await self.io.remove(name)
+                except Exception:
+                    pass
+
+        await asyncio.gather(*[roll(o) for o in sorted(objs)])
+        self._size = snap_size
+        await self.io.setxattr(HEADER_PREFIX + self.name, SIZE_XATTR,
+                               b"%d" % snap_size)
 
     async def resize(self, new_size: int) -> None:
         if new_size < self._size:

@@ -217,6 +217,8 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_pool_default_min_size", OPT_INT, 2, "min replicas to serve IO"),
     Option("osd_pool_default_pg_num", OPT_INT, 32, "default pg count"),
     Option("osd_op_num_shards", OPT_INT, 4, "op queue shards per osd"),
+    Option("osd_mclock_capacity_iops", OPT_FLOAT, 10000.0,
+           "assumed per-osd op capacity for mClock tag rates"),
     Option("osd_recovery_max_active", OPT_INT, 8,
            "max concurrent recovery ops per osd"),
     Option("osd_max_pg_log_entries", OPT_INT, 2000,

@@ -76,3 +76,57 @@ def test_rbd_image_lifecycle_and_io():
             await c.stop()
 
     run(main(), timeout=120)
+
+
+def test_rbd_snapshots_and_rollback():
+    """librbd snapshot model: snap_create -> overwrite -> read-at-snap
+    -> rollback restores, snap_remove trims."""
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await c.client.mon_command("osd pool create", pool="rbd",
+                                       pg_num=8)
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(
+                next(p.id for p in c.client.osdmap.pools.values()
+                     if p.name == "rbd"))
+            rbd = RBD(c.client.io_ctx("rbd"))
+            layout = FileLayout(stripe_unit=4096, stripe_count=1,
+                                object_size=16384)
+            await rbd.create("vm", 1 << 17, layout)
+            img = await rbd.open("vm")
+            await img.write(0, b"generation-one" * 100)
+            await img.snap_create("s1")
+            await img.write(0, b"generation-TWO" * 100)
+            assert (await img.read(0, 14 * 100)
+                    == b"generation-TWO" * 100)
+            # read the snapshot view
+            img.set_snap("s1")
+            assert (await img.read(0, 14 * 100)
+                    == b"generation-one" * 100)
+            img.set_snap(None)
+            assert "s1" in img.snap_list()
+
+            # snapshots persist across open()
+            img2 = await rbd.open("vm")
+            assert "s1" in img2.snap_list()
+            img2.set_snap("s1")
+            assert (await img2.read(0, 14 * 100)
+                    == b"generation-one" * 100)
+            img2.set_snap(None)
+
+            # rollback restores the snapshot contents to the head
+            await img2.snap_rollback("s1")
+            assert (await img2.read(0, 14 * 100)
+                    == b"generation-one" * 100)
+
+            # snap removal succeeds and head is unaffected
+            await img2.snap_remove("s1")
+            assert img2.snap_list() == {}
+            assert (await img2.read(0, 14 * 100)
+                    == b"generation-one" * 100)
+        finally:
+            await c.stop()
+
+    run(main())

@@ -1,0 +1,257 @@
+"""Snapshot semantics end-to-end: clone-on-write, snap reads, trim.
+
+Mirrors the reference's snapshot behavior (PrimaryLogPG make_writeable,
+SnapMapper, snap trim; src/test/librados/snapshots.cc): write -> snap ->
+overwrite -> read-at-snap on replicated AND EC pools, deletion with
+live clones, selfmanaged snapcs, trim reclaiming clones, and clone
+survival through recovery.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import ObjectNotFound
+
+from test_cluster import Cluster, run
+
+
+async def _mkpool(c, name, **kw):
+    out = await c.client.mon_command("osd pool create", pool=name,
+                                     pg_num=8, **kw)
+    pid = out["pool_id"]
+    await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+    await c.wait_health(pid)
+    return pid
+
+
+def test_pool_snap_write_overwrite_read_at_snap():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await _mkpool(c, "data", size=3)
+            io = c.client.io_ctx("data")
+            await io.write_full("obj", b"v1" * 100)
+            sid = await io.snap_create("s1")
+            await io.write_full("obj", b"v2" * 150)
+            assert await io.read("obj") == b"v2" * 150
+            io.set_read_snap(sid)
+            assert await io.read("obj") == b"v1" * 100
+            assert await io.stat("obj") == 200
+            io.set_read_snap(None)
+            # a second snapshot over the new contents
+            sid2 = await io.snap_create("s2")
+            await io.write_full("obj", b"v3")
+            io.set_read_snap(sid2)
+            assert await io.read("obj") == b"v2" * 150
+            io.set_read_snap(sid)
+            assert await io.read("obj") == b"v1" * 100
+            assert set(io.snap_list().values()) == {"s1", "s2"}
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_snap_delete_head_keeps_clones():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await _mkpool(c, "data", size=3)
+            io = c.client.io_ctx("data")
+            await io.write_full("gone", b"alive")
+            sid = await io.snap_create("keep")
+            await io.remove("gone")
+            with pytest.raises(ObjectNotFound):
+                await io.read("gone")
+            names = await c.client.list_objects(io.pool_id)
+            assert "gone" not in names
+            io.set_read_snap(sid)
+            assert await io.read("gone") == b"alive"
+            # resurrect the head; the clone still serves the old data
+            io.set_read_snap(None)
+            await io.write_full("gone", b"back")
+            assert await io.read("gone") == b"back"
+            io.set_read_snap(sid)
+            assert await io.read("gone") == b"alive"
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_object_created_after_snap_is_absent_at_snap():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await _mkpool(c, "data", size=3)
+            io = c.client.io_ctx("data")
+            sid = await io.snap_create("early")
+            await io.write_full("late", b"new")
+            io.set_read_snap(sid)
+            with pytest.raises(ObjectNotFound):
+                await io.read("late")
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_snap_trim_reclaims_clones():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            pid = await _mkpool(c, "data", size=3)
+            io = c.client.io_ctx("data")
+            for i in range(4):
+                await io.write_full("o%d" % i, b"old-%d" % i)
+            sid = await io.snap_create("s")
+            for i in range(4):
+                await io.write_full("o%d" % i, b"new-%d" % i)
+            # clones exist on the primaries
+            from ceph_tpu.osd.snaps import load_snapset
+            from ceph_tpu.store.objectstore import hobject_t
+
+            def clone_count():
+                n = 0
+                for osd in c.osds:
+                    if osd.stopping:
+                        continue
+                    for pg in osd.pgs.values():
+                        if pg.pool_id != pid:
+                            continue
+                        for h in osd.store.collection_list(pg.cid):
+                            from ceph_tpu.store.objectstore import \
+                                NOSNAP
+                            if h.snap != NOSNAP:
+                                n += 1
+                return n
+
+            assert clone_count() > 0
+            await io.snap_remove("s")
+            t0 = asyncio.get_running_loop().time()
+            while clone_count() > 0:
+                if asyncio.get_running_loop().time() - t0 > 20:
+                    raise TimeoutError(
+                        "snap trim never reclaimed %d clones"
+                        % clone_count())
+                await asyncio.sleep(0.1)
+            # heads still serve the new data
+            for i in range(4):
+                assert await io.read("o%d" % i) == b"new-%d" % i
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_selfmanaged_snaps():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await _mkpool(c, "data", size=3)
+            io = c.client.io_ctx("data")
+            await io.write_full("obj", b"gen0")
+            sid = await io.selfmanaged_snap_create()
+            io.set_selfmanaged_snapc(sid, [sid])
+            await io.write_full("obj", b"gen1")
+            io.set_read_snap(sid)
+            assert await io.read("obj") == b"gen0"
+            io.set_read_snap(None)
+            assert await io.read("obj") == b"gen1"
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_ec_pool_snapshots():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await _mkpool(c, "ecpool", pool_type="erasure")
+            io = c.client.io_ctx("ecpool")
+            await io.write_full("obj", b"ec-v1" * 50)
+            sid = await io.snap_create("s1")
+            await io.write_full("obj", b"ec-v2" * 80)
+            assert await io.read("obj") == b"ec-v2" * 80
+            io.set_read_snap(sid)
+            assert await io.read("obj") == b"ec-v1" * 50
+            io.set_read_snap(None)
+            # delete with a live clone: whiteout semantics
+            await io.remove("obj")
+            with pytest.raises(ObjectNotFound):
+                await io.read("obj")
+            io.set_read_snap(sid)
+            assert await io.read("obj") == b"ec-v1" * 50
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_ec_snap_trim():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            pid = await _mkpool(c, "ecpool", pool_type="erasure")
+            io = c.client.io_ctx("ecpool")
+            await io.write_full("obj", b"old")
+            await io.snap_create("s")
+            await io.write_full("obj", b"new")
+
+            from ceph_tpu.store.objectstore import NOSNAP
+
+            def clone_count():
+                n = 0
+                for osd in c.osds:
+                    for pg in osd.pgs.values():
+                        if pg.pool_id != pid:
+                            continue
+                        for h in osd.store.collection_list(pg.cid):
+                            if h.snap != NOSNAP:
+                                n += 1
+                return n
+
+            assert clone_count() > 0
+            await io.snap_remove("s")
+            t0 = asyncio.get_running_loop().time()
+            while clone_count() > 0:
+                if asyncio.get_running_loop().time() - t0 > 20:
+                    raise TimeoutError("ec snap trim stalled")
+                await asyncio.sleep(0.1)
+            assert await io.read("obj") == b"new"
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_snap_read_after_recovery():
+    """Clones survive an OSD death + recovery (pushes bundle them)."""
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            pid = await _mkpool(c, "data", size=3)
+            io = c.client.io_ctx("data")
+            await io.write_full("obj", b"snapped")
+            sid = await io.snap_create("s")
+            await io.write_full("obj", b"head")
+            await c.kill_osd(2)
+            # wait for the map to mark it down and the pool to re-peer
+            t0 = asyncio.get_running_loop().time()
+            while c.mon.osdmap.is_up(2):
+                if asyncio.get_running_loop().time() - t0 > 10:
+                    raise TimeoutError("osd.2 never marked down")
+                await asyncio.sleep(0.05)
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io.set_read_snap(sid)
+            assert await io.read("obj") == b"snapped"
+            io.set_read_snap(None)
+            assert await io.read("obj") == b"head"
+        finally:
+            await c.stop()
+
+    run(main())
