@@ -1,0 +1,3 @@
+from .daemon import Manager
+
+__all__ = ["Manager"]

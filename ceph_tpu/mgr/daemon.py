@@ -1,0 +1,218 @@
+"""Manager daemon: cluster-wide aggregation + autonomous balancing.
+
+Condensed analog of src/mgr/ (DaemonServer.cc receiving every
+daemon's perf-counter reports, ClusterState caching maps) plus the two
+mgr python modules the survey calls first-class:
+
+* prometheus — ONE scrape endpoint exposing per-OSD op counters and a
+  PG-state summary for the whole cluster (pybind/mgr/prometheus);
+* balancer  — a timer loop running the upmap optimizer
+  (pybind/mgr/balancer/module.py Module.serve) and committing the
+  computed pg_upmap_items through the monitor, so a skewed cluster
+  converges without operator action.
+
+Registration rides the map: `mgr register` stores this daemon's
+address in OSDMap.mgr_addr (the MgrMap role) and every OSD's
+heartbeat loop ships MMgrReport there (OSD::ms_handle ->
+MgrClient::send_report in the reference).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..msg import Messenger
+from ..msg.messages import (MMgrReport, MMonCommand, MMonCommandAck,
+                            MMonGetMap, MMonSubscribe, MOSDMapMsg)
+from ..osd.osdmap import OSDMap, consume_map_payload
+from ..utils.context import Context
+from ..utils.exporter import PrometheusExporter
+
+
+class Manager:
+    def __init__(self, mon_addr, ctx: Context | None = None,
+                 balance_interval: float = 5.0):
+        self.mon_addrs = ([mon_addr] if isinstance(mon_addr, str)
+                          else list(mon_addr))
+        self.ctx = ctx or Context("mgr")
+        self.msgr = Messenger("mgr")
+        self.msgr.add_dispatcher(self)
+        self.osdmap: OSDMap = OSDMap()
+        self.balance_interval = balance_interval
+        self.balancer_enabled = True
+        self.balancer_rounds = 0
+        self.balancer_changes = 0
+        # daemon -> {"perf": .., "pg_states": .., "stamp": ..}
+        self.daemon_reports: dict[str, dict] = {}
+        self.exporter = PrometheusExporter(self.ctx)
+        self._tid = 0
+        self._cmd_futures: dict[int, asyncio.Future] = {}
+        self._tasks: list = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    http_port: int = 0) -> str:
+        addr = await self.msgr.bind(host, port)
+        mon = self.msgr.connect_to(self.mon_addrs[0],
+                                   entity_hint="mon.0")
+        mon.send(MMonSubscribe(start=1))
+        await self._register()
+        self.http_addr = await self.exporter.start(host, http_port)
+        self._register_cluster_gauges()
+        self._tasks.append(self.msgr.spawn(self._balancer_loop()))
+        self.ctx.log.info("mgr", "mgr serving at %s (metrics %s)"
+                          % (addr, self.http_addr))
+        return addr
+
+    async def shutdown(self) -> None:
+        await self.exporter.stop()
+        await self.msgr.shutdown()
+
+    async def _register(self) -> None:
+        await self.mon_command("mgr register", addr=self.msgr.addr)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MOSDMapMsg):
+            self.osdmap, _ = consume_map_payload(
+                self.osdmap, msg.full, msg.incrementals)
+            return True
+        if isinstance(msg, MMgrReport):
+            self.daemon_reports[msg.daemon] = {
+                "perf": msg.perf or {},
+                "pg_states": msg.pg_states or {},
+                "num_pgs": msg.num_pgs or 0,
+                "num_objects": msg.num_objects or 0,
+                "epoch": msg.epoch,
+                "stamp": asyncio.get_event_loop().time(),
+            }
+            return True
+        if isinstance(msg, MMonCommandAck):
+            fut = self._cmd_futures.pop(msg.tid, None)
+            if fut is not None and not fut.done():
+                if msg.result == 0:
+                    fut.set_result(msg.out or {})
+                else:
+                    fut.set_exception(IOError(msg.result, msg.out))
+            return True
+        return False
+
+    async def mon_command(self, prefix: str, timeout: float = 10.0,
+                          **args) -> dict:
+        cmd = {"prefix": prefix}
+        cmd.update(args)
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_event_loop().create_future()
+        self._cmd_futures[tid] = fut
+        self.msgr.send_to(self.mon_addrs[0],
+                          MMonCommand(tid=tid, cmd=cmd),
+                          entity_hint="mon.0")
+        return await asyncio.wait_for(fut, timeout)
+
+    # -- prometheus surface ------------------------------------------------
+
+    def _register_cluster_gauges(self) -> None:
+        exp = self.exporter
+        exp.add_gauge("cluster_osdmap_epoch",
+                      lambda: self.osdmap.epoch, "map epoch")
+        exp.add_gauge("cluster_num_osds",
+                      lambda: self.osdmap.max_osd, "osds in map")
+        exp.add_gauge(
+            "cluster_num_up_osds",
+            lambda: sum(1 for o in range(self.osdmap.max_osd)
+                        if self.osdmap.is_up(o)), "up osds")
+        exp.add_gauge("cluster_num_pools",
+                      lambda: len(self.osdmap.pools), "pools")
+        exp.add_gauge("mgr_daemons_reporting",
+                      lambda: len(self.daemon_reports),
+                      "daemons with a live report")
+        exp.add_gauge("balancer_rounds",
+                      lambda: self.balancer_rounds,
+                      "balancer optimizer runs")
+        exp.add_gauge("balancer_changes",
+                      lambda: self.balancer_changes,
+                      "upmap items committed by the balancer")
+        exp.add_renderer(self._render_reports)
+
+    def _render_reports(self) -> list[str]:
+        """Per-daemon series from the MMgrReports (the prometheus
+        module's per-daemon metric families)."""
+        lines: list[str] = []
+        pg_totals: dict[str, int] = {}
+        for daemon in sorted(self.daemon_reports):
+            rep = self.daemon_reports[daemon]
+            label = '{daemon="%s"}' % daemon
+            for grp, counters in sorted(
+                    (rep.get("perf") or {}).items()):
+                if not isinstance(counters, dict):
+                    continue
+                for cname, val in sorted(counters.items()):
+                    if isinstance(val, (int, float)):
+                        lines.append(
+                            "ceph_tpu_daemon_%s_%s%s %g"
+                            % (grp, cname, label, val))
+            lines.append("ceph_tpu_daemon_num_pgs%s %d"
+                         % (label, rep.get("num_pgs") or 0))
+            lines.append("ceph_tpu_daemon_num_objects%s %d"
+                         % (label, rep.get("num_objects") or 0))
+            for state, n in (rep.get("pg_states") or {}).items():
+                pg_totals[state] = pg_totals.get(state, 0) + n
+        for state in sorted(pg_totals):
+            lines.append('ceph_tpu_pg_state{state="%s"} %d'
+                         % (state, pg_totals[state]))
+        return lines
+
+    # -- balancer loop -----------------------------------------------------
+
+    async def _balancer_loop(self) -> None:
+        """pybind/mgr/balancer Module.serve: periodically run the
+        upmap optimizer against the current map and commit its
+        pg_upmap_items through the monitor."""
+        from ..osd.balancer import calc_pg_upmaps
+
+        while True:
+            await asyncio.sleep(self.balance_interval)
+            if not self.balancer_enabled or not self.osdmap.pools:
+                continue
+            inc = self.osdmap.new_incremental()
+            try:
+                n = calc_pg_upmaps(self.osdmap, inc,
+                                   max_deviation=1.0,
+                                   max_iterations=32)
+            except Exception as e:
+                self.ctx.log.info("mgr", "balancer failed: %r" % e)
+                continue
+            self.balancer_rounds += 1
+            removals = [pgid for pgid in inc.old_pg_upmap_items
+                        if pgid not in inc.new_pg_upmap_items]
+            if not n and not removals:
+                continue
+            for pgid, items in inc.new_pg_upmap_items.items():
+                try:
+                    if items:
+                        await self.mon_command(
+                            "osd pg-upmap-items", pool=pgid.pool,
+                            ps=pgid.ps,
+                            mappings=[list(t) for t in items])
+                    else:
+                        await self.mon_command(
+                            "osd rm-pg-upmap-items", pool=pgid.pool,
+                            ps=pgid.ps)
+                    self.balancer_changes += 1
+                except Exception as e:
+                    self.ctx.log.info(
+                        "mgr", "upmap commit failed: %r" % e)
+            for pgid in removals:
+                # stale entries the optimizer retired (e.g. the source
+                # osd left the raw set) — committed as removals too
+                try:
+                    await self.mon_command(
+                        "osd rm-pg-upmap-items", pool=pgid.pool,
+                        ps=pgid.ps)
+                    self.balancer_changes += 1
+                except Exception as e:
+                    self.ctx.log.info(
+                        "mgr", "upmap removal failed: %r" % e)

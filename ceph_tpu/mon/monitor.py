@@ -335,8 +335,14 @@ class Monitor:
                               "last_committed", "first_committed",
                               "lease_until", "uncommitted", "epoch")})
             return True
-        if isinstance(msg, (MOSDBoot, MOSDFailure, MOSDAlive))                 and self.multi and not self.is_leader():
+        from ..msg.messages import MOSDPGTemp
+        if isinstance(msg, (MOSDBoot, MOSDFailure, MOSDAlive,
+                            MOSDPGTemp)) \
+                and self.multi and not self.is_leader():
             return True   # OSDs broadcast to every mon; leader acts
+        if isinstance(msg, MOSDPGTemp):
+            self._handle_pg_temp(msg)
+            return True
         if isinstance(msg, MMonGetMap):
             self._send_map(conn, msg.have)
         elif isinstance(msg, MMonSubscribe):
@@ -365,6 +371,26 @@ class Monitor:
                 return
             if rank != self.rank:
                 self.elector.peer_lost(rank)
+
+    def _handle_pg_temp(self, msg) -> None:
+        """OSDMonitor::prepare_pgtemp: commit requested pg_temp
+        mappings (a primary pinning the previous acting set while
+        backfill runs) and clears (backfill done)."""
+        from ..osd.osdmap import pg_t
+        changed = False
+        for pool, ps, want in (msg.pgs or []):
+            pgid = pg_t(int(pool), int(ps))
+            want = [int(o) for o in (want or [])]
+            cur = self.osdmap.pg_temp.get(pgid, [])
+            pend = (self.pending_inc.new_pg_temp.get(pgid)
+                    if self.pending_inc is not None else None)
+            now = pend if pend is not None else cur
+            if list(now) == want:
+                continue
+            self._pending().new_pg_temp[pgid] = want
+            changed = True
+        if changed:
+            self._propose_pending()
 
     # -- boot --------------------------------------------------------------
 
@@ -562,6 +588,29 @@ class Monitor:
                 inc.new_state[osd] = OSD_UP
                 self.down_pending_out[osd] = time.monotonic()
                 self._propose_pending()
+            return {}
+        if prefix == "mgr register":
+            # MgrMonitor's role: record the active manager's address
+            # in the map so daemons know where to send MMgrReports
+            inc = self._pending()
+            inc.new_mgr_addr = str(cmd["addr"])
+            self._propose_pending()
+            return {}
+        if prefix == "osd pg-upmap-items":
+            # the balancer's apply channel (OSDMonitor pg-upmap-items)
+            from ..osd.osdmap import pg_t as _pg_t
+            pgid = _pg_t(int(cmd["pool"]), int(cmd["ps"]))
+            items = [(int(a), int(b)) for a, b in cmd["mappings"]]
+            inc = self._pending()
+            inc.new_pg_upmap_items[pgid] = items
+            self._propose_pending()
+            return {}
+        if prefix == "osd rm-pg-upmap-items":
+            from ..osd.osdmap import pg_t as _pg_t
+            pgid = _pg_t(int(cmd["pool"]), int(cmd["ps"]))
+            inc = self._pending()
+            inc.new_pg_upmap_items[pgid] = []
+            self._propose_pending()
             return {}
         if prefix == "osd pool mksnap":
             return self._cmd_pool_mksnap(cmd)

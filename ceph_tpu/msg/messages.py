@@ -101,6 +101,28 @@ class MOSDAlive(Message):
 
 
 @register
+class MMgrReport(Message):
+    """Daemon -> mgr perf/state report (MMgrReport.h via
+    DaemonServer::handle_report): perf = the daemon's PerfCounters
+    dump; pg_states = {state_name: count} for the PGs it is primary
+    of; num_pgs/num_objects round out the health summary."""
+
+    TYPE = "mgr_report"
+    FIELDS = ("daemon", "epoch", "perf", "pg_states", "num_pgs",
+              "num_objects")
+
+
+@register
+class MOSDPGTemp(Message):
+    """OSD -> mon pg_temp request (MOSDPGTemp.h / OSDMonitor
+    prepare_pgtemp): pgs = [[pool, ps, [osds...]], ...]; an empty osd
+    list clears the mapping (PeeringState queue_want_pg_temp)."""
+
+    TYPE = "osd_pg_temp"
+    FIELDS = ("pgs", "epoch")
+
+
+@register
 class MMonCommand(Message):
     """Generic admin command (MMonCommand.h): {"prefix": ..., args}."""
 

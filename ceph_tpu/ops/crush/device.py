@@ -1255,25 +1255,57 @@ class MapState:
         w, ex = jnp.asarray(w_np), jnp.asarray(ex_np)
         iu, af = jnp.asarray(iu_np), jnp.asarray(af_np)
         cm = jnp.asarray(changed)
-        KA = max(64, min(1 << 19,
-                         1 << (max(1, self.pg_num - 1)).bit_length()))
-        K1 = max(8, min(1 << 13, KA))
+        K1 = max(8, min(1 << 13, 1 << max(
+            1, (self.pg_num - 1).bit_length())))
         K2 = max(8, min(1 << 11, K1))
         K3 = max(8, min(1 << 10, K2))
+        KA = 0
+        KT = 0
+        if self.dm._rc_ok(self.npg):
+            # expected hits per 2048-lane row group: a lane is hit if
+            # any of its S raw slots holds a changed osd; size the
+            # compaction slots with a ~6-sigma margin (overflow is
+            # detected and retried wider, never silent)
+            D = max(1, ex_np.shape[0])
+            frac = float(changed.sum()) / D
+            # .shape is metadata — np.asarray here would drag the
+            # whole device-resident raw table over the tunnel
+            S = int(self.raw.shape[1])
+            mu = self.dm.RC_ROW * min(1.0, S * frac)
+            thresh = mu + 6.0 * (mu ** 0.5) + 16.0
+            KT = 128 * int(-(-thresh // 128))
+            if KT > 1024:
+                KT = 0      # massive churn: XLA nonzero path
+        if KT == 0:
+            KA = max(64, min(
+                1 << 19,
+                1 << (max(1, self.pg_num - 1)).bit_length()))
         while True:
             rm = self.dm._compiled_remap(
                 self.ruleno, self.result_max, self.can_shift,
                 self.use_aff, self.pgp_num, self.pgp_mask,
                 self.pool_id, self.hashps, KA, K1, K2, K3, self.npg,
-                self.pg_num)
+                self.pg_num, KT)
             raw2, up2, prim2, counts = rm(self.raw, self.up_full,
                                           self.prim_full, w, ex, iu,
                                           af, cm)
-            nA, nf, n2, n3 = (int(v) for v in np.asarray(counts))
-            if nA <= KA and nf <= K1 and n2 <= K2 and n3 <= K3:
+            nA, nf, n2, n3, rowmax = (int(v)
+                                      for v in np.asarray(counts))
+            if KT and rowmax > KT:
+                KT = 128 * (-(-int(rowmax * 2) // 128))
+                if KT > 2048:
+                    KT = 0
+                    KA = max(64, min(
+                        1 << 19,
+                        1 << (max(1, self.pg_num - 1)).bit_length()))
+                continue
+            if (KA == 0 or nA <= KA) and nf <= K1 and n2 <= K2 \
+                    and n3 <= K3:
                 break
-            KA = max(KA, 1 << (max(1, nA - 1)).bit_length())
-            K1 = max(K1, min(1 << (max(1, nf - 1)).bit_length(), KA))
+            if KA:
+                KA = max(KA, 1 << (max(1, nA - 1)).bit_length())
+            K1 = max(K1, min(1 << (max(1, nf - 1)).bit_length(),
+                             KA or (1 << 19)))
             K2 = max(K2, min(1 << (max(1, n2 - 1)).bit_length(), K1))
             K3 = max(K3, min(1 << (max(1, n3 - 1)).bit_length(), K2))
         return MapState(
@@ -1547,30 +1579,61 @@ class DeviceMapper:
 
         return pps, settle, chain
 
+    # rowcompact geometry: lanes per row group / default slot count
+    RC_ROW = 2048
+    RC_KT = 128
+
+    def _rc_ok(self, npg: int) -> bool:
+        """The pallas rowcompact path needs aligned lane counts and a
+        mosaic-capable backend (or interpret mode in tests)."""
+        from . import pallas_draw
+        return (pallas_draw.pallas_enabled()
+                and npg % (8 * self.RC_ROW) == 0)
+
     @functools.lru_cache(maxsize=None)
     def _compiled_device_resolve(self, ruleno: int, result_max: int,
                                  can_shift: bool, use_aff: bool,
                                  pgp_num: int, pgp_mask: int,
                                  pool_id: int, hashps: bool,
                                  K1: int, K2: int, K3: int, npg: int,
-                                 pg_num: int):
+                                 pg_num: int, kt: int = 0):
         """Device-resident resolve for the full-map pass: compact the
         flagged lanes, settle them through the three-stage chain, and
         scatter back — the only host traffic is the overflow-guard
         counters (essential on a remote-chip tunnel that moves ~5 MB/s
-        with ~100ms latency per readback)."""
+        with ~100ms latency per readback).
+
+        kt > 0 uses the pallas rowcompact kernel for the first
+        compaction: XLA's nonzero over the full PG axis is the single
+        most expensive op of the resolve on this platform (~0.9s at
+        10M lanes, BENCH r4 notes); rowcompact reduces the nonzero to
+        the npg/ROW*kt padded index space.  kt == 0 is the pure-XLA
+        fallback."""
+        from . import pallas_draw
         _pps, _settle, chain = self._resolve_chain_parts(
             ruleno, result_max, can_shift, use_aff, pgp_num, pgp_mask,
             pool_id, hashps, K1, K2, K3)
+        rc = (pallas_draw.make_rowcompact_kernel(
+                  npg, self.RC_ROW, kt, pg_num) if kt else None)
 
         @jax.jit
         def run(raw_t, up, prim, flag, w, ex, iu, af):
-            flag = flag & (jnp.arange(npg, dtype=jnp.int32) < pg_num)
-            nflag = jnp.sum(flag, dtype=jnp.int32)
+            if rc is not None:
+                idxp, validp, cnt = rc(flag)
+                nflag = jnp.sum(validp, dtype=jnp.int32)
+                rowmax = jnp.max(cnt)
+                raw_t, up, prim, n2, n3 = chain(
+                    raw_t, up, prim, validp, nflag,
+                    lambda p: idxp[p], w, ex, iu, af)
+                return raw_t, up, prim, jnp.stack(
+                    [nflag, n2, n3, rowmax])
+            flag2 = flag & (jnp.arange(npg, dtype=jnp.int32) < pg_num)
+            nflag = jnp.sum(flag2, dtype=jnp.int32)
             raw_t, up, prim, n2, n3 = chain(
-                raw_t, up, prim, flag, nflag, lambda p: p, w, ex, iu,
+                raw_t, up, prim, flag2, nflag, lambda p: p, w, ex, iu,
                 af)
-            return raw_t, up, prim, jnp.stack([nflag, n2, n3])
+            return raw_t, up, prim, jnp.stack(
+                [nflag, n2, n3, jnp.int32(0)])
 
         return run
 
@@ -1614,14 +1677,22 @@ class DeviceMapper:
                          1 << (max(1, pg_num - 1)).bit_length()))
         K2 = max(8, min(1 << 13, K1))
         K3 = max(8, min(2048, K1))
+        kt = self.RC_KT if self._rc_ok(npg) else 0
         while True:
             res = self._compiled_device_resolve(
                 ruleno, result_max, bool(can_shift), use_aff,
                 int(pgp_num), int(pgp_num_mask), int(pool_id),
-                bool(hashpspool), K1, K2, K3, npg, pg_num)
+                bool(hashpspool), K1, K2, K3, npg, pg_num, kt)
             raw2, up2, prim2, counts = res(raw, up, prim, flag,
                                            w, ex, iu, af)
-            nflag, n2, ndust = (int(v) for v in np.asarray(counts))
+            nflag, n2, ndust, rowmax = (int(v)
+                                        for v in np.asarray(counts))
+            if kt and rowmax > kt:
+                # a row group overflowed its compaction slots: widen
+                kt = 128 * (-(-int(rowmax * 2) // 128))
+                if kt > 2048:
+                    kt = 0      # absurd flag density: XLA fallback
+                continue
             if nflag <= K1 and n2 <= K2 and ndust <= K3:
                 break
             K1 = max(K1, 1 << (max(1, nflag - 1)).bit_length())
@@ -1638,7 +1709,7 @@ class DeviceMapper:
                         can_shift: bool, use_aff: bool, pgp_num: int,
                         pgp_mask: int, pool_id: int, hashps: bool,
                         KA: int, K1: int, K2: int, K3: int, npg: int,
-                        pg_num: int):
+                        pg_num: int, KT: int = 0):
         """Incremental remap: find the lanes whose raw row touches a
         changed OSD (a hit-scan kernel over the stored raw rows),
         recompute only those through the fast pass, and settle their
@@ -1652,6 +1723,12 @@ class DeviceMapper:
         _pps, settle, chain = self._resolve_chain_parts(
             ruleno, result_max, can_shift, use_aff, pgp_num, pgp_mask,
             pool_id, hashps, K1, K2, K3)
+        # KA == 0 selects the pallas rowcompact compaction (KT slots
+        # per 2048-lane row group): the npg-wide jnp.nonzero this
+        # replaces was ~70% of the whole remap on this platform
+        rc = (pallas_draw.make_rowcompact_kernel(
+                  npg, self.RC_ROW, KT, pg_num)
+              if KA == 0 else None)
 
         @jax.jit
         def run(raw_t, up, prim, w, ex, iu, af, changed):
@@ -1666,17 +1743,31 @@ class DeviceMapper:
                 cb = small_fetch(changed.astype(jnp.int32), idxc, 1)
                 hit = jnp.any((raw_t != ITEM_NONE) & (raw_t < D)
                               & (cb > 0), axis=1)
-            hit = hit & (jnp.arange(npg, dtype=jnp.int32) < pg_num)
-            nA = jnp.sum(hit, dtype=jnp.int32)
-            idxA = jnp.nonzero(hit, size=KA, fill_value=0)[0]
-            raw_t, up, prim, flag = settle(core, raw_t, up, prim,
-                                           idxA, w, ex, iu, af)
-            flag = flag & (jnp.arange(KA, dtype=jnp.int32) < nA)
+            if rc is not None:
+                # padded per-group compaction: pad slots duplicate the
+                # group base lane (settle recomputes it harmlessly)
+                # and the validity mask gates the flags
+                idxA, validA, cnt = rc(hit)
+                nA = jnp.sum(validA, dtype=jnp.int32)
+                rowmax = jnp.max(cnt)
+                raw_t, up, prim, flag = settle(core, raw_t, up, prim,
+                                               idxA, w, ex, iu, af)
+                flag = flag & validA
+            else:
+                hit = hit & (jnp.arange(npg, dtype=jnp.int32)
+                             < pg_num)
+                nA = jnp.sum(hit, dtype=jnp.int32)
+                rowmax = jnp.int32(0)
+                idxA = jnp.nonzero(hit, size=KA, fill_value=0)[0]
+                raw_t, up, prim, flag = settle(core, raw_t, up, prim,
+                                               idxA, w, ex, iu, af)
+                flag = flag & (jnp.arange(KA, dtype=jnp.int32) < nA)
             nflag = jnp.sum(flag, dtype=jnp.int32)
             raw_t, up, prim, n2, n3 = chain(
                 raw_t, up, prim, flag, nflag, lambda p: idxA[p],
                 w, ex, iu, af)
-            return raw_t, up, prim, jnp.stack([nA, nflag, n2, n3])
+            return raw_t, up, prim, jnp.stack(
+                [nA, nflag, n2, n3, rowmax])
 
         return run
 

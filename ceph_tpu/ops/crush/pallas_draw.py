@@ -570,3 +570,161 @@ def make_hitscan_kernel(D: int, S: int):
         return out.reshape(L) != 0
 
     return run
+
+
+def make_rowcompact_kernel(n_lanes: int, row: int, kt: int,
+                           pg_num: int):
+    """Stream compaction of a sparse boolean mask without cumsum or
+    dynamic stores — the jnp.nonzero replacement for the incremental
+    remap's affected-lane gather (XLA's 10M-lane nonzero costs ~0.9s
+    on this platform; see BENCH notes).
+
+    The mask is viewed as NR = n_lanes/row row groups, 8 groups per
+    grid step.  Per group, an MXU triangular-matmul computes hit
+    positions (a block-diagonal strict-lower matrix keeps the prefix
+    inside each group), a one-hot selection matrix compacts the hit
+    lane indices into KT fixed slots (two bf16 limb matmuls reassemble
+    indices exactly — single-term sums, so bf16 is lossless), and a
+    group-membership matmul folds sublane partials per group.  All
+    reads and writes are static blocks: out[g, j] = index of the j-th
+    hit in group g, valid[g, j] = j < count(g) and index < pg_num.
+    Pad slots carry the group base lane (a real, harmless duplicate
+    for the resolve gather/scatter downstream).  Rows with count > KT
+    overflow — detected via the cnt output's max, never silent.
+
+    Returns fn(hit [n_lanes] bool) ->
+      (idx [NR*kt] int32, valid [NR*kt] bool, cnt [NR] int32).
+    """
+    from jax.experimental import pallas as pl
+
+    if n_lanes % (8 * row) or row % 128 or kt % 128:
+        raise ValueError("rowcompact: n_lanes %d / row %d / kt %d "
+                         "misaligned" % (n_lanes, row, kt))
+    r2 = row // 128          # sublane rows per group
+    s8 = 8 * r2              # sublane rows per grid step (8 groups)
+    nr = n_lanes // row
+    interp = _interpret()
+    i32 = jnp.int32
+    f32 = jnp.float32
+    bf16 = jnp.bfloat16
+
+    # U[j, i] = 1 for j <= i: h @ U = inclusive prefix along lanes
+    U128 = np.triu(np.ones((128, 128), np.float32))
+    # block-diagonal strict-lower: exclusive prefix over sublane rows
+    # WITHIN each group of r2 rows
+    LxB = np.zeros((s8, s8), np.float32)
+    for g in range(8):
+        LxB[g * r2:(g + 1) * r2, g * r2:(g + 1) * r2] = \
+            np.tril(np.ones((r2, r2)), k=-1)
+    # group membership: G[g, q] = 1 iff sublane row q is in group g
+    Gm = np.zeros((8, s8), np.float32)
+    for g in range(8):
+        Gm[g, g * r2:(g + 1) * r2] = 1.0
+
+    def kern(h_ref, u_ref, lx_ref, gm_ref, idx_ref, val_ref,
+             cnt_ref):
+        step = pl.program_id(0)
+        h = h_ref[...].astype(f32)                       # (s8, 128)
+        # hits in the padded lane region [pg_num, n_lanes) must not
+        # occupy slots or counts (they would inflate rowmax and waste
+        # settle work); mask them at the source
+        glane = (jax.lax.broadcasted_iota(i32, (s8, 128), 0)
+                 + step * np.int32(s8)) * np.int32(128) \
+            + jax.lax.broadcasted_iota(i32, (s8, 128), 1)
+        h = jnp.where(glane < np.int32(pg_num), h, 0.0)
+        hb = h > 0.0
+        p1 = jax.lax.dot_general(
+            h, u_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)                  # (s8, 128)
+        rsum = jnp.broadcast_to(p1[:, 127:128], (s8, 128))
+        roff = jax.lax.dot_general(
+            lx_ref[...], rsum, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)                  # (s8, 128)
+        roffv = roff[:, 0:1]                             # (s8, 1)
+        rsumv = p1[:, 127:128]                           # (s8, 1)
+        totals = jax.lax.dot_general(
+            gm_ref[...], rsum, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)[:, 0:1]          # (8, 1)
+        # D[r, jr] = lane of the (jr+1)-th hit in sublane row r (a row
+        # of 128 lanes holds at most 128 hits, so 128 columns always
+        # suffice); built as 128 masked lane-reductions — single-term
+        # sums, exact in f32
+        lane_f = jax.lax.broadcasted_iota(
+            i32, (s8, 128), 1).astype(f32)
+        cols = [jnp.sum(jnp.where((p1 - 1.0 == np.float32(jr)) & hb,
+                                  lane_f, 0.0),
+                        axis=1, keepdims=True)
+                for jr in range(128)]
+        D = jnp.concatenate(cols, axis=1)                # (s8, 128)
+        if kt > 128:
+            D = jnp.concatenate(
+                [D, jnp.zeros((s8, kt - 128), f32)], axis=1)
+        # place row r's hits at group slots [roff[r], roff[r]+rsum[r]):
+        # a per-row roll by roff[r], decomposed into static
+        # conditional rolls (Mosaic has no per-row dynamic shift);
+        # wrapped-around junk lands outside the row's slot interval
+        # and is masked by rowsel (capacity overflow is caught via
+        # cnt > kt, never silent)
+        roffi = roffv.astype(i32)
+        sh = D
+        b = 1
+        while b < kt:
+            cond = ((roffi // np.int32(b)) % np.int32(2)) == 1
+            sh = jnp.where(cond, jnp.roll(sh, b, axis=1), sh)
+            b *= 2
+        slot_f = jax.lax.broadcasted_iota(
+            i32, (s8, kt), 1).astype(f32)
+        rowsel = (slot_f >= roffv) & (slot_f < roffv + rsumv)
+        sub_f = (jax.lax.broadcasted_iota(i32, (s8, kt), 0)
+                 % np.int32(r2)).astype(f32)
+        # fold sublane and lane components through SEPARATE matmuls:
+        # the MXU's default precision multiplies in bf16, which is
+        # only exact below 256 — sub (< r2) and lane (< 128) each
+        # qualify, their 128-scaled sum would not
+        sub_m = jnp.where(rowsel, sub_f, 0.0)
+        lane_m = jnp.where(rowsel, sh, 0.0)
+        fold = lambda x: jax.lax.dot_general(  # noqa: E731
+            gm_ref[...], x, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)
+        gbase = (step * np.int32(8)
+                 + jax.lax.broadcasted_iota(i32, (8, kt), 0)) \
+            * np.int32(row)
+        idx = (fold(sub_m).astype(i32) * np.int32(128)
+               + fold(lane_m).astype(i32) + gbase)       # (8, kt)
+        slot8 = jax.lax.broadcasted_iota(
+            i32, (8, kt), 1).astype(f32)
+        valid = ((slot8 < totals)
+                 & (idx < np.int32(pg_num))).astype(i32)
+        idx_ref[...] = idx
+        val_ref[...] = valid
+        cnt_ref[...] = jnp.broadcast_to(totals.astype(i32), (8, 128))
+
+    @jax.jit
+    def run(hit):
+        h2 = hit.astype(i32).reshape(n_lanes // 128, 128)
+        z2 = lambda i: (i32(0), i32(0))  # noqa: E731
+        o8 = lambda i: (i32(i), i32(0))  # noqa: E731
+        idx, val, cnt = pl.pallas_call(
+            kern,
+            grid=(nr // 8,),
+            in_specs=[
+                pl.BlockSpec((s8, 128), o8),
+                pl.BlockSpec((128, 128), z2),
+                pl.BlockSpec((s8, s8), z2),
+                pl.BlockSpec((8, s8), z2),
+            ],
+            out_specs=[
+                pl.BlockSpec((8, kt), o8),
+                pl.BlockSpec((8, kt), o8),
+                pl.BlockSpec((8, 128), o8),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((nr, kt), jnp.int32),
+                jax.ShapeDtypeStruct((nr, kt), jnp.int32),
+                jax.ShapeDtypeStruct((nr, 128), jnp.int32),
+            ],
+            interpret=interp,
+        )(h2, jnp.asarray(U128), jnp.asarray(LxB), jnp.asarray(Gm))
+        return (idx.reshape(-1), val.reshape(-1) != 0, cnt[:, 0])
+
+    return run

@@ -288,6 +288,10 @@ class OSD:
 
     def _advance_pg(self, pg: PG, up, upp, acting, actingp) -> None:
         interval_changed = (acting != pg.acting or actingp != pg.primary)
+        if interval_changed and pg.acting:
+            # remember the data-holding set for pg_temp pinning
+            # (PeeringState keeps this in past_intervals)
+            pg.prev_acting = list(pg.acting)
         pg.up, pg.acting, pg.primary = up, acting, actingp
         if not interval_changed and pg.state in (STATE_ACTIVE,
                                                  STATE_REPLICA):
@@ -541,11 +545,28 @@ class OSD:
 
     def _finish_peering(self, pg: PG) -> None:
         pg.state = STATE_ACTIVE
+        self._maybe_request_pg_temp(pg)
+        # up-but-not-acting members (we are serving under a pg_temp
+        # pin): backfill them too, so the pin can be released once
+        # they hold everything (PeeringState Backfilling with the
+        # acting set pinned to the previous interval's members)
+        extra = [o for o in pg.up
+                 if 0 <= o != ITEM_NONE and o not in pg.acting
+                 and o != self.whoami]
+        for osd in extra:
+            missing = {}
+            for h in self.store.collection_list(pg.cid):
+                if h.name != "__pgmeta__":
+                    missing[h.name] = LogEntry.MODIFY
+            for e in pg.log.entries:
+                missing.setdefault(e.oid, e.op)
+            pg.peer_missing[osd] = missing
         # activate replicas with their DELTA of the authoritative log
         # (backfill targets get the full log and a reset flag)
-        for osd in pg.acting:
+        for osd in list(pg.acting) + extra:
             if 0 <= osd != self.whoami and osd != ITEM_NONE:
-                if osd in getattr(pg, "backfill_targets", set()):
+                if osd in getattr(pg, "backfill_targets", set()) \
+                        or osd in extra:
                     payload = self._pack_log(pg, activate=True,
                                              backfill=True)
                 else:
@@ -564,6 +585,55 @@ class OSD:
         self._maybe_snap_trim(pg)
         if not pg.missing:
             self._requeue_waiters(pg)
+
+    def _maybe_request_pg_temp(self, pg: PG) -> None:
+        """queue_want_pg_temp (PeeringState.cc): when the fresh acting
+        set needs backfill but the previous interval's members are
+        alive and sufficient, ask the monitor to pin acting to them so
+        clients keep full-strength service during backfill
+        (OSDMonitor::prepare_pgtemp commits it; cleared when backfill
+        completes).  Replicated pools only — EC acting sets are
+        positional and pinning them needs shard-aware ordering."""
+        from ..msg.messages import MOSDPGTemp
+        pool = self.osdmap.pools.get(pg.pool_id)
+        if pool is None or pool.is_erasure():
+            return
+        pgid = pg_t(pg.pool_id, pg.ps)
+        if self.osdmap.pg_temp.get(pgid):
+            return                      # already pinned
+        if not getattr(pg, "backfill_targets", None):
+            return
+        prev = [o for o in getattr(pg, "prev_acting", [])
+                if 0 <= o != ITEM_NONE and self.osdmap.is_up(o)]
+        if len(prev) < pool.min_size:
+            return
+        if set(prev) == set(pg.acting):
+            return
+        if getattr(pg, "_temp_req_epoch", -1) >= self.osdmap.epoch:
+            return
+        pg._temp_req_epoch = self.osdmap.epoch
+        self._send_mons(MOSDPGTemp(
+            epoch=self.osdmap.epoch,
+            pgs=[[pg.pool_id, pg.ps, prev]]))
+
+    def _maybe_clear_pg_temp(self, pg: PG) -> None:
+        """Backfill complete: every up member holds everything —
+        release the pg_temp pin so acting flips to the real mapping."""
+        from ..msg.messages import MOSDPGTemp
+        pgid = pg_t(pg.pool_id, pg.ps)
+        if not self.osdmap.pg_temp.get(pgid) or not pg.is_primary():
+            return
+        for o in pg.up:
+            if o < 0 or o == ITEM_NONE or o == self.whoami:
+                continue
+            if pg.peer_missing.get(o):
+                return                  # still backfilling
+        if getattr(pg, "_temp_clear_epoch", -1) >= self.osdmap.epoch:
+            return
+        pg._temp_clear_epoch = self.osdmap.epoch
+        self._send_mons(MOSDPGTemp(
+            epoch=self.osdmap.epoch,
+            pgs=[[pg.pool_id, pg.ps, []]]))
 
     def _activate_replica(self, conn, pg: PG, payload: dict) -> None:
         """Replica activation: append the delta when it chains onto
@@ -691,11 +761,20 @@ class OSD:
 
     async def _ec_recover(self, pg: PG) -> None:
         """EC recovery: reconstruct (never copy) shards
-        (ECBackend::continue_recovery_op)."""
-        await self.ec.recover_primary_shards(pg)
-        for osd_id, missing in list(pg.peer_missing.items()):
-            if missing:
-                await self.ec.recover_peer_shards(pg, osd_id, missing)
+        (ECBackend::continue_recovery_op).  The _recovery_flow guard
+        keeps the heartbeat watchdog from stacking concurrent flows
+        while mClock paces this one."""
+        if getattr(pg, "_recovery_flow", False):
+            return
+        pg._recovery_flow = True
+        try:
+            await self.ec.recover_primary_shards(pg)
+            for osd_id, missing in list(pg.peer_missing.items()):
+                if missing:
+                    await self.ec.recover_peer_shards(pg, osd_id,
+                                                      missing)
+        finally:
+            pg._recovery_flow = False
         if not pg.missing:
             self._requeue_waiters(pg)
 
@@ -798,6 +877,7 @@ class OSD:
         if pm:
             for oid in msg.oids:
                 pm.pop(oid, None)
+        self._maybe_clear_pg_temp(pg)
 
     def _requeue_waiters(self, pg: PG) -> None:
         waiting, pg.waiting_for_active = pg.waiting_for_active, []
@@ -1200,6 +1280,21 @@ class OSD:
             await asyncio.sleep(conf["heartbeat_interval"])
             if self.osdmap is None or not self.booted:
                 continue
+            # recovery watchdog (OSD tick -> RecoveryPreemption /
+            # queue_recovery): a push flow aborted by an interval
+            # change or a dropped reply must not strand missing
+            # objects — re-kick any primary PG with outstanding work
+            # and re-check pg_temp release
+            for pg in list(self.pgs.values()):
+                if not pg.is_primary() or pg.state != STATE_ACTIVE:
+                    continue
+                if (pg.missing
+                        or any(pg.peer_missing.get(o)
+                               for o in pg.peer_missing)) \
+                        and not getattr(pg, "_recovery_flow", False):
+                    self._kick_recovery(pg)
+                self._maybe_clear_pg_temp(pg)
+            self._maybe_send_mgr_report()
             now = time.monotonic()
             grace = conf["heartbeat_grace"]
             # prune state for peers the map says are down, so a later
@@ -1226,6 +1321,39 @@ class OSD:
                     self._send_mons(MOSDFailure(
                         target=osd, failed_for=now - last,
                         epoch=self.osdmap.epoch))
+
+    def _maybe_send_mgr_report(self) -> None:
+        """MgrClient::send_report: ship perf counters + a PG state
+        summary to the active manager recorded in the map."""
+        addr = getattr(self.osdmap, "mgr_addr", "")
+        if not addr:
+            return
+        now = time.monotonic()
+        if now - getattr(self, "_mgr_report_stamp", 0.0) < 2.0:
+            return
+        self._mgr_report_stamp = now
+        from ..msg.messages import MMgrReport
+        from .pg import STATE_INITIAL, STATE_PEERING
+        names = {STATE_ACTIVE: "active", STATE_REPLICA: "replica",
+                 STATE_PEERING: "peering", STATE_INITIAL: "creating"}
+        states: dict[str, int] = {}
+        num_objects = 0
+        for pg in self.pgs.values():
+            st = names.get(pg.state, "unknown")
+            states[st] = states.get(st, 0) + 1
+            if pg.is_primary():
+                if pg.missing or any(pg.peer_missing.get(o)
+                                     for o in pg.peer_missing):
+                    states["recovering"] = \
+                        states.get("recovering", 0) + 1
+                num_objects += sum(
+                    1 for h in self.store.collection_list(pg.cid)
+                    if h.name != "__pgmeta__")
+        self.msgr.send_to(addr, MMgrReport(
+            daemon="osd.%d" % self.whoami, epoch=self.osdmap.epoch,
+            perf=self.ctx.perf.dump(), pg_states=states,
+            num_pgs=len(self.pgs), num_objects=num_objects),
+            entity_hint="mgr")
 
     def _handle_ping(self, conn, msg: MOSDPing) -> None:
         if msg.op == "ping":

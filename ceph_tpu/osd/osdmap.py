@@ -179,6 +179,7 @@ class OSDMap:
         self.crush = CrushMap()
         self.pools: dict[int, PGPool] = {}
         self.pool_max = -1
+        self.mgr_addr = ""          # active manager (MgrMap's role)
         self.pg_temp: dict[pg_t, list[int]] = {}
         self.primary_temp: dict[pg_t, int] = {}
         self.pg_upmap: dict[pg_t, list[int]] = {}
@@ -405,6 +406,8 @@ class OSDMap:
         self.epoch = inc.epoch
         if inc.new_max_osd >= 0:
             self.set_max_osd(inc.new_max_osd)
+        if inc.new_mgr_addr is not None:
+            self.mgr_addr = inc.new_mgr_addr
         for pid, pool in inc.new_pools.items():
             self.pools[pid] = pool
             self.pool_max = max(self.pool_max, pid)
@@ -478,6 +481,7 @@ class OSDMap:
             "crush": self.crush.to_dict(),
             "pools": {str(k): p.to_dict() for k, p in self.pools.items()},
             "pool_max": self.pool_max,
+            "mgr_addr": self.mgr_addr,
             "pg_temp": _enc_pg_map(self.pg_temp),
             "primary_temp": _enc_pg_map(self.primary_temp),
             "pg_upmap": _enc_pg_map(self.pg_upmap),
@@ -507,6 +511,7 @@ class OSDMap:
         m.pools = {int(k): PGPool.from_dict(p)
                    for k, p in d["pools"].items()}
         m.pool_max = d["pool_max"]
+        m.mgr_addr = d.get("mgr_addr", "")
         m.pg_temp = _dec_pg_map(d["pg_temp"], list)
         m.primary_temp = _dec_pg_map(d["primary_temp"], int)
         m.pg_upmap = _dec_pg_map(d["pg_upmap"], list)
@@ -570,6 +575,7 @@ class Incremental:
 
     epoch: int
     new_max_osd: int = -1
+    new_mgr_addr: str | None = None
     new_pools: dict[int, PGPool] = field(default_factory=dict)
     old_pools: list[int] = field(default_factory=list)
     new_state: dict[int, int] = field(default_factory=dict)    # xor bits
@@ -592,6 +598,7 @@ class Incremental:
         return {
             "epoch": self.epoch,
             "new_max_osd": self.new_max_osd,
+            "new_mgr_addr": self.new_mgr_addr,
             "new_pools": {str(k): p.to_dict()
                           for k, p in self.new_pools.items()},
             "old_pools": list(self.old_pools),
@@ -623,6 +630,7 @@ class Incremental:
     def from_dict(cls, d: dict) -> "Incremental":
         inc = cls(epoch=d["epoch"])
         inc.new_max_osd = d["new_max_osd"]
+        inc.new_mgr_addr = d.get("new_mgr_addr")
         inc.new_pools = {int(k): PGPool.from_dict(p)
                          for k, p in d["new_pools"].items()}
         inc.old_pools = list(d["old_pools"])

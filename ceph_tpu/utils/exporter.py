@@ -35,6 +35,12 @@ class PrometheusExporter:
                   desc: str = "") -> None:
         self._gauges[name] = (fn, desc)
 
+    def add_renderer(self, fn: Callable[[], list]) -> None:
+        """Custom line source appended to the exposition (labeled
+        per-daemon series the flat gauge registry cannot express —
+        the mgr's per-report metric families)."""
+        self.__dict__.setdefault("_renderers", []).append(fn)
+
     def render(self) -> str:
         """The exposition document (text format 0.0.4)."""
         lines: list[str] = []
@@ -61,6 +67,11 @@ class PrometheusExporter:
                 elif isinstance(val, (int, float)):
                     lines.append("# TYPE %s counter" % base)
                     lines.append("%s %g" % (base, val))
+        for fn in self.__dict__.get("_renderers", []):
+            try:
+                lines.extend(fn())
+            except Exception:
+                pass
         return "\n".join(lines) + "\n"
 
     async def _handle(self, reader: asyncio.StreamReader,

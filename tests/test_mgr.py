@@ -1,0 +1,114 @@
+"""Manager daemon: report aggregation, cluster metrics, balancer loop.
+
+Mirrors src/mgr/DaemonServer.cc (every daemon reports perf/state to
+the mgr) + the prometheus and balancer mgr modules: one scrape
+endpoint serves per-OSD counters and PG-state summaries for the whole
+cluster, and the balancer timer converges a skewed cluster by
+committing upmap items through the monitor.
+"""
+
+import asyncio
+
+from ceph_tpu.mgr import Manager
+from ceph_tpu.osd.osdmap import pg_t
+from ceph_tpu.utils.context import Context
+
+from test_cluster import FAST_CONF, Cluster, run
+
+
+async def _scrape(addr: str) -> str:
+    host, port = addr.rsplit(":", 1)
+    reader, writer = await asyncio.open_connection(host, int(port))
+    writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+    await writer.drain()
+    data = await asyncio.wait_for(reader.read(-1), 10)
+    writer.close()
+    return data.decode()
+
+
+def _pg_deviation(osdmap, pid) -> float:
+    counts: dict[int, int] = {}
+    pool = osdmap.pools[pid]
+    for ps in range(pool.pg_num):
+        up, _, _, _ = osdmap.pg_to_up_acting_osds(pg_t(pid, ps))
+        for o in up:
+            if o >= 0:
+                counts[o] = counts.get(o, 0) + 1
+    if not counts:
+        return 0.0
+    mean = sum(counts.values()) / len(counts)
+    return max(abs(c - mean) for c in counts.values())
+
+
+def test_mgr_aggregation_and_balancer():
+    async def main():
+        c = await Cluster(4).start()
+        mgr = Manager(c.mon.addr,
+                      Context("mgr", conf_overrides=FAST_CONF),
+                      balance_interval=0.5)
+        try:
+            await mgr.start()
+            out = await c.client.mon_command(
+                "osd pool create", pool="data", pg_num=64, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            for i in range(30):
+                await io.write_full("obj-%d" % i, b"p" * 64)
+
+            # the map records the registered manager
+            assert c.mon.osdmap.mgr_addr == mgr.msgr.addr
+
+            # every OSD's report lands (2s cadence)
+            t0 = asyncio.get_running_loop().time()
+            while len(mgr.daemon_reports) < 4:
+                if asyncio.get_running_loop().time() - t0 > 15:
+                    raise TimeoutError(
+                        "mgr saw only %s" %
+                        sorted(mgr.daemon_reports))
+                await asyncio.sleep(0.1)
+
+            # one scrape endpoint for the whole cluster
+            body = await _scrape(mgr.http_addr)
+            assert 'ceph_tpu_daemon_num_pgs{daemon="osd.0"}' in body
+            assert 'ceph_tpu_daemon_num_pgs{daemon="osd.3"}' in body
+            assert "cluster_num_up_osds 4" in body
+
+            # balancer: runs autonomously and leaves the pool at (or
+            # drives it toward) its deviation target
+            dev0 = _pg_deviation(c.mon.osdmap, pid)
+            t0 = asyncio.get_running_loop().time()
+            while mgr.balancer_rounds < 2:
+                if asyncio.get_running_loop().time() - t0 > 20:
+                    raise TimeoutError("balancer never ran")
+                await asyncio.sleep(0.1)
+            await asyncio.sleep(1.0)   # let commits land
+            dev1 = _pg_deviation(c.mon.osdmap, pid)
+            assert dev1 <= max(dev0, 1.0), (dev0, dev1)
+            if dev0 > 1.0:
+                # skew existed: the balancer must have acted on it
+                assert (mgr.balancer_changes > 0
+                        or dev1 < dev0), (dev0, dev1)
+
+            # after the balancer's churn settles, the aggregated PG
+            # state summary converges to active (reports lag by their
+            # 2s cadence, hence the poll)
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            t0 = asyncio.get_running_loop().time()
+            while True:
+                body = await _scrape(mgr.http_addr)
+                if 'ceph_tpu_pg_state{state="active"}' in body:
+                    break
+                if asyncio.get_running_loop().time() - t0 > 20:
+                    raise TimeoutError(
+                        "pg summary never became active:\n" +
+                        "\n".join(ln for ln in body.splitlines()
+                                  if "pg_state" in ln))
+                await asyncio.sleep(0.3)
+        finally:
+            await mgr.shutdown()
+            await c.stop()
+
+    run(main(), timeout=90)

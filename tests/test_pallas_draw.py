@@ -150,3 +150,73 @@ def test_do_rule_batch_uses_kernel_and_matches_host():
         row = np.full(3, ITEM_NONE, np.int32)
         row[:len(raw)] = raw[:3]
         np.testing.assert_array_equal(row, res[i], err_msg=str(i))
+
+
+def test_rowcompact_remap_parity():
+    """The rowcompact-compacted incremental remap must be bit-equal to
+    a fresh full pass computed with pallas disabled (the XLA nonzero
+    reference path)."""
+    rng = np.random.default_rng(13)
+    m = _two_level_map(rng, hosts=11, per_host=7, uniform=True)
+    dm = dev.DeviceMapper(m)
+    n_osds = 77
+    pg_num = 16384            # npg % (8*RC_ROW) == 0: rc path engages
+    w = np.full((n_osds,), 0x10000, np.int32)
+    ex = np.ones((n_osds,), bool)
+    iu = np.ones((n_osds,), bool)
+    st = dm.map_pool_state(0, 3, pg_num, pg_num, pg_num - 1, 5, True,
+                           w, ex, iu, None, True)
+    assert dm._rc_ok(st.npg), "test setup must exercise rowcompact"
+    # churn: 6 osds out+down -> incremental remap
+    w2 = w.copy()
+    iu2 = iu.copy()
+    for o in (3, 11, 29, 41, 55, 70):
+        w2[o] = 0
+        iu2[o] = False
+    st2 = st.remap(w2, ex, iu2, None)
+    # reference: fresh full pass on the XLA-only path
+    os.environ["CEPH_TPU_NO_PALLAS_CRUSH"] = "1"
+    try:
+        dm_ref = dev.DeviceMapper(m)
+        ref = dm_ref.map_pool_state(0, 3, pg_num, pg_num, pg_num - 1,
+                                    5, True, w2, ex, iu2, None, True)
+    finally:
+        del os.environ["CEPH_TPU_NO_PALLAS_CRUSH"]
+    np.testing.assert_array_equal(np.asarray(st2.up),
+                                  np.asarray(ref.up))
+    np.testing.assert_array_equal(np.asarray(st2.prim),
+                                  np.asarray(ref.prim))
+
+
+def test_rowcompact_remap_parity_padded_pgnum():
+    """pg_num < npg: churn hits in the padded lane region must not
+    consume compaction slots or corrupt counts (kernel-side glane
+    mask), and the remap stays bit-equal to the XLA reference."""
+    rng = np.random.default_rng(17)
+    m = _two_level_map(rng, hosts=11, per_host=7, uniform=True)
+    dm = dev.DeviceMapper(m)
+    n_osds = 77
+    pg_num = 16380            # npg rounds up to 16384
+    w = np.full((n_osds,), 0x10000, np.int32)
+    ex = np.ones((n_osds,), bool)
+    iu = np.ones((n_osds,), bool)
+    st = dm.map_pool_state(0, 3, pg_num, pg_num, 16383, 9, True,
+                           w, ex, iu, None, True)
+    assert st.npg > pg_num and dm._rc_ok(st.npg)
+    w2 = w.copy()
+    iu2 = iu.copy()
+    for o in (2, 17, 33, 48, 61):
+        w2[o] = 0
+        iu2[o] = False
+    st2 = st.remap(w2, ex, iu2, None)
+    os.environ["CEPH_TPU_NO_PALLAS_CRUSH"] = "1"
+    try:
+        dm_ref = dev.DeviceMapper(m)
+        ref = dm_ref.map_pool_state(0, 3, pg_num, pg_num, 16383, 9,
+                                    True, w2, ex, iu2, None, True)
+    finally:
+        del os.environ["CEPH_TPU_NO_PALLAS_CRUSH"]
+    np.testing.assert_array_equal(np.asarray(st2.up),
+                                  np.asarray(ref.up))
+    np.testing.assert_array_equal(np.asarray(st2.prim),
+                                  np.asarray(ref.prim))

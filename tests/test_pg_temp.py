@@ -1,0 +1,109 @@
+"""pg_temp: the primary pins the previous acting set during backfill.
+
+Mirrors the reference flow (PeeringState queue_want_pg_temp ->
+OSDMonitor::prepare_pgtemp -> OSDMap _get_temp_osds): after a remap
+introduces a backfill target, the map should grow pg_temp entries
+pinning acting to the data-holding set, client I/O keeps working (and
+targets the pinned set, not the degraded up set), and the entries
+clear once backfill completes.
+"""
+
+import asyncio
+
+from ceph_tpu.osd.osdmap import pg_t
+
+from test_cluster import FAST_CONF, Cluster, run
+
+SLOW_RECOVERY_CONF = dict(FAST_CONF)
+# small mClock capacity -> recovery paced slowly enough to observe the
+# pg_temp window deterministically
+SLOW_RECOVERY_CONF["osd_mclock_capacity_iops"] = 150.0
+SLOW_RECOVERY_CONF["mon_osd_down_out_interval"] = 3600.0
+# tiny pg log so the fresh member cannot log-recover: it must
+# BACKFILL, which is what pg_temp pins acting for (an untrimmed log
+# makes the new member log-recoverable and no pin is needed)
+SLOW_RECOVERY_CONF["osd_max_pg_log_entries"] = 8
+
+
+def test_pg_temp_pins_previous_acting_during_backfill():
+    async def main():
+        c = Cluster(4)
+        # slow recovery on the OSDs so the backfill window is visible
+        import ceph_tpu.utils.context as ctxmod
+        from ceph_tpu.client import RadosClient
+        from ceph_tpu.mon import Monitor
+        from ceph_tpu.osd.daemon import OSD
+
+        c.mon = Monitor(ctxmod.Context("mon",
+                                       conf_overrides=FAST_CONF))
+        await c.mon.start()
+        for i in range(4):
+            osd = OSD(i, c.mon.addr, ctxmod.Context(
+                "osd.%d" % i, conf_overrides=SLOW_RECOVERY_CONF))
+            await osd.start()
+            c.osds.append(osd)
+        for osd in c.osds:
+            await osd.wait_for_boot()
+        c.client = RadosClient(c.mon.addr)
+        await c.client.connect()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="data", pg_num=8, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            payloads = {}
+            for i in range(120):
+                oid = "obj-%d" % i
+                payloads[oid] = b"x%03d" % i * 50
+                await io.write_full(oid, payloads[oid])
+            # find an osd that serves PGs of this pool, mark it out
+            victim = None
+            for o in range(4):
+                for ps in range(8):
+                    up, _, acting, _ = \
+                        c.mon.osdmap.pg_to_up_acting_osds(
+                            pg_t(pid, ps))
+                    if o in acting:
+                        victim = o
+                        break
+                if victim is not None:
+                    break
+            await c.client.mon_command("osd out", id=victim)
+            # the pg_temp window: entries appear for remapped PGs
+            t0 = asyncio.get_running_loop().time()
+            saw_temp = None
+            while saw_temp is None:
+                if asyncio.get_running_loop().time() - t0 > 15:
+                    raise TimeoutError("no pg_temp entry appeared")
+                for pgid, temp in list(c.mon.osdmap.pg_temp.items()):
+                    if pgid.pool == pid and temp:
+                        saw_temp = (pgid, list(temp))
+                        break
+                await asyncio.sleep(0.01)
+            pgid, temp = saw_temp
+            # during the pin: the mapping serves from the pinned set
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            up, upp, acting, actingp = \
+                c.client.osdmap.pg_to_up_acting_osds(pgid)
+            if c.client.osdmap.pg_temp.get(pgid):
+                assert acting == temp, (acting, temp)
+                assert up != acting
+            # client I/O works throughout the backfill window
+            for oid in ("obj-1", "obj-57", "obj-111"):
+                assert await io.read(oid) == payloads[oid]
+            # ... and the pin clears once backfill completes
+            t0 = asyncio.get_running_loop().time()
+            while any(pg.pool == pid
+                      for pg in c.mon.osdmap.pg_temp):
+                if asyncio.get_running_loop().time() - t0 > 40:
+                    raise TimeoutError("pg_temp never cleared")
+                await asyncio.sleep(0.05)
+            await c.wait_health(pid)
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+        finally:
+            await c.stop()
+
+    run(main(), timeout=120)
