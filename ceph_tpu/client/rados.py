@@ -63,7 +63,9 @@ class RadosClient:
         self.mon_addrs = ([mon_addr] if isinstance(mon_addr, str)
                           else list(mon_addr))
         self._mon_i = 0
-        self.msgr = Messenger(name)
+        from ..msg.auth import AuthContext
+        self.msgr = Messenger(
+            name, auth=AuthContext.from_conf(self.ctx.conf))
         self.msgr.add_dispatcher(self)
         # epoch-0 empty map is the universal incremental base
         self.osdmap: OSDMap = OSDMap()

@@ -35,7 +35,9 @@ class Manager:
         self.mon_addrs = ([mon_addr] if isinstance(mon_addr, str)
                           else list(mon_addr))
         self.ctx = ctx or Context("mgr")
-        self.msgr = Messenger("mgr")
+        from ..msg.auth import AuthContext
+        self.msgr = Messenger(
+            "mgr", auth=AuthContext.from_conf(self.ctx.conf))
         self.msgr.add_dispatcher(self)
         self.osdmap: OSDMap = OSDMap()
         self.balance_interval = balance_interval

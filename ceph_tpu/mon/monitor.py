@@ -84,7 +84,9 @@ class Monitor:
         self._proposal_wake = asyncio.Event() if self.multi else None
         self._proposal_waiters: list = []
         self._last_proposal = None
-        self.msgr = Messenger(name)
+        from ..msg.auth import AuthContext
+        self.msgr = Messenger(
+            name, auth=AuthContext.from_conf(self.ctx.conf))
         self.msgr.add_dispatcher(self)
         self.osdmap = OSDMap()
         self.osdmap.fsid = fsid

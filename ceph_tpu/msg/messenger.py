@@ -165,7 +165,8 @@ class Connection:
                 host, port = self.peer_addr.rsplit(":", 1)
                 reader, writer = await asyncio.open_connection(
                     host, int(port))
-                await self.msgr._handshake_out(self, reader, writer)
+                framer = await self.msgr._handshake_out(self, reader,
+                                                        writer)
             except asyncio.CancelledError:
                 if writer is not None:
                     writer.close()
@@ -180,7 +181,7 @@ class Connection:
                 backoff = min(backoff * 2, 2.0)
                 continue
             backoff = 0.02
-            closed = await self._session(reader, writer)
+            closed = await self._session(reader, writer, framer)
             if closed or self.policy.lossy:
                 await self._die()
                 return
@@ -189,22 +190,25 @@ class Connection:
     async def _run_inbound(self) -> None:
         while self._open:
             try:
-                reader, writer = await self._transports.get()
+                reader, writer, framer = await self._transports.get()
             except asyncio.CancelledError:
                 return
-            closed = await self._session(reader, writer)
+            closed = await self._session(reader, writer, framer)
             if closed or self.policy.lossy:
                 await self._die()
                 return
 
-    async def _session(self, reader, writer) -> bool:
+    async def _session(self, reader, writer, framer=None) -> bool:
         """Run one transport until it faults. Returns True when the
-        peer closed gracefully (no replay should follow)."""
+        peer closed gracefully (no replay should follow).  The AEAD
+        framer is BOUND to this transport (derived from this
+        handshake's nonces), so counters restart exactly when the
+        peer's do."""
         self._writer = writer
         if self.policy.resend:
             self._replay_unacked()
-        rt = asyncio.ensure_future(self._read_frames(reader))
-        wt = asyncio.ensure_future(self._write_frames(writer))
+        rt = asyncio.ensure_future(self._read_frames(reader, framer))
+        wt = asyncio.ensure_future(self._write_frames(writer, framer))
         try:
             done, pending = await asyncio.wait(
                 {rt, wt}, return_when=asyncio.FIRST_COMPLETED)
@@ -232,7 +236,7 @@ class Connection:
 
     # -- frame loops (subtasks of _session) ---------------------------------
 
-    async def _write_frames(self, writer) -> None:
+    async def _write_frames(self, writer, framer=None) -> None:
         while True:
             tag, payload = await self.out_q.get()
             try:
@@ -240,6 +244,8 @@ class Connection:
                         random.randrange(
                             self.msgr.inject_socket_failures) == 0):
                     raise ConnectionError_("injected socket failure")
+                if framer is not None:
+                    payload = framer.seal(payload)
                 await _write_frame(writer, tag, payload)
             except asyncio.CancelledError:
                 raise
@@ -248,14 +254,16 @@ class Connection:
                 # and will be replayed on the next transport
                 return
 
-    async def _read_frames(self, reader) -> None:
+    async def _read_frames(self, reader, framer=None) -> None:
         while True:
             try:
                 tag, payload = await _read_frame(reader)
+                if framer is not None and tag != TAG_CLOSE:
+                    payload = framer.open(payload)
             except asyncio.CancelledError:
                 raise
             except Exception:
-                return  # transport fault -> session ends
+                return  # transport fault (incl. AEAD reject) -> ends
             if tag == TAG_MSG:
                 msg = decode_message(payload)  # poison frame = fault
                 dup = msg.seq <= self.in_seq
@@ -305,8 +313,9 @@ class Connection:
 class Messenger:
     """Endpoint owning connections + the dispatch path."""
 
-    def __init__(self, entity: str, nonce: int = 0):
+    def __init__(self, entity: str, nonce: int = 0, auth=None):
         self.entity = entity
+        self.auth = auth            # AuthContext or None (DummyAuth)
         # the nonce identifies this messenger *instance*: a restarted
         # daemon must present a different one so peers reset sessions
         self.nonce = nonce if nonce else random.getrandbits(63)
@@ -402,6 +411,40 @@ class Messenger:
         conn.peer_nonce = nonce
         ack = peer.get("ack", 0)
         conn.unacked = [(s, d) for s, d in conn.unacked if s > ack]
+        return await self._auth_out(reader, writer)
+
+    @staticmethod
+    async def _read_auth_blob(reader, cap: int = 4096) -> bytes:
+        """Pre-auth reads are fully bounded (time AND size): this is
+        attacker-reachable surface."""
+        (n,) = struct.unpack(">I", await asyncio.wait_for(
+            reader.readexactly(4), 5.0))
+        if n > cap:
+            raise ConnectionError_("auth blob too large (%d)" % n)
+        return await asyncio.wait_for(reader.readexactly(n), 5.0)
+
+    async def _auth_out(self, reader, writer):
+        """Initiator side of the cluster-auth exchange (the cephx
+        authorizer round): mutual HMAC challenge-response over the
+        shared key.  Returns the transport's AEAD framer (secure
+        mode) or None."""
+        if self.auth is None:
+            return None
+        from ..utils import denc
+        from .auth import SecureFramer
+        ncb, hello = self.auth.client_hello()
+        blob = denc.encode(hello)
+        writer.write(struct.pack(">I", len(blob)) + blob)
+        await writer.drain()
+        challenge = denc.decode(await self._read_auth_blob(reader))
+        nsb, reply = self.auth.client_verify(ncb, challenge)
+        blob = denc.encode(reply)
+        writer.write(struct.pack(">I", len(blob)) + blob)
+        await writer.drain()
+        if self.auth.secure:
+            return SecureFramer(self.auth.session_key(ncb, nsb),
+                                initiator=True)
+        return None
 
     # -- inbound -----------------------------------------------------------
 
@@ -421,20 +464,42 @@ class Messenger:
         entity = peer["entity"]
         nonce = peer.get("nonce", 0)
         policy = self.policy_for(entity)
-        # session reuse: a lossless peer reconnecting with the SAME
-        # nonce reattaches to its existing Connection so seq state and
-        # replay work; a different nonce means the peer restarted and
-        # gets a fresh session (ProtocolV2 reset_session)
-        conn = None
+        # READ-ONLY session peek: the ident reply advertises the
+        # session's in_seq, but NO session state may change before the
+        # peer proves the cluster key (an unauthenticated ident could
+        # otherwise tear down live sessions or purge replay queues)
+        existing = None
         if not policy.lossy:
             for c in list(self._inbound):
                 if c.peer_entity == entity and c.is_open:
-                    if c.peer_nonce == nonce:
-                        conn = c
-                    else:
-                        c.mark_down()
-                        await self._reset(c)
+                    existing = c
                     break
+        ack_out = (existing.in_seq
+                   if existing is not None
+                   and existing.peer_nonce == nonce else 0)
+        try:
+            writer.write(BANNER)
+            ident = denc.encode({"entity": self.entity,
+                                 "nonce": self.nonce,
+                                 "addr": self.addr or "",
+                                 "ack": ack_out})
+            writer.write(struct.pack(">I", len(ident)) + ident)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            return
+        ok, framer = await self._auth_in(reader, writer)
+        if not ok:
+            return          # unauthenticated peer: refused
+        # authenticated: now apply session-reuse semantics
+        # (ProtocolV2 reconnect/reset_session)
+        conn = None
+        if not policy.lossy and existing is not None \
+                and existing.is_open:
+            if existing.peer_nonce == nonce:
+                conn = existing
+            else:
+                existing.mark_down()
+                await self._reset(existing)
         if conn is None:
             conn = Connection(self, None, policy)
             conn.peer_entity = entity
@@ -443,17 +508,36 @@ class Messenger:
             conn._start()
         conn.unacked = [(s, d) for s, d in conn.unacked
                         if s > peer.get("ack", 0)]
+        conn._transports.put_nowait((reader, writer, framer))
+
+    async def _auth_in(self, reader, writer):
+        """Acceptor side: refuse any peer that cannot prove the key
+        (AuthRegistry's cephx_cluster_required gate).  Returns
+        (authenticated, framer)."""
+        if self.auth is None:
+            return True, None
+        from ..utils import denc
+        from .auth import AuthError, SecureFramer
         try:
-            writer.write(BANNER)
-            ident = denc.encode({"entity": self.entity,
-                                 "nonce": self.nonce,
-                                 "addr": self.addr or "",
-                                 "ack": conn.in_seq})
-            writer.write(struct.pack(">I", len(ident)) + ident)
+            hello = denc.decode(await self._read_auth_blob(reader))
+            ncb, nsb, challenge = self.auth.server_challenge(hello)
+            blob = denc.encode(challenge)
+            writer.write(struct.pack(">I", len(blob)) + blob)
             await writer.drain()
-        except (ConnectionError, OSError):
-            return
-        conn._transports.put_nowait((reader, writer))
+            self.auth.server_verify(ncb, nsb, denc.decode(
+                await self._read_auth_blob(reader)))
+        except (AuthError, asyncio.TimeoutError, ConnectionError,
+                ConnectionError_, OSError,
+                asyncio.IncompleteReadError, ValueError, KeyError):
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return False, None
+        if self.auth.secure:
+            return True, SecureFramer(
+                self.auth.session_key(ncb, nsb), initiator=False)
+        return True, None
 
     # -- dispatch ----------------------------------------------------------
 

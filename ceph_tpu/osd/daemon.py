@@ -64,7 +64,10 @@ class OSD:
         self._mon_i = whoami % max(1, len(self.mon_addrs))
         self.ctx = ctx or Context("osd.%d" % whoami)
         self.store = store or MemStore()
-        self.msgr = Messenger("osd.%d" % whoami)
+        from ..msg.auth import AuthContext
+        self.msgr = Messenger(
+            "osd.%d" % whoami,
+            auth=AuthContext.from_conf(self.ctx.conf))
         self.msgr.peer_policy["osd"] = Policy.lossless_peer()
         self.msgr.add_dispatcher(self)
         from .ecbackend import ECPGBackend
@@ -407,13 +410,26 @@ class OSD:
         sender = int(msg.src.split(".")[1])
         if payload.get("need_full"):
             # a replica's log diverged from the delta we sent: re-sync
-            # it with the full log and re-push every logged object
+            # with the full log.  When the replica shipped its log we
+            # compute the divergence boundary and push ONLY the
+            # affected objects (PGLog::merge_log); without it, the
+            # conservative whole-log re-push
             if pg.is_primary() and pg.state == STATE_ACTIVE:
+                from .pg import merge_divergent
                 miss = pg.peer_missing.setdefault(sender, {})
-                for oid in payload.get("my_oids") or []:
-                    miss.setdefault(oid, LogEntry.MODIFY)
-                for e in pg.log.entries:
-                    miss.setdefault(e.oid, e.op)
+                narrow = None
+                peer_entries = [LogEntry.from_wire(w)
+                                for w in payload.get("my_log") or []]
+                if peer_entries:
+                    narrow = merge_divergent(peer_entries,
+                                             pg.log.entries)
+                if narrow is not None:
+                    miss.update(narrow)
+                else:
+                    for e in peer_entries:
+                        miss.setdefault(e.oid, LogEntry.MODIFY)
+                    for e in pg.log.entries:
+                        miss.setdefault(e.oid, e.op)
                 self._send_osd(sender, MOSDPGLog(
                     pool=pg.pool_id, ps=pg.ps,
                     epoch=self.osdmap.epoch,
@@ -504,14 +520,21 @@ class OSD:
                 query="log", since=[0, 0]))
             return False
         else:
-            # divergent histories, full log in hand: adopt wholesale;
-            # every oid in either log gets re-synced
-            if pool is None or not pool.is_erasure():
-                for e in pg.log.entries:
-                    if e.version > tail:
-                        pg.missing[e.oid] = LogEntry.MODIFY
-            for e in entries:
-                pg.missing[e.oid] = e.op
+            # divergent histories, full log in hand: roll back only
+            # the entries past the common boundary when the logs
+            # share history (PGLog::merge_log); otherwise every oid in
+            # either log gets re-synced
+            from .pg import merge_divergent
+            narrow = merge_divergent(pg.log.entries, entries)
+            if narrow is not None:
+                pg.missing.update(narrow)
+            else:
+                if pool is None or not pool.is_erasure():
+                    for e in pg.log.entries:
+                        if e.version > tail:
+                            pg.missing[e.oid] = LogEntry.MODIFY
+                for e in entries:
+                    pg.missing[e.oid] = e.op
             pg.replace_log(t, entries, tail)
         if last_update > pg.info.last_update:
             pg.info.last_update = last_update
@@ -667,8 +690,8 @@ class OSD:
                     pool=pg.pool_id, ps=pg.ps,
                     epoch=self.osdmap.epoch,
                     info={"need_full": True,
-                          "my_oids": [e.oid
-                                      for e in pg.log.entries]}))
+                          "my_log": [e.to_wire()
+                                     for e in pg.log.entries]}))
                 return
             for e in entries:
                 if e.version > mine:
@@ -678,14 +701,20 @@ class OSD:
             if last_update > pg.info.last_update:
                 pg.info.last_update = last_update
         else:
-            # full log (divergence re-sync): adopt wholesale; every
-            # logged object is conservatively marked missing — the
-            # primary re-pushes authoritative copies for all of them
+            # full log (divergence re-sync): adopt the authoritative
+            # log, rolling back ONLY the divergent objects when the
+            # logs share history (PGLog::merge_log); disjoint
+            # histories keep the conservative whole-log resync
+            from .pg import merge_divergent
+            narrow = merge_divergent(pg.log.entries, entries)
             pool = self.osdmap.pools.get(pg.pool_id)
-            if pool is None or not pool.is_erasure():
-                pg.missing = {}
-            for e in entries:
-                pg.missing[e.oid] = e.op
+            if narrow is not None:
+                pg.missing.update(narrow)
+            else:
+                if pool is None or not pool.is_erasure():
+                    pg.missing = {}
+                for e in entries:
+                    pg.missing[e.oid] = e.op
             pg.replace_log(t, entries, tail)
             pg.info.last_update = last_update
         pg.persist_meta(t)

@@ -95,6 +95,9 @@ class ECPGBackend:
         self._reads: dict[int, dict] = {}
         self._writes: dict[int, dict] = {}
         self._locks: dict[tuple, _OidLock] = {}
+        # telemetry: shard bytes fetched over the wire (RMW
+        # amplification visibility; tests pin partial-write traffic)
+        self.sub_read_bytes = 0
 
     # -- codec -------------------------------------------------------------
 
@@ -250,7 +253,19 @@ class ECPGBackend:
                                   epoch=epoch, version=0))
             return
 
-        # write path: build the new object payload (RMW when needed)
+        # write path.  Pure in-place overwrites first try the
+        # parity-delta RMW (bytes moved proportional to the touched
+        # range, not the object — ECBackend start_rmw's role)
+        if msg.ops and all(o["op"] == "write" for o in msg.ops):
+            res = await self._try_delta_write(pg, msg)
+            if res is not None:
+                outs2, ok2 = res
+                conn.send(MOSDOpReply(
+                    tid=msg.tid, result=0 if ok2 else -11,
+                    outs=outs2, epoch=epoch,
+                    version=pg.info.last_update[1]))
+                return
+        # whole-object RMW fallback
         outs = []
         current: bytes | None = None
         loaded = False
@@ -318,39 +333,8 @@ class ECPGBackend:
         # snapshot bookkeeping (make_writeable on shards): first write
         # under a newer SnapContext clones every shard object inside
         # the same shard transactions
-        clone_to = None
-        snapset_b = None
-        sna_snaps: list[int] = []
-        whiteout = False
-        snapc = getattr(msg, "snapc", None)
-        if snapc:
-            seq = int(snapc[0])
-            snap_ids = [int(s) for s in snapc[1]]
-            ss = await self._get_snapset(pg, msg.oid)
-            head_exists, head_white = await self._head_state(pg,
-                                                             msg.oid)
-            if ss is None:
-                ss = snapmod.new_snapset()
-            newer = [s for s in snap_ids if s > ss["seq"]]
-            if head_exists and not head_white and newer \
-                    and seq > ss["seq"]:
-                clone_to = seq
-                try:
-                    szb = await self._fetch_xattr(pg, msg.oid,
-                                                  SIZE_XATTR)
-                    size = int(szb or 0)
-                except Exception:
-                    size = 0
-                ss["clones"].append(clone_to)
-                ss["clones"].sort()
-                ss["clone_size"][clone_to] = size
-                ss["clone_snaps"][clone_to] = sorted(newer)
-                sna_snaps = sorted(newer)
-            if seq > ss["seq"]:
-                ss["seq"] = seq
-            if is_delete and ss["clones"]:
-                whiteout = True
-            snapset_b = snapmod.snapset_bytes(ss)
+        clone_to, snapset_b, sna_snaps, whiteout = \
+            await self._prepare_snapc(pg, msg, is_delete)
         ok = await self.submit_write(pg, msg.oid, current, is_delete,
                                      xattrs, clone_to=clone_to,
                                      snapset_b=snapset_b,
@@ -424,12 +408,7 @@ class ECPGBackend:
         hinfo = None if shards is None else hinfo_bytes(shards)
         ho = hobject_t(oid)
 
-        self._tid += 1
-        tid = self._tid
-        waiting: set[int] = set()
-        ev = asyncio.Event()
-        st = {"waiting": waiting, "event": ev}
-        self._writes[tid] = st
+        txns: dict[int, Transaction] = {}
         for j, osd_id in enumerate(pg.acting):
             if osd_id == ITEM_NONE or osd_id < 0:
                 continue
@@ -451,9 +430,30 @@ class ECPGBackend:
             if snapset_b is not None and not (is_delete
                                               and not whiteout):
                 t.setattr(pg.cid, ho, snapmod.SNAPSET_ATTR, snapset_b)
-            for s in (sna_snaps or ()):
+            for sn in (sna_snaps or ()):
                 t.omap_setkeys(pg.cid, PGMETA_OID,
-                               {snapmod.sna_key(s, oid): b"1"})
+                               {snapmod.sna_key(sn, oid): b"1"})
+            txns[j] = t
+        return await self._commit_shard_txns(pg, oid, entry, txns)
+
+    async def _commit_shard_txns(self, pg: PG, oid: str, entry,
+                                 txns: dict[int, "Transaction"]
+                                 ) -> bool:
+        """Distribute per-position shard transactions with the
+        submit_write ack contract: local apply carries the log/meta
+        rows, remotes ride MOSDECSubOpWrite, stragglers become
+        peer_missing, success = >= k shards persisted."""
+        epoch = self.osd.osdmap.epoch
+        self._tid += 1
+        tid = self._tid
+        waiting: set[int] = set()
+        ev = asyncio.Event()
+        st = {"waiting": waiting, "event": ev}
+        self._writes[tid] = st
+        for j, t in txns.items():
+            osd_id = pg.acting[j]
+            if osd_id == ITEM_NONE or osd_id < 0:
+                continue
             if osd_id == self.osd.whoami:
                 entryt = Transaction()
                 entryt.append(t)
@@ -474,15 +474,8 @@ class ECPGBackend:
                 pass
         self._writes.pop(tid, None)
         if st["waiting"]:
-            # a member missed the write: its shard is now behind; mark
-            # it missing so recovery (or the next peering) repairs it
             for osd_id in st["waiting"]:
                 pg.peer_missing.setdefault(osd_id, {})[oid] = entry.op
-            # the write IS durable once >= k shards persisted (the
-            # object decodes and the pg log advanced); failing it
-            # would make a durable write look failed and a client
-            # retry would double-log it.  Only report failure when
-            # fewer than k shards landed — genuinely unreadable.
             codec = self.codec(self.osd.osdmap.pools[pg.pool_id])
             applied = sum(
                 1 for j, osd_id in enumerate(pg.acting)
@@ -493,6 +486,259 @@ class ECPGBackend:
                 return True
             return False
         return True
+
+    async def _prepare_snapc(self, pg: PG, msg,
+                             is_delete: bool = False):
+        """Shared snapshot bookkeeping for both EC write paths:
+        (clone_to, snapset_b, sna_snaps, whiteout)."""
+        from . import snaps as snapmod
+        clone_to = None
+        snapset_b = None
+        sna_snaps: list[int] = []
+        whiteout = False
+        snapc = getattr(msg, "snapc", None)
+        if snapc:
+            seq = int(snapc[0])
+            snap_ids = [int(s) for s in snapc[1]]
+            ss = await self._get_snapset(pg, msg.oid)
+            head_exists, head_white = await self._head_state(pg,
+                                                             msg.oid)
+            if ss is None:
+                ss = snapmod.new_snapset()
+            newer = [s for s in snap_ids if s > ss["seq"]]
+            if head_exists and not head_white and newer \
+                    and seq > ss["seq"]:
+                clone_to = seq
+                try:
+                    szb = await self._fetch_xattr(pg, msg.oid,
+                                                  SIZE_XATTR)
+                    size = int(szb or 0)
+                except Exception:
+                    size = 0
+                ss["clones"].append(clone_to)
+                ss["clones"].sort()
+                ss["clone_size"][clone_to] = size
+                ss["clone_snaps"][clone_to] = sorted(newer)
+                sna_snaps = sorted(newer)
+            if seq > ss["seq"]:
+                ss["seq"] = seq
+            if is_delete and ss["clones"]:
+                whiteout = True
+            snapset_b = snapmod.snapset_bytes(ss)
+        return clone_to, snapset_b, sna_snaps, whiteout
+
+    async def _try_delta_write(self, pg: PG, msg):
+        """Chunk-aware partial overwrite: parity-delta RMW
+        (ECBackend::start_rmw + ECUtil stripe math, ECBackend.cc:1898,
+        ECUtil.h:25-66 — re-derived for the contiguous chunk layout
+        using GF linearity).
+
+        For an in-place overwrite of byte range [a,b) the only chunks
+        whose bytes change are the touched data chunk columns and the
+        SAME columns of every parity chunk:
+
+            new_parity_i[x] = old_parity_i[x] XOR
+                              sum_j gfmul(M[i][j], delta_j[x])
+
+        so the network traffic is (1+m) ranged reads + (1+m) ranged
+        writes proportional to the touched bytes — NOT the object
+        size.  Untouched data shards get an attr-only version bump so
+        readers never mix generations.  Shard crcs (hinfo) update
+        incrementally via crc32 linearity:
+        crc(new) = crc(old) ^ crc(delta0pad) ^ crc(zeros) — computed
+        by the primary with no extra I/O.  Returns op outs, or None
+        when ineligible (growth, degraded members, non-matrix codec,
+        big spans), in which case the caller's whole-object RMW runs.
+        The per-object oid_lock plays the ExtentCache role of
+        serializing overlapping RMW cycles."""
+        import zlib
+
+        from ..ec import gf as gfmod
+        import numpy as np
+        pool = self.osd.osdmap.pools[pg.pool_id]
+        codec = self.codec(pool)
+        matrix = getattr(codec, "matrix", None)
+        if (not matrix or getattr(codec, "w", 0) != 8
+                or codec.get_chunk_mapping()):
+            return None
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        m = n - k
+        if msg.oid in pg.missing or any(
+                msg.oid in pm for pm in pg.peer_missing.values()):
+            # a stale shard exists somewhere: the delta path cannot
+            # detect it (it never reads untouched shards) and must not
+            # re-stamp versions over old bytes — whole-object RMW
+            # rewrites every shard and heals instead
+            return None
+        local = self._local_shard(pg, hobject_t(msg.oid))
+        if local is None:
+            return None                      # primary degraded: RMW
+        _j, _buf, size, ver, lattrs = local
+        from . import snaps as snapmod
+        if lattrs.get(snapmod.WHITEOUT_ATTR) == b"1":
+            return None
+        hinfo_raw = lattrs.get(HINFO_XATTR)
+        if hinfo_raw is None:
+            return None
+        old_crcs = [int(x) for x in hinfo_raw.split(b",")]
+        if len(old_crcs) != n:
+            return None
+        writes = []
+        total = 0
+        for op in msg.ops:
+            off = int(op.get("offset", 0))
+            data = bytes(op["data"])
+            if off < 0 or off + len(data) > size or not data:
+                return None                  # growth/degenerate: RMW
+            writes.append((off, data))
+            total += len(data)
+        if total * 4 > size:
+            return None                      # big span: full RMW wins
+        cs = codec.get_chunk_size(size)
+        # per-chunk parts: {j: [(c0, new_bytes), ...]} in column space
+        per_chunk: dict[int, list] = {}
+        for off, data in writes:
+            pos = off
+            while pos < off + len(data):
+                j = pos // cs
+                c0 = pos % cs
+                take = min(cs - c0, off + len(data) - pos)
+                per_chunk.setdefault(j, []).append(
+                    (c0, data[pos - off:pos - off + take]))
+                pos += take
+        # merged column intervals (parity changes exactly there); a
+        # boundary-crossing write yields ranges at OPPOSITE chunk ends
+        # — they must stay separate reads, never one covering span
+        raw_ivs = sorted((c0, c0 + len(d))
+                         for parts in per_chunk.values()
+                         for c0, d in parts)
+        ivs: list[list[int]] = []
+        for a, b in raw_ivs:
+            if ivs and a <= ivs[-1][1]:
+                ivs[-1][1] = max(ivs[-1][1], b)
+            else:
+                ivs.append([a, b])
+
+        async def ranged(j, a, b):
+            """Old shard bytes [a,b) of position j, or None."""
+            osd_id = pg.acting[j] if j < len(pg.acting) else -1
+            if osd_id < 0 or osd_id == ITEM_NONE:
+                return None
+            if osd_id == self.osd.whoami:
+                loc = self._local_shard(pg, hobject_t(msg.oid))
+                if loc is None or loc[0] != j or loc[3] != ver:
+                    return None
+                return loc[1][a:b]
+            rows = (await self._sub_read(
+                pg, msg.oid, [osd_id], off=a,
+                length=b - a)).get(osd_id) or []
+            if not rows:
+                return None
+            rj, buf, _sz, rver, _attrs = rows[0]
+            if rj != j or tuple(rver) != ver or len(buf) < b - a:
+                return None              # stale/short: full RMW
+            return bytes(buf)
+
+        # old bytes: per-part for touched data chunks, per-interval
+        # for every parity chunk — ALL reads issued concurrently (one
+        # latency round, not one RTT per shard/part)
+        keys: list[tuple] = []
+        coros = []
+        for j, parts in per_chunk.items():
+            for c0, d in parts:
+                keys.append(("d", j, c0))
+                coros.append(ranged(j, c0, c0 + len(d)))
+        for i in range(k, n):
+            for a, b in ivs:
+                keys.append(("p", i, a))
+                coros.append(ranged(i, a, b))
+        results = await asyncio.gather(*coros)
+        old_part: dict[tuple, bytes] = {}
+        old_par: dict[tuple, bytes] = {}
+        for (kind, x, y), ob in zip(keys, results):
+            if ob is None:
+                return None
+            if kind == "d":
+                old_part[(x, y)] = ob
+            else:
+                old_par[(x, y)] = ob
+        # deltas + incremental crcs (crc32 linearity over GF(2))
+        import numpy as _np
+        zeros_cs_crc = zlib.crc32(bytes(cs)) & 0xFFFFFFFF
+        new_crcs = list(old_crcs)
+        delta_part: dict[tuple, bytes] = {}
+        for j, parts in per_chunk.items():
+            dpad = bytearray(cs)
+            for c0, d in parts:
+                ob = old_part[(j, c0)]
+                delta = bytes(x ^ y for x, y in zip(ob, d))
+                delta_part[(j, c0)] = delta
+                dpad[c0:c0 + len(delta)] = delta
+            new_crcs[j] = (old_crcs[j] ^ zlib.crc32(bytes(dpad))
+                           ^ zeros_cs_crc) & 0xFFFFFFFF
+        new_par: dict[tuple, bytes] = {}
+        for i in range(m):
+            dpad = bytearray(cs)
+            for a, b in ivs:
+                acc = _np.zeros((b - a,), dtype=_np.uint8)
+                for j, parts in per_chunk.items():
+                    coef = _np.array([[matrix[i][j]]],
+                                     dtype=_np.uint8)
+                    for c0, d in parts:
+                        if c0 >= b or c0 + len(d) <= a:
+                            continue
+                        darr = _np.frombuffer(
+                            delta_part[(j, c0)], _np.uint8)[None, :]
+                        contrib = gfmod.matmul_u8(coef, darr)[0]
+                        acc[c0 - a:c0 - a + len(d)] ^= contrib
+                ob = _np.frombuffer(old_par[(k + i, a)], _np.uint8)
+                new_par[(k + i, a)] = (ob[:b - a] ^ acc).tobytes()
+                dpad[a:b] = acc.tobytes()
+            new_crcs[k + i] = (old_crcs[k + i]
+                               ^ zlib.crc32(bytes(dpad))
+                               ^ zeros_cs_crc) & 0xFFFFFFFF
+        # snapshot bookkeeping shares the write path's semantics
+        clone_to, snapset_b, sna_snaps, _wo = \
+            await self._prepare_snapc(pg, msg)
+        epoch = self.osd.osdmap.epoch
+        version = (epoch, pg.info.last_update[1] + 1)
+        entry = LogEntry(LogEntry.MODIFY, msg.oid, version,
+                         pg.info.last_update)
+        pg.info.last_update = version
+        pg.log.append(entry)
+        ho = hobject_t(msg.oid)
+        hinfo_b = b",".join(b"%d" % c for c in new_crcs)
+        from . import snaps as _snapmod
+        from .pg import PGMETA_OID
+        txns: dict[int, Transaction] = {}
+        for j in range(min(n, len(pg.acting))):
+            t = Transaction()
+            if clone_to is not None:
+                t.clone(pg.cid, ho,
+                        hobject_t(msg.oid, snap=clone_to))
+            if j in per_chunk:
+                for c0, d in per_chunk[j]:
+                    t.write(pg.cid, ho, c0, len(d), bytes(d))
+            elif j >= k:
+                for a, b in ivs:
+                    t.write(pg.cid, ho, a, b - a,
+                            new_par[(j, a)])
+            t.setattr(pg.cid, ho, VER_XATTR, _ver_bytes(version))
+            t.setattr(pg.cid, ho, HINFO_XATTR, hinfo_b)
+            if snapset_b is not None:
+                t.setattr(pg.cid, ho, _snapmod.SNAPSET_ATTR,
+                          snapset_b)
+                t.setattr(pg.cid, ho, _snapmod.WHITEOUT_ATTR, b"0")
+            for s in (sna_snaps or ()):
+                t.omap_setkeys(pg.cid, PGMETA_OID,
+                               {_snapmod.sna_key(s, msg.oid): b"1"})
+            txns[j] = t
+        ok = await self._commit_shard_txns(pg, msg.oid, entry, txns)
+        # the log entry is appended either way: do NOT fall back to the
+        # whole-object path after a commit attempt (same durability
+        # contract as submit_write: ok = >= k shards persisted)
+        return ([{} for _ in msg.ops], ok)
 
     def handle_sub_write(self, conn, msg: MOSDECSubOpWrite) -> None:
         """Shard side (ECBackend::handle_sub_write)."""
@@ -623,10 +869,13 @@ class ECPGBackend:
         return None
 
     async def _sub_read(self, pg: PG, oid: str,
-                        members: list, snap: int = None) -> dict:
+                        members: list, snap: int = None,
+                        off: int = 0, length: int = -1) -> dict:
         """One round of MOSDECSubOpRead to `members`; returns
         {sender: [(j, bytes, size, ver), ...]}.  snap targets a clone
-        shard object (hobject snap field on the wire row)."""
+        shard object; off/length select a shard byte range (-1 = the
+        whole shard) — the ranged form is what makes partial-overwrite
+        RMW traffic proportional to the touched extent."""
         self._tid += 1
         tid = self._tid
         ev = asyncio.Event()
@@ -636,7 +885,8 @@ class ECPGBackend:
         for osd_id in members:
             self.osd._send_osd(osd_id, MOSDECSubOpRead(
                 pool=pg.pool_id, ps=pg.ps, shard=-1, tid=tid,
-                reads=[[oid, -1, snap]], epoch=self.osd.osdmap.epoch))
+                reads=[[oid, length, snap, off]],
+                epoch=self.osd.osdmap.epoch))
         try:
             await asyncio.wait_for(ev.wait(), 10.0)
         except asyncio.TimeoutError:
@@ -673,6 +923,8 @@ class ECPGBackend:
         for row in msg.reads:
             oid = row[0]
             snap = row[2] if len(row) > 2 else None
+            off = row[3] if len(row) > 3 else 0
+            length = row[1] if len(row) > 1 else -1
             if pg is None:
                 errors.append([oid, -2])
                 continue
@@ -683,6 +935,8 @@ class ECPGBackend:
                 errors.append([oid, -2])
                 continue
             j, buf, size, ver, attrs = local
+            if length is not None and length >= 0:
+                buf = buf[off:off + length]
             wire_attrs = {k: v for k, v in attrs.items()
                           if isinstance(k, str)}
             buffers.append([oid, j, buf, size, list(ver), wire_attrs])
@@ -700,6 +954,7 @@ class ECPGBackend:
             oid, j, buf, sz, ver = row[0], row[1], row[2], row[3], \
                 row[4]
             attrs = row[5] if len(row) > 5 else {}
+            self.sub_read_bytes += len(buf)
             rows.append((j, buf, sz, ver, attrs))
         st["buffers"][sender] = rows
         for oid, err in msg.errors:

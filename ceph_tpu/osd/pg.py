@@ -229,3 +229,39 @@ class PG:
         t.touch(self.cid, PGMETA_OID)
         self.persist_meta(t)
         self.osd.store.apply_transaction(t)
+
+
+def merge_divergent(my_entries, auth_entries):
+    """PGLog::merge_log / _merge_divergent_entries core: given this
+    node's log and the authoritative log, find the newest COMMON entry
+    (same version and object) and compute exactly the objects whose
+    state can differ beyond it:
+
+      * authoritative entries after the common point — the authority
+        changed them; we need its copies;
+      * our own entries after the common point (the divergent ones —
+        writes nobody else acked) — they must be ROLLED BACK to the
+        authority's state (push of its copy, or deletion when the
+        authority never had the object).
+
+    Returns {oid: op} of that narrow set, or None when the logs share
+    no entry at all (disjoint histories — the caller falls back to the
+    conservative whole-log resync, e.g. when the divergence predates
+    the authoritative log's tail)."""
+    auth_keys = {(tuple(e.version), e.oid) for e in auth_entries}
+    common = None
+    for e in reversed(my_entries):
+        if (tuple(e.version), e.oid) in auth_keys:
+            common = tuple(e.version)
+            break
+    if common is None:
+        return None
+    missing: dict[str, str] = {}
+    for e in auth_entries:
+        if tuple(e.version) > common:
+            missing[e.oid] = e.op
+    for e in my_entries:
+        if tuple(e.version) > common:
+            # divergent entry: rollback — authoritative copy wins
+            missing.setdefault(e.oid, LogEntry.MODIFY)
+    return missing

@@ -219,6 +219,12 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_op_num_shards", OPT_INT, 4, "op queue shards per osd"),
     Option("osd_mclock_capacity_iops", OPT_FLOAT, 10000.0,
            "assumed per-osd op capacity for mClock tag rates"),
+    Option("auth_cluster_required", OPT_STR, "none",
+           "cluster auth mode: none | shared (cephx analog)"),
+    Option("auth_key", OPT_STR, "",
+           "shared cluster secret (the keyring role)"),
+    Option("ms_secure_mode", OPT_INT, 0,
+           "1 = AEAD-encrypt every frame (ProtocolV2 secure mode)"),
     Option("osd_recovery_max_active", OPT_INT, 8,
            "max concurrent recovery ops per osd"),
     Option("osd_max_pg_log_entries", OPT_INT, 2000,

@@ -1,0 +1,156 @@
+"""Divergent-log merge: rollback exactly the divergent objects.
+
+Mirrors PGLog::merge_log / _merge_divergent_entries
+(src/osd/PGLog.cc): a replica whose log diverged from the
+authoritative history must re-sync ONLY the objects past the common
+boundary — not every logged object (round-3 behavior this replaces).
+"""
+
+import asyncio
+
+from ceph_tpu.osd.pg import LogEntry, merge_divergent
+
+from test_cluster import Cluster, run
+
+
+def _e(op, oid, v, prior=(0, 0)):
+    return LogEntry(op, oid, v, prior)
+
+
+class TestMergeDivergent:
+    def test_clean_prefix_is_not_divergent(self):
+        auth = [_e("modify", "a", (1, 1)), _e("modify", "b", (1, 2)),
+                _e("modify", "c", (1, 3))]
+        mine = auth[:2]
+        # behind but not divergent: only the tail needs syncing
+        assert merge_divergent(mine, auth) == {"c": "modify"}
+
+    def test_divergent_entries_roll_back(self):
+        common = [_e("modify", "a", (1, 1)), _e("modify", "b", (1, 2))]
+        mine = common + [_e("modify", "x", (1, 3)),
+                         _e("modify", "y", (1, 4))]
+        auth = common + [_e("modify", "c", (2, 3))]
+        got = merge_divergent(mine, auth)
+        # exactly the divergent objects (x, y rolled back) + the
+        # authoritative tail (c) — NOT a or b
+        assert got == {"x": "modify", "y": "modify", "c": "modify"}
+
+    def test_auth_entry_wins_for_shared_object(self):
+        common = [_e("modify", "a", (1, 1))]
+        mine = common + [_e("modify", "o", (1, 2))]
+        auth = common + [_e("delete", "o", (2, 2))]
+        assert merge_divergent(mine, auth) == {"o": "delete"}
+
+    def test_disjoint_histories_fall_back(self):
+        mine = [_e("modify", "a", (1, 1))]
+        auth = [_e("modify", "b", (5, 7))]
+        assert merge_divergent(mine, auth) is None
+
+    def test_empty_mine_with_nonempty_auth(self):
+        auth = [_e("modify", "a", (1, 1))]
+        assert merge_divergent([], auth) is None
+
+
+def test_divergent_replica_rolls_back_only_divergent_objects():
+    """Stage a true divergence: a replica logs a write nobody acked
+    (a primary that died mid-replication), newer-interval writes then
+    supersede it, and the rejoining replica must roll back ONLY the
+    divergent object plus the genuinely new ones — asserted via push
+    counts (PGLog.cc merge_log behavior, replacing round 3's
+    whole-log re-push)."""
+
+    async def main():
+        from ceph_tpu.osd.daemon import OSD
+        from ceph_tpu.utils.context import Context
+        from test_cluster import FAST_CONF
+
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="d", pg_num=1, size=3)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("d")
+            for i in range(20):
+                await io.write_full("obj-%d" % i, b"v%03d" % i)
+
+            from ceph_tpu.osd.osdmap import pg_t
+            pgid = pg_t(pid, 0)
+            _, _, acting, actingp = \
+                c.mon.osdmap.pg_to_up_acting_osds(pgid)
+            replica = next(o for o in acting
+                           if 0 <= o != actingp)
+            rosd = c.osds[replica]
+            pg = rosd.pgs[pgid]
+
+            # forge an unreplicated write on the replica: an entry +
+            # object only it has (the divergent state)
+            from ceph_tpu.store.objectstore import (Transaction,
+                                                    hobject_t)
+            t = Transaction()
+            ho = hobject_t("ghost")
+            t.touch(pg.cid, ho)
+            t.write(pg.cid, ho, 0, 5, b"GHOST")
+            ver = (c.mon.osdmap.epoch, pg.info.last_update[1] + 1)
+            entry = LogEntry(LogEntry.MODIFY, "ghost", ver,
+                             pg.info.last_update)
+            pg.log.append(entry)
+            pg.info.last_update = ver
+            pg.persist_log_entry(t, entry)
+            pg.persist_meta(t)
+            rosd.store.apply_transaction(t)
+
+            # take the diverged replica down; newer-interval writes
+            # supersede its forged entry
+            store = rosd.store
+            await c.kill_osd(replica)
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            while c.client.osdmap.is_up(replica):
+                assert loop.time() - t0 < 30
+                await asyncio.sleep(0.05)
+            for i in range(20, 25):
+                await io.write_full("obj-%d" % i, b"v%03d" % i)
+
+            # revive on the same disk; count what gets pushed to it
+            osd2 = OSD(replica, c.mon.addr,
+                       Context("osd.%d" % replica,
+                               conf_overrides=FAST_CONF),
+                       store=store)
+            pushed: list[str] = []
+            orig = OSD._handle_pg_push
+
+            def spy(self, conn, msg):
+                if self is osd2 and msg.pushes \
+                        and not msg.pushes[0].get("pull"):
+                    pushed.extend(p["oid"] for p in msg.pushes)
+                return orig(self, conn, msg)
+
+            OSD._handle_pg_push = spy
+            try:
+                await osd2.start()
+                await osd2.wait_for_boot()
+                c.osds[replica] = osd2
+                await c.wait_health(pid, timeout=30)
+                t0 = loop.time()
+                while "ghost" not in pushed and loop.time() - t0 < 15:
+                    await asyncio.sleep(0.05)
+            finally:
+                OSD._handle_pg_push = orig
+
+            # the divergent object was rolled back (authority never
+            # had it -> deletion push) and the rollback was NARROW:
+            # ghost + the 5 objects written while it was down, NOT the
+            # 20 clean ones
+            assert "ghost" in pushed, pushed
+            assert len(set(pushed)) <= 8, \
+                "whole-log resync pushed %s" % sorted(set(pushed))
+            pg2 = osd2.pgs[pgid]
+            assert not osd2.store.exists(pg2.cid, ho)
+            for i in (0, 7, 19, 22, 24):
+                assert await io.read("obj-%d" % i) == b"v%03d" % i
+        finally:
+            await c.stop()
+
+    run(main(), timeout=120)
