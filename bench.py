@@ -282,12 +282,22 @@ def bench_backend_path() -> dict:
         return time.perf_counter() - t0
 
     chained(2)
-    t1 = chained(3)
-    t2 = chained(23)
-    if t2 <= t1:
+    estimates = []
+    for _ in range(3):
+        t1 = chained(4)
+        t2 = chained(100)     # long runs: tunnel jitter amortizes
+        if t2 > t1:
+            estimates.append((t2 - t1) / 96)
+    if not estimates:
         return {}
-    per = (t2 - t1) / 20
-    return {"ec_backend_path_gibps": round(k * N / per / (1 << 30), 1)}
+    per = sorted(estimates)[len(estimates) // 2]
+    gibps = k * N / per / (1 << 30)
+    out = {"ec_backend_path_gibps": round(gibps, 1)}
+    if gibps > 600:
+        # above the single-chip HBM roofline (~600 GiB/s payload):
+        # tunnel pipelining noise in the slope, not real throughput
+        out["ec_backend_path_note"] = "above HBM roofline: noisy slope"
+    return out
 
 
 def main() -> None:

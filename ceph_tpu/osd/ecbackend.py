@@ -447,12 +447,22 @@ class ECPGBackend:
         self._tid += 1
         tid = self._tid
         waiting: set[int] = set()
+        down_skipped: set[int] = set()
         ev = asyncio.Event()
         st = {"waiting": waiting, "event": ev}
         self._writes[tid] = st
         for j, t in txns.items():
             osd_id = pg.acting[j]
             if osd_id == ITEM_NONE or osd_id < 0:
+                continue
+            if osd_id != self.osd.whoami \
+                    and not self.osd.osdmap.is_up(osd_id):
+                # a member the map already knows is down cannot ack:
+                # mark it behind immediately instead of stalling the
+                # client write on the sub-op timeout — but it still
+                # counts as NOT applied for the >= k durability check
+                pg.peer_missing.setdefault(osd_id, {})[oid] = entry.op
+                down_skipped.add(osd_id)
                 continue
             if osd_id == self.osd.whoami:
                 entryt = Transaction()
@@ -469,18 +479,21 @@ class ECPGBackend:
                     log_entry=entry.to_wire(), epoch=epoch))
         if waiting:
             try:
-                await asyncio.wait_for(ev.wait(), 10.0)
+                await asyncio.wait_for(
+                    ev.wait(),
+                    float(self.osd.ctx.conf["osd_ec_subop_timeout"]))
             except asyncio.TimeoutError:
                 pass
         self._writes.pop(tid, None)
-        if st["waiting"]:
+        behind = set(st["waiting"]) | down_skipped
+        if behind:
             for osd_id in st["waiting"]:
                 pg.peer_missing.setdefault(osd_id, {})[oid] = entry.op
             codec = self.codec(self.osd.osdmap.pools[pg.pool_id])
             applied = sum(
                 1 for j, osd_id in enumerate(pg.acting)
                 if osd_id != ITEM_NONE and osd_id >= 0
-                and osd_id not in st["waiting"])
+                and osd_id not in behind)
             if applied >= codec.get_data_chunk_count():
                 self.osd._kick_recovery(pg)
                 return True
@@ -888,7 +901,9 @@ class ECPGBackend:
                 reads=[[oid, length, snap, off]],
                 epoch=self.osd.osdmap.epoch))
         try:
-            await asyncio.wait_for(ev.wait(), 10.0)
+            await asyncio.wait_for(
+                ev.wait(),
+                float(self.osd.ctx.conf["osd_ec_subop_timeout"]))
         except asyncio.TimeoutError:
             pass
         self._reads.pop(tid, None)

@@ -219,6 +219,8 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_op_num_shards", OPT_INT, 4, "op queue shards per osd"),
     Option("osd_mclock_capacity_iops", OPT_FLOAT, 10000.0,
            "assumed per-osd op capacity for mClock tag rates"),
+    Option("osd_ec_subop_timeout", OPT_FLOAT, 10.0,
+           "deadline for EC sub-op acks before marking peers behind"),
     Option("auth_cluster_required", OPT_STR, "none",
            "cluster auth mode: none | shared (cephx analog)"),
     Option("auth_key", OPT_STR, "",
