@@ -75,26 +75,31 @@ class AuthContext:
         nc = os.urandom(16)
         return nc, {"nonce": nc.hex()}
 
-    def server_challenge(self, hello: dict) -> tuple[bytes, bytes,
-                                                     dict]:
+    def server_challenge(self, hello: dict, bind: bytes = b"") \
+            -> tuple[bytes, bytes, dict]:
+        """``bind`` is transcript material (the pre-auth ident blobs):
+        mixing it into the proofs makes the unauthenticated part of the
+        handshake tamper-evident — a MITM that rewrites an ident (e.g.
+        to forge a session ack) breaks both proofs even though it
+        relays the auth frames untouched."""
         nc = bytes.fromhex(hello["nonce"])
         ns = os.urandom(16)
-        proof = _hmac(self.key, b"srv", nc, ns)
+        proof = _hmac(self.key, b"srv", nc, ns, bind)
         return nc, ns, {"nonce": ns.hex(), "proof": proof.hex()}
 
-    def client_verify(self, nc: bytes, reply: dict) -> tuple[bytes,
-                                                             dict]:
+    def client_verify(self, nc: bytes, reply: dict,
+                      bind: bytes = b"") -> tuple[bytes, dict]:
         ns = bytes.fromhex(reply["nonce"])
-        want = _hmac(self.key, b"srv", nc, ns)
+        want = _hmac(self.key, b"srv", nc, ns, bind)
         if not hmac.compare_digest(want,
                                    bytes.fromhex(reply["proof"])):
             raise AuthError("server failed key proof")
-        proof = _hmac(self.key, b"cli", nc, ns)
+        proof = _hmac(self.key, b"cli", nc, ns, bind)
         return ns, {"proof": proof.hex()}
 
-    def server_verify(self, nc: bytes, ns: bytes,
-                      reply: dict) -> None:
-        want = _hmac(self.key, b"cli", nc, ns)
+    def server_verify(self, nc: bytes, ns: bytes, reply: dict,
+                      bind: bytes = b"") -> None:
+        want = _hmac(self.key, b"cli", nc, ns, bind)
         if not hmac.compare_digest(want,
                                    bytes.fromhex(reply["proof"])):
             raise AuthError("client failed key proof")
@@ -146,25 +151,31 @@ class SecureFramer:
             ctr += 1
         return bytes(out[:n])
 
-    def seal(self, payload: bytes) -> bytes:
+    def seal(self, payload: bytes, aad: bytes = b"") -> bytes:
+        """``aad`` is authenticated-but-unencrypted associated data —
+        the messenger passes the frame tag so an on-path attacker
+        cannot relabel a frame (e.g. flip it to TAG_CLOSE to fake a
+        graceful shutdown) without failing the MAC."""
         n = self._txn
         self._txn += 1
         ks = self._stream(self._tx, n, len(payload))
         ct = _xor(payload, ks)
         mac = hashlib.blake2b(
-            n.to_bytes(8, "big") + ct, key=self._tx,
-            digest_size=16).digest()
+            n.to_bytes(8, "big")
+            + len(aad).to_bytes(4, "big") + aad + ct,
+            key=self._tx, digest_size=16).digest()
         return ct + mac
 
-    def open(self, blob: bytes) -> bytes:
+    def open(self, blob: bytes, aad: bytes = b"") -> bytes:
         if len(blob) < 16:
             raise AuthError("short secure frame")
         n = self._rxn
         self._rxn += 1
         ct, mac = blob[:-16], blob[-16:]
         want = hashlib.blake2b(
-            n.to_bytes(8, "big") + ct, key=self._rx,
-            digest_size=16).digest()
+            n.to_bytes(8, "big")
+            + len(aad).to_bytes(4, "big") + aad + ct,
+            key=self._rx, digest_size=16).digest()
         if not hmac.compare_digest(mac, want):
             raise AuthError("secure frame MAC mismatch")
         ks = self._stream(self._rx, n, len(ct))

@@ -112,6 +112,7 @@ class Connection:
         self._transports: asyncio.Queue = asyncio.Queue()  # inbound only
         self._supervisor: asyncio.Task | None = None
         self._writer: asyncio.StreamWriter | None = None
+        self._framer = None             # AEAD bound to live transport
 
     # -- public API --------------------------------------------------------
 
@@ -136,15 +137,33 @@ class Connection:
         self._open = False
         if self._writer is not None:
             try:
-                # best-effort graceful close so the peer resets promptly
-                self._writer.write(
-                    _HDR.pack(TAG_CLOSE, 0, zlib.crc32(b"")))
+                # best-effort graceful close so the peer resets
+                # promptly; sealed under the transport AEAD so a close
+                # is only believed when it came from the key holder
+                payload = b""
+                if self._framer is not None:
+                    payload = self._framer.seal(
+                        payload, bytes([TAG_CLOSE]))
+                self._writer.write(_HDR.pack(
+                    TAG_CLOSE, len(payload), zlib.crc32(payload))
+                    + payload)
                 self._writer.close()
             except Exception:
                 pass
+        self._drain_transports()
         if self._supervisor is not None:
             self._supervisor.cancel()
         self.msgr._forget(self)
+
+    def _drain_transports(self) -> None:
+        """Close transports accepted for this session but never run —
+        an abandoned open socket would wedge Server.wait_closed()."""
+        while not self._transports.empty():
+            try:
+                _r, w, _f = self._transports.get_nowait()
+                w.close()
+            except Exception:
+                pass
 
     @property
     def is_open(self) -> bool:
@@ -188,15 +207,19 @@ class Connection:
             await asyncio.sleep(0.01)
 
     async def _run_inbound(self) -> None:
-        while self._open:
-            try:
-                reader, writer, framer = await self._transports.get()
-            except asyncio.CancelledError:
-                return
-            closed = await self._session(reader, writer, framer)
-            if closed or self.policy.lossy:
-                await self._die()
-                return
+        try:
+            while self._open:
+                try:
+                    reader, writer, framer = \
+                        await self._transports.get()
+                except asyncio.CancelledError:
+                    return
+                closed = await self._session(reader, writer, framer)
+                if closed or self.policy.lossy:
+                    await self._die()
+                    return
+        finally:
+            self._drain_transports()
 
     async def _session(self, reader, writer, framer=None) -> bool:
         """Run one transport until it faults. Returns True when the
@@ -205,6 +228,7 @@ class Connection:
         handshake's nonces), so counters restart exactly when the
         peer's do."""
         self._writer = writer
+        self._framer = framer
         if self.policy.resend:
             self._replay_unacked()
         rt = asyncio.ensure_future(self._read_frames(reader, framer))
@@ -225,6 +249,7 @@ class Connection:
         except Exception:
             pass
         self._writer = None
+        self._framer = None
         return any(isinstance(r, _PeerClosed) for r in results)
 
     async def _die(self) -> None:
@@ -245,7 +270,9 @@ class Connection:
                             self.msgr.inject_socket_failures) == 0):
                     raise ConnectionError_("injected socket failure")
                 if framer is not None:
-                    payload = framer.seal(payload)
+                    # the tag rides as AEAD associated data: relabeled
+                    # frames fail the MAC at the receiver
+                    payload = framer.seal(payload, bytes([tag]))
                 await _write_frame(writer, tag, payload)
             except asyncio.CancelledError:
                 raise
@@ -258,8 +285,12 @@ class Connection:
         while True:
             try:
                 tag, payload = await _read_frame(reader)
-                if framer is not None and tag != TAG_CLOSE:
-                    payload = framer.open(payload)
+                if framer is not None:
+                    # every tag is authenticated, TAG_CLOSE included:
+                    # an unverifiable close is a transport fault (so
+                    # lossless replay still runs), never an orderly
+                    # shutdown an attacker could forge
+                    payload = framer.open(payload, bytes([tag]))
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -328,6 +359,12 @@ class Messenger:
         # strong refs: the event loop only weakly references tasks, so
         # fire-and-forget tasks would be GC'd mid-await
         self._tasks: set = set()
+        # every accepted transport, so shutdown can force-close ones
+        # still mid-handshake (weak: sessions own live writers)
+        import weakref
+
+        self._in_writers: weakref.WeakSet = weakref.WeakSet()
+        self._shutting_down = False
         self.default_policy = Policy.lossy_client()
         self.peer_policy: dict[str, Policy] = {}    # by entity type
 
@@ -348,15 +385,35 @@ class Messenger:
         return self.addr
 
     async def shutdown(self) -> None:
-        for conn in list(self._conns.values()) + list(self._inbound):
-            conn.mark_down()
+        self._shutting_down = True
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
-        for t in list(self._tasks):
-            t.cancel()
-        if self._tasks:
-            await asyncio.gather(*self._tasks, return_exceptions=True)
+        # accept handlers may still complete concurrently and (before
+        # _shutting_down was set) spawn supervisors: cancel in passes
+        # until the task set drains
+        for _pass in range(10):
+            for conn in (list(self._conns.values())
+                         + list(self._inbound)):
+                conn.mark_down()
+            for t in list(self._tasks):
+                t.cancel()
+            if not self._tasks:
+                break
+            await asyncio.gather(*list(self._tasks),
+                                 return_exceptions=True)
+        for w in list(self._in_writers):
+            try:
+                w.close()
+            except Exception:
+                pass
+        if self._server is not None:
+            # py3.12 wait_closed() waits for every accepted connection;
+            # _accept closes on all refusal paths so this terminates —
+            # the bound is a backstop so a leak can never hang a daemon
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                pass
 
     def add_dispatcher(self, d) -> None:
         self.dispatchers.append(d)
@@ -402,7 +459,16 @@ class Messenger:
         if banner != BANNER:
             raise ConnectionError_("bad banner %r" % banner)
         (n,) = struct.unpack(">I", await reader.readexactly(4))
-        peer = denc.decode(await reader.readexactly(n))
+        peer_blob = await reader.readexactly(n)
+        peer = denc.decode(peer_blob)
+        # the idents are unauthenticated at this point: they travel as
+        # transcript bind material in the key proofs, and NO session
+        # state (nonce, in_seq, unacked purge) moves until the peer has
+        # proven the cluster key — a forged ident must not be able to
+        # drop queued lossless messages (mirror of the acceptor's
+        # READ-ONLY session peek)
+        framer = await self._auth_out(reader, writer,
+                                      bind=ident + peer_blob)
         conn.peer_entity = peer["entity"]
         nonce = peer.get("nonce", 0)
         if conn.peer_nonce >= 0 and conn.peer_nonce != nonce:
@@ -411,23 +477,25 @@ class Messenger:
         conn.peer_nonce = nonce
         ack = peer.get("ack", 0)
         conn.unacked = [(s, d) for s, d in conn.unacked if s > ack]
-        return await self._auth_out(reader, writer)
+        return framer
 
     @staticmethod
-    async def _read_auth_blob(reader, cap: int = 4096) -> bytes:
+    async def _read_auth_blob(reader, cap: int = 4096,
+                              timeout: float = 5.0) -> bytes:
         """Pre-auth reads are fully bounded (time AND size): this is
         attacker-reachable surface."""
         (n,) = struct.unpack(">I", await asyncio.wait_for(
-            reader.readexactly(4), 5.0))
+            reader.readexactly(4), timeout))
         if n > cap:
             raise ConnectionError_("auth blob too large (%d)" % n)
-        return await asyncio.wait_for(reader.readexactly(n), 5.0)
+        return await asyncio.wait_for(reader.readexactly(n), timeout)
 
-    async def _auth_out(self, reader, writer):
+    async def _auth_out(self, reader, writer, bind: bytes = b""):
         """Initiator side of the cluster-auth exchange (the cephx
         authorizer round): mutual HMAC challenge-response over the
-        shared key.  Returns the transport's AEAD framer (secure
-        mode) or None."""
+        shared key, with the pre-auth ident transcript mixed into the
+        proofs (``bind``) so ident tampering fails auth.  Returns the
+        transport's AEAD framer (secure mode) or None."""
         if self.auth is None:
             return None
         from ..utils import denc
@@ -437,7 +505,7 @@ class Messenger:
         writer.write(struct.pack(">I", len(blob)) + blob)
         await writer.drain()
         challenge = denc.decode(await self._read_auth_blob(reader))
-        nsb, reply = self.auth.client_verify(ncb, challenge)
+        nsb, reply = self.auth.client_verify(ncb, challenge, bind)
         blob = denc.encode(reply)
         writer.write(struct.pack(">I", len(blob)) + blob)
         await writer.drain()
@@ -450,18 +518,45 @@ class Messenger:
 
     async def _accept(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        """Inbound handler.  EVERY exit path must either hand the
+        transport to a Connection or close the writer: an abandoned
+        open socket makes Server.wait_closed() (which waits on all
+        accepted connections in py3.12) hang shutdown forever."""
+        handed_off = False
+        self._in_writers.add(writer)
+        try:
+            handed_off = await self._accept_inner(reader, writer)
+        finally:
+            if not handed_off:
+                # single close point: any refusal/exception path that
+                # did not hand the transport to a Connection closes it
+                # (an abandoned socket wedges Server.wait_closed)
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+    async def _accept_inner(self, reader, writer) -> bool:
+        """Returns True only when the transport was handed off to a
+        Connection; every other outcome is a refusal and _accept
+        closes the writer."""
         from ..utils import denc
 
         try:
-            banner = await reader.readexactly(len(BANNER))
+            # pre-auth reads are time-bounded: an idle dialer must not
+            # pin an accept handler (and thus shutdown) indefinitely
+            banner = await asyncio.wait_for(
+                reader.readexactly(len(BANNER)), 10.0)
             if banner != BANNER:
-                writer.close()
-                return
-            (n,) = struct.unpack(">I", await reader.readexactly(4))
-            peer = denc.decode(await reader.readexactly(n))
-        except (ConnectionError, OSError, asyncio.IncompleteReadError):
-            return
-        entity = peer["entity"]
+                return False
+            peer_blob = await self._read_auth_blob(reader,
+                                                   timeout=10.0)
+            peer = denc.decode(peer_blob)
+            entity = peer["entity"]
+        except (ConnectionError, OSError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, ValueError, KeyError,
+                struct.error, RecursionError, ConnectionError_):
+            return False
         nonce = peer.get("nonce", 0)
         policy = self.policy_for(entity)
         # READ-ONLY session peek: the ident reply advertises the
@@ -486,10 +581,15 @@ class Messenger:
             writer.write(struct.pack(">I", len(ident)) + ident)
             await writer.drain()
         except (ConnectionError, OSError):
-            return
-        ok, framer = await self._auth_in(reader, writer)
+            return False
+        ok, framer = await self._auth_in(reader, writer,
+                                         bind=peer_blob + ident)
         if not ok:
-            return          # unauthenticated peer: refused
+            return False    # unauthenticated peer: refused
+        if self._shutting_down:
+            # a handshake completing after shutdown()'s task snapshot
+            # must not spawn a supervisor nobody will ever cancel
+            return False
         # authenticated: now apply session-reuse semantics
         # (ProtocolV2 reconnect/reset_session)
         conn = None
@@ -508,9 +608,12 @@ class Messenger:
             conn._start()
         conn.unacked = [(s, d) for s, d in conn.unacked
                         if s > peer.get("ack", 0)]
+        if not conn.is_open:
+            return False    # raced mark_down: nobody will run this
         conn._transports.put_nowait((reader, writer, framer))
+        return True
 
-    async def _auth_in(self, reader, writer):
+    async def _auth_in(self, reader, writer, bind: bytes = b""):
         """Acceptor side: refuse any peer that cannot prove the key
         (AuthRegistry's cephx_cluster_required gate).  Returns
         (authenticated, framer)."""
@@ -520,15 +623,17 @@ class Messenger:
         from .auth import AuthError, SecureFramer
         try:
             hello = denc.decode(await self._read_auth_blob(reader))
-            ncb, nsb, challenge = self.auth.server_challenge(hello)
+            ncb, nsb, challenge = self.auth.server_challenge(
+                hello, bind)
             blob = denc.encode(challenge)
             writer.write(struct.pack(">I", len(blob)) + blob)
             await writer.drain()
             self.auth.server_verify(ncb, nsb, denc.decode(
-                await self._read_auth_blob(reader)))
+                await self._read_auth_blob(reader)), bind)
         except (AuthError, asyncio.TimeoutError, ConnectionError,
                 ConnectionError_, OSError,
-                asyncio.IncompleteReadError, ValueError, KeyError):
+                asyncio.IncompleteReadError, ValueError, KeyError,
+                struct.error, RecursionError):
             try:
                 writer.close()
             except Exception:

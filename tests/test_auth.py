@@ -141,3 +141,36 @@ def test_secure_mode_end_to_end():
             await mon.shutdown()
 
     run(main())
+
+
+def test_aead_tag_is_authenticated():
+    """The frame tag rides as AEAD associated data: a frame relabeled
+    on the wire (e.g. MSG -> CLOSE to fake a graceful shutdown) fails
+    the MAC instead of being believed."""
+    ac = AuthContext("shared", b"k" * 16, secure=True)
+    sk = ac.session_key(b"\x01" * 16, b"\x02" * 16)
+    a = SecureFramer(sk, initiator=True)
+    b = SecureFramer(sk, initiator=False)
+    blob = a.seal(b"payload", b"\x01")          # sealed as TAG_MSG
+    with pytest.raises(AuthError):
+        b.open(blob, b"\x04")                   # opened as TAG_CLOSE
+
+
+def test_ident_transcript_bound_to_proofs():
+    """The pre-auth ident blobs are mixed into the key proofs: a MITM
+    that rewrites an ident (say, to forge a session ack that would
+    purge the replay queue) breaks auth even though it relays the
+    proof frames untouched."""
+    ac = AuthContext("shared", b"k" * 16)
+    nc, hello = ac.client_hello()
+    real_bind = b"client-ident" + b"server-ident"
+    forged_bind = b"client-ident-FORGED" + b"server-ident"
+    ncs, ns, challenge = ac.server_challenge(hello, real_bind)
+    # initiator saw the forged ident -> its view of the transcript
+    # differs -> it rejects the server proof
+    with pytest.raises(AuthError):
+        ac.client_verify(nc, challenge, forged_bind)
+    # and symmetrically for the acceptor verifying the client
+    _ns, reply = ac.client_verify(nc, challenge, real_bind)
+    with pytest.raises(AuthError):
+        ac.server_verify(ncs, ns, reply, forged_bind)

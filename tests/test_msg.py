@@ -203,3 +203,29 @@ def test_lossy_client_reset():
         await client.shutdown()
 
     run(main())
+
+
+def test_shutdown_not_wedged_by_halfopen_inbound():
+    """A dialer that connects and goes silent (or disconnects
+    mid-handshake) must not pin the acceptor's shutdown:
+    Server.wait_closed() in py3.12 waits on every accepted connection,
+    so every _accept exit path has to close its transport."""
+
+    async def main():
+        server = Messenger("mon.0")
+        await server.bind()
+        host, port = server.addr.rsplit(":", 1)
+        # 1) connect, send a partial banner, then vanish
+        _r1, w1 = await asyncio.open_connection(host, int(port))
+        w1.write(b"cep")
+        await w1.drain()
+        w1.close()
+        # 2) connect and send nothing at all, keep the socket open
+        _r2, w2 = await asyncio.open_connection(host, int(port))
+        await asyncio.sleep(0.1)
+        t0 = asyncio.get_running_loop().time()
+        await server.shutdown()
+        assert asyncio.get_running_loop().time() - t0 < 4.0
+        w2.close()
+
+    run(main())
