@@ -63,7 +63,9 @@ class OSD:
                           else list(mon_addr))
         self._mon_i = whoami % max(1, len(self.mon_addrs))
         self.ctx = ctx or Context("osd.%d" % whoami)
-        self.store = store or MemStore()
+        from ..store import create_store
+
+        self.store = store or create_store(self.ctx.conf, whoami)
         from ..msg.auth import AuthContext
         self.msgr = Messenger(
             "osd.%d" % whoami,

@@ -106,7 +106,12 @@ class SQLiteKV(KeyValueDB):
     def open(self) -> None:
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA synchronous=NORMAL")
+        # FULL fsyncs the WAL on every commit: sync=True submits must
+        # be power-loss durable (the extent store's deferred in-place
+        # writes depend on the committed WAL record surviving reboot;
+        # NORMAL could roll the commit back and strand a torn block)
+        self._conn.execute("PRAGMA synchronous=FULL")
+        self._sync = True
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS kv "
             "(k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID")
@@ -121,6 +126,10 @@ class SQLiteKV(KeyValueDB):
                            sync: bool = True) -> None:
         assert self._conn is not None, "not open"
         with self._lock:
+            if sync != self._sync:
+                self._conn.execute("PRAGMA synchronous=%s"
+                                   % ("FULL" if sync else "NORMAL"))
+                self._sync = sync
             cur = self._conn.cursor()
             for op in tx.ops:
                 if op[0] == "set":

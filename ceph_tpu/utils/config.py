@@ -236,6 +236,16 @@ DEFAULT_SCHEMA: list[Option] = [
            "max stripes aggregated into one device EC dispatch"),
     Option("ec_batch_flush_us", OPT_INT, 200,
            "deadline before a partial EC batch is flushed (µs)"),
+    Option("osd_objectstore", OPT_STR, "memstore",
+           "backing store engine (src/common/options osd_objectstore)",
+           enum_allowed=("memstore", "kstore", "extentstore")),
+    Option("osd_data", OPT_STR, "",
+           "store directory; empty = ephemeral (RAM engines)"),
+    Option("extentstore_device_size", OPT_INT, 1 << 30,
+           "initial (sparse) block device size in bytes"),
+    Option("extentstore_deferred_threshold", OPT_INT, 65536,
+           "writes at or under this many bytes take the deferred WAL"
+           " path (bluestore_prefer_deferred_size role)"),
     Option("crush_backend", OPT_STR, "auto", "crush mapping backend",
            enum_allowed=("auto", "host", "jax", "native")),
     Option("ec_backend", OPT_STR, "auto", "erasure-code compute backend",

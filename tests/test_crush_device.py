@@ -177,6 +177,7 @@ class TestGoldenMaps:
     """Replay the reference-generated golden vectors on the device engine
     for every straw2-only map in the corpus."""
 
+    @pytest.mark.slow
     def test_golden_straw2_maps(self):
         with open(os.path.join(GOLDEN, "crush_mappings.json")) as f:
             cases = json.load(f)

@@ -1,0 +1,412 @@
+"""ExtentStore-specific guarantees (the BlueStore-class engine):
+WAL crash recovery, torn-block repair, checksum-on-read, bounded
+write amplification, allocator behavior, device growth.
+
+Models src/test/objectstore/store_test.cc's BlueStore sections plus
+the deferred-write kill-point tests."""
+
+import struct
+
+import pytest
+
+from ceph_tpu.store.allocator import (AllocError, BitmapAllocator,
+                                      ExtentAllocator)
+from ceph_tpu.store.blk import FileBlockDevice, MemBlockDevice
+from ceph_tpu.store.extentstore import ChecksumError, ExtentStore
+from ceph_tpu.store.objectstore import Transaction, coll_t, hobject_t
+
+CID = coll_t.pg(1, 0)
+BS = 4096
+
+
+def mkstore(tmp_path, **kw):
+    s = ExtentStore(str(tmp_path / "estore"), dev_size=1 << 24, **kw)
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection(CID)
+    s.apply_transaction(t)
+    return s
+
+
+def write(s, oid, off, data):
+    t = Transaction()
+    t.write(CID, oid, off, len(data), data)
+    s.apply_transaction(t)
+
+
+# -- allocators ------------------------------------------------------------
+
+
+class TestAllocators:
+    @pytest.mark.parametrize("cls", [ExtentAllocator, BitmapAllocator])
+    def test_alloc_release_cycle(self, cls):
+        a = cls(BS) if cls is ExtentAllocator else cls(BS, 0)
+        a.init_add_free(0, 64 * BS)
+        assert a.free_bytes == 64 * BS
+        e1 = a.allocate(10 * BS)
+        assert sum(ln for _o, ln in e1) == 10 * BS
+        e2 = a.allocate(5 * BS)
+        # no overlap between grants
+        got = set()
+        for off, ln in e1 + e2:
+            for b in range(off // BS, (off + ln) // BS):
+                assert b not in got
+                got.add(b)
+        a.release(e1)
+        assert a.free_bytes == 59 * BS
+        with pytest.raises(AllocError):
+            a.allocate(60 * BS)
+
+    @pytest.mark.parametrize("cls", [ExtentAllocator, BitmapAllocator])
+    def test_fragmented_allocation(self, cls):
+        a = cls(BS) if cls is ExtentAllocator else cls(BS, 0)
+        a.init_add_free(0, 16 * BS)
+        grants = [a.allocate(BS) for _ in range(16)]
+        # free every other unit -> 8 fragments
+        for g in grants[::2]:
+            a.release(g)
+        out = a.allocate(8 * BS)
+        assert sum(ln for _o, ln in out) == 8 * BS
+        assert a.free_bytes == 0
+
+    def test_double_free_detected(self):
+        a = ExtentAllocator(BS)
+        a.init_add_free(0, 4 * BS)
+        with pytest.raises(AllocError):
+            a.init_add_free(BS, BS)
+
+    def test_extent_coalescing(self):
+        a = ExtentAllocator(BS)
+        a.init_add_free(0, BS)
+        a.init_add_free(2 * BS, BS)
+        a.init_add_free(BS, BS)        # bridges the gap
+        [(off, ln)] = a.allocate(3 * BS)
+        assert (off, ln) == (0, 3 * BS)
+
+
+# -- data path -------------------------------------------------------------
+
+
+class TestDataPath:
+    def test_partial_write_is_extent_granular(self, tmp_path):
+        """A 4 KiB overwrite of a 4 MiB object must not rewrite the
+        image: KV bytes for the second txn stay ~onode-sized and only
+        the touched block's WAL image is carried."""
+        s = mkstore(tmp_path)
+        oid = hobject_t("big", pool=1)
+        write(s, oid, 0, b"\xab" * (4 << 20))
+
+        staged = []
+        orig_get = s.db.get_transaction
+
+        def spy():
+            b = orig_get()
+            staged.append(b)
+            return b
+
+        s.db.get_transaction = spy
+        write(s, oid, 123456, b"\xcd" * 4096)
+        s.db.get_transaction = orig_get
+        kv_bytes = sum(len(op[1]) + len(op[2] if len(op) > 2 else b"")
+                       for b in staged for op in b.ops)
+        # onode map (~16 KiB for 1024 blocks) + two 4 KiB WAL images,
+        # nowhere near the 4 MiB object
+        assert kv_bytes < 64 << 10
+        data = s.read(CID, oid, 123456 - 8, 4096 + 16)
+        assert data == (b"\xab" * 8) + (b"\xcd" * 4096) + (b"\xab" * 8)
+        s.umount()
+
+    def test_unaligned_writes_and_holes(self, tmp_path):
+        s = mkstore(tmp_path)
+        oid = hobject_t("o", pool=1)
+        write(s, oid, 5, b"hello")
+        write(s, oid, BS * 3 + 100, b"far")
+        assert s.read(CID, oid, 0, 5) == b"\x00" * 5
+        assert s.read(CID, oid, 5, 5) == b"hello"
+        assert s.read(CID, oid, BS, BS) == b"\x00" * BS   # hole
+        assert s.stat(CID, oid) == BS * 3 + 103
+        s.umount()
+
+    def test_remount_durability(self, tmp_path):
+        s = mkstore(tmp_path)
+        oid = hobject_t("o", pool=1)
+        write(s, oid, 0, b"persist me" * 1000)
+        t = Transaction()
+        t.setattr(CID, oid, "_", b"meta")
+        t.omap_setkeys(CID, oid, {b"k": b"v"})
+        s.apply_transaction(t)
+        s.umount()
+
+        s2 = ExtentStore(str(tmp_path / "estore"))
+        s2.mount()
+        assert s2.read(CID, oid) == b"persist me" * 1000
+        assert s2.getattr(CID, oid, "_") == b"meta"
+        assert s2.omap_get(CID, oid) == {b"k": b"v"}
+        s2.umount()
+
+    def test_big_write_goes_cow(self, tmp_path):
+        """Large aligned writes take fresh blocks (no WAL payload)."""
+        s = mkstore(tmp_path)
+        oid = hobject_t("o", pool=1)
+        payload = bytes(range(256)) * (256 * 4)  # 256 KiB > threshold
+        write(s, oid, 0, payload)
+        assert s.read(CID, oid) == payload
+        # overwrite: the object must land on different blocks, and the
+        # old ones must be reusable afterwards
+        before = dict(s._colls[CID].onodes[oid].blocks)
+        write(s, oid, 0, payload[::-1])
+        after = s._colls[CID].onodes[oid].blocks
+        assert all(before[b][0] != after[b][0] for b in before)
+        assert s.read(CID, oid) == payload[::-1]
+        s.umount()
+
+    def test_truncate_regrow_reads_zeros(self, tmp_path):
+        s = mkstore(tmp_path)
+        oid = hobject_t("o", pool=1)
+        write(s, oid, 0, b"helloworld")
+        t = Transaction()
+        t.truncate(CID, oid, 5)
+        t.truncate(CID, oid, 10)
+        s.apply_transaction(t)
+        assert s.read(CID, oid) == b"hello" + b"\x00" * 5
+        s.umount()
+
+
+# -- crash recovery --------------------------------------------------------
+
+
+class TestCrashRecovery:
+    def test_kill_between_wal_commit_and_apply(self, tmp_path):
+        """The VERDICT kill-point: deferred write committed to the KV
+        but never applied to the device.  Remount must replay the WAL
+        and serve the new data."""
+        s = mkstore(tmp_path)
+        oid = hobject_t("o", pool=1)
+        write(s, oid, 0, b"A" * (64 << 10))
+        s.crash_before_deferred_apply = True
+        write(s, oid, 1000, b"NEWDATA")    # small -> deferred
+        # simulate the crash: drop the store without umount flushing
+        s.dev.close()
+        s.db.close()
+
+        s2 = ExtentStore(str(tmp_path / "estore"))
+        s2.mount()
+        assert s2.read(CID, oid, 1000, 7) == b"NEWDATA"
+        assert s2.read(CID, oid, 0, 4) == b"AAAA"
+        # WAL was consumed: records are gone
+        assert not list(s2.db.iterate(b"W\x00", b"W\x00\xff"))
+        s2.umount()
+
+    def test_torn_inplace_block_repaired_by_replay(self, tmp_path):
+        """A torn in-place write (garbage where the deferred apply was
+        headed) is rewritten by WAL replay — the reason small
+        overwrites go through the WAL at all."""
+        s = mkstore(tmp_path)
+        oid = hobject_t("o", pool=1)
+        write(s, oid, 0, b"B" * BS)
+        doff = s._colls[CID].onodes[oid].blocks[0][0]
+        s.crash_before_deferred_apply = True
+        write(s, oid, 100, b"patch")
+        dev_path = str(tmp_path / "estore" / "block")
+        s.dev.close()
+        s.db.close()
+        # tear the block: half old, half garbage
+        with open(dev_path, "r+b") as f:
+            f.seek(doff)
+            f.write(b"\xff" * (BS // 2))
+
+        s2 = ExtentStore(str(tmp_path / "estore"))
+        s2.mount()
+        expect = bytearray(b"B" * BS)
+        expect[100:105] = b"patch"
+        assert s2.read(CID, oid) == bytes(expect)
+        s2.umount()
+
+    def test_crash_before_kv_commit_keeps_old_object(self, tmp_path):
+        """Big-write COW: fresh blocks written pre-commit are garbage
+        on free space if the commit never lands; the old extent map
+        still reads the old bytes."""
+        s = mkstore(tmp_path)
+        oid = hobject_t("o", pool=1)
+        old = b"OLD!" * ((256 << 10) // 4)
+        write(s, oid, 0, old)
+        # intercept the KV commit to simulate dying right before it
+        def no_commit(tx, sync=True):
+            raise RuntimeError("simulated crash before kv commit")
+
+        orig = s.db.submit_transaction
+        s.db.submit_transaction = no_commit
+        with pytest.raises(RuntimeError):
+            write(s, oid, 0, b"NEW!" * ((256 << 10) // 4))
+        s.db.submit_transaction = orig
+        s.dev.close()
+        s.db.close()
+
+        s2 = ExtentStore(str(tmp_path / "estore"))
+        s2.mount()
+        assert s2.read(CID, oid) == old
+        s2.umount()
+
+
+# -- checksums -------------------------------------------------------------
+
+
+class TestChecksums:
+    def test_bitrot_detected_on_read(self, tmp_path):
+        s = mkstore(tmp_path)
+        oid = hobject_t("o", pool=1)
+        write(s, oid, 0, b"C" * BS)
+        doff = s._colls[CID].onodes[oid].blocks[0][0]
+        s.umount()
+        with open(str(tmp_path / "estore" / "block"), "r+b") as f:
+            f.seek(doff + 17)
+            f.write(b"\x55")            # single flipped byte
+
+        s2 = ExtentStore(str(tmp_path / "estore"))
+        s2.mount()
+        with pytest.raises(ChecksumError):
+            s2.read(CID, oid)
+        s2.umount()
+
+    def test_memdevice_roundtrip(self):
+        """Ephemeral extent store (RAM device + RAM KV) for OSDs in
+        tests: same engine, no files."""
+        s = ExtentStore("", dev_size=1 << 22)
+        s.mkfs()
+        s.mount()
+        t = Transaction()
+        t.create_collection(CID)
+        s.apply_transaction(t)
+        oid = hobject_t("o", pool=1)
+        write(s, oid, 0, b"ram" * 1000)
+        assert s.read(CID, oid) == b"ram" * 1000
+        s.umount()
+
+
+class TestDeviceGrowth:
+    def test_device_extends_when_full(self, tmp_path):
+        s = ExtentStore(str(tmp_path / "estore"), dev_size=1 << 20)
+        s.mkfs()
+        s.mount()
+        t = Transaction()
+        t.create_collection(CID)
+        s.apply_transaction(t)
+        oid = hobject_t("o", pool=1)
+        data = b"G" * (4 << 20)          # 4x the initial device
+        write(s, oid, 0, data)
+        assert s.read(CID, oid) == data
+        assert s.dev.size >= 4 << 20
+        s.umount()
+        s2 = ExtentStore(str(tmp_path / "estore"))
+        s2.mount()
+        assert s2.read(CID, oid) == data
+        s2.umount()
+
+
+# -- cluster e2e on the extent store ---------------------------------------
+
+
+def test_cluster_runs_on_extentstore(tmp_path):
+    """The conf switch the VERDICT asked for: mon + OSDs boot with
+    osd_objectstore=extentstore on real files, serve I/O, and a
+    restarted OSD remounts its device+KV and recovers."""
+    import asyncio
+
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_cluster import FAST_CONF, run
+    from ceph_tpu.client import RadosClient
+    from ceph_tpu.mon import Monitor
+    from ceph_tpu.osd.daemon import OSD
+    from ceph_tpu.utils.context import Context
+
+    conf = dict(FAST_CONF)
+    conf.update({"osd_objectstore": "extentstore",
+                 "osd_data": str(tmp_path),
+                 "extentstore_device_size": 1 << 24})
+
+    async def main():
+        mon = Monitor(Context("mon", conf_overrides=conf))
+        await mon.start()
+        osds = []
+        for i in range(3):
+            o = OSD(i, mon.addr,
+                    Context("osd.%d" % i, conf_overrides=conf))
+            await o.start()
+            osds.append(o)
+        for o in osds:
+            await o.wait_for_boot()
+        client = RadosClient(mon.addr,
+                             Context("client", conf_overrides=conf))
+        try:
+            await client.connect()
+            out = await client.mon_command(
+                "osd pool create", pool="p", pg_num=8, size=3)
+            await client.wait_for_epoch(mon.osdmap.epoch)
+            io = client.io_ctx("p")
+            payloads = {}
+            for i in range(12):
+                data = bytes([i]) * (3000 + 700 * i)
+                payloads["obj-%d" % i] = data
+                await io.write_full("obj-%d" % i, data)
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+
+            # restart osd.1 from its on-disk state (fresh OSD object,
+            # conf-driven store pointed at the same directory)
+            await osds[1].shutdown()
+            t0 = asyncio.get_running_loop().time()
+            while client.osdmap.is_up(1):
+                assert asyncio.get_running_loop().time() - t0 < 30
+                await asyncio.sleep(0.05)
+            await io.write_full("while-down", b"fresh")
+            o = OSD(1, mon.addr,
+                    Context("osd.1", conf_overrides=conf))
+            await o.start()
+            await o.wait_for_boot()
+            osds[1] = o
+            t0 = asyncio.get_running_loop().time()
+            while not client.osdmap.is_up(1):
+                assert asyncio.get_running_loop().time() - t0 < 30
+                await asyncio.sleep(0.05)
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+            assert await io.read("while-down") == b"fresh"
+        finally:
+            await client.shutdown()
+            for o in osds:
+                if not o.stopping:
+                    await o.shutdown()
+            await mon.shutdown()
+
+    run(main(), timeout=120)
+
+
+def test_failed_op_rolls_back_ram_state(tmp_path):
+    """A mid-transaction failure must not leave RAM diverged from the
+    KV (phantom objects readable until restart, leaked allocations):
+    the whole batch rolls back to committed state."""
+    s = mkstore(tmp_path)
+    pre = hobject_t("pre", pool=1)
+    write(s, pre, 0, b"keep me")
+    free0 = s.alloc.free_bytes
+    oid = hobject_t("x", pool=1)
+    t = Transaction()
+    t.create(CID, oid)
+    s.apply_transaction(t)
+    t = Transaction()
+    t.write(CID, hobject_t("phantom", pool=1), 0, 1 << 20,
+            b"p" * (1 << 20))
+    t.create(CID, oid)                 # AlreadyExists, after the write
+    with pytest.raises(Exception):
+        s.apply_transaction(t)
+    assert not s.exists(CID, hobject_t("phantom", pool=1))
+    assert s.read(CID, pre) == b"keep me"
+    assert s.alloc.free_bytes == free0          # no leaked blocks
+    s.umount()
+    s2 = ExtentStore(str(tmp_path / "estore"))
+    s2.mount()
+    assert not s2.exists(CID, hobject_t("phantom", pool=1))
+    assert s2.exists(CID, oid)
+    s2.umount()

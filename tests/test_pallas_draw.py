@@ -152,6 +152,7 @@ def test_do_rule_batch_uses_kernel_and_matches_host():
         np.testing.assert_array_equal(row, res[i], err_msg=str(i))
 
 
+@pytest.mark.slow
 def test_rowcompact_remap_parity():
     """The rowcompact-compacted incremental remap must be bit-equal to
     a fresh full pass computed with pallas disabled (the XLA nonzero
@@ -188,6 +189,7 @@ def test_rowcompact_remap_parity():
                                   np.asarray(ref.prim))
 
 
+@pytest.mark.slow
 def test_rowcompact_remap_parity_padded_pgnum():
     """pg_num < npg: churn hits in the padded lane region must not
     consume compaction slots or corrupt counts (kernel-side glane

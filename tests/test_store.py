@@ -1,10 +1,11 @@
-"""ObjectStore tier tests (MemStore + KStore), modeled on the
-reference's store_test.cc basics: transaction semantics, object facets,
-collection listing order, splits, and KStore durability across
+"""ObjectStore tier tests (MemStore + KStore + ExtentStore), modeled
+on the reference's store_test.cc basics: transaction semantics, object
+facets, collection listing order, splits, and durability across
 mount cycles."""
 
 import pytest
 
+from ceph_tpu.store.extentstore import ExtentStore
 from ceph_tpu.store.kstore import KStore
 from ceph_tpu.store.kv import MemKV, SQLiteKV
 from ceph_tpu.store.memstore import MemStore
@@ -33,10 +34,17 @@ def make_kstore(tmp_path):
     return s
 
 
-@pytest.fixture(params=["memstore", "kstore"])
+def make_extentstore(tmp_path):
+    s = ExtentStore(str(tmp_path / "estore"), dev_size=1 << 24)
+    s.mkfs()
+    s.mount()
+    return s
+
+
+@pytest.fixture(params=["memstore", "kstore", "extentstore"])
 def store(request, tmp_path):
-    s = (make_memstore if request.param == "memstore"
-         else make_kstore)(tmp_path)
+    s = {"memstore": make_memstore, "kstore": make_kstore,
+         "extentstore": make_extentstore}[request.param](tmp_path)
     yield s
     s.umount()
 
