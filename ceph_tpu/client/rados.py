@@ -23,15 +23,20 @@ from ..osd.osdmap import OSDMap, consume_map_payload, pg_t
 from ..utils.context import Context
 
 
-class ObjectNotFound(Exception):
-    pass
-
-
 class RadosError(Exception):
     def __init__(self, code: int, detail=None):
         super().__init__("rados error %d: %r" % (code, detail))
         self.code = code
         self.detail = detail
+
+
+class ObjectNotFound(RadosError):
+    """ENOENT surface — a RadosError subclass so callers matching the
+    documented errno contract (`except RadosError as e: e.code`)
+    catch it too."""
+
+    def __init__(self, oid):
+        super().__init__(-2, oid)
 
 
 class _InFlight:
@@ -442,6 +447,18 @@ class IoCtx:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "truncate", "length": int(length)}],
             snapc=self._snapc())
+
+    async def exec(self, oid: str, cls: str, method: str,
+                   inp: dict | None = None) -> dict:
+        """Run an in-OSD object-class method (librados exec /
+        CEPH_OSD_OP_CALL): the primary routes it to the read or write
+        interpreter by the method's registered RD/WR flags and returns
+        the method's output dict.  Errors surface as RadosError with
+        the method's errno-style code."""
+        outs = await self.client.submit_op(self.pool_id, oid, [
+            {"op": "call", "cls": cls, "method": method,
+             "input": dict(inp or {})}], snapc=self._snapc())
+        return outs[0].get("out", {})
 
     async def watch(self, oid: str, callback) -> None:
         """Register interest: callback(payload) runs on every notify

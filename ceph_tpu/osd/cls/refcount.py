@@ -1,0 +1,76 @@
+"""cls_refcount: tag-set reference counting with self-delete.
+
+Analog of src/cls/refcount/cls_refcount.cc (the machinery RGW uses to
+share one RADOS object among logical copies): refs are a set of tags
+in an xattr; ``put`` on the last tag removes the object inside the
+same atomic method — no client round-trip can race it.
+
+An object with no refcount attr holds one implicit wildcard ref
+(the reference's cls_refcount_put behavior): the first ``put``
+removes it regardless of tag.
+"""
+
+from __future__ import annotations
+
+from ...utils import denc
+from . import EINVAL, ENOENT, RD, WR, ClsError, MethodContext
+
+REF_XATTR = "refcount"
+
+
+def _load(ctx: MethodContext) -> list | None:
+    blob = ctx.getxattr(REF_XATTR)
+    return list(denc.decode(blob)) if blob else None
+
+
+def get(ctx: MethodContext, inp: dict) -> dict:
+    tag = inp.get("tag", "")
+    if not tag:
+        raise ClsError(EINVAL, "empty tag")
+    refs = _load(ctx) or []
+    if tag not in refs:
+        refs.append(tag)
+    ctx.setxattr(REF_XATTR, denc.encode(refs))
+    return {}
+
+
+def put(ctx: MethodContext, inp: dict) -> dict:
+    tag = inp.get("tag", "")
+    if not tag:
+        raise ClsError(EINVAL, "empty tag")
+    if not ctx.exists():
+        raise ClsError(ENOENT, "object absent")
+    refs = _load(ctx)
+    if refs is None:
+        # implicit single wildcard ref
+        ctx.remove()
+        return {"removed": True}
+    if tag not in refs:
+        raise ClsError(ENOENT, "no such tag")
+    refs.remove(tag)
+    if refs:
+        ctx.setxattr(REF_XATTR, denc.encode(refs))
+        return {"removed": False}
+    ctx.remove()
+    return {"removed": True}
+
+
+def set_refs(ctx: MethodContext, inp: dict) -> dict:
+    refs = list(inp.get("refs", []))
+    if not refs:
+        raise ClsError(EINVAL, "empty ref list")
+    ctx.setxattr(REF_XATTR, denc.encode(refs))
+    return {}
+
+
+def read(ctx: MethodContext, inp: dict) -> dict:
+    return {"refs": _load(ctx) or []}
+
+
+def register(h) -> None:
+    h.register_class("refcount", {
+        "get": (WR, get),
+        "put": (WR, put),
+        "set": (WR, set_refs),
+        "read": (RD, read),
+    })

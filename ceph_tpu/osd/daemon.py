@@ -72,11 +72,13 @@ class OSD:
             auth=AuthContext.from_conf(self.ctx.conf))
         self.msgr.peer_policy["osd"] = Policy.lossless_peer()
         self.msgr.add_dispatcher(self)
+        from .cls import default_handler
         from .ecbackend import ECPGBackend
         from .scheduler import OpScheduler
         from .scrubber import Scrubber
         from .watch import WatchRegistry
 
+        self.cls_handler = default_handler()
         self.ec = ECPGBackend(self)
         self.scrubber = Scrubber(self)
         self.watches = WatchRegistry(self)
@@ -941,7 +943,7 @@ class OSD:
                 return
             self.msgr.spawn(self.ec.handle_op(pg, conn, msg))
             return
-        writes = any(o["op"] in _WRITE_OPS for o in msg.ops)
+        writes = any(self._op_is_write(o) for o in msg.ops)
         if not self._min_size_ok(pg, pool):
             pg.waiting_for_active.append((conn, msg))
             return
@@ -958,7 +960,8 @@ class OSD:
             self._execute_write(pg, conn, msg)
         else:
             outs, result = self._do_read_ops(
-                pg, msg.oid, msg.ops, getattr(msg, "snapid", None))
+                pg, msg.oid, msg.ops, getattr(msg, "snapid", None),
+                entity=msg.src)
             conn.send(MOSDOpReply(tid=msg.tid, result=result,
                                   outs=outs, epoch=self.osdmap.epoch,
                                   version=0))
@@ -1004,9 +1007,25 @@ class OSD:
                 pass  # unknown profile: handle_op will fail the op
         return live >= need
 
+    def _op_is_write(self, o: dict) -> bool:
+        """Write-path routing: builder ops by name; a cls call by its
+        registered method flags (PrimaryLogPG's CEPH_OSD_OP_CALL
+        flag check)."""
+        from .cls import ClsError
+
+        if o["op"] in _WRITE_OPS:
+            return True
+        if o["op"] == "call":
+            try:
+                return self.cls_handler.is_write(
+                    o.get("cls", ""), o.get("method", ""))
+            except ClsError:
+                return False    # unknown: read path reports the error
+        return False
+
     # read-side op interpreter (do_osd_ops read branch)
     def _do_read_ops(self, pg: PG, oid: str, ops: list,
-                     snapid: int | None = None):
+                     snapid: int | None = None, entity: str = ""):
         from ..store.objectstore import NOSNAP
         from . import snaps as snapmod
         if snapid not in (None, NOSNAP):
@@ -1038,6 +1057,19 @@ class OSD:
                         pg.cid, ho, op["name"])})
                 elif name == "omap-get":
                     outs.append({"kv": self.store.omap_get(pg.cid, ho)})
+                elif name == "call":
+                    from .cls import MethodContext
+
+                    ctx = MethodContext(self.store, pg.cid, ho,
+                                        None, entity)
+                    code, out = self.cls_handler.call(
+                        op.get("cls", ""), op.get("method", ""),
+                        ctx, op.get("input") or {})
+                    if code != 0:
+                        outs.append(out)
+                        result = code
+                    else:
+                        outs.append({"out": out})
                 elif name == "pgls":
                     # PG object listing (the rados ls / pool
                     # enumeration primitive, PrimaryLogPG do_pg_op
@@ -1119,6 +1151,29 @@ class OSD:
             elif name == "omap-set":
                 t.omap_setkeys(pg.cid, ho, op["kv"])
                 outs.append({})
+            elif name == "call":
+                # cls method: reads committed state, stages writes
+                # into this op's replicated transaction (atomic with
+                # the rest of the op list)
+                from .cls import MethodContext
+
+                cctx = MethodContext(self.store, pg.cid, ho, t,
+                                     msg.src)
+                code, out = self.cls_handler.call(
+                    op.get("cls", ""), op.get("method", ""),
+                    cctx, op.get("input") or {})
+                if code != 0:
+                    outs.append(out)
+                    result = code
+                else:
+                    if cctx._staged_remove and \
+                            self.store.exists(pg.cid, ho) \
+                            and not head_whiteout:
+                        # snapshot-aware deletion, like the delete op
+                        is_delete = snapmod.delete_head(
+                            self.store, pg, ho, ss, t)
+                        ss = None
+                    outs.append({"out": out})
             elif name in _WRITE_OPS or name in ("read", "stat"):
                 outs.append({"error": "mixed rw unsupported"})
                 result = -22

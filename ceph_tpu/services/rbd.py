@@ -41,22 +41,30 @@ class RBD:
 
     async def create(self, name: str, size: int,
                      layout: FileLayout | None = None) -> None:
+        """Header + directory registration ride cls_rbd methods: the
+        exists check happens INSIDE the OSD, so two racing creates
+        cannot both win (the race src/cls/rbd exists to close)."""
+        from ..client.rados import RadosError
+
         layout = layout or FileLayout(stripe_unit=1 << 22,
                                       stripe_count=1,
                                       object_size=1 << 22)
         hdr = HEADER_PREFIX + name
         try:
-            await self.io.stat(hdr)
-            raise RBDError("image %r exists" % name)
-        except RBDError:
+            await self.io.exec(hdr, "rbd", "create",
+                               {"size": size,
+                                "layout": layout.encode()})
+        except RadosError as e:
+            if e.code == -17:
+                raise RBDError("image %r exists" % name) from None
             raise
-        except Exception:
-            pass
-        await self.io.write_full(hdr, b"")
-        await self.io.setxattr(hdr, SIZE_XATTR, b"%d" % size)
-        await self.io.setxattr(hdr, LAYOUT_XATTR, layout.encode())
         # image directory: one omap row per image (rbd_directory)
-        await self.io.omap_set(DIR_OID, {name.encode(): b"1"})
+        try:
+            await self.io.exec(DIR_OID, "rbd", "dir_add",
+                               {"name": name})
+        except RadosError as e:
+            if e.code != -17:
+                raise
 
     async def list(self) -> list[str]:
         try:
@@ -78,26 +86,29 @@ class RBD:
 
         await asyncio.gather(*[rm(o) for o in
                                {e[0] for e in exts}])
+        from ..client.rados import RadosError
+
         try:
             await self.io.remove(HEADER_PREFIX + name)
-        except Exception:
-            pass
-        await self.io.omap_rm(DIR_OID, [name.encode()])
+        except RadosError as e:
+            if e.code != -2:
+                raise
+        try:
+            await self.io.exec(DIR_OID, "rbd", "dir_remove",
+                               {"name": name})
+        except RadosError as e:
+            if e.code != -2:
+                raise
 
     async def open(self, name: str) -> "Image":
         hdr = HEADER_PREFIX + name
         try:
-            size = int(await self.io.getxattr(hdr, SIZE_XATTR))
-            layout = FileLayout.decode(
-                await self.io.getxattr(hdr, LAYOUT_XATTR))
+            meta = await self.io.exec(hdr, "rbd", "get_metadata", {})
+            size = int(meta["size"])
+            layout = FileLayout.decode(bytes(meta["layout"]))
         except Exception:
             raise RBDError("image %r does not exist" % name)
-        snaps = {}
-        try:
-            snaps = denc.decode(await self.io.getxattr(hdr,
-                                                       SNAPS_XATTR))
-        except Exception:
-            pass
+        snaps = dict(meta.get("snaps") or {})
         # each image gets its OWN IoCtx: snap context and read-snap
         # state are per-image (a shared ioctx would let one image's
         # _apply_snapc clobber another's write snapc)
@@ -134,19 +145,34 @@ class Image:
                      reverse=True)
         self.io.set_selfmanaged_snapc(ids[0] if ids else 0, ids)
 
-    async def _persist_snaps(self) -> None:
-        await self.io.setxattr(HEADER_PREFIX + self.name, SNAPS_XATTR,
-                               denc.encode(self.snaps))
-
     def snap_list(self) -> dict[str, dict]:
         return dict(self.snaps)
 
     async def snap_create(self, snapname: str) -> int:
+        """Selfmanaged snapid from the mon, then the header's snap
+        table is edited by cls_rbd.snap_add — the exists check runs
+        in-OSD, so racing snap_creates cannot both record."""
+        from ..client.rados import RadosError
+
         if snapname in self.snaps:
             raise RBDError("snap %r exists" % snapname)
         sid = await self.io.selfmanaged_snap_create()
+        try:
+            await self.io.exec(HEADER_PREFIX + self.name, "rbd",
+                               "snap_add", {"name": snapname,
+                                            "snapid": sid,
+                                            "size": self._size})
+        except RadosError as e:
+            # losing a snap_add race must not leak the allocated
+            # snapid into the pool's snap bookkeeping forever
+            try:
+                await self.io.selfmanaged_snap_remove(sid)
+            except Exception:
+                pass
+            if e.code == -17:
+                raise RBDError("snap %r exists" % snapname) from None
+            raise
         self.snaps[snapname] = {"id": sid, "size": self._size}
-        await self._persist_snaps()
         self._apply_snapc()
         return sid
 
@@ -157,9 +183,19 @@ class Image:
         # cluster-side removal FIRST: if the mon command fails the
         # header still records the snapid and removal can be retried
         # (dropping the record first would leak the clones forever)
+        from ..client.rados import RadosError
+
         await self.io.selfmanaged_snap_remove(int(rec["id"]))
+        try:
+            await self.io.exec(HEADER_PREFIX + self.name, "rbd",
+                               "snap_remove", {"name": snapname})
+        except RadosError as e:
+            if e.code != -2:
+                # transient failure: the header still records the
+                # snap — surface it so the caller retries rather
+                # than silently resurrecting a dead snapid on reopen
+                raise
         self.snaps.pop(snapname, None)
-        await self._persist_snaps()
         self._apply_snapc()
 
     def set_snap(self, snapname: str | None) -> None:
@@ -208,8 +244,8 @@ class Image:
 
         await asyncio.gather(*[roll(o) for o in sorted(objs)])
         self._size = snap_size
-        await self.io.setxattr(HEADER_PREFIX + self.name, SIZE_XATTR,
-                               b"%d" % snap_size)
+        await self.io.exec(HEADER_PREFIX + self.name, "rbd",
+                           "set_size", {"size": snap_size})
 
     async def resize(self, new_size: int) -> None:
         if new_size < self._size:
@@ -241,8 +277,8 @@ class Image:
                         pass
                     break
         self._size = new_size
-        await self.io.setxattr(HEADER_PREFIX + self.name, SIZE_XATTR,
-                               b"%d" % new_size)
+        await self.io.exec(HEADER_PREFIX + self.name, "rbd",
+                           "set_size", {"size": new_size})
 
     async def write(self, offset: int, data: bytes) -> None:
         if offset + len(data) > self._size:
