@@ -358,6 +358,7 @@ class Monitor:
             self._handle_failure(conn, msg)
         elif isinstance(msg, MOSDAlive):
             self.failure_info.pop(msg.osd, None)
+            self._handle_alive_up_thru(msg)
         elif isinstance(msg, MMonCommand):
             self._handle_command(conn, msg)
         else:
@@ -417,6 +418,24 @@ class Monitor:
         self._propose_pending()
         self.ctx.log.info("mon", "osd.%d booted at %s (epoch %d)"
                           % (osd, addr, self.osdmap.epoch))
+
+    def _handle_alive_up_thru(self, msg) -> None:
+        """OSDMonitor::prepare_alive: record that the osd was alive
+        and primary-capable through the requested epoch.  Peering
+        logic later uses up_thru >= interval_start as the witness
+        that the interval could have served writes."""
+        want = getattr(msg, "want_up_thru", None)
+        if not want:
+            return
+        osd = msg.osd
+        if not (osd < self.osdmap.max_osd and self.osdmap.is_up(osd)):
+            return
+        cur = self.osdmap.get_up_thru(osd)
+        inc = self._pending()
+        pend = inc.new_up_thru.get(osd, 0)
+        if want > max(cur, pend):
+            inc.new_up_thru[osd] = want
+            self._propose_pending()
 
     def _in_crush(self, osd: int) -> bool:
         root = self.osdmap.crush.buckets.get(-1)

@@ -94,10 +94,12 @@ class MOSDFailure(Message):
 
 @register
 class MOSDAlive(Message):
-    """OSD -> mon: cancel my pending failure reports (MOSDAlive.h)."""
+    """OSD -> mon: cancel my pending failure reports, and/or request
+    an up_thru bump so a fresh primary can prove its interval could go
+    read-write before activating (MOSDAlive.h want/version)."""
 
     TYPE = "osd_alive"
-    FIELDS = ("osd", "epoch")
+    FIELDS = ("osd", "epoch", "want_up_thru")
 
 
 @register

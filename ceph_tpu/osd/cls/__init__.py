@@ -64,50 +64,68 @@ class MethodContext:
     which lock-style classes use as locker identity."""
 
     def __init__(self, store, cid: coll_t, oid: hobject_t,
-                 txn: Transaction | None, entity: str):
+                 txn: Transaction | None, entity: str,
+                 whiteout: bool = False):
         self.store = store
         self.cid = cid
         self.oid = oid
         self.txn = txn              # None on the read path
         self.entity = entity
         self._staged_remove = False
+        # snapshot-deleted head: the object is logically ABSENT even
+        # though a tombstone with stale xattrs sits on disk.  Reads
+        # behave as not-found; the first write resurrects it clean.
+        self._whiteout = whiteout
 
     # -- reads (cls_cxx_read / getxattr / map_get_* ) ----------------------
 
     def exists(self) -> bool:
-        return self.store.exists(self.cid, self.oid)
+        return (not self._whiteout
+                and self.store.exists(self.cid, self.oid))
 
     def stat(self) -> int:
+        if self._whiteout:
+            raise ClsError(ENOENT, "object absent")
         try:
             return self.store.stat(self.cid, self.oid)
         except NotFound:
             raise ClsError(ENOENT, "object absent") from None
 
     def read(self, offset: int = 0, length: int = -1) -> bytes:
+        if self._whiteout:
+            raise ClsError(ENOENT, "object absent")
         try:
             return self.store.read(self.cid, self.oid, offset, length)
         except NotFound:
             raise ClsError(ENOENT, "object absent") from None
 
     def getxattr(self, name: str) -> bytes | None:
+        if self._whiteout:
+            return None
         try:
             return self.store.getattr(self.cid, self.oid, name)
         except NotFound:
             return None
 
     def getxattrs(self) -> dict:
+        if self._whiteout:
+            return {}
         try:
             return self.store.getattrs(self.cid, self.oid)
         except NotFound:
             return {}
 
     def omap_get(self) -> dict:
+        if self._whiteout:
+            return {}
         try:
             return self.store.omap_get(self.cid, self.oid)
         except NotFound:
             return {}
 
     def omap_get_vals(self, keys) -> dict:
+        if self._whiteout:
+            return {}
         try:
             return self.store.omap_get_values(self.cid, self.oid, keys)
         except NotFound:
@@ -121,6 +139,24 @@ class MethodContext:
         return self.txn
 
     def create(self) -> None:
+        if self._whiteout:
+            # resurrect the tombstone clean: stale non-snapshot
+            # xattrs and omap must not leak into the new incarnation
+            # (the snapset attr survives — the clones are still live)
+            t = self._w()
+            keep = ("snapset",)
+            try:
+                stale = [n for n in
+                         self.store.getattrs(self.cid, self.oid)
+                         if n not in keep]
+            except NotFound:
+                stale = []
+            for n in stale:
+                t.rmattr(self.cid, self.oid, n)
+            t.omap_clear(self.cid, self.oid)
+            t.setattr(self.cid, self.oid, "whiteout", b"0")
+            self._whiteout = False
+            return
         if not self.exists():
             self._w().touch(self.cid, self.oid)
 
@@ -129,10 +165,9 @@ class MethodContext:
         self._w().write(self.cid, self.oid, offset, len(data), data)
 
     def write_full(self, data: bytes) -> None:
-        if self.exists():
+        self.create()
+        if self.store.exists(self.cid, self.oid):
             self._w().truncate(self.cid, self.oid, 0)
-        else:
-            self._w().touch(self.cid, self.oid)
         self._w().write(self.cid, self.oid, 0, len(data), data)
 
     def setxattr(self, name: str, val: bytes) -> None:

@@ -221,8 +221,35 @@ class OSD:
     # -- map handling ------------------------------------------------------
 
     def _handle_osd_map(self, msg: MOSDMapMsg) -> None:
-        self.osdmap, changed = consume_map_payload(
-            self.osdmap, msg.full, msg.incrementals)
+        """Advance EPOCH BY EPOCH (OSD::advance_map walks every map):
+        PGs must observe each intermediate interval so past_intervals
+        records the acting sets that could have served writes while
+        this osd was behind or down."""
+        from .osdmap import Incremental, OSDMap
+
+        changed = False
+        if msg.full is not None:
+            m = OSDMap.decode(msg.full)
+            if m.epoch > self.osdmap.epoch:
+                # pool deletion is a TRANSITION event: on a real jump
+                # (we had a nonzero epoch) drop PGs of pools gone from
+                # the new map; a boot-time replay starting below the
+                # pool's creation epoch must NOT drop loaded PGs
+                if self.osdmap.epoch > 0:
+                    self._drop_pgs_for_pools(
+                        {pg.pool for pg in self.pgs}
+                        - m.pools.keys())
+                self.osdmap = m
+                changed = True
+                self._advance_pgs()
+        for raw in msg.incrementals or []:
+            inc = Incremental.decode(raw)
+            if inc.epoch == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(inc)
+                changed = True
+                if inc.old_pools:
+                    self._drop_pgs_for_pools(set(inc.old_pools))
+                self._advance_pgs()
         up_here = (self.osdmap.is_up(self.whoami)
                    and self.osdmap.osd_addrs.get(self.whoami)
                    == self.msgr.addr)
@@ -245,7 +272,6 @@ class OSD:
         self.ctx.log.debug(
             "osd", "osd.%d at epoch %d" % (self.whoami,
                                            self.osdmap.epoch))
-        self._advance_pgs()
         waiting, self._waiting_for_map = self._waiting_for_map, []
         for conn, m in waiting:
             self._handle_op(conn, m)
@@ -289,28 +315,62 @@ class OSD:
                     pg.create_onstore()
                     self.pgs[pgid] = pg
                 self._advance_pg(pg, up, upp, acting, actingp)
-        # pools removed from the map: drop their PGs
-        for pgid in [p for p in self.pgs if p.pool not in m.pools]:
+
+    def _drop_pgs_for_pools(self, pools: set[int]) -> None:
+        for pgid in [p for p in self.pgs if p.pool in pools]:
             del self.pgs[pgid]
 
     def _advance_pg(self, pg: PG, up, upp, acting, actingp) -> None:
         interval_changed = (acting != pg.acting or actingp != pg.primary)
         if interval_changed and pg.acting:
             # remember the data-holding set for pg_temp pinning
-            # (PeeringState keeps this in past_intervals)
             pg.prev_acting = list(pg.acting)
+        if interval_changed and pg.info.same_interval_since \
+                and pg.acting:
+            # close the ending interval into past_intervals
+            # (PastIntervals::check_new_interval): it "maybe went rw"
+            # iff it had a primary whose up_thru reached the interval
+            # and enough acting members to meet min_size
+            pool = self.osdmap.pools.get(pg.pool_id)
+            members = [o for o in pg.acting if 0 <= o != ITEM_NONE]
+            rw = (pg.primary >= 0 and pg.primary != ITEM_NONE
+                  and len(members) >= (pool.min_size if pool else 1)
+                  and (self.osdmap.get_up_thru(pg.primary)
+                       >= pg.info.same_interval_since))
+            pg.past_intervals.append({
+                "first": pg.info.same_interval_since,
+                "last": self.osdmap.epoch - 1,
+                "up": list(pg.up), "acting": list(pg.acting),
+                "primary": pg.primary, "rw": rw})
         pg.up, pg.acting, pg.primary = up, acting, actingp
-        if not interval_changed and pg.state in (STATE_ACTIVE,
-                                                 STATE_REPLICA):
-            # ops can be parked by the min_size gate while acting
-            # members are down; a peer rejoining without an acting-set
-            # change (e.g. pg_temp pinning) triggers no peering, so
-            # retry them on every map advance — _handle_op re-gates
-            if pg.state == STATE_ACTIVE and pg.waiting_for_active \
-                    and pg.is_primary():
-                self._requeue_waiters(pg)
-            # the map may have added removed_snaps: start trimming
-            self._maybe_snap_trim(pg)
+        if not interval_changed:
+            if pg.state in (STATE_ACTIVE, STATE_REPLICA):
+                # ops can be parked by the min_size gate while acting
+                # members are down; a peer rejoining without an
+                # acting-set change (e.g. pg_temp pinning) triggers no
+                # peering, so retry them on every map advance
+                if pg.state == STATE_ACTIVE and pg.waiting_for_active \
+                        and pg.is_primary():
+                    self._requeue_waiters(pg)
+                # the map may have added removed_snaps: start trimming
+                self._maybe_snap_trim(pg)
+            elif pg.state == STATE_PEERING and pg.is_primary():
+                # same interval, new map: a blocked prior set may have
+                # a member back up, or our up_thru bump may have landed
+                if pg.peering_blocked:
+                    self._start_peering(pg)
+                elif pg.waiting_up_thru and \
+                        self.osdmap.get_up_thru(self.whoami) \
+                        >= pg.waiting_up_thru:
+                    pg.waiting_up_thru = 0
+                    self._finish_peering(pg)
+                elif pg.waiting_up_thru:
+                    self._request_up_thru(pg.waiting_up_thru)
+                elif any(v is None and not self.osdmap.is_up(o)
+                         for o, v in pg.waiting_for_peers.items()):
+                    # a queried prior member died mid-round: recompute
+                    # the prior set (it may now be blocked, or smaller)
+                    self._start_peering(pg)
             return
         pg.info.same_interval_since = self.osdmap.epoch
         pg.in_flight.clear()
@@ -323,6 +383,11 @@ class OSD:
             # for a position it no longer has: mark them missing
             for oid, op in self.ec.scan_stale_shards(pg).items():
                 pg.missing.setdefault(oid, op)
+        # durable interval history: a restart mid-outage must still
+        # know which past acting sets may hold newer writes
+        t = Transaction()
+        pg.persist_meta(t)
+        self.store.apply_transaction(t)
         if pg.is_primary():
             self._start_peering(pg)
         else:
@@ -330,13 +395,57 @@ class OSD:
 
     # -- peering (primary) -------------------------------------------------
 
+    def _build_prior(self, pg: PG) -> tuple[set[int], bool]:
+        """PeeringState::build_prior: everyone who might hold writes —
+        current acting peers plus live members of every past interval
+        that may have gone rw.  Blocked (PG down) when some rw
+        interval has NO live member at all (and we were not in it):
+        its writes could exist only on the dead osds, so activating
+        now could adopt stale authority."""
+        prior = {o for o in pg.acting
+                 if 0 <= o != self.whoami and o != ITEM_NONE}
+        blocked = False
+        for iv in pg.past_intervals:
+            if not iv.get("rw"):
+                continue
+            members = [o for o in iv["acting"]
+                       if 0 <= o != ITEM_NONE]
+            live = [o for o in members
+                    if o != self.whoami and self.osdmap.is_up(o)]
+            prior.update(live)
+            if members and not live and self.whoami not in members:
+                blocked = True
+        return prior, blocked
+
+    def _request_up_thru(self, want: int) -> None:
+        """Ask the mon to record our up_thru >= want (prepare_alive
+        path); deduped per epoch so N PGs in one interval send once."""
+        if getattr(self, "_up_thru_asked", (0, 0)) >= \
+                (want, self.osdmap.epoch):
+            return
+        self._up_thru_asked = (want, self.osdmap.epoch)
+        self._send_mons(MOSDAlive(osd=self.whoami,
+                                  epoch=self.osdmap.epoch,
+                                  want_up_thru=want))
+
     def _start_peering(self, pg: PG) -> None:
         pg.state = STATE_PEERING
         pg.peer_info.clear()
         pg.waiting_for_peers = {}
         pg.waiting_for_log = None
-        peers = [o for o in pg.acting
-                 if 0 <= o != self.whoami and o != ITEM_NONE]
+        pg.waiting_up_thru = 0
+        prior, blocked = self._build_prior(pg)
+        pg.peering_blocked = blocked
+        if blocked:
+            # PG down: every member of a maybe-rw interval is dead.
+            # Hold peering until a map change brings one back
+            # (PeeringState Down state)
+            self.ctx.log.info(
+                "osd", "pg %s down: prior rw interval has no live "
+                "member" % pg.pgid)
+            return
+        peers = sorted(o for o in prior if self.osdmap.is_up(o)
+                       or o in pg.acting)
         if not peers:
             self._finish_peering(pg)
             return
@@ -556,6 +665,10 @@ class OSD:
             info = pg.peer_info.get(osd)
             if info is None or payload is None:
                 continue
+            if osd not in pg.acting and osd not in pg.up:
+                # prior-interval stray: its info (and possibly its
+                # log) fed authority; it is not a recovery target
+                continue
             missing = {}
             if info.last_update >= pg.log.tail:
                 missing = pg.log.objects_since(info.last_update)
@@ -571,7 +684,25 @@ class OSD:
         self._finish_peering(pg)
 
     def _finish_peering(self, pg: PG) -> None:
+        # up_thru gate (PeeringState::adjust_need_up_thru / WaitUpThru):
+        # before activating, the map must record that we were primary-
+        # capable through this interval's start — otherwise a LATER
+        # peering round could not tell whether this interval went rw,
+        # and a stale primary could silently adopt authority
+        need = pg.info.same_interval_since
+        if self.osdmap.get_up_thru(self.whoami) < need:
+            pg.waiting_up_thru = need
+            self._request_up_thru(need)
+            return                      # resumes on the bumped map
         pg.state = STATE_ACTIVE
+        pg.peering_blocked = False
+        # activation settles all prior history: last_epoch_started
+        # advances and past intervals are consumed
+        pg.info.last_epoch_started = self.osdmap.epoch
+        pg.past_intervals = []
+        t = Transaction()
+        pg.persist_meta(t)
+        self.store.apply_transaction(t)
         self._maybe_request_pg_temp(pg)
         # up-but-not-acting members (we are serving under a pg_temp
         # pin): backfill them too, so the pin can be released once
@@ -682,13 +813,15 @@ class OSD:
             pg.info.last_update = last_update
         elif since is not None:
             mine = pg.info.last_update
-            # mirror the primary-side _merge_authoritative guard: the
-            # shipped delta only chains if our head is also at or past
-            # the primary's log tail — a replica below the tail has a
-            # gap the delta cannot cover and must take the full path
-            chains = (since == mine and tail <= mine
-                      and (not entries
-                           or entries[0].prior_version == mine))
+            # the delta chains when the part BEYOND our head continues
+            # exactly from it; entries at or below our head are a
+            # shared prefix (a re-peering round built its delta from a
+            # pre-activation info snapshot) and are skipped, not
+            # grounds for a full resync.  A replica below the
+            # primary's log tail has a gap no delta can cover.
+            new = [e for e in entries if e.version > mine]
+            chains = (since <= mine and tail <= mine
+                      and (not new or new[0].prior_version == mine))
             if not chains:
                 conn.send(MOSDPGLog(
                     pool=pg.pool_id, ps=pg.ps,
@@ -721,6 +854,10 @@ class OSD:
                     pg.missing[e.oid] = e.op
             pg.replace_log(t, entries, tail)
             pg.info.last_update = last_update
+        # activation consumes our interval history too: the primary's
+        # authority covers it (peering heard us)
+        pg.info.last_epoch_started = self.osdmap.epoch
+        pg.past_intervals = []
         pg.persist_meta(t)
         self.store.apply_transaction(t)
         pg.state = STATE_REPLICA
@@ -747,12 +884,22 @@ class OSD:
         acting0 = list(pg.acting)
         try:
             if pg.missing:
-                # pull what the primary lacks from a peer that has it
+                # pull what the primary lacks from a peer PROVEN to
+                # have it: the authoritative log's owner first, else a
+                # peer whose info reached the authoritative head (a
+                # stale prior-interval stray also sits in peer_info —
+                # pulling from it would adopt old data as recovered)
                 src = None
-                for osd, info in pg.peer_info.items():
-                    if not pg.peer_missing.get(osd):
-                        src = osd
-                        break
+                auth = getattr(pg, "auth_osd", self.whoami)
+                if auth != self.whoami and self.osdmap.is_up(auth):
+                    src = auth
+                if src is None:
+                    for osd, info in pg.peer_info.items():
+                        if (not pg.peer_missing.get(osd)
+                                and info.last_update
+                                >= pg.info.last_update):
+                            src = osd
+                            break
                 if src is None:
                     for osd in pg.acting:
                         if 0 <= osd != self.whoami and osd != ITEM_NONE:
@@ -910,6 +1057,10 @@ class OSD:
         if pm:
             for oid in msg.oids:
                 pm.pop(oid, None)
+            # degraded-object writes park until their replicas are
+            # whole again: re-gate them now
+            if pg.waiting_for_active and pg.state == STATE_ACTIVE:
+                self._requeue_waiters(pg)
         self._maybe_clear_pg_temp(pg)
 
     def _requeue_waiters(self, pg: PG) -> None:
@@ -953,6 +1104,22 @@ class OSD:
             return
         oid = msg.oid
         if oid in pg.missing:
+            pg.waiting_for_active.append((conn, msg))
+            self._kick_recovery(pg)
+            return
+        if writes and any(oid in (pg.peer_missing.get(o) or {})
+                          for o in pg.acting
+                          if 0 <= o != self.whoami
+                          and o != ITEM_NONE
+                          and o not in getattr(pg, "backfill_targets",
+                                               set())):
+            # wait_for_degraded_object (PrimaryLogPG.cc): a write to
+            # an object a log-recovering replica still lacks would
+            # ship ops (truncate, partial write) it cannot apply —
+            # recover it first, then requeue.  Backfill targets are
+            # exempt (their peer_missing is the WHOLE collection; the
+            # reference keeps the PG writable through backfill) — the
+            # replica apply path tolerates their absent objects.
             pg.waiting_for_active.append((conn, msg))
             self._kick_recovery(pg)
             return
@@ -1158,7 +1325,7 @@ class OSD:
                 from .cls import MethodContext
 
                 cctx = MethodContext(self.store, pg.cid, ho, t,
-                                     msg.src)
+                                     msg.src, whiteout=head_whiteout)
                 code, out = self.cls_handler.call(
                     op.get("cls", ""), op.get("method", ""),
                     cctx, op.get("input") or {})
@@ -1231,7 +1398,20 @@ class OSD:
         # mirror the primary's trim policy so the in-memory log stays
         # in lockstep with the omap rows the replicated txn trims
         pg.maybe_trim_log(t)
-        self.store.apply_transaction(t)
+        try:
+            self.store.apply_transaction(t)
+        except NotFound:
+            # backfill target: the txn touches an object this replica
+            # has not received yet.  Apply the remaining ops one by
+            # one — the skipped object converges via the backfill
+            # push, and the pgmeta rows later in the txn must land.
+            for op in t.ops:
+                one = Transaction()
+                one.ops.append(op)
+                try:
+                    self.store.apply_transaction(one)
+                except NotFound:
+                    pass
         conn.send(MOSDRepOpReply(pool=msg.pool, ps=msg.ps, tid=msg.tid,
                                  result=0, epoch=msg.epoch))
 

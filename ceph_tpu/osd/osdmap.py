@@ -176,6 +176,10 @@ class OSDMap:
         self.osd_weight: list[int] = []      # 16.16 in/out weight
         self.osd_primary_affinity: list[int] | None = None
         self.osd_addrs: dict[int, str] = {}
+        # latest epoch through which each osd was confirmed able to
+        # serve as primary (OSDMap::get_up_thru): peering uses it to
+        # decide whether a past interval could have gone read-write
+        self.osd_up_thru: dict[int, int] = {}
         self.crush = CrushMap()
         self.pools: dict[int, PGPool] = {}
         self.pool_max = -1
@@ -217,6 +221,9 @@ class OSDMap:
 
     def get_weight(self, osd: int) -> int:
         return self.osd_weight[osd]
+
+    def get_up_thru(self, osd: int) -> int:
+        return self.osd_up_thru.get(osd, 0)
 
     def primary_affinity(self, osd: int) -> int:
         if self.osd_primary_affinity is None:
@@ -429,6 +436,8 @@ class OSDMap:
         for osd, addr in inc.new_up_client.items():
             self.osd_state[osd] |= OSD_EXISTS | OSD_UP
             self.osd_addrs[osd] = addr
+        for osd, thru in inc.new_up_thru.items():
+            self.osd_up_thru[osd] = thru
         for pg, osds in inc.new_pg_temp.items():
             if osds:
                 self.pg_temp[pg] = list(osds)
@@ -478,6 +487,8 @@ class OSDMap:
                 list(self.osd_primary_affinity)
                 if self.osd_primary_affinity is not None else None),
             "osd_addrs": {str(k): v for k, v in self.osd_addrs.items()},
+            "osd_up_thru": {str(k): v
+                            for k, v in self.osd_up_thru.items()},
             "crush": self.crush.to_dict(),
             "pools": {str(k): p.to_dict() for k, p in self.pools.items()},
             "pool_max": self.pool_max,
@@ -507,6 +518,8 @@ class OSDMap:
             list(d["osd_primary_affinity"])
             if d["osd_primary_affinity"] is not None else None)
         m.osd_addrs = {int(k): v for k, v in d["osd_addrs"].items()}
+        m.osd_up_thru = {int(k): v
+                         for k, v in d.get("osd_up_thru", {}).items()}
         m.crush = CrushMap.from_dict(d["crush"])
         m.pools = {int(k): PGPool.from_dict(p)
                    for k, p in d["pools"].items()}
@@ -582,6 +595,7 @@ class Incremental:
     new_weight: dict[int, int] = field(default_factory=dict)
     new_primary_affinity: dict[int, int] = field(default_factory=dict)
     new_up_client: dict[int, str] = field(default_factory=dict)
+    new_up_thru: dict[int, int] = field(default_factory=dict)
     new_pg_temp: dict[pg_t, list[int]] = field(default_factory=dict)
     new_primary_temp: dict[pg_t, int] = field(default_factory=dict)
     new_pg_upmap: dict[pg_t, list[int]] = field(default_factory=dict)
@@ -608,6 +622,8 @@ class Incremental:
                 str(k): v for k, v in self.new_primary_affinity.items()},
             "new_up_client": {str(k): v
                               for k, v in self.new_up_client.items()},
+            "new_up_thru": {str(k): v
+                            for k, v in self.new_up_thru.items()},
             "new_pg_temp": _enc_pg_map(self.new_pg_temp),
             "new_primary_temp": _enc_pg_map(self.new_primary_temp),
             "new_pg_upmap": _enc_pg_map(self.new_pg_upmap),
@@ -640,6 +656,8 @@ class Incremental:
             int(k): v for k, v in d["new_primary_affinity"].items()}
         inc.new_up_client = {int(k): v
                              for k, v in d["new_up_client"].items()}
+        inc.new_up_thru = {int(k): v
+                           for k, v in d.get("new_up_thru", {}).items()}
         inc.new_pg_temp = _dec_pg_map(d["new_pg_temp"], list)
         inc.new_primary_temp = _dec_pg_map(d["new_primary_temp"], int)
         inc.new_pg_upmap = _dec_pg_map(d["new_pg_upmap"], list)

@@ -102,13 +102,18 @@ class PGInfo:
         self.last_complete: tuple[int, int] = (0, 0)
         self.log_tail: tuple[int, int] = (0, 0)
         self.same_interval_since = 0
+        # epoch of the last completed activation: past intervals
+        # older than this are settled history (pg_info_t
+        # last_epoch_started, PeeringState.h:587 neighborhood)
+        self.last_epoch_started = 0
 
     def to_wire(self) -> dict:
         return {"pool": self.pool, "ps": self.ps,
                 "last_update": list(self.last_update),
                 "last_complete": list(self.last_complete),
                 "log_tail": list(self.log_tail),
-                "same_interval_since": self.same_interval_since}
+                "same_interval_since": self.same_interval_since,
+                "last_epoch_started": self.last_epoch_started}
 
     @classmethod
     def from_wire(cls, d: dict) -> "PGInfo":
@@ -117,6 +122,7 @@ class PGInfo:
         info.last_complete = tuple(d["last_complete"])
         info.log_tail = tuple(d["log_tail"])
         info.same_interval_since = d["same_interval_since"]
+        info.last_epoch_started = d.get("last_epoch_started", 0)
         return info
 
 
@@ -148,6 +154,17 @@ class PG:
         self.waiting_for_peers: dict[int, dict] = {}   # peering round
         self.recovering: set[str] = set()
         self.in_flight: dict[int, dict] = {}    # repop tid -> state
+        # PastIntervals (src/osd/osd_types.h PastIntervals): one
+        # record per acting-set interval since last_epoch_started:
+        # {"first", "last", "up", "acting", "primary", "rw"} where
+        # "rw" = the interval could have served writes (its primary's
+        # up_thru reached the interval, enough acting members).
+        # Cleared on activation; peering must hear from (or rule out)
+        # every rw interval before claiming authority.
+        self.past_intervals: list[dict] = []
+        self.peering_blocked = False   # a prior rw interval has no
+        #                                live member: cannot activate
+        self.waiting_up_thru = 0       # epoch our up_thru must reach
 
     # -- identity ----------------------------------------------------------
 
@@ -163,6 +180,7 @@ class PG:
     def persist_meta(self, t: Transaction) -> None:
         t.omap_setkeys(self.cid, PGMETA_OID, {
             b"info": denc.encode(self.info.to_wire()),
+            b"past_intervals": denc.encode(self.past_intervals),
         })
 
     def persist_log_entry(self, t: Transaction, e: LogEntry) -> None:
@@ -215,6 +233,10 @@ class PG:
         if b"info" not in data:
             return False
         self.info = PGInfo.from_wire(denc.decode(data[b"info"]))
+        if b"past_intervals" in data:
+            self.past_intervals = [
+                dict(iv) for iv in
+                denc.decode(data[b"past_intervals"])]
         entries = []
         for k, v in sorted(data.items()):
             if k.startswith(b"log."):
@@ -224,8 +246,12 @@ class PG:
         return True
 
     def create_onstore(self) -> None:
+        """Idempotent: a collection can already exist on disk from a
+        previous tenure whose pgmeta never became loadable (load()
+        returned False) — re-adopt it rather than failing."""
         t = Transaction()
-        t.create_collection(self.cid)
+        if not self.osd.store.collection_exists(self.cid):
+            t.create_collection(self.cid)
         t.touch(self.cid, PGMETA_OID)
         self.persist_meta(t)
         self.osd.store.apply_transaction(t)

@@ -1,0 +1,145 @@
+"""past_intervals + up_thru: a primary isolated across cascading
+failures must NOT activate with stale authority.
+
+The scenario the round-4 verdict called out (PeeringState.h:587
+PastIntervals, OSDMap up_thru): writes land in an interval the
+returning primary never saw; without interval history it would
+activate alone and serve the stale copy — silent data loss.  With it,
+the PG holds in the Down/blocked state until a member of the
+maybe-went-rw interval returns."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.osd.osdmap import pg_t
+from ceph_tpu.utils.context import Context
+from tests.test_cluster import FAST_CONF, Cluster, run
+
+CONF = dict(FAST_CONF)
+CONF["osd_pool_default_min_size"] = 1    # let a lone survivor TRY
+
+
+async def _wait(pred, timeout, what):
+    t0 = asyncio.get_running_loop().time()
+    while not pred():
+        if asyncio.get_running_loop().time() - t0 > timeout:
+            raise TimeoutError(what)
+        await asyncio.sleep(0.05)
+
+
+def test_stale_primary_cannot_activate_across_cascading_failures():
+    async def main():
+        c = await Cluster(3).start()
+        replacements = []
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="p", pg_num=8, size=2)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("p")
+            # pick an object and learn its acting set [a, b]
+            await io.write_full("obj", b"v1-stale")
+            primary, pgid, acting = c.client._calc_target(pid, "obj")
+            a, b = acting[0], acting[1]
+            third = ({0, 1, 2} - {a, b}).pop()
+
+            # interval 2: kill a; writes land on [b, third]
+            store_a = c.osds[a].store
+            await c.kill_osd(a)
+            await _wait(lambda: not c.client.osdmap.is_up(a), 30,
+                        "a never marked down")
+            await c.wait_health(pid, timeout=30)
+            _p, _g, acting2 = c.client._calc_target(pid, "obj")
+            assert a not in acting2
+            await io.write_full("obj", b"v2-fresh")
+
+            # interval 3: kill the survivors, revive only a
+            store_b = c.osds[b].store
+            store_t = c.osds[third].store
+            await c.kill_osd(b)
+            await c.kill_osd(third)
+            osd_a = OSD(a, c.mon.addr,
+                        Context("osd.%d" % a, conf_overrides=CONF),
+                        store=store_a)
+            await osd_a.start()
+            await osd_a.wait_for_boot()
+            c.osds[a] = osd_a
+            await _wait(lambda: (not c.client.osdmap.is_up(b)
+                                 and not c.client.osdmap.is_up(third)),
+                        30, "survivors never marked down")
+
+            # a must NOT activate: the [b, third] interval may have
+            # gone rw and none of its members are alive
+            pg = None
+            for _ in range(100):
+                pg = osd_a.pgs.get(pgid)
+                if pg is not None and pg.is_primary():
+                    break
+                await asyncio.sleep(0.05)
+            assert pg is not None
+            await asyncio.sleep(1.0)     # give peering every chance
+            assert pg.state != "active", \
+                "stale primary activated with lost interval!"
+            assert pg.peering_blocked
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(io.read("obj"), 2.0)
+
+            # revive a member of the lost interval: the PG unblocks
+            # and serves the FRESH data
+            osd_t = OSD(third, c.mon.addr,
+                        Context("osd.%d" % third,
+                                conf_overrides=CONF),
+                        store=store_t)
+            await osd_t.start()
+            await osd_t.wait_for_boot()
+            c.osds[third] = osd_t
+            await _wait(lambda: pg.state == "active"
+                        or c.osds[a].pgs.get(pgid) is not pg, 30,
+                        "pg never activated after revival")
+            assert await asyncio.wait_for(io.read("obj"), 10.0) == \
+                b"v2-fresh"
+
+            # b can come back too; cluster converges fully
+            osd_b = OSD(b, c.mon.addr,
+                        Context("osd.%d" % b, conf_overrides=CONF),
+                        store=store_b)
+            await osd_b.start()
+            await osd_b.wait_for_boot()
+            c.osds[b] = osd_b
+            await c.wait_health(pid, timeout=30)
+            assert await io.read("obj") == b"v2-fresh"
+        finally:
+            await c.stop()
+
+    run(main(), timeout=180)
+
+
+def test_up_thru_recorded_before_activation():
+    """Every activated interval leaves an up_thru witness in the map:
+    the activating primary's up_thru reaches its interval epoch
+    (OSDMonitor prepare_alive / PeeringState WaitUpThru)."""
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="p", pg_num=8, size=2)
+            pid = out["pool_id"]
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            m = c.mon.osdmap
+            for o in c.osds:
+                for pgid, pg in o.pgs.items():
+                    if pg.pool_id != pid or not pg.is_primary():
+                        continue
+                    assert m.get_up_thru(o.whoami) >= \
+                        pg.info.same_interval_since, \
+                        ("osd.%d primary of %s active without "
+                         "up_thru witness" % (o.whoami, pg.pgid))
+        finally:
+            await c.stop()
+
+    run(main(), timeout=60)
