@@ -217,9 +217,10 @@ class MOSDPGLog(Message):
 class MOSDRepScrub(Message):
     """Primary -> replica: build a scrub map for these objects
     (MOSDRepScrub.h); fetch=True also returns the bytes (the repair
-    pull)."""
+    pull); inventory=True returns the replica's full hobject key list
+    instead (the stray-clone sweep)."""
     TYPE = "rep_scrub"
-    FIELDS = ("pool", "ps", "tid", "oids", "fetch")
+    FIELDS = ("pool", "ps", "tid", "oids", "fetch", "inventory")
 
 
 @register

@@ -231,6 +231,18 @@ class OSD:
         if msg.full is not None:
             m = OSDMap.decode(msg.full)
             if m.epoch > self.osdmap.epoch:
+                if self.osdmap.epoch > 0 \
+                        and m.epoch > self.osdmap.epoch + 1:
+                    # full-map fallback across a gap: the intervals
+                    # inside it cannot be reconstructed (the reference
+                    # replays stored old maps; this build's mons ship
+                    # contiguous incrementals, so this is the rare
+                    # store-gap path) — past_intervals coverage is
+                    # conservative-by-last-known here
+                    self.ctx.log.info(
+                        "osd", "osd.%d map jump %d -> %d: interval "
+                        "history across the gap is approximate"
+                        % (self.whoami, self.osdmap.epoch, m.epoch))
                 # pool deletion is a TRANSITION event: on a real jump
                 # (we had a nonzero epoch) drop PGs of pools gone from
                 # the new map; a boot-time replay starting below the
@@ -1401,17 +1413,23 @@ class OSD:
         try:
             self.store.apply_transaction(t)
         except NotFound:
-            # backfill target: the txn touches an object this replica
-            # has not received yet.  Apply the remaining ops one by
-            # one — the skipped object converges via the backfill
-            # push, and the pgmeta rows later in the txn must land.
+            # Tolerated ONLY while this replica is a known backfill /
+            # recovery target for the object (pg.missing lists it):
+            # the skipped ops converge via the push.  The pgmeta rows
+            # later in the txn must still land, so apply op by op.
+            # Anything else is real divergence and must surface.
+            if not pg.missing:
+                raise
             for op in t.ops:
                 one = Transaction()
                 one.ops.append(op)
                 try:
                     self.store.apply_transaction(one)
                 except NotFound:
-                    pass
+                    ho = next((a for a in op
+                               if isinstance(a, hobject_t)), None)
+                    if ho is None or ho.name not in pg.missing:
+                        raise
         conn.send(MOSDRepOpReply(pool=msg.pool, ps=msg.ps, tid=msg.tid,
                                  result=0, epoch=msg.epoch))
 

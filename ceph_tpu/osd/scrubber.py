@@ -29,8 +29,30 @@ import itertools
 import zlib
 
 from ..msg.messages import MOSDPGPush, MOSDRepScrub, MOSDRepScrubMap
-from ..store.objectstore import NotFound, Transaction, hobject_t
+from ..store.objectstore import NOSNAP, NotFound, Transaction, \
+    hobject_t
 from .pg import PG
+
+
+def _skey(name: str, snap: int = NOSNAP) -> str:
+    """Scrub-map key for one hobject: heads keep their (escaped)
+    name, clones append "@@<snapid-hex>" — the scrubber walks the
+    WHOLE snap set (scrub_backend.cc scrubs every hobject, clones
+    included).  '@' in object names is escaped to '@a' so a client
+    object literally named 'x@@2a' can never be conflated with the
+    clone (x, 0x2a)."""
+    esc = name.replace("@", "@a")
+    return esc if snap == NOSNAP else "%s@@%x" % (esc, snap)
+
+
+def _sobj(key: str) -> hobject_t:
+    name, sep, s = key.rpartition("@@")
+    if sep:
+        try:
+            return hobject_t(name.replace("@a", "@"), snap=int(s, 16))
+        except ValueError:
+            pass
+    return hobject_t(key.replace("@a", "@"))
 
 
 def _digest(data: bytes) -> int:
@@ -59,7 +81,7 @@ class Scrubber:
         local objects (ScrubMap::objects)."""
         out = {}
         for oid in oids:
-            ho = hobject_t(oid)
+            ho = _sobj(oid)
             try:
                 data = self.osd.store.read(pg.cid, ho)
                 attrs = dict(self.osd.store.getattrs(pg.cid, ho))
@@ -77,12 +99,21 @@ class Scrubber:
         return out
 
     def handle_rep_scrub(self, conn, msg: MOSDRepScrub) -> None:
-        """Replica side: build and return the chunk's scrub map."""
+        """Replica side: build and return the chunk's scrub map (or,
+        in inventory mode, every hobject key we hold — the primary's
+        stray sweep must see replica-only clones too)."""
         from .osdmap import pg_t
 
         pg = self.osd.pgs.get(pg_t(msg.pool, msg.ps))
-        objects = {} if pg is None else self.build_scrub_map(
-            pg, msg.oids, fetch=bool(msg.fetch))
+        if pg is None:
+            objects = {}
+        elif msg.inventory:
+            objects = {_skey(h.name, h.snap): {}
+                       for h in self.osd.store.collection_list(pg.cid)
+                       if h.name != "__pgmeta__"}
+        else:
+            objects = self.build_scrub_map(pg, msg.oids,
+                                           fetch=bool(msg.fetch))
         conn.send(MOSDRepScrubMap(pool=msg.pool, ps=msg.ps,
                                   tid=msg.tid, objects=objects))
 
@@ -101,13 +132,20 @@ class Scrubber:
 
     async def _gather_maps(self, pg: PG, oids: list[str],
                            fetch: bool = False,
-                           members=None) -> dict:
+                           members=None,
+                           inventory: bool = False) -> dict:
         """Scrub maps from the acting members (self included)."""
         targets0 = members if members is not None else pg.acting
         maps = {}
         if members is None or self.osd.whoami in targets0:
-            maps[self.osd.whoami] = self.build_scrub_map(
-                pg, oids, fetch=fetch)
+            if inventory:
+                maps[self.osd.whoami] = {
+                    _skey(h.name, h.snap): {}
+                    for h in self.osd.store.collection_list(pg.cid)
+                    if h.name != "__pgmeta__"}
+            else:
+                maps[self.osd.whoami] = self.build_scrub_map(
+                    pg, oids, fetch=fetch)
         self._tid += 1
         tid = self._tid
         waiting: set[int] = set()
@@ -126,7 +164,8 @@ class Scrubber:
             waiting.add(osd_id)
             self.osd.msgr.send_to(addr, MOSDRepScrub(
                 pool=pg.pool_id, ps=pg.ps, tid=tid, oids=oids,
-                fetch=fetch), entity_hint="osd.%d" % osd_id)
+                fetch=fetch, inventory=inventory),
+                entity_hint="osd.%d" % osd_id)
         if waiting:
             try:
                 await asyncio.wait_for(ev.wait(), 5.0)
@@ -146,11 +185,20 @@ class Scrubber:
         result = {"errors": 0, "inconsistent": [], "repaired": 0}
         if pool is None or not pg.is_primary():
             return result
-        oids = sorted({h.name for h in
-                       self.osd.store.collection_list(pg.cid)})
-        for e in pg.log.entries:      # replica-only objects
-            if e.oid not in oids:
-                oids.append(e.oid)
+        # hobject inventory from EVERY member: replica-only strays
+        # (e.g. a clone a lost trim left behind) must be scrubbed too
+        keys = {_skey(h.name, h.snap) for h in
+                self.osd.store.collection_list(pg.cid)
+                if h.name != "__pgmeta__"}
+        inv = await self._gather_maps(pg, [], inventory=True)
+        for mm in inv.values():
+            keys.update(mm)
+        keys.update(_skey(e.oid) for e in pg.log.entries)
+        oids = sorted(keys)
+        presence: dict[str, set[int]] = {}
+        # head snapset votes across members: the orphan sweep must
+        # not trust a single (possibly rotted) copy
+        ss_votes: dict[str, dict[bytes, int]] = {}
         for i in range(0, len(oids), chunk):
             batch = oids[i:i + chunk]
             # each chunk passes the mClock 'scrub' class so scrubbing
@@ -159,13 +207,88 @@ class Scrubber:
             await self.osd.sched.admit(K_SCRUB, cost=len(batch),
                                        key=(pg.pool_id, pg.ps))
             maps = await self._gather_maps(pg, batch)
+            from .snaps import SNAPSET_ATTR
+            for osd_id, mm in maps.items():
+                for k, row in mm.items():
+                    presence.setdefault(k, set()).add(osd_id)
+                    if "@@" not in k:
+                        raw = row["attrs"].get(SNAPSET_ATTR)
+                        if raw:
+                            v = ss_votes.setdefault(k, {})
+                            v[bytes(raw)] = v.get(bytes(raw), 0) + 1
             if pool.is_erasure():
                 await self._compare_ec(pg, pool, batch, maps, deep,
                                        repair, result)
             else:
                 await self._compare_replicated(pg, batch, maps,
                                               repair, result)
+        await self._validate_snapsets(pg, presence, ss_votes,
+                                      repair, result)
         return result
+
+    async def _validate_snapsets(self, pg: PG, presence, ss_votes,
+                                 repair, result) -> None:
+        """Snap-set consistency (scrub_backend.cc + SnapMapper roles):
+        every clone a head's snapset lists must exist on some member
+        (a listed-but-absent clone is unrecoverable data loss, flagged
+        only), and every on-disk clone must be claimed by its head's
+        snapset (orphans are flagged and, on repair, removed
+        everywhere — the reference's snap-mapper repair).  Each head's
+        snapset is the MAJORITY copy across members, so one rotted
+        replica cannot drive a cluster-wide clone deletion."""
+        from ..utils import denc
+
+        snapsets: dict[str, dict] = {}
+        for name, votes in ss_votes.items():
+            for raw, _n in sorted(votes.items(),
+                                  key=lambda kv: -kv[1]):
+                try:
+                    snapsets[name] = denc.decode(raw)
+                    break
+                except Exception:
+                    continue
+            else:
+                result["errors"] += 1
+                result["inconsistent"].append(name)
+        for name, ss in snapsets.items():
+            for snap in ss.get("clones", []):
+                key = _skey(name, int(snap))
+                if key not in presence:
+                    result["errors"] += 1
+                    result["inconsistent"].append(key)
+                    self.osd.ctx.log.info(
+                        "osd", "scrub %d.%x %s: clone listed in "
+                        "snapset but absent on every member"
+                        % (pg.pool_id, pg.ps, key))
+        orphans = []
+        for key, members in presence.items():
+            ho = _sobj(key)
+            if ho.snap == NOSNAP:
+                continue
+            ss = snapsets.get(ho.name)
+            if ss is None or int(ho.snap) not in [
+                    int(c) for c in ss.get("clones", [])]:
+                orphans.append((key, ho, sorted(members)))
+        for key, ho, members in orphans:
+            result["errors"] += 1
+            result["inconsistent"].append(key)
+            self.osd.ctx.log.info(
+                "osd", "scrub %d.%x %s: orphan clone (no snapset "
+                "claims it) on %s" % (pg.pool_id, pg.ps, key, members))
+            if not repair:
+                continue
+            for osd_id in members:
+                if osd_id == self.osd.whoami:
+                    t = Transaction()
+                    t.remove(pg.cid, ho)
+                    self.osd.store.apply_transaction(t)
+                else:
+                    self.osd._send_osd(osd_id, MOSDPGPush(
+                        pool=pg.pool_id, ps=pg.ps,
+                        epoch=self.osd.osdmap.epoch,
+                        pushes=[{"oid": ho.name, "snap": ho.snap,
+                                 "delete": True}]))
+            result["repaired"] += 1
 
     # -- replicated compare -------------------------------------------------
 
@@ -205,10 +328,10 @@ class Scrubber:
                 continue
             attrs = present[auth_osd]["attrs"]
             repaired = 0
+            ho = _sobj(oid)
             for osd_id in bad:
                 if osd_id == self.osd.whoami:
                     t = Transaction()
-                    ho = hobject_t(oid)
                     t.write(pg.cid, ho, 0, len(data), data)
                     t.truncate(pg.cid, ho, len(data))
                     t.setattrs(pg.cid, ho, dict(attrs))
@@ -218,8 +341,8 @@ class Scrubber:
                     self.osd._send_osd(osd_id, MOSDPGPush(
                         pool=pg.pool_id, ps=pg.ps,
                         epoch=self.osd.osdmap.epoch,
-                        pushes=[{"oid": oid, "delete": False,
-                                 "data": data,
+                        pushes=[{"oid": ho.name, "snap": ho.snap,
+                                 "delete": False, "data": data,
                                  "attrs": dict(attrs), "omap": {}}]))
                     repaired += 1
             result["repaired"] += repaired
@@ -228,7 +351,7 @@ class Scrubber:
                           auth_osd: int) -> bytes | None:
         if auth_osd == self.osd.whoami:
             try:
-                return self.osd.store.read(pg.cid, hobject_t(oid))
+                return self.osd.store.read(pg.cid, _sobj(oid))
             except NotFound:
                 return None
         maps = await self._gather_maps(pg, [oid], fetch=True,
@@ -380,9 +503,9 @@ class Scrubber:
                 continue
             attrs = dict(auth_attrs)
             attrs["ec_shard"] = b"%d" % j
+            ho = _sobj(oid)
             if osd_id == self.osd.whoami:
                 t = Transaction()
-                ho = hobject_t(oid)
                 t.write(pg.cid, ho, 0, len(expect[j]), expect[j])
                 t.truncate(pg.cid, ho, len(expect[j]))
                 t.setattrs(pg.cid, ho, attrs)
@@ -391,8 +514,8 @@ class Scrubber:
                 self.osd._send_osd(osd_id, MOSDPGPush(
                     pool=pg.pool_id, ps=pg.ps,
                     epoch=self.osd.osdmap.epoch,
-                    pushes=[{"oid": oid, "delete": False,
-                             "data": expect[j], "attrs": attrs,
-                             "omap": {}}]))
+                    pushes=[{"oid": ho.name, "snap": ho.snap,
+                             "delete": False, "data": expect[j],
+                             "attrs": attrs, "omap": {}}]))
             repaired += 1
         return repaired
