@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 
 from ..msg import Messenger
+from ..msg.messenger import ms_compress_from_conf
 from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
                             MOSDMapMsg, MOSDOp, MOSDOpReply,
                             MWatchNotify)
@@ -70,7 +71,8 @@ class RadosClient:
         self._mon_i = 0
         from ..msg.auth import AuthContext
         self.msgr = Messenger(
-            name, auth=AuthContext.from_conf(self.ctx.conf))
+            name, auth=AuthContext.from_conf(self.ctx.conf),
+            compress=ms_compress_from_conf(self.ctx.conf))
         self.msgr.add_dispatcher(self)
         # epoch-0 empty map is the universal incremental base
         self.osdmap: OSDMap = OSDMap()

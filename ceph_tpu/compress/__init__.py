@@ -1,0 +1,158 @@
+"""Compression plugin framework (src/compressor/ tier).
+
+Analog of Compressor.h:35 + the per-algorithm plugin directories
+(src/compressor/{zlib,snappy,zstd,lz4}/): a registry of named
+compressors behind one two-method interface, consumed by
+
+* the OSD data path (pool-level compression of full-object writes,
+  BlueStore blob-compression role — see osd/daemon.py), and
+* the messenger (on-wire frame compression, the msgr2
+  compression_onwire.cc role — see msg/messenger.py).
+
+Algorithms ship from the stdlib (zlib, lzma, bz2) with optional
+snappy/zstd/lz4 picked up when their modules exist in the image —
+the same graceful-degradation contract the reference's plugin loader
+has (missing .so = algorithm unavailable, not an error).
+
+Every blob is self-describing: compress() returns the raw algorithm
+output, and callers record the algorithm name beside it (pool xattr /
+wire flag), mirroring how the reference stores the alg in the blob /
+negotiates it per connection.
+"""
+
+from __future__ import annotations
+
+import bz2
+import lzma
+import zlib
+
+
+class CompressorError(Exception):
+    pass
+
+
+# xattr names marking a compressed object image (shared by the OSD
+# write path and the cls MethodContext so both see one convention)
+OBJ_ALGO_ATTR = "comp-alg"
+OBJ_SIZE_ATTR = "comp-size"
+
+
+class Compressor:
+    """One algorithm (CompressionPlugin + Compressor instance)."""
+
+    name = ""
+
+    def compress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, blob: bytes) -> bytes:
+        raise NotImplementedError
+
+
+class ZlibCompressor(Compressor):
+    name = "zlib"
+
+    # level 1: compression runs on the daemon's event loop, so the
+    # default trades ratio for latency (heavier levels/algos are an
+    # explicit operator choice via compression_algorithm)
+    def __init__(self, level: int = 1):
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, blob: bytes) -> bytes:
+        try:
+            return zlib.decompress(blob)
+        except zlib.error as e:
+            raise CompressorError("zlib: %s" % e) from None
+
+
+class LzmaCompressor(Compressor):
+    name = "lzma"
+
+    def compress(self, data: bytes) -> bytes:
+        return lzma.compress(data, preset=1)
+
+    def decompress(self, blob: bytes) -> bytes:
+        try:
+            return lzma.decompress(blob)
+        except lzma.LZMAError as e:
+            raise CompressorError("lzma: %s" % e) from None
+
+
+class Bz2Compressor(Compressor):
+    name = "bz2"
+
+    def compress(self, data: bytes) -> bytes:
+        return bz2.compress(data, 1)
+
+    def decompress(self, blob: bytes) -> bytes:
+        try:
+            return bz2.decompress(blob)
+        except (OSError, ValueError) as e:
+            raise CompressorError("bz2: %s" % e) from None
+
+
+_REGISTRY: dict[str, Compressor] = {}
+
+
+def register(comp: Compressor) -> None:
+    _REGISTRY[comp.name] = comp
+
+
+def create(name: str) -> Compressor:
+    """Compressor::create: by-name factory; unknown = error."""
+    c = _REGISTRY.get(name)
+    if c is None:
+        raise CompressorError("no compressor %r (have: %s)"
+                              % (name, sorted(_REGISTRY)))
+    return c
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+register(ZlibCompressor())
+register(LzmaCompressor())
+register(Bz2Compressor())
+
+# optional third-party algorithms, loaded like dlopen'd plugins
+try:                                    # pragma: no cover
+    import snappy as _snappy
+
+    class SnappyCompressor(Compressor):
+        name = "snappy"
+
+        def compress(self, data: bytes) -> bytes:
+            return _snappy.compress(data)
+
+        def decompress(self, blob: bytes) -> bytes:
+            try:
+                return _snappy.decompress(blob)
+            except Exception as e:
+                raise CompressorError("snappy: %s" % e) from None
+
+    register(SnappyCompressor())
+except ImportError:
+    pass
+
+try:                                    # pragma: no cover
+    import zstandard as _zstd
+
+    class ZstdCompressor(Compressor):
+        name = "zstd"
+
+        def compress(self, data: bytes) -> bytes:
+            return _zstd.ZstdCompressor().compress(data)
+
+        def decompress(self, blob: bytes) -> bytes:
+            try:
+                return _zstd.ZstdDecompressor().decompress(blob)
+            except Exception as e:
+                raise CompressorError("zstd: %s" % e) from None
+
+    register(ZstdCompressor())
+except ImportError:
+    pass

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 
 from ..msg import Messenger
+from ..msg.messenger import ms_compress_from_conf
 from ..msg.messages import (MMgrReport, MMonCommand, MMonCommandAck,
                             MMonGetMap, MMonSubscribe, MOSDMapMsg)
 from ..osd.osdmap import OSDMap, consume_map_payload
@@ -37,7 +38,8 @@ class Manager:
         self.ctx = ctx or Context("mgr")
         from ..msg.auth import AuthContext
         self.msgr = Messenger(
-            "mgr", auth=AuthContext.from_conf(self.ctx.conf))
+            "mgr", auth=AuthContext.from_conf(self.ctx.conf),
+            compress=ms_compress_from_conf(self.ctx.conf))
         self.msgr.add_dispatcher(self)
         self.osdmap: OSDMap = OSDMap()
         self.balance_interval = balance_interval

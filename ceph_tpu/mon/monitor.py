@@ -32,6 +32,7 @@ import time
 from ..models.crushmap import (CHOOSE_FIRSTN, CHOOSE_INDEP, EMIT, STRAW2,
                                TAKE, CrushMap)
 from ..msg import Messenger
+from ..msg.messenger import ms_compress_from_conf
 from ..msg.messages import (MMonCommand, MMonCommandAck, MMonElection,
                             MMonGetMap, MMonPaxos, MMonSubscribe,
                             MOSDAlive, MOSDBoot, MOSDFailure,
@@ -86,7 +87,8 @@ class Monitor:
         self._last_proposal = None
         from ..msg.auth import AuthContext
         self.msgr = Messenger(
-            name, auth=AuthContext.from_conf(self.ctx.conf))
+            name, auth=AuthContext.from_conf(self.ctx.conf),
+            compress=ms_compress_from_conf(self.ctx.conf))
         self.msgr.add_dispatcher(self)
         self.osdmap = OSDMap()
         self.osdmap.fsid = fsid
@@ -790,6 +792,17 @@ class Monitor:
             pool.pgp_num = int(val)
         elif key == "crush_rule":
             pool.crush_rule = int(val)
+        elif key == "compression_mode":
+            if val not in ("none", "force"):
+                raise ValueError("compression_mode: none|force")
+            pool.compression_mode = val
+        elif key == "compression_algorithm":
+            from ..compress import available
+
+            if val not in available():
+                raise ValueError("no compressor %r (have %s)"
+                                 % (val, available()))
+            pool.compression_algorithm = val
         else:
             raise ValueError("cannot set %r" % key)
         pool.last_change = self.osdmap.epoch + 1

@@ -48,6 +48,37 @@ TAG_CLOSE = 4
 _HDR = struct.Struct(">BII")  # tag, length, crc32
 
 
+def ms_compress_from_conf(conf) -> list[str]:
+    """Wire-compression preference list from conf (ms_compress),
+    filtered to locally-available algorithms — a node must never
+    ADVERTISE what it cannot run, or the two ends of a connection
+    would disagree about the frame format."""
+    try:
+        raw = conf["ms_compress"]
+    except Exception:
+        return []
+    from ..compress import available
+
+    have = set(available())
+    return [a.strip() for a in raw.split(",")
+            if a.strip() and a.strip() in have]
+
+
+def _pick_compressor(acceptor_prefs, initiator_algos):
+    """Common wire compressor, acceptor's preference order deciding
+    (both sides compute the same answer from the exchanged idents).
+    Returns a Compressor instance or None."""
+    common = [a for a in acceptor_prefs if a in (initiator_algos or [])]
+    if not common:
+        return None
+    from ..compress import CompressorError, create
+
+    try:
+        return create(common[0])
+    except CompressorError:
+        return None
+
+
 class Policy:
     """Connection semantics per peer type (src/msg/Policy.h)."""
 
@@ -160,7 +191,7 @@ class Connection:
         an abandoned open socket would wedge Server.wait_closed()."""
         while not self._transports.empty():
             try:
-                _r, w, _f = self._transports.get_nowait()
+                _r, w = self._transports.get_nowait()[:2]
                 w.close()
             except Exception:
                 pass
@@ -184,8 +215,8 @@ class Connection:
                 host, port = self.peer_addr.rsplit(":", 1)
                 reader, writer = await asyncio.open_connection(
                     host, int(port))
-                framer = await self.msgr._handshake_out(self, reader,
-                                                        writer)
+                framer, comp = await self.msgr._handshake_out(
+                    self, reader, writer)
             except asyncio.CancelledError:
                 if writer is not None:
                     writer.close()
@@ -200,7 +231,7 @@ class Connection:
                 backoff = min(backoff * 2, 2.0)
                 continue
             backoff = 0.02
-            closed = await self._session(reader, writer, framer)
+            closed = await self._session(reader, writer, framer, comp)
             if closed or self.policy.lossy:
                 await self._die()
                 return
@@ -210,18 +241,20 @@ class Connection:
         try:
             while self._open:
                 try:
-                    reader, writer, framer = \
+                    reader, writer, framer, comp = \
                         await self._transports.get()
                 except asyncio.CancelledError:
                     return
-                closed = await self._session(reader, writer, framer)
+                closed = await self._session(reader, writer, framer,
+                                             comp)
                 if closed or self.policy.lossy:
                     await self._die()
                     return
         finally:
             self._drain_transports()
 
-    async def _session(self, reader, writer, framer=None) -> bool:
+    async def _session(self, reader, writer, framer=None,
+                       comp=None) -> bool:
         """Run one transport until it faults. Returns True when the
         peer closed gracefully (no replay should follow).  The AEAD
         framer is BOUND to this transport (derived from this
@@ -231,8 +264,10 @@ class Connection:
         self._framer = framer
         if self.policy.resend:
             self._replay_unacked()
-        rt = asyncio.ensure_future(self._read_frames(reader, framer))
-        wt = asyncio.ensure_future(self._write_frames(writer, framer))
+        rt = asyncio.ensure_future(
+            self._read_frames(reader, framer, comp))
+        wt = asyncio.ensure_future(
+            self._write_frames(writer, framer, comp))
         try:
             done, pending = await asyncio.wait(
                 {rt, wt}, return_when=asyncio.FIRST_COMPLETED)
@@ -261,7 +296,8 @@ class Connection:
 
     # -- frame loops (subtasks of _session) ---------------------------------
 
-    async def _write_frames(self, writer, framer=None) -> None:
+    async def _write_frames(self, writer, framer=None,
+                            comp=None) -> None:
         while True:
             tag, payload = await self.out_q.get()
             try:
@@ -269,6 +305,17 @@ class Connection:
                         random.randrange(
                             self.msgr.inject_socket_failures) == 0):
                     raise ConnectionError_("injected socket failure")
+                if comp is not None and tag == TAG_MSG:
+                    # compress-then-encrypt; 1-byte flag says whether
+                    # this frame actually compressed (small or
+                    # incompressible payloads ride raw)
+                    if len(payload) >= 512:
+                        blob = comp.compress(payload)
+                        payload = (b"\x01" + blob
+                                   if len(blob) < len(payload)
+                                   else b"\x00" + payload)
+                    else:
+                        payload = b"\x00" + payload
                 if framer is not None:
                     # the tag rides as AEAD associated data: relabeled
                     # frames fail the MAC at the receiver
@@ -281,7 +328,8 @@ class Connection:
                 # and will be replayed on the next transport
                 return
 
-    async def _read_frames(self, reader, framer=None) -> None:
+    async def _read_frames(self, reader, framer=None,
+                           comp=None) -> None:
         while True:
             try:
                 tag, payload = await _read_frame(reader)
@@ -291,6 +339,10 @@ class Connection:
                     # lossless replay still runs), never an orderly
                     # shutdown an attacker could forge
                     payload = framer.open(payload, bytes([tag]))
+                if comp is not None and tag == TAG_MSG:
+                    flag, payload = payload[:1], payload[1:]
+                    if flag == b"\x01":
+                        payload = comp.decompress(payload)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -344,9 +396,14 @@ class Connection:
 class Messenger:
     """Endpoint owning connections + the dispatch path."""
 
-    def __init__(self, entity: str, nonce: int = 0, auth=None):
+    def __init__(self, entity: str, nonce: int = 0, auth=None,
+                 compress: list[str] | None = None):
         self.entity = entity
         self.auth = auth            # AuthContext or None (DummyAuth)
+        # on-wire compression preferences (msgr2 compression_onwire
+        # role): advertised in the ident, the ACCEPTOR's order picks
+        # the common algorithm; empty/None disables
+        self.compress_algos = list(compress or [])
         # the nonce identifies this messenger *instance*: a restarted
         # daemon must present a different one so peers reset sessions
         self.nonce = nonce if nonce else random.getrandbits(63)
@@ -452,7 +509,8 @@ class Messenger:
         # much it already received, so replay covers only the gap
         ident = denc.encode({"entity": self.entity, "nonce": self.nonce,
                              "addr": self.addr or "",
-                             "ack": conn.in_seq})
+                             "ack": conn.in_seq,
+                             "comp": self.compress_algos})
         writer.write(struct.pack(">I", len(ident)) + ident)
         await writer.drain()
         banner = await reader.readexactly(len(BANNER))
@@ -461,6 +519,9 @@ class Messenger:
         (n,) = struct.unpack(">I", await reader.readexactly(4))
         peer_blob = await reader.readexactly(n)
         peer = denc.decode(peer_blob)
+        # acceptor's preference order picks the wire compressor
+        comp = _pick_compressor(peer.get("comp") or [],
+                                self.compress_algos)
         # the idents are unauthenticated at this point: they travel as
         # transcript bind material in the key proofs, and NO session
         # state (nonce, in_seq, unacked purge) moves until the peer has
@@ -477,7 +538,7 @@ class Messenger:
         conn.peer_nonce = nonce
         ack = peer.get("ack", 0)
         conn.unacked = [(s, d) for s, d in conn.unacked if s > ack]
-        return framer
+        return framer, comp
 
     @staticmethod
     async def _read_auth_blob(reader, cap: int = 4096,
@@ -577,11 +638,14 @@ class Messenger:
             ident = denc.encode({"entity": self.entity,
                                  "nonce": self.nonce,
                                  "addr": self.addr or "",
-                                 "ack": ack_out})
+                                 "ack": ack_out,
+                                 "comp": self.compress_algos})
             writer.write(struct.pack(">I", len(ident)) + ident)
             await writer.drain()
         except (ConnectionError, OSError):
             return False
+        comp = _pick_compressor(self.compress_algos,
+                                peer.get("comp") or [])
         ok, framer = await self._auth_in(reader, writer,
                                          bind=peer_blob + ident)
         if not ok:
@@ -610,7 +674,7 @@ class Messenger:
                         if s > peer.get("ack", 0)]
         if not conn.is_open:
             return False    # raced mark_down: nobody will run this
-        conn._transports.put_nowait((reader, writer, framer))
+        conn._transports.put_nowait((reader, writer, framer, comp))
         return True
 
     async def _auth_in(self, reader, writer, bind: bytes = b""):

@@ -76,6 +76,35 @@ class MethodContext:
         # though a tombstone with stale xattrs sits on disk.  Reads
         # behave as not-found; the first write resurrects it clean.
         self._whiteout = whiteout
+        # pool-compressed image (comp-alg xattr): reads decompress,
+        # the first data write rewrites raw (mirrors the daemon's
+        # _decompress_in_txn), so class methods always see logical
+        # bytes, never the physical blob
+        self._comp_decompressed = False
+
+    def _comp_algo(self) -> str | None:
+        if self._comp_decompressed:
+            return None
+        from ...compress import OBJ_ALGO_ATTR
+
+        raw = self.getxattr(OBJ_ALGO_ATTR)
+        return raw.decode() if raw else None
+
+    def _decompress_for_write(self) -> None:
+        algo = self._comp_algo()
+        if algo is None:
+            return
+        from ...compress import (OBJ_ALGO_ATTR, OBJ_SIZE_ATTR,
+                                 create)
+
+        raw = create(algo).decompress(
+            self.store.read(self.cid, self.oid))
+        t = self._w()
+        t.truncate(self.cid, self.oid, 0)
+        t.write(self.cid, self.oid, 0, len(raw), raw)
+        t.rmattr(self.cid, self.oid, OBJ_ALGO_ATTR)
+        t.rmattr(self.cid, self.oid, OBJ_SIZE_ATTR)
+        self._comp_decompressed = True
 
     # -- reads (cls_cxx_read / getxattr / map_get_* ) ----------------------
 
@@ -86,6 +115,11 @@ class MethodContext:
     def stat(self) -> int:
         if self._whiteout:
             raise ClsError(ENOENT, "object absent")
+        from ...compress import OBJ_SIZE_ATTR
+
+        raw = self.getxattr(OBJ_SIZE_ATTR)
+        if raw and not self._comp_decompressed:
+            return int(raw)
         try:
             return self.store.stat(self.cid, self.oid)
         except NotFound:
@@ -95,7 +129,20 @@ class MethodContext:
         if self._whiteout:
             raise ClsError(ENOENT, "object absent")
         try:
-            return self.store.read(self.cid, self.oid, offset, length)
+            algo = self._comp_algo()
+            if algo is None:
+                return self.store.read(self.cid, self.oid, offset,
+                                       length)
+            from ...compress import CompressorError, create
+
+            try:
+                raw = create(algo).decompress(
+                    self.store.read(self.cid, self.oid))
+            except CompressorError as e:
+                raise ClsError(EIO, str(e)) from None
+            if length < 0:
+                return raw[offset:]
+            return raw[offset:offset + length]
         except NotFound:
             raise ClsError(ENOENT, "object absent") from None
 
@@ -162,10 +209,12 @@ class MethodContext:
 
     def write(self, offset: int, data: bytes) -> None:
         self.create()
+        self._decompress_for_write()
         self._w().write(self.cid, self.oid, offset, len(data), data)
 
     def write_full(self, data: bytes) -> None:
         self.create()
+        self._decompress_for_write()
         if self.store.exists(self.cid, self.oid):
             self._w().truncate(self.cid, self.oid, 0)
         self._w().write(self.cid, self.oid, 0, len(data), data)
@@ -185,6 +234,7 @@ class MethodContext:
         self._w().omap_rmkeys(self.cid, self.oid, keys)
 
     def truncate(self, length: int) -> None:
+        self._decompress_for_write()
         self._w().truncate(self.cid, self.oid, length)
 
     def remove(self) -> None:

@@ -31,7 +31,8 @@ from __future__ import annotations
 import asyncio
 import time
 
-from ..msg import Messenger, Policy
+from ..msg import Messenger
+from ..msg.messenger import ms_compress_from_conf, Policy
 from ..msg.messages import (MMonSubscribe, MOSDAlive, MOSDBoot,
                             MOSDECSubOpRead, MOSDECSubOpReadReply,
                             MOSDECSubOpWrite, MOSDECSubOpWriteReply,
@@ -69,7 +70,8 @@ class OSD:
         from ..msg.auth import AuthContext
         self.msgr = Messenger(
             "osd.%d" % whoami,
-            auth=AuthContext.from_conf(self.ctx.conf))
+            auth=AuthContext.from_conf(self.ctx.conf),
+            compress=ms_compress_from_conf(self.ctx.conf))
         self.msgr.peer_policy["osd"] = Policy.lossless_peer()
         self.msgr.add_dispatcher(self)
         from .cls import default_handler
@@ -1202,6 +1204,106 @@ class OSD:
                 return False    # unknown: read path reports the error
         return False
 
+    # -- pool compression (BlueStore blob-compression role over the
+    # object layer; src/compressor consumers) --------------------------
+
+    def _maybe_compress(self, pool, pg: PG, ho, data: bytes,
+                        t: Transaction, cstate: dict) -> bytes:
+        """Full-object writes on a compression pool store the
+        compressed image when it saves enough (the reference's
+        required-ratio gate); the algorithm + logical size ride
+        xattrs so every consumer (reads, recovery pushes, scrub) sees
+        a self-describing blob.  EC pools skip — stripe math needs
+        the raw bytes.  ``cstate`` tracks per-txn staged comp state
+        (ho -> algo | None): later ops in the SAME MOSDOp must see
+        what earlier ops staged, not the committed attrs."""
+        from ..compress import OBJ_ALGO_ATTR, OBJ_SIZE_ATTR, create
+
+        if pool is None or pool.compression_mode != "force" \
+                or pool.is_erasure() or len(data) < 128:
+            self._clear_comp_attrs(pg, ho, t, cstate)
+            return data
+        blob = create(pool.compression_algorithm).compress(data)
+        if len(blob) * 10 >= len(data) * 9:     # <10% saved: keep raw
+            self._clear_comp_attrs(pg, ho, t, cstate)
+            return data
+        t.setattr(pg.cid, ho, OBJ_ALGO_ATTR,
+                  pool.compression_algorithm.encode())
+        t.setattr(pg.cid, ho, OBJ_SIZE_ATTR, b"%d" % len(data))
+        # keep the raw image beside the staged algo: a later op in
+        # this txn cannot read the blob back (it is not applied yet)
+        cstate[ho] = (pool.compression_algorithm, data)
+        return blob
+
+    def _clear_comp_attrs(self, pg: PG, ho, t: Transaction,
+                          cstate: dict) -> None:
+        from ..compress import OBJ_ALGO_ATTR, OBJ_SIZE_ATTR
+
+        if self._comp_state(pg, ho, cstate)[0] is not None:
+            t.rmattr(pg.cid, ho, OBJ_ALGO_ATTR)
+            t.rmattr(pg.cid, ho, OBJ_SIZE_ATTR)
+        cstate[ho] = None
+
+    def _comp_state(self, pg: PG, ho, cstate: dict | None = None
+                    ) -> tuple[str | None, bytes | None]:
+        """(algo, staged raw bytes) — txn-staged state wins over the
+        committed attrs."""
+        if cstate is not None and ho in cstate:
+            st = cstate[ho]
+            return (None, None) if st is None else st
+        from ..compress import OBJ_ALGO_ATTR
+
+        try:
+            return (self.store.getattr(pg.cid, ho,
+                                       OBJ_ALGO_ATTR).decode(), None)
+        except NotFound:
+            return (None, None)
+
+    def _comp_algo(self, pg: PG, ho,
+                   cstate: dict | None = None) -> str | None:
+        return self._comp_state(pg, ho, cstate)[0]
+
+    def _decompress_in_txn(self, pg: PG, ho, t: Transaction,
+                           cstate: dict) -> None:
+        """Partial mutations of a compressed object rewrite it raw
+        first (staged in the same txn), so offset math stays exact —
+        the GC/rewrite move BlueStore makes when a compressed blob is
+        partially overwritten.  No-op if this txn already staged a
+        raw image (cstate says None)."""
+        algo, raw = self._comp_state(pg, ho, cstate)
+        if algo is None:
+            cstate[ho] = None
+            return
+        from ..compress import OBJ_ALGO_ATTR, OBJ_SIZE_ATTR, create
+
+        if raw is None:
+            raw = create(algo).decompress(self.store.read(pg.cid, ho))
+        t.truncate(pg.cid, ho, 0)
+        t.write(pg.cid, ho, 0, len(raw), raw)
+        t.rmattr(pg.cid, ho, OBJ_ALGO_ATTR)
+        t.rmattr(pg.cid, ho, OBJ_SIZE_ATTR)
+        cstate[ho] = None
+
+    def _read_decompressed(self, pg: PG, ho, offset: int = 0,
+                           length: int = -1) -> bytes:
+        algo = self._comp_algo(pg, ho)
+        if algo is None:
+            return self.store.read(pg.cid, ho, offset, length)
+        from ..compress import create
+
+        raw = create(algo).decompress(self.store.read(pg.cid, ho))
+        if length < 0:
+            return raw[offset:]
+        return raw[offset:offset + length]
+
+    def _stat_decompressed(self, pg: PG, ho) -> int:
+        from ..compress import OBJ_SIZE_ATTR
+
+        try:
+            return int(self.store.getattr(pg.cid, ho, OBJ_SIZE_ATTR))
+        except NotFound:
+            return self.store.stat(pg.cid, ho)
+
     # read-side op interpreter (do_osd_ops read branch)
     def _do_read_ops(self, pg: PG, oid: str, ops: list,
                      snapid: int | None = None, entity: str = ""):
@@ -1226,11 +1328,12 @@ class OSD:
             try:
                 if name == "read":
                     length = op.get("length", 0) or -1
-                    data = self.store.read(pg.cid, ho,
-                                           op.get("offset", 0), length)
+                    data = self._read_decompressed(
+                        pg, ho, op.get("offset", 0), length)
                     outs.append({"data": data})
                 elif name == "stat":
-                    outs.append({"size": self.store.stat(pg.cid, ho)})
+                    outs.append({"size": self._stat_decompressed(
+                        pg, ho)})
                 elif name == "getxattr":
                     outs.append({"value": self.store.getattr(
                         pg.cid, ho, op["name"])})
@@ -1268,6 +1371,14 @@ class OSD:
             except NotFound:
                 outs.append({"error": "not found"})
                 result = -2
+            except Exception as e:
+                from ..compress import CompressorError
+
+                if not isinstance(e, CompressorError):
+                    raise
+                # corrupt blob / missing plugin: EIO, never a wedge
+                outs.append({"error": str(e)})
+                result = -5
         return outs, result
 
     def _execute_write(self, pg: PG, conn, msg: MOSDOp) -> None:
@@ -1285,6 +1396,8 @@ class OSD:
                                     getattr(msg, "snapc", None), t)
         head_whiteout = snapmod.is_whiteout(self.store, pg.cid, ho)
         is_delete = False
+        cstate: dict = {}   # per-txn staged compression state
+        from ..compress import CompressorError
         for op in msg.ops:
             name = op["op"]
             if name == "write":
@@ -1295,6 +1408,12 @@ class OSD:
                 elif head_whiteout:
                     # resurrecting a whiteout head: clear the tombstone
                     t.setattr(pg.cid, ho, snapmod.WHITEOUT_ATTR, b"0")
+                try:
+                    self._decompress_in_txn(pg, ho, t, cstate)
+                except CompressorError as e:
+                    outs.append({"error": str(e)})
+                    result = -5
+                    continue
                 t.write(pg.cid, ho, off, len(data), data)
                 outs.append({})
             elif name == "writefull":
@@ -1306,7 +1425,15 @@ class OSD:
                                   b"0")
                 else:
                     t.touch(pg.cid, ho)
-                t.write(pg.cid, ho, 0, len(data), data)
+                pool0 = self.osdmap.pools.get(pg.pool_id)
+                try:
+                    stored = self._maybe_compress(pool0, pg, ho,
+                                                  data, t, cstate)
+                except CompressorError as e:
+                    outs.append({"error": str(e)})
+                    result = -5
+                    continue
+                t.write(pg.cid, ho, 0, len(stored), stored)
                 outs.append({})
             elif name == "delete":
                 if self.store.exists(pg.cid, ho) and not head_whiteout:
@@ -1318,6 +1445,12 @@ class OSD:
                     outs.append({"error": "not found"})
                     result = -2
             elif name == "truncate":
+                try:
+                    self._decompress_in_txn(pg, ho, t, cstate)
+                except CompressorError as e:
+                    outs.append({"error": str(e)})
+                    result = -5
+                    continue
                 t.truncate(pg.cid, ho, op["length"])
                 outs.append({})
             elif name == "setxattr":

@@ -91,6 +91,10 @@ class PGPool:
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)       # snapid -> name
     removed_snaps: list = field(default_factory=list)
+    # pool-level compression (pg_pool_t compression_* options feeding
+    # the BlueStore blob-compression role): mode "none" | "force"
+    compression_mode: str = "none"
+    compression_algorithm: str = "zlib"
 
     def __post_init__(self):
         if not self.pgp_num:
@@ -152,6 +156,8 @@ class PGPool:
             "snap_seq": self.snap_seq,
             "snaps": {str(k): v for k, v in self.snaps.items()},
             "removed_snaps": list(self.removed_snaps),
+            "compression_mode": self.compression_mode,
+            "compression_algorithm": self.compression_algorithm,
         }
 
     @classmethod
@@ -161,6 +167,8 @@ class PGPool:
                       for k, v in (d.get("snaps") or {}).items()}
         d.setdefault("snap_seq", 0)
         d.setdefault("removed_snaps", [])
+        d.setdefault("compression_mode", "none")
+        d.setdefault("compression_algorithm", "zlib")
         return cls(**d)
 
 

@@ -227,6 +227,9 @@ DEFAULT_SCHEMA: list[Option] = [
            "shared cluster secret (the keyring role)"),
     Option("ms_secure_mode", OPT_INT, 0,
            "1 = AEAD-encrypt every frame (ProtocolV2 secure mode)"),
+    Option("ms_compress", OPT_STR, "",
+           "comma-separated on-wire compression preferences"
+           " (msgr2 compression_onwire role); empty = off"),
     Option("osd_recovery_max_active", OPT_INT, 8,
            "max concurrent recovery ops per osd"),
     Option("osd_max_pg_log_entries", OPT_INT, 2000,
