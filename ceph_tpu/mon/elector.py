@@ -1,13 +1,28 @@
-"""Monitor leader election (classic strategy).
+"""Monitor leader election: classic, disallow, and connectivity
+strategies.
 
-Analog of src/mon/Elector.h + ElectionLogic.cc's CLASSIC mode: the
-lowest-ranked monitor that can reach a majority wins.  Epochs are odd
-while electing and even when stable (ElectionLogic::bump_epoch
-semantics); every PROPOSE carries the proposer's epoch so stale rounds
-are ignored, DEFER (ack) goes to the lowest-ranked proposer seen this
-round, and a proposer declares VICTORY once a majority (including
-itself) has deferred.  Losing contact with the leader (or a victory
-timeout) restarts the election with a bumped epoch.
+Analog of src/mon/Elector.h + ElectionLogic.cc:
+
+* CLASSIC — the lowest-ranked monitor that can reach a majority wins.
+* DISALLOW — classic, but ranks named in mon_disallowed_leaders never
+  lead (they defer, never propose; ElectionLogic handle "disallowed").
+* CONNECTIVITY — candidates are ranked by how well the QUORUM can
+  reach them (ElectionLogic.cc:332 propose_connectivity_handler):
+  every monitor keeps a decaying per-peer connectivity score
+  (ConnectionTracker role), reports are GOSSIPED inside election
+  messages with per-reporter versions, and a voter defers to the
+  proposer whose aggregate score (mean of all reporters' views) is
+  higher — rank only breaks near-ties.  Scores persist in the mon
+  store (Elector.h:278 persist_connectivity_scores) so a restarted
+  monitor remembers who was flaky.
+
+Epochs are odd while electing and even when stable
+(ElectionLogic::bump_epoch semantics); every PROPOSE carries the
+proposer's epoch so stale rounds are ignored, DEFER (ack) goes to the
+best candidate seen this round, and a proposer declares VICTORY once
+a majority (including itself) has deferred.  Losing contact with the
+leader (or a victory timeout) restarts the election with a bumped
+epoch.
 """
 
 from __future__ import annotations
@@ -22,11 +37,154 @@ PROPOSE = "propose"
 DEFER = "defer"
 VICTORY = "victory"
 
+CLASSIC = "classic"
+DISALLOW = "disallow"
+CONNECTIVITY = "connectivity"
+
+_SCORES_KEY = b"elector:scores"
+
+
+class ConnectionTracker:
+    """Decaying per-peer connectivity scores with gossip merge
+    (src/mon/ConnectionTracker.h).
+
+    Each monitor owns ONE report: {peer rank: score in [0,1]} plus a
+    version; election traffic carries every report a node has seen,
+    and receivers keep the freshest per reporter.  A candidate's
+    aggregate score is the mean of all reporters' views of it, so a
+    monitor that half the cluster cannot reach scores low everywhere
+    once gossip spreads."""
+
+    DECAY = 0.5         # per-tick multiplier for unseen peers
+    FLOOR = 0.001
+
+    def __init__(self, rank: int, store=None):
+        self.rank = rank
+        self.store = store
+        self.reports: dict[int, dict] = {}
+        self._seen: set[int] = set()     # peers heard from this tick
+        self._load()
+        mine = self.reports.setdefault(
+            rank, {"v": 0, "scores": {}})
+        mine["scores"][rank] = 1.0
+
+    # -- observation --------------------------------------------------------
+
+    def saw(self, rank: int) -> None:
+        """A message arrived from this peer: it is reachable now."""
+        if rank == self.rank:
+            return
+        self._seen.add(rank)
+        mine = self.reports[self.rank]
+        cur = mine["scores"].get(rank, 1.0)
+        if cur != 1.0:
+            # gradual recovery (halfway per receipt): a peer dropping
+            # half its traffic oscillates well below 1.0 instead of
+            # snapping healthy on every delivered message — that gap
+            # is what lets the strategy demote FLAKY monitors, not
+            # just fully-partitioned ones
+            mine["scores"][rank] = min(1.0, cur * 0.5 + 0.5)
+            mine["v"] += 1
+            self._persist()
+
+    def lost(self, rank: int) -> None:
+        """Transport to the peer reset: degrade immediately."""
+        mine = self.reports[self.rank]
+        cur = mine["scores"].get(rank, 1.0)
+        mine["scores"][rank] = max(self.FLOOR, cur * self.DECAY)
+        mine["v"] += 1
+        self._persist()
+
+    def tick(self) -> None:
+        """Decay every peer not heard from since the last tick, then
+        persist (the reference decays on a halflife; one multiplier
+        per tick is the same shape)."""
+        mine = self.reports[self.rank]
+        changed = False
+        for r, s in list(mine["scores"].items()):
+            if r == self.rank or r in self._seen:
+                continue
+            ns = max(self.FLOOR, s * self.DECAY)
+            if ns != s:
+                mine["scores"][r] = ns
+                changed = True
+        self._seen.clear()
+        if changed:
+            mine["v"] += 1
+            self._persist()
+
+    # -- gossip -------------------------------------------------------------
+
+    def wire(self) -> dict:
+        return {str(r): {"v": rep["v"],
+                         "scores": {str(p): s
+                                    for p, s in rep["scores"].items()}}
+                for r, rep in self.reports.items()}
+
+    def merge(self, wire: dict | None) -> None:
+        for r_s, rep in (wire or {}).items():
+            r = int(r_s)
+            if r == self.rank:
+                continue            # nobody overwrites MY report
+            cur = self.reports.get(r)
+            if cur is None or rep["v"] > cur["v"]:
+                self.reports[r] = {
+                    "v": rep["v"],
+                    "scores": {int(p): float(s)
+                               for p, s in rep["scores"].items()}}
+
+    def aggregate(self, rank: int) -> float:
+        """Mean of every reporter's view of ``rank`` (the candidate's
+        cluster-wide reachability)."""
+        views = [rep["scores"][rank]
+                 for rep in self.reports.values()
+                 if rank in rep["scores"]]
+        return sum(views) / len(views) if views else 1.0
+
+    # -- persistence --------------------------------------------------------
+
+    def _persist(self) -> None:
+        if self.store is None:
+            return
+        from ..utils import denc
+
+        tx = self.store.get_transaction()
+        tx.set(_SCORES_KEY, denc.encode(self.wire()))
+        self.store.submit_transaction(tx, sync=False)
+
+    def _load(self) -> None:
+        if self.store is None:
+            return
+        from ..utils import denc
+
+        raw = self.store.get(_SCORES_KEY)
+        if raw is None:
+            return
+        try:
+            for r_s, rep in denc.decode(raw).items():
+                self.reports[int(r_s)] = {
+                    "v": rep["v"],
+                    "scores": {int(p): float(s)
+                               for p, s in rep["scores"].items()}}
+        except Exception:
+            self.reports = {}
+
 
 class Elector:
-    def __init__(self, mon, timeout: float = 2.0):
+    def __init__(self, mon, timeout: float = 2.0,
+                 strategy: str = CLASSIC,
+                 disallowed: set[int] | None = None):
         self.mon = mon                  # Monitor: rank, peers, send
         self.timeout = timeout
+        self.strategy = strategy
+        self.disallowed = set(disallowed or ())
+        # the tracker persists (and is even consulted) only under the
+        # connectivity strategy — classic clusters pay no per-message
+        # KV writes or gossip bytes for scores they never read
+        self.tracker = ConnectionTracker(
+            mon.rank,
+            getattr(mon, "store", None)
+            if strategy == CONNECTIVITY else None)
         self.stopped = False
         self.epoch = 1
         self.state = ELECTING
@@ -40,6 +198,21 @@ class Elector:
 
     def _majority(self) -> int:
         return len(self.mon.monmap) // 2 + 1
+
+    def _allowed(self, rank: int) -> bool:
+        return rank not in self.disallowed
+
+    def _prefer(self, a: int, b: int) -> bool:
+        """True when candidate ``a`` should lead over ``b``.  Classic
+        and disallow rank by id; connectivity ranks by aggregate
+        reachability, id breaking near-ties (the 0.05 margin keeps
+        score jitter from flapping leadership)."""
+        if self.strategy == CONNECTIVITY:
+            sa, sb = (self.tracker.aggregate(a),
+                      self.tracker.aggregate(b))
+            if abs(sa - sb) > 0.05:
+                return sa > sb
+        return a < b
 
     def _bump(self, to_epoch: int | None = None, electing=True) -> None:
         e = max(self.epoch + 1, to_epoch or 0)
@@ -85,6 +258,13 @@ class Elector:
         self.state = ELECTING
         self.leader = None
         self.quorum = set()
+        if not self._allowed(self.mon.rank):
+            # a disallowed monitor never proposes itself: it bumps the
+            # epoch and waits for an allowed candidate's PROPOSE
+            self.deferred_to = None
+            self._defers = set()
+            self._arm_timer()
+            return
         self.deferred_to = self.mon.rank
         self._defers = {self.mon.rank}
         self.mon.ctx.log.debug(
@@ -125,7 +305,11 @@ class Elector:
     # -- message handlers ---------------------------------------------------
 
     def handle(self, src_rank: int, op: str, epoch: int,
-               quorum=None) -> None:
+               quorum=None, scores=None) -> None:
+        self.tracker.saw(src_rank)
+        self.tracker.merge(scores)
+        if op == "ping":
+            return      # liveness probe: tracker.saw above is enough
         if op == PROPOSE:
             if epoch < self.epoch and self.state != ELECTING:
                 # stale proposer (e.g. rejoining): poke it to catch up
@@ -135,31 +319,37 @@ class Elector:
             if epoch > self.epoch:
                 # a fresh round supersedes any stale defer state —
                 # keeping it would suppress re-proposing and block
-                # defers to higher-ranked proposers at the new epoch
+                # defers to better proposers at the new epoch
                 self.epoch = epoch if epoch % 2 else epoch + 1
                 self.state = ELECTING
                 self.deferred_to = None
                 self._defers = set()
             if self.state != ELECTING:
                 return
-            if src_rank < self.mon.rank:
-                # defer to the better-ranked proposer
+            me = self.mon.rank
+            i_can_lead = self._allowed(me)
+            src_better = (not i_can_lead and self._allowed(src_rank)
+                          ) or (self._allowed(src_rank)
+                                and self._prefer(src_rank, me))
+            if src_better:
+                # defer to the better candidate — unless we already
+                # acked someone at least as good this round
                 if self.deferred_to is None \
-                        or src_rank <= self.deferred_to:
+                        or src_rank == self.deferred_to \
+                        or self._prefer(src_rank, self.deferred_to):
                     self.deferred_to = src_rank
                     self.mon.send_election(DEFER, self.epoch,
                                            to_rank=src_rank)
                     self._arm_timer()
             else:
-                # outrank them: (re)propose ourselves — but only if we
-                # have not already deferred this round (deferred_to is
-                # either None, our own rank, or a better rank we acked;
-                # ElectionLogic ignores worse-ranked proposals after
-                # acking a better one — revoking the defer could hand
-                # two proposers disjoint majorities in the same epoch)
-                if self.deferred_to is None:
-                    self.deferred_to = self.mon.rank
-                    self._defers = {self.mon.rank}
+                # we are the better candidate: (re)propose ourselves —
+                # but only if we have not already deferred this round
+                # (ElectionLogic ignores worse proposals after acking
+                # a better one — revoking the defer could hand two
+                # proposers disjoint majorities in the same epoch)
+                if self.deferred_to is None and i_can_lead:
+                    self.deferred_to = me
+                    self._defers = {me}
                     self.mon.send_election(PROPOSE, self.epoch)
                     self._arm_timer()
         elif op == DEFER:
@@ -185,6 +375,7 @@ class Elector:
     def peer_lost(self, rank: int) -> None:
         """A quorum member became unreachable: re-elect if it matters
         (the leader died, or we are the leader and lost majority)."""
+        self.tracker.lost(rank)
         if self.state == PEON and rank == self.leader:
             self.start_election()
         elif self.state == LEADER and rank in self.quorum:

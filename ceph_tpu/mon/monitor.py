@@ -79,7 +79,14 @@ class Monitor:
                           if n == name), 0)
         self.paxos = Paxos(self.store, rank=self.rank)
         self.multi = len(self.monmap) > 1
-        self.elector = Elector(self) if self.multi else None
+        if self.multi:
+            strategy = self.ctx.conf["mon_election_strategy"]
+            disallowed = self._parse_disallowed(
+                self.ctx.conf["mon_disallowed_leaders"])
+            self.elector = Elector(self, strategy=strategy,
+                                   disallowed=disallowed)
+        else:
+            self.elector = None
         self.mpaxos = (MultiPaxos(self, self.paxos) if self.multi
                        else None)
         self._proposal_wake = asyncio.Event() if self.multi else None
@@ -100,6 +107,32 @@ class Monitor:
         self.down_pending_out: dict[int, float] = {}
         self._tick_task = None
         self._load()
+
+    def _parse_disallowed(self, raw: str) -> set[int]:
+        """mon_disallowed_leaders accepts ranks or monitor names;
+        unknown tokens are ignored with a warning (a typo must not
+        stop the daemon), but barring EVERY rank is a configuration
+        that can never form a quorum and is rejected outright."""
+        out: set[int] = set()
+        names = {n: i for i, (n, _a) in enumerate(self.monmap)}
+        for tok in (raw or "").split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if tok in names:
+                out.add(names[tok])
+            else:
+                try:
+                    out.add(int(tok))
+                except ValueError:
+                    self.ctx.log.info(
+                        "mon", "ignoring unknown disallowed leader"
+                        " %r" % tok)
+        if out >= set(range(len(self.monmap))):
+            raise ValueError(
+                "mon_disallowed_leaders bars every rank: no quorum"
+                " could ever form")
+        return out
 
     # -- persistence -------------------------------------------------------
 
@@ -180,8 +213,12 @@ class Monitor:
 
     def send_election(self, op: str, epoch: int, to_rank=None,
                       quorum=None) -> None:
+        from .elector import CONNECTIVITY
+
+        scores = (self.elector.tracker.wire()
+                  if self.elector.strategy == CONNECTIVITY else None)
         msg = MMonElection(op=op, epoch=epoch, rank=self.rank,
-                           quorum=quorum)
+                           quorum=quorum, scores=scores)
         targets = ([to_rank] if to_rank is not None else
                    [r for r in self.quorum_ranks() if r != self.rank])
         for r in targets:
@@ -329,9 +366,12 @@ class Monitor:
         if isinstance(msg, MMonElection):
             if self.elector is not None:
                 self.elector.handle(msg.rank, msg.op, msg.epoch,
-                                    msg.quorum)
+                                    msg.quorum,
+                                    getattr(msg, "scores", None))
             return True
         if isinstance(msg, MMonPaxos):
+            if self.elector is not None:
+                self.elector.tracker.saw(msg.rank)
             if self.mpaxos is not None:
                 self.mpaxos.handle(msg.rank, msg.op, {
                     f: getattr(msg, f)
@@ -505,7 +545,17 @@ class Monitor:
             self._tick()
 
     def _tick(self) -> None:
-        """Auto-out down osds after the down-out interval."""
+        """Auto-out down osds after the down-out interval; decay +
+        persist connectivity scores and probe peer liveness."""
+        if self.elector is not None:
+            from .elector import CONNECTIVITY
+
+            self.elector.tracker.tick()
+            if self.elector.strategy == CONNECTIVITY:
+                # all-pairs liveness probes: the reference's Elector
+                # pings keep scores meaningful between elections
+                # (steady-state paxos is a leader-centred star)
+                self.send_election("ping", self.elector.epoch)
         now = time.monotonic()
         interval = self.ctx.conf["mon_osd_down_out_interval"]
         changed = False

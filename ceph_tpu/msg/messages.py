@@ -32,9 +32,11 @@ class MPong(Message):
 
 @register
 class MMonElection(Message):
-    """Elector rounds (MMonElection.h): op = propose|defer|victory."""
+    """Elector rounds (MMonElection.h): op = propose|defer|victory;
+    scores gossips the sender's ConnectionTracker reports
+    (connectivity strategy)."""
     TYPE = "mon_election"
-    FIELDS = ("op", "epoch", "rank", "quorum")
+    FIELDS = ("op", "epoch", "rank", "quorum", "scores")
 
 
 @register

@@ -65,7 +65,8 @@ class MethodContext:
 
     def __init__(self, store, cid: coll_t, oid: hobject_t,
                  txn: Transaction | None, entity: str,
-                 whiteout: bool = False):
+                 whiteout: bool = False,
+                 cstate: dict | None = None):
         self.store = store
         self.cid = cid
         self.oid = oid
@@ -79,32 +80,52 @@ class MethodContext:
         # pool-compressed image (comp-alg xattr): reads decompress,
         # the first data write rewrites raw (mirrors the daemon's
         # _decompress_in_txn), so class methods always see logical
-        # bytes, never the physical blob
-        self._comp_decompressed = False
+        # bytes, never the physical blob.  ``cstate`` is the daemon's
+        # per-txn compression state — an earlier op in the SAME
+        # MOSDOp may have staged a compressed or raw image this
+        # method must honor.
+        self._cstate = cstate if cstate is not None else {}
+        self._staged_raw: bytes | None = None
 
-    def _comp_algo(self) -> str | None:
-        if self._comp_decompressed:
-            return None
+    def _comp_state(self) -> tuple[str | None, bytes | None]:
+        if self.oid in self._cstate:
+            st = self._cstate[self.oid]
+            return (None, None) if st is None else st
         from ...compress import OBJ_ALGO_ATTR
 
-        raw = self.getxattr(OBJ_ALGO_ATTR)
-        return raw.decode() if raw else None
+        raw = None if self._whiteout else self.getxattr(OBJ_ALGO_ATTR)
+        return (raw.decode() if raw else None, None)
+
+    def _logical_bytes(self) -> bytes | None:
+        """The decompressed image when the object is (or was, earlier
+        in this txn) compressed; None = object is plain raw."""
+        algo, staged = self._comp_state()
+        if algo is None:
+            return self._staged_raw
+        if staged is not None:
+            return staged
+        from ...compress import CompressorError, create
+
+        blob = self.store.read(self.cid, self.oid)
+        try:
+            return create(algo).decompress(blob) if blob else b""
+        except CompressorError as e:
+            raise ClsError(EIO, str(e)) from None
 
     def _decompress_for_write(self) -> None:
-        algo = self._comp_algo()
+        algo, _staged = self._comp_state()
         if algo is None:
             return
-        from ...compress import (OBJ_ALGO_ATTR, OBJ_SIZE_ATTR,
-                                 create)
+        from ...compress import OBJ_ALGO_ATTR, OBJ_SIZE_ATTR
 
-        raw = create(algo).decompress(
-            self.store.read(self.cid, self.oid))
+        raw = self._logical_bytes() or b""
         t = self._w()
         t.truncate(self.cid, self.oid, 0)
         t.write(self.cid, self.oid, 0, len(raw), raw)
         t.rmattr(self.cid, self.oid, OBJ_ALGO_ATTR)
         t.rmattr(self.cid, self.oid, OBJ_SIZE_ATTR)
-        self._comp_decompressed = True
+        self._cstate[self.oid] = None
+        self._staged_raw = raw
 
     # -- reads (cls_cxx_read / getxattr / map_get_* ) ----------------------
 
@@ -115,12 +136,10 @@ class MethodContext:
     def stat(self) -> int:
         if self._whiteout:
             raise ClsError(ENOENT, "object absent")
-        from ...compress import OBJ_SIZE_ATTR
-
-        raw = self.getxattr(OBJ_SIZE_ATTR)
-        if raw and not self._comp_decompressed:
-            return int(raw)
         try:
+            raw = self._logical_bytes()
+            if raw is not None:
+                return len(raw)
             return self.store.stat(self.cid, self.oid)
         except NotFound:
             raise ClsError(ENOENT, "object absent") from None
@@ -129,17 +148,10 @@ class MethodContext:
         if self._whiteout:
             raise ClsError(ENOENT, "object absent")
         try:
-            algo = self._comp_algo()
-            if algo is None:
+            raw = self._logical_bytes()
+            if raw is None:
                 return self.store.read(self.cid, self.oid, offset,
                                        length)
-            from ...compress import CompressorError, create
-
-            try:
-                raw = create(algo).decompress(
-                    self.store.read(self.cid, self.oid))
-            except CompressorError as e:
-                raise ClsError(EIO, str(e)) from None
             if length < 0:
                 return raw[offset:]
             return raw[offset:offset + length]

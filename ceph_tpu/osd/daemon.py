@@ -1277,7 +1277,11 @@ class OSD:
         from ..compress import OBJ_ALGO_ATTR, OBJ_SIZE_ATTR, create
 
         if raw is None:
-            raw = create(algo).decompress(self.store.read(pg.cid, ho))
+            blob = self.store.read(pg.cid, ho)
+            # a whiteout tombstone keeps its comp attrs but was
+            # truncated to zero: its logical image is empty, not a
+            # corrupt stream
+            raw = create(algo).decompress(blob) if blob else b""
         t.truncate(pg.cid, ho, 0)
         t.write(pg.cid, ho, 0, len(raw), raw)
         t.rmattr(pg.cid, ho, OBJ_ALGO_ATTR)
@@ -1470,7 +1474,8 @@ class OSD:
                 from .cls import MethodContext
 
                 cctx = MethodContext(self.store, pg.cid, ho, t,
-                                     msg.src, whiteout=head_whiteout)
+                                     msg.src, whiteout=head_whiteout,
+                                     cstate=cstate)
                 code, out = self.cls_handler.call(
                     op.get("cls", ""), op.get("method", ""),
                     cctx, op.get("input") or {})

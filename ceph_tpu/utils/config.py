@@ -213,6 +213,12 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("mon_osd_min_down_reporters", OPT_INT, 1,
            "distinct reporters required to mark an osd down"),
     Option("mon_lease", OPT_FLOAT, 5.0, "paxos lease duration (s)"),
+    Option("mon_election_strategy", OPT_STR, "classic",
+           "leader election strategy (ElectionLogic modes)",
+           enum_allowed=("classic", "disallow", "connectivity")),
+    Option("mon_disallowed_leaders", OPT_STR, "",
+           "comma-separated ranks that must never lead"
+           " (disallow/connectivity strategies)"),
     Option("osd_pool_default_size", OPT_INT, 3, "default replica count"),
     Option("osd_pool_default_min_size", OPT_INT, 2, "min replicas to serve IO"),
     Option("osd_pool_default_pg_num", OPT_INT, 32, "default pg count"),
