@@ -58,7 +58,7 @@ class ConnectionTracker:
     DECAY = 0.5         # per-tick multiplier for unseen peers
     FLOOR = 0.001
 
-    def __init__(self, rank: int, store=None):
+    def __init__(self, rank: int, store=None, n_ranks: int = 0):
         self.rank = rank
         self.store = store
         self.reports: dict[int, dict] = {}
@@ -67,6 +67,11 @@ class ConnectionTracker:
         mine = self.reports.setdefault(
             rank, {"v": 0, "scores": {}})
         mine["scores"][rank] = 1.0
+        # seed EVERY monmap rank so tick() decays peers that go
+        # silent without a transport reset (a blackholed peer must
+        # not keep its perfect score just because lost() never fired)
+        for r in range(n_ranks):
+            mine["scores"].setdefault(r, 1.0)
 
     # -- observation --------------------------------------------------------
 
@@ -184,7 +189,8 @@ class Elector:
         self.tracker = ConnectionTracker(
             mon.rank,
             getattr(mon, "store", None)
-            if strategy == CONNECTIVITY else None)
+            if strategy == CONNECTIVITY else None,
+            n_ranks=len(getattr(mon, "monmap", [])))
         self.stopped = False
         self.epoch = 1
         self.state = ELECTING
@@ -254,17 +260,24 @@ class Elector:
     def start_election(self) -> None:
         if self.stopped:
             return
-        self._bump(electing=True)
-        self.state = ELECTING
-        self.leader = None
-        self.quorum = set()
         if not self._allowed(self.mon.rank):
-            # a disallowed monitor never proposes itself: it bumps the
-            # epoch and waits for an allowed candidate's PROPOSE
+            # a disallowed monitor never proposes itself — and it
+            # must NOT bump its epoch while waiting (nobody would see
+            # the bump, so a few timeouts would race it permanently
+            # ahead of the cluster and its DEFERs/VICTORYs would all
+            # be dropped as epoch mismatches).  It waits at its
+            # current epoch for an allowed candidate's PROPOSE.
+            self.state = ELECTING
+            self.leader = None
+            self.quorum = set()
             self.deferred_to = None
             self._defers = set()
             self._arm_timer()
             return
+        self._bump(electing=True)
+        self.state = ELECTING
+        self.leader = None
+        self.quorum = set()
         self.deferred_to = self.mon.rank
         self._defers = {self.mon.rank}
         self.mon.ctx.log.debug(

@@ -83,6 +83,14 @@ class Monitor:
             strategy = self.ctx.conf["mon_election_strategy"]
             disallowed = self._parse_disallowed(
                 self.ctx.conf["mon_disallowed_leaders"])
+            if strategy == "classic" and disallowed:
+                # classic ignores the disallow list (reference
+                # behavior; the option documents its scope) — honor
+                # that rather than silently barring leaders
+                self.ctx.log.info(
+                    "mon", "mon_disallowed_leaders ignored under the"
+                    " classic election strategy")
+                disallowed = set()
             self.elector = Elector(self, strategy=strategy,
                                    disallowed=disallowed)
         else:

@@ -53,7 +53,10 @@ class Message:
 
     @classmethod
     def from_wire(cls, d: dict) -> "Message":
-        return cls(**d)
+        # drop fields from NEWER peers (mixed-version clusters: an
+        # old daemon keeps the fields it knows; unknown message TYPES
+        # are handled by UnknownMessage in decode_message)
+        return cls(**{k: v for k, v in d.items() if k in cls.FIELDS})
 
     def __repr__(self) -> str:
         kv = ", ".join("%s=%r" % (f, getattr(self, f))
@@ -61,8 +64,16 @@ class Message:
         return "%s(%s)" % (type(self).__name__, kv)
 
 
+# message envelope version (frame-level ENCODE_START): bump compat
+# only if the [type, seq, src, fields] layout itself changes
+MSG_STRUCT_V = 1
+MSG_STRUCT_COMPAT = 1
+
+
 def encode_message(msg: Message) -> bytes:
-    return denc.encode([msg.TYPE, msg.seq, msg.src, msg.to_wire()])
+    return denc.encode_versioned(
+        [msg.TYPE, msg.seq, msg.src, msg.to_wire()],
+        MSG_STRUCT_V, MSG_STRUCT_COMPAT)
 
 
 class UnknownMessage(Message):
@@ -75,7 +86,11 @@ class UnknownMessage(Message):
 
 
 def decode_message(data: bytes | memoryview) -> Message:
-    mtype, seq, src, fields = denc.decode(data)
+    if bytes(data[:1]) == b"V":
+        _v, row = denc.decode_versioned(data, MSG_STRUCT_V)
+        mtype, seq, src, fields = row[:4]
+    else:                               # legacy unversioned frame
+        mtype, seq, src, fields = denc.decode(data)
     cls = _REGISTRY.get(mtype)
     if cls is None:
         msg = UnknownMessage(wire_type=mtype)

@@ -87,23 +87,35 @@ class MethodContext:
         self._cstate = cstate if cstate is not None else {}
         self._staged_raw: bytes | None = None
 
-    def _comp_state(self) -> tuple[str | None, bytes | None]:
+    def _comp_state(self) -> tuple[str | None, bytes | None, bool]:
+        """(algo, staged image, staged?) — the txn's staged state wins
+        over committed attrs.  staged image may be the RAW bytes of a
+        this-txn decompression/writefull (algo None) or the raw
+        source of a staged compressed blob (algo set)."""
         if self.oid in self._cstate:
             st = self._cstate[self.oid]
-            return (None, None) if st is None else st
+            if st is None:
+                return (None, None, True)
+            return (st[0], st[1], True)
         from ...compress import OBJ_ALGO_ATTR
 
         raw = None if self._whiteout else self.getxattr(OBJ_ALGO_ATTR)
-        return (raw.decode() if raw else None, None)
+        return (raw.decode() if raw else None, None, False)
 
     def _logical_bytes(self) -> bytes | None:
-        """The decompressed image when the object is (or was, earlier
-        in this txn) compressed; None = object is plain raw."""
-        algo, staged = self._comp_state()
-        if algo is None:
-            return self._staged_raw
+        """The logical image when the object is compressed or was
+        rewritten earlier in this txn; None = committed raw state is
+        authoritative."""
+        algo, staged, in_txn = self._comp_state()
         if staged is not None:
             return staged
+        if algo is None:
+            return self._staged_raw if in_txn else None
+        if in_txn:
+            # staged compressed without content: cannot happen (the
+            # daemon always records the raw beside a staged algo),
+            # but fail safe as "empty"
+            return b""
         from ...compress import CompressorError, create
 
         blob = self.store.read(self.cid, self.oid)
@@ -113,7 +125,7 @@ class MethodContext:
             raise ClsError(EIO, str(e)) from None
 
     def _decompress_for_write(self) -> None:
-        algo, _staged = self._comp_state()
+        algo, _staged, _in_txn = self._comp_state()
         if algo is None:
             return
         from ...compress import OBJ_ALGO_ATTR, OBJ_SIZE_ATTR
@@ -124,7 +136,7 @@ class MethodContext:
         t.write(self.cid, self.oid, 0, len(raw), raw)
         t.rmattr(self.cid, self.oid, OBJ_ALGO_ATTR)
         t.rmattr(self.cid, self.oid, OBJ_SIZE_ATTR)
-        self._cstate[self.oid] = None
+        self._cstate[self.oid] = (None, raw)
         self._staged_raw = raw
 
     # -- reads (cls_cxx_read / getxattr / map_get_* ) ----------------------
@@ -136,10 +148,21 @@ class MethodContext:
     def stat(self) -> int:
         if self._whiteout:
             raise ClsError(ENOENT, "object absent")
+        algo, staged, in_txn = self._comp_state()
+        if staged is not None:
+            return len(staged)
+        if algo is not None and not in_txn:
+            # committed-compressed: the logical size is one xattr
+            # away — no need to decompress the whole blob
+            from ...compress import OBJ_SIZE_ATTR
+
+            raw = self.getxattr(OBJ_SIZE_ATTR)
+            if raw:
+                return int(raw)
         try:
-            raw = self._logical_bytes()
-            if raw is not None:
-                return len(raw)
+            raw_img = self._logical_bytes()
+            if raw_img is not None:
+                return len(raw_img)
             return self.store.stat(self.cid, self.oid)
         except NotFound:
             raise ClsError(ENOENT, "object absent") from None

@@ -1222,10 +1222,12 @@ class OSD:
         if pool is None or pool.compression_mode != "force" \
                 or pool.is_erasure() or len(data) < 128:
             self._clear_comp_attrs(pg, ho, t, cstate)
+            cstate[ho] = (None, data)
             return data
         blob = create(pool.compression_algorithm).compress(data)
         if len(blob) * 10 >= len(data) * 9:     # <10% saved: keep raw
             self._clear_comp_attrs(pg, ho, t, cstate)
+            cstate[ho] = (None, data)
             return data
         t.setattr(pg.cid, ho, OBJ_ALGO_ATTR,
                   pool.compression_algorithm.encode())
@@ -1242,7 +1244,7 @@ class OSD:
         if self._comp_state(pg, ho, cstate)[0] is not None:
             t.rmattr(pg.cid, ho, OBJ_ALGO_ATTR)
             t.rmattr(pg.cid, ho, OBJ_SIZE_ATTR)
-        cstate[ho] = None
+        cstate[ho] = None   # raw; content set by the caller's write
 
     def _comp_state(self, pg: PG, ho, cstate: dict | None = None
                     ) -> tuple[str | None, bytes | None]:
@@ -1272,7 +1274,6 @@ class OSD:
         raw image (cstate says None)."""
         algo, raw = self._comp_state(pg, ho, cstate)
         if algo is None:
-            cstate[ho] = None
             return
         from ..compress import OBJ_ALGO_ATTR, OBJ_SIZE_ATTR, create
 
@@ -1286,7 +1287,9 @@ class OSD:
         t.write(pg.cid, ho, 0, len(raw), raw)
         t.rmattr(pg.cid, ho, OBJ_ALGO_ATTR)
         t.rmattr(pg.cid, ho, OBJ_SIZE_ATTR)
-        cstate[ho] = None
+        # (None, raw): raw image staged WITH its content, so a later
+        # op in this txn (e.g. a cls read) still sees logical bytes
+        cstate[ho] = (None, raw)
 
     def _read_decompressed(self, pg: PG, ho, offset: int = 0,
                            length: int = -1) -> bytes:

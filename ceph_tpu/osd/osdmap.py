@@ -162,7 +162,12 @@ class PGPool:
 
     @classmethod
     def from_dict(cls, d: dict) -> "PGPool":
-        d = dict(d)
+        # tolerate keys from NEWER writers (forward compat: an old
+        # daemon reading a new map keeps what it understands)
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(cls)}
+        d = {k: v for k, v in d.items() if k in known}
         d["snaps"] = {int(k): v
                       for k, v in (d.get("snaps") or {}).items()}
         d.setdefault("snap_seq", 0)
@@ -546,15 +551,27 @@ class OSDMap:
             for k, v in d.get("erasure_code_profiles", {}).items()}
         return m
 
+    # encoding version history (ENCODE_START discipline, encoding.h):
+    #   1 — round-4 layout
+    #   2 — +osd_up_thru, +pool compression fields (additive: compat
+    #       stays 1, old decoders read their known keys)
+    STRUCT_V = 2
+    STRUCT_COMPAT = 1
+
     def encode(self) -> bytes:
         from ..utils import denc
 
-        return denc.encode(self.to_dict())
+        return denc.encode_versioned(self.to_dict(), self.STRUCT_V,
+                                     self.STRUCT_COMPAT)
 
     @classmethod
     def decode(cls, data: bytes) -> "OSDMap":
         from ..utils import denc
 
+        if bytes(data[:1]) == b"V":
+            _v, d = denc.decode_versioned(data, cls.STRUCT_V)
+            return cls.from_dict(d)
+        # legacy (pre-versioning) blob, e.g. an old store's full map
         return cls.from_dict(denc.decode(data))
 
 
@@ -684,13 +701,20 @@ class Incremental:
             d.get("old_erasure_code_profiles", []))
         return inc
 
+    STRUCT_V = 2        # 2: +new_up_thru (additive)
+    STRUCT_COMPAT = 1
+
     def encode(self) -> bytes:
         from ..utils import denc
 
-        return denc.encode(self.to_dict())
+        return denc.encode_versioned(self.to_dict(), self.STRUCT_V,
+                                     self.STRUCT_COMPAT)
 
     @classmethod
     def decode(cls, data: bytes) -> "Incremental":
         from ..utils import denc
 
+        if bytes(data[:1]) == b"V":
+            _v, d = denc.decode_versioned(data, cls.STRUCT_V)
+            return cls.from_dict(d)
         return cls.from_dict(denc.decode(data))

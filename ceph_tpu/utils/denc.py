@@ -129,3 +129,52 @@ def _dec(mv: memoryview, off: int):
             d[k] = val
         return d, off
     raise ValueError("denc: bad tag %r at %d" % (tag, off - 1))
+
+
+# -- versioned struct envelope (ENCODE_START/DECODE_START semantics) --
+
+
+class IncompatibleEncoding(ValueError):
+    """The blob requires a newer decoder (compat > supported) —
+    the reference's buffer::malformed_input on DECODE_START."""
+
+
+_VHDR = struct.Struct(">BBI")           # version, compat, payload len
+
+
+def encode_versioned(value, version: int, compat: int = 1) -> bytes:
+    """src/include/encoding.h ENCODE_START analog: a struct payload
+    framed with (version, compat, length).
+
+    * ``version`` — what this writer produced;
+    * ``compat`` — the oldest decoder that can still make sense of it
+      (bump only on breaking layout changes);
+    * the LENGTH makes newer-minor payloads skippable by old readers
+      (they decode what they understand and seek past the rest),
+      which is what makes rolling upgrades possible.
+    """
+    payload = encode(value)
+    return (b"V" + _VHDR.pack(version, compat, len(payload))
+            + payload)
+
+
+def decode_versioned(data: bytes | memoryview,
+                     supported: int) -> tuple[int, object]:
+    """DECODE_START analog: returns (writer_version, value).  Raises
+    IncompatibleEncoding when the writer says even ``supported`` is
+    too old (compat gate); tolerates payloads LONGER than one value
+    (a newer writer's extra trailing fields are skipped via the
+    length header)."""
+    mv = memoryview(data)
+    if mv[:1].tobytes() != b"V":
+        raise ValueError("not a versioned encoding")
+    version, compat, length = _VHDR.unpack_from(mv, 1)
+    if compat > supported:
+        raise IncompatibleEncoding(
+            "encoding v%d requires decoder >= v%d (have v%d)"
+            % (version, compat, supported))
+    payload = mv[1 + _VHDR.size:1 + _VHDR.size + length]
+    value, off = _dec(payload, 0)
+    # bytes past the first value inside the framed payload belong to
+    # a newer minor version: skipped by design
+    return version, value

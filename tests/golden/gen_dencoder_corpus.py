@@ -1,0 +1,77 @@
+"""Generate the pinned dencoder corpus (ceph-object-corpus role).
+
+Run from the repo root:  python tests/golden/gen_dencoder_corpus.py
+Writes tests/golden/dencoder/<type>.<n>.{hex,json}.  Regenerate ONLY
+when an encoding version is deliberately bumped — the corpus exists
+to catch accidental drift."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from ceph_tpu.cli.dencoder import _registry, _to_jsonable  # noqa: E402
+from ceph_tpu.models.crushmap import STRAW2, CrushMap  # noqa: E402
+from ceph_tpu.msg.message import encode_message  # noqa: E402
+from ceph_tpu.msg.messages import MOSDOp  # noqa: E402
+from ceph_tpu.osd.osdmap import (Incremental, OSDMap,  # noqa: E402
+                                 PGPool, pg_t)
+
+
+def sample_osdmap() -> OSDMap:
+    crush = CrushMap()
+    crush.add_bucket(STRAW2, 1, [0, 1, 2], [0x10000] * 3, id=-1)
+    m = OSDMap()
+    inc = Incremental(epoch=1)
+    inc.new_max_osd = 3
+    inc.new_crush = crush
+    inc.new_pools[1] = PGPool(id=1, name="data", pg_num=8, size=3)
+    m.apply_incremental(inc)
+    inc2 = m.new_incremental()
+    inc2.new_state[0] = 3
+    inc2.new_weight[0] = 0x10000
+    inc2.new_up_thru[0] = 2
+    inc2.new_pg_temp[pg_t(1, 3)] = [2, 0]
+    m.apply_incremental(inc2)
+    m.osd_addrs[0] = "127.0.0.1:6800"
+    return m
+
+
+def sample_inc() -> Incremental:
+    inc = Incremental(epoch=7)
+    inc.new_state[1] = 2
+    inc.new_weight[1] = 0
+    inc.new_up_thru[2] = 6
+    return inc
+
+
+def main() -> None:
+    out = os.path.join(os.path.dirname(__file__), "dencoder")
+    os.makedirs(out, exist_ok=True)
+    types = _registry()
+    blobs = {
+        "osdmap.1": sample_osdmap().encode(),
+        "osdmap_inc.1": sample_inc().encode(),
+        "pg_info.1": types["pg_info"].enc(
+            {"pool": 1, "ps": 3, "last_update": [7, 42],
+             "last_complete": [7, 41], "log_tail": [6, 10],
+             "same_interval_since": 7, "last_epoch_started": 7}),
+        "pg_log_entry.1": types["pg_log_entry"].enc(
+            ["modify", "obj-1", [7, 42], [7, 41]]),
+        "message.1": encode_message(MOSDOp(
+            tid=9, pool=1, ps=3, oid="obj-1", snapc=None,
+            ops=[{"op": "write", "offset": 0, "data": b"hi"}],
+            epoch=7, flags=0)),
+    }
+    for name, blob in blobs.items():
+        tname = name.split(".")[0]
+        open(os.path.join(out, name + ".hex"), "w").write(blob.hex())
+        dump = _to_jsonable(types[tname].dec(blob))
+        json.dump(dump, open(os.path.join(out, name + ".json"), "w"),
+                  indent=2)  # insertion order IS the wire order
+        print("pinned", name)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Versioned encoding + dencoder (src/include/encoding.h
+ENCODE_START/DECODE_START + src/tools/ceph-dencoder analogs):
+corpus stability, forward/backward compatibility, compat gating."""
+
+import os
+import struct
+
+import pytest
+
+from ceph_tpu.cli import dencoder
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+from ceph_tpu.utils import denc
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "dencoder")
+
+
+def test_corpus_pinned_blobs_decode_unchanged():
+    assert dencoder._corpus(dencoder._registry(), GOLDEN) == 0
+
+
+def test_envelope_version_and_compat_gate():
+    blob = denc.encode_versioned({"k": 1}, version=3, compat=2)
+    v, val = denc.decode_versioned(blob, supported=3)
+    assert (v, val) == (3, {"k": 1})
+    with pytest.raises(denc.IncompatibleEncoding):
+        denc.decode_versioned(blob, supported=1)
+
+
+def test_newer_minor_payload_is_skipped():
+    """An old decoder reads what it understands and seeks past a
+    newer writer's trailing additions (the length header's job)."""
+    payload = denc.encode({"known": 1}) + denc.encode(
+        {"from-the-future": True})
+    blob = b"V" + struct.pack(">BBI", 9, 1, len(payload)) + payload
+    v, val = denc.decode_versioned(blob, supported=2)
+    assert v == 9 and val == {"known": 1}
+
+
+def test_mixed_version_map_exchange():
+    """A map blob from a NEWER writer (extra pool/map fields) decodes
+    on this 'old' node, keeping every understood field; a legacy
+    UNVERSIONED blob still decodes too (upgrade in the other
+    direction)."""
+    m = OSDMap()
+    inc = Incremental(epoch=1)
+    inc.new_max_osd = 2
+    from ceph_tpu.osd.osdmap import PGPool
+    inc.new_pools[1] = PGPool(id=1, name="p", pg_num=8)
+    m.apply_incremental(inc)
+
+    # newer writer: same dict plus fields we have never heard of
+    d = m.to_dict()
+    d["quantum_flag"] = True
+    d["pools"]["1"]["pool_opts_v9"] = {"x": 1}
+    future_blob = denc.encode_versioned(d, OSDMap.STRUCT_V + 1,
+                                        OSDMap.STRUCT_COMPAT)
+    m2 = OSDMap.decode(future_blob)
+    assert m2.epoch == m.epoch
+    assert m2.pools[1].name == "p"
+    assert m2.pools[1].pg_num == 8
+
+    # legacy pre-versioning blob
+    legacy = denc.encode(m.to_dict())
+    m3 = OSDMap.decode(legacy)
+    assert m3.epoch == m.epoch and m3.pools[1].name == "p"
+
+    # a BREAKING future layout is refused, not misread
+    breaking = denc.encode_versioned({"totally": "different"},
+                                     OSDMap.STRUCT_V + 5,
+                                     OSDMap.STRUCT_V + 5)
+    with pytest.raises(denc.IncompatibleEncoding):
+        OSDMap.decode(breaking)
+
+
+def test_mixed_version_message_exchange():
+    """Messages from a newer peer carrying extra fields dispatch with
+    the known subset (rolling-upgrade wire behavior)."""
+    from ceph_tpu.msg.message import decode_message
+    from ceph_tpu.msg.messages import MPing
+
+    row = ["ping", 7, "osd.1",
+           {"stamp": 1.5, "new_field_v9": "ignored"}]
+    blob = denc.encode_versioned(row, 1, 1)
+    msg = decode_message(blob)
+    assert isinstance(msg, MPing)
+    assert msg.stamp == 1.5 and msg.seq == 7
+    assert not hasattr(msg, "new_field_v9")
+
+
+def test_pg_log_entry_tolerates_future_fields():
+    from ceph_tpu.osd.pg import LogEntry
+
+    e = LogEntry.from_wire(["modify", "o", [3, 4], [3, 3],
+                            "future-extra", {"more": 1}])
+    assert e.op == "modify" and e.version == (3, 4)
+
+
+def test_cli_encode_decode_roundtrip(capsys):
+    assert dencoder.main(["type", "pg_log_entry", "encode",
+                          '["delete","x",[2,9],[2,8]]']) == 0
+    hexblob = capsys.readouterr().out.strip()
+    assert dencoder.main(["type", "pg_log_entry", "decode",
+                          hexblob]) == 0
+    out = capsys.readouterr().out
+    assert '"delete"' in out and '"x"' in out
+    assert dencoder.main(["list"]) == 0
+    assert "osdmap" in capsys.readouterr().out
