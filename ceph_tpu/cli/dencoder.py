@@ -116,6 +116,9 @@ def main(argv=None) -> int:
                                            t.compat))
         return 0
     if cmd == "corpus":
+        if not argv:
+            print("usage: dencoder corpus <dir>", file=sys.stderr)
+            return 2
         return _corpus(types, argv[0])
     if cmd != "type" or len(argv) < 2:
         print("usage: dencoder list | corpus <dir> | "
@@ -131,6 +134,10 @@ def main(argv=None) -> int:
     if action == "version":
         print("v%d compat %d" % (t.version, t.compat))
         return 0
+    if action in ("encode", "decode") and len(argv) < 3:
+        print("usage: dencoder type <name> %s <arg|->" % action,
+              file=sys.stderr)
+        return 2
     if action == "encode":
         value = _from_jsonable(json.loads(_read_arg(argv[2])))
         print(t.enc(value).hex())

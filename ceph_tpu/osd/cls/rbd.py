@@ -11,11 +11,14 @@ unchanged.
 from __future__ import annotations
 
 from ...utils import denc
-from . import EEXIST, EINVAL, ENOENT, RD, WR, ClsError, MethodContext
+from . import (EBUSY, EEXIST, EINVAL, ENOENT, RD, WR, ClsError,
+               MethodContext)
 
 SIZE_XATTR = "rbd.size"
 LAYOUT_XATTR = "rbd.layout"
 SNAPS_XATTR = "rbd.snaps"
+PARENT_XATTR = "rbd.parent"     # denc {"image","snapid","overlap"}
+_CHILD_PREFIX = b"child."       # omap child.<snapid>.<name> on parent
 
 
 def create(ctx: MethodContext, inp: dict) -> dict:
@@ -41,7 +44,11 @@ def get_metadata(ctx: MethodContext, inp: dict) -> dict:
     layout = ctx.getxattr(LAYOUT_XATTR) or b""
     snaps_blob = ctx.getxattr(SNAPS_XATTR)
     snaps = denc.decode(snaps_blob) if snaps_blob else {}
-    return {"size": int(size), "layout": layout, "snaps": snaps}
+    out = {"size": int(size), "layout": layout, "snaps": snaps}
+    parent = ctx.getxattr(PARENT_XATTR)
+    if parent:
+        out["parent"] = denc.decode(parent)
+    return out
 
 
 def set_size(ctx: MethodContext, inp: dict) -> dict:
@@ -77,9 +84,72 @@ def snap_remove(ctx: MethodContext, inp: dict) -> dict:
     snaps = denc.decode(blob) if blob else {}
     if name not in snaps:
         raise ClsError(ENOENT, "no such snap")
+    # a snapshot with clone children cannot be removed (the
+    # protect/unprotect gate of cls_rbd, collapsed to its purpose)
+    pref = _CHILD_PREFIX + (b"%d." % int(snaps[name]["id"]))
+    for k in ctx.omap_get():
+        if bytes(k).startswith(pref):
+            raise ClsError(EBUSY, "snap has clone children")
     removed = snaps.pop(name)
     ctx.setxattr(SNAPS_XATTR, denc.encode(snaps))
     return {"id": removed["id"]}
+
+
+def set_parent(ctx: MethodContext, inp: dict) -> dict:
+    """Mark a CLONE's header with its parent linkage."""
+    if ctx.getxattr(SIZE_XATTR) is None:
+        raise ClsError(ENOENT, "no image header")
+    image = inp.get("image", "")
+    snapid = int(inp.get("snapid", 0))
+    overlap = int(inp.get("overlap", -1))
+    if not image or snapid <= 0 or overlap < 0:
+        raise ClsError(EINVAL, "bad parent args")
+    if ctx.getxattr(PARENT_XATTR) is not None:
+        raise ClsError(EEXIST, "parent already set")
+    ctx.setxattr(PARENT_XATTR, denc.encode(
+        {"image": image, "snapid": snapid, "overlap": overlap}))
+    return {}
+
+
+def remove_parent(ctx: MethodContext, inp: dict) -> dict:
+    """Flatten completion: the clone stands alone."""
+    if ctx.getxattr(PARENT_XATTR) is None:
+        raise ClsError(ENOENT, "no parent")
+    ctx.rmxattr(PARENT_XATTR)
+    return {}
+
+
+def child_add(ctx: MethodContext, inp: dict) -> dict:
+    """Register a clone on its PARENT's header (cls_rbd children)."""
+    snapid = int(inp.get("snapid", 0))
+    name = inp.get("name", "")
+    if snapid <= 0 or not name:
+        raise ClsError(EINVAL, "bad child args")
+    ctx.omap_set({_CHILD_PREFIX + b"%d.%s" % (snapid, name.encode()):
+                  b"1"})
+    return {}
+
+
+def child_rm(ctx: MethodContext, inp: dict) -> dict:
+    snapid = int(inp.get("snapid", 0))
+    name = inp.get("name", "")
+    key = _CHILD_PREFIX + b"%d.%s" % (snapid, name.encode())
+    if not ctx.omap_get_vals([key]):
+        raise ClsError(ENOENT, "no such child")
+    ctx.omap_rm([key])
+    return {}
+
+
+def children(ctx: MethodContext, inp: dict) -> dict:
+    out = []
+    for k in ctx.omap_get():
+        kb = bytes(k)
+        if kb.startswith(_CHILD_PREFIX):
+            snap_s, _sep, name = \
+                kb[len(_CHILD_PREFIX):].partition(b".")
+            out.append({"snapid": int(snap_s),
+                        "name": name.decode()})
+    return {"children": out}
 
 
 def dir_add(ctx: MethodContext, inp: dict) -> dict:
@@ -110,4 +180,9 @@ def register(h) -> None:
         "snap_remove": (WR, snap_remove),
         "dir_add": (WR, dir_add),
         "dir_remove": (WR, dir_remove),
+        "set_parent": (WR, set_parent),
+        "remove_parent": (WR, remove_parent),
+        "child_add": (WR, child_add),
+        "child_rm": (WR, child_rm),
+        "children": (RD, children),
     })

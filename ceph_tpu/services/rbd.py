@@ -75,6 +75,15 @@ class RBD:
 
     async def remove(self, name: str) -> None:
         img = await self.open(name)
+        # librbd refuses to remove an image that still has snapshots
+        # or registered clone children — deleting a parent under its
+        # clones is cross-image data loss
+        kids = await self.io.exec(HEADER_PREFIX + name, "rbd",
+                                  "children", {})
+        if kids.get("children"):
+            raise RBDError("image %r has clone children" % name)
+        if img.snaps:
+            raise RBDError("image %r has snapshots" % name)
         exts = file_to_extents(img.layout, 0, max(img._size, 1))
         import asyncio
 
@@ -88,6 +97,16 @@ class RBD:
                                {e[0] for e in exts}])
         from ..client.rados import RadosError
 
+        if img.parent is not None:
+            # deregister from the parent so its snap unpins
+            try:
+                await self.io.exec(
+                    HEADER_PREFIX + img.parent.name, "rbd",
+                    "child_rm", {"snapid": img.parent_snapid,
+                                 "name": name})
+            except RadosError as e:
+                if e.code != -2:
+                    raise
         try:
             await self.io.remove(HEADER_PREFIX + name)
         except RadosError as e:
@@ -100,6 +119,60 @@ class RBD:
             if e.code != -2:
                 raise
 
+    async def clone(self, parent_name: str, parent_snap: str,
+                    clone_name: str) -> None:
+        """Snapshot-parent clone (librbd::clone /
+        DeepCopyRequest-free COW path): the clone starts as a header
+        pointing at (parent, snapid, overlap); data objects
+        materialize on first write (copy-up) and reads fall through
+        to the parent below the overlap."""
+        from ..client.rados import RadosError
+
+        parent = await self.open(parent_name)
+        rec = parent.snaps.get(parent_snap)
+        if rec is None:
+            raise RBDError("no snap %r on %r"
+                           % (parent_snap, parent_name))
+        sid, psize = int(rec["id"]), int(rec["size"])
+        hdr = HEADER_PREFIX + clone_name
+        try:
+            await self.io.exec(hdr, "rbd", "create",
+                               {"size": psize,
+                                "layout": parent.layout.encode()})
+        except RadosError as e:
+            if e.code == -17:
+                raise RBDError("image %r exists"
+                               % clone_name) from None
+            raise
+        # registration order matters for crash safety: the child
+        # link on the PARENT lands first, so from the moment a clone
+        # header could carry a parent pointer, the snap is already
+        # unremovable; a crash in between leaves only a stray child
+        # entry (unpinnable via child_rm), never a clone whose parent
+        # snap can vanish under it
+        await self.io.exec(HEADER_PREFIX + parent_name, "rbd",
+                           "child_add", {"snapid": sid,
+                                         "name": clone_name})
+        try:
+            await self.io.exec(hdr, "rbd", "set_parent",
+                               {"image": parent_name, "snapid": sid,
+                                "overlap": psize})
+        except Exception:
+            try:
+                await self.io.exec(HEADER_PREFIX + parent_name,
+                                   "rbd", "child_rm",
+                                   {"snapid": sid,
+                                    "name": clone_name})
+            except Exception:
+                pass
+            raise
+        try:
+            await self.io.exec(DIR_OID, "rbd", "dir_add",
+                               {"name": clone_name})
+        except RadosError as e:
+            if e.code != -17:
+                raise
+
     async def open(self, name: str) -> "Image":
         hdr = HEADER_PREFIX + name
         try:
@@ -109,12 +182,24 @@ class RBD:
         except Exception:
             raise RBDError("image %r does not exist" % name)
         snaps = dict(meta.get("snaps") or {})
+        parent_meta = meta.get("parent")
         # each image gets its OWN IoCtx: snap context and read-snap
         # state are per-image (a shared ioctx would let one image's
         # _apply_snapc clobber another's write snapc)
         from ..client.rados import IoCtx
         img_io = IoCtx(self.io.client, self.io.pool_id)
         img = Image(img_io, name, size, layout, snaps)
+        if parent_meta:
+            pimg = await self.open(parent_meta["image"])
+            # route the parent handle's reads at the snapshot
+            psnap = next((n for n, r in pimg.snaps.items()
+                          if int(r["id"]) == int(parent_meta
+                                                 ["snapid"])), None)
+            if psnap is not None:
+                pimg.set_snap(psnap)
+                img.parent = pimg
+                img.parent_snapid = int(parent_meta["snapid"])
+                img.overlap = int(parent_meta["overlap"])
         img._apply_snapc()
         return img
 
@@ -130,6 +215,11 @@ class Image:
         self.layout = layout
         # name -> {"id": selfmanaged snapid, "size": image size then}
         self.snaps: dict = snaps or {}
+        # clone linkage (parent Image handle pinned at the snap,
+        # overlap = parent size at clone time); None = standalone
+        self.parent: "Image | None" = None
+        self.parent_snapid = 0
+        self.overlap = 0
 
     def _data_name(self, objectno: int) -> str:
         return "%s%s.%016x" % (DATA_PREFIX, self.name, objectno)
@@ -180,11 +270,19 @@ class Image:
         rec = self.snaps.get(snapname)
         if rec is None:
             raise RBDError("no snap %r" % snapname)
-        # cluster-side removal FIRST: if the mon command fails the
-        # header still records the snapid and removal can be retried
-        # (dropping the record first would leak the clones forever)
         from ..client.rados import RadosError
 
+        # clone children pin their parent snap: refuse before any
+        # cluster-side state changes (the cls snap_remove gate
+        # re-checks inside the atomic header edit)
+        kids = await self.io.exec(HEADER_PREFIX + self.name, "rbd",
+                                  "children", {})
+        if any(int(c["snapid"]) == int(rec["id"])
+               for c in kids.get("children", [])):
+            raise RBDError("snap %r has clone children" % snapname)
+        # cluster-side removal next: if the mon command fails the
+        # header still records the snapid and removal can be retried
+        # (dropping the record first would leak the clones forever)
         await self.io.selfmanaged_snap_remove(int(rec["id"]))
         try:
             await self.io.exec(HEADER_PREFIX + self.name, "rbd",
@@ -199,13 +297,22 @@ class Image:
         self._apply_snapc()
 
     def set_snap(self, snapname: str | None) -> None:
-        """Route reads to a snapshot (librbd snap_set); None = head."""
+        """Route reads to a snapshot (librbd snap_set); None = head.
+        The image size follows the snapshot's recorded size, so reads
+        through a pinned handle are bounded by what existed AT the
+        snap — a later head resize must not clamp (or extend) them."""
         if snapname is None:
             self.io.set_read_snap(None)
+            if getattr(self, "_head_size", None) is not None:
+                self._size = self._head_size
+                self._head_size = None
             return
         rec = self.snaps.get(snapname)
         if rec is None:
             raise RBDError("no snap %r" % snapname)
+        if getattr(self, "_head_size", None) is None:
+            self._head_size = self._size
+        self._size = int(rec["size"])
         self.io.set_read_snap(int(rec["id"]))
 
     async def snap_rollback(self, snapname: str) -> None:
@@ -280,17 +387,60 @@ class Image:
         await self.io.exec(HEADER_PREFIX + self.name, "rbd",
                            "set_size", {"size": new_size})
 
+    async def _copy_up(self, objectno: int) -> None:
+        """librbd copy-up: materialize a clone object from the
+        parent's SNAPSHOT before a partial write, so the untouched
+        remainder of the block survives.  Reads the parent's DATA
+        OBJECT directly (striping-exact for any stripe_count — the
+        clone shares the parent's layout, so object numbering and
+        interleave agree byte for byte)."""
+        from ..client.rados import ObjectNotFound
+
+        try:
+            block = await self.parent.io.read(
+                self.parent._data_name(objectno),
+                self.layout.object_size, 0)
+        except ObjectNotFound:
+            return                      # parent never wrote it
+        if block:
+            await self.io.write_full(self._data_name(objectno),
+                                     block)
+
     async def write(self, offset: int, data: bytes) -> None:
         if offset + len(data) > self._size:
             raise RBDError("write past image end (%d > %d)"
                            % (offset + len(data), self._size))
         import asyncio
 
+        from ..client.rados import ObjectNotFound
+
         exts = file_to_extents(self.layout, offset, len(data))
-        await asyncio.gather(*[
-            self.io.write(self._data_name(o),
-                          data[fo - offset:fo - offset + ln], oo)
-            for o, oo, ln, fo in exts])
+        osz = self.layout.object_size
+        # group per object: one copy-up decision per object, and the
+        # object's extents apply IN ORDER after it (two concurrent
+        # copy-ups in one gather could clobber each other's writes)
+        by_obj: dict[int, list] = {}
+        for o, oo, ln, fo in exts:
+            by_obj.setdefault(o, []).append((oo, ln, fo))
+
+        async def put(o, pieces):
+            whole = any(oo == 0 and ln == osz for oo, ln, _ in pieces)
+            if self.parent is not None and not whole:
+                # copy-up no-ops when the parent never wrote the
+                # object, so no overlap math is needed here (file
+                # offsets and object numbers interleave under
+                # striping — the object read is the exact unit)
+                try:
+                    await self.io.stat(self._data_name(o))
+                except ObjectNotFound:
+                    await self._copy_up(o)
+            for oo, ln, fo in pieces:
+                await self.io.write(
+                    self._data_name(o),
+                    data[fo - offset:fo - offset + ln], oo)
+
+        await asyncio.gather(*[put(o, pieces)
+                               for o, pieces in by_obj.items()])
 
     async def read(self, offset: int, length: int) -> bytes:
         length = max(0, min(length, self._size - offset))
@@ -298,33 +448,78 @@ class Image:
             return b""
         import asyncio
 
+        from ..client.rados import ObjectNotFound
+
         exts = file_to_extents(self.layout, offset, length)
 
-        async def fetch(o, oo, ln):
+        async def fetch(o, oo, ln, fo):
             try:
                 return await self.io.read(self._data_name(o), ln, oo)
+            except ObjectNotFound:
+                # COW fall-through: below the overlap the parent's
+                # snapshot serves the bytes; past it, sparse zeros
+                if self.parent is not None and fo < self.overlap:
+                    cov = min(ln, self.overlap - fo)
+                    return await self.parent.read(fo, cov)
+                return b""
             except Exception:
                 return b""     # unwritten extent: sparse zeros
 
-        parts = await asyncio.gather(*[fetch(o, oo, ln)
-                                       for o, oo, ln, _fo in exts])
+        parts = await asyncio.gather(*[fetch(o, oo, ln, fo)
+                                       for o, oo, ln, fo in exts])
         buf = bytearray(length)
         for (o, oo, ln, fo), part in zip(exts, parts):
             part = part[:ln]
             buf[fo - offset:fo - offset + len(part)] = part
         return bytes(buf)
 
+    async def flatten(self) -> None:
+        """Sever the parent link by materializing every still-COW
+        object below the overlap (librbd::Operations::flatten)."""
+        if self.parent is None:
+            raise RBDError("image has no parent")
+        import asyncio
+
+        from ..client.rados import ObjectNotFound
+
+        objs = ({e[0] for e in file_to_extents(self.layout, 0,
+                                               self.overlap)}
+                if self.overlap else set())
+        osz = self.layout.object_size
+
+        async def mat(o):
+            try:
+                await self.io.stat(self._data_name(o))
+            except ObjectNotFound:
+                await self._copy_up(o)
+
+        await asyncio.gather(*[mat(o) for o in sorted(objs)])
+        await self.io.exec(HEADER_PREFIX + self.name, "rbd",
+                           "remove_parent", {})
+        await self.io.exec(HEADER_PREFIX + self.parent.name, "rbd",
+                           "child_rm", {"snapid": self.parent_snapid,
+                                        "name": self.name})
+        self.parent = None
+        self.parent_snapid = 0
+        self.overlap = 0
+
     async def discard(self, offset: int, length: int) -> None:
         """Zero a range by dropping fully-covered objects and zeroing
-        partial ones (librbd discard)."""
+        partial ones (librbd discard).  On a clone, objects under the
+        parent overlap are ZEROED, never removed — removal would
+        resurrect the parent's bytes through the COW fall-through."""
         import asyncio
 
         exts = file_to_extents(self.layout, offset, length)
         full, partial = [], []
         osz = self.layout.object_size
         for o, oo, ln, fo in exts:
-            (full if (oo == 0 and ln == osz) else partial).append(
-                (o, oo, ln))
+            covered = (self.parent is not None
+                       and fo - oo < self.overlap)
+            if oo == 0 and ln == osz and not covered:
+                full.append(o)
+            else:
+                partial.append((ln, fo))
 
         async def rm(o):
             try:
@@ -332,7 +527,8 @@ class Image:
             except Exception:
                 pass
 
-        await asyncio.gather(*[rm(o) for o, _oo, _ln in full])
-        await asyncio.gather(*[
-            self.io.write(self._data_name(o), b"\0" * ln, oo)
-            for o, oo, ln in partial])
+        await asyncio.gather(*[rm(o) for o in full])
+        # partial zeroing routes through write() so clone objects get
+        # their copy-up before the zeros land
+        await asyncio.gather(*[self.write(fo, b"\0" * ln)
+                               for ln, fo in partial])

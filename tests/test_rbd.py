@@ -130,3 +130,106 @@ def test_rbd_snapshots_and_rollback():
             await c.stop()
 
     run(main())
+
+
+def test_rbd_clone_cow_and_flatten():
+    """Snapshot-parent clones (librbd clone semantics): COW reads
+    fall through to the parent, writes copy-up then diverge without
+    touching the parent, flatten severs the link, and a parent snap
+    with children cannot be removed."""
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await c.client.mon_command("osd pool create", pool="rbd",
+                                       pg_num=8)
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(
+                next(p.id for p in c.client.osdmap.pools.values()
+                     if p.name == "rbd"))
+            rbd = RBD(c.client.io_ctx("rbd"))
+            layout = FileLayout(stripe_unit=4096, stripe_count=1,
+                                object_size=16384)
+            await rbd.create("golden", 1 << 17, layout)
+            parent = await rbd.open("golden")
+            base = bytes(range(256)) * 256          # 64 KiB
+            await parent.write(0, base)
+            await parent.snap_create("template")
+            # parent keeps evolving after the snap
+            await parent.write(0, b"\xee" * 4096)
+
+            await rbd.clone("golden", "template", "vm1")
+            assert "vm1" in await rbd.list()
+            clone = await rbd.open("vm1")
+            assert clone.size() == 1 << 17
+            # COW read: the clone sees the SNAPSHOT, not the evolved
+            # parent head
+            assert await clone.read(0, len(base)) == base
+            # sparse region beyond parent data reads zeros
+            assert await clone.read(1 << 16, 4096) == b"\0" * 4096
+
+            # a partial write copies-up, then diverges; the parent
+            # snapshot stays byte-identical
+            await clone.write(100, b"CLONE-WRITE")
+            want = bytearray(base)
+            want[100:111] = b"CLONE-WRITE"
+            assert await clone.read(0, len(base)) == bytes(want)
+            parent.set_snap("template")
+            assert await parent.read(0, len(base)) == base
+            parent.set_snap(None)
+
+            # the pinned snap cannot be removed under the clone
+            try:
+                await parent.snap_remove("template")
+                raise AssertionError("snap_remove with children!")
+            except RBDError:
+                pass
+
+            # flatten: clone materializes; parent snap now removable
+            await clone.flatten()
+            assert await clone.read(0, len(base)) == bytes(want)
+            reopened = await rbd.open("vm1")
+            assert reopened.parent is None
+            assert await reopened.read(0, len(base)) == bytes(want)
+            await parent.snap_remove("template")
+
+            # flattened clone survives parent deletion entirely
+            await rbd.remove("golden")
+            again = await rbd.open("vm1")
+            assert await again.read(0, 200) == bytes(want)[:200]
+        finally:
+            await c.stop()
+
+    run(main(), timeout=120)
+
+
+def test_rbd_clone_discard_does_not_resurrect_parent():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            await c.client.mon_command("osd pool create", pool="rbd",
+                                       pg_num=8)
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(
+                next(p.id for p in c.client.osdmap.pools.values()
+                     if p.name == "rbd"))
+            rbd = RBD(c.client.io_ctx("rbd"))
+            layout = FileLayout(stripe_unit=4096, stripe_count=1,
+                                object_size=16384)
+            await rbd.create("par", 1 << 16, layout)
+            parent = await rbd.open("par")
+            await parent.write(0, b"\xaa" * (1 << 16))
+            await parent.snap_create("s")
+            await rbd.clone("par", "s", "ch")
+            clone = await rbd.open("ch")
+            # discard a full object's range and a partial range
+            await clone.discard(0, 16384)        # whole object 0
+            await clone.discard(20000, 1000)     # partial in obj 1
+            assert await clone.read(0, 16384) == b"\0" * 16384
+            assert await clone.read(20000, 1000) == b"\0" * 1000
+            # the rest of object 1 still serves parent bytes
+            assert await clone.read(16384, 3616) == b"\xaa" * 3616
+        finally:
+            await c.stop()
+
+    run(main(), timeout=120)
