@@ -17,7 +17,7 @@ import asyncio
 
 from ..msg import Messenger
 from ..msg.messenger import ms_compress_from_conf
-from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
+from ..msg.messages import (MConfig, MMonCommand, MMonCommandAck, MMonSubscribe,
                             MOSDMapMsg, MOSDOp, MOSDOpReply,
                             MWatchNotify)
 from ..osd.osdmap import OSDMap, consume_map_payload, pg_t
@@ -120,6 +120,9 @@ class RadosClient:
     # -- dispatch ----------------------------------------------------------
 
     def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MConfig):
+            self.ctx.conf.apply_mon_values(msg.values or {})
+            return True
         if isinstance(msg, MOSDMapMsg):
             self._handle_map(msg)
         elif isinstance(msg, MOSDOpReply):

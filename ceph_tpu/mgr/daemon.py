@@ -23,7 +23,7 @@ import asyncio
 
 from ..msg import Messenger
 from ..msg.messenger import ms_compress_from_conf
-from ..msg.messages import (MMgrReport, MMonCommand, MMonCommandAck,
+from ..msg.messages import (MConfig, MMgrReport, MMonCommand, MMonCommandAck,
                             MMonGetMap, MMonSubscribe, MOSDMapMsg)
 from ..osd.osdmap import OSDMap, consume_map_payload
 from ..utils.context import Context
@@ -79,6 +79,9 @@ class Manager:
     # -- dispatch ----------------------------------------------------------
 
     def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MConfig):
+            self.ctx.conf.apply_mon_values(msg.values or {})
+            return True
         if isinstance(msg, MOSDMapMsg):
             self.osdmap, _ = consume_map_payload(
                 self.osdmap, msg.full, msg.incrementals)

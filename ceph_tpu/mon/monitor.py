@@ -114,6 +114,24 @@ class Monitor:
         self.failure_info: dict[int, dict[int, FailureReport]] = {}
         self.down_pending_out: dict[int, float] = {}
         self._tick_task = None
+        # PaxosService quartet (ConfigMonitor/AuthMonitor/
+        # HealthMonitor/LogMonitor analogs): their mutations ride the
+        # same paxos stream as map changes via pending_svc
+        from .services import (AuthMonitor, ConfigMonitor,
+                               HealthMonitor, LogMonitor)
+
+        self.config_mon = ConfigMonitor(self)
+        self.auth_mon = AuthMonitor(self)
+        self.health_mon = HealthMonitor(self)
+        self.log_mon = LogMonitor(self)
+        self.pending_svc: dict[str, list] = {}
+        # service state loads BEFORE _load(): crash recovery replays
+        # a pending blob through the same apply path, which rewrites
+        # the persisted service images — replaying onto empty dicts
+        # would erase everything but the replayed ops
+        self.config_mon.load()
+        self.auth_mon.load()
+        self.log_mon.load()
         self._load()
 
     def _parse_disallowed(self, raw: str) -> set[int]:
@@ -163,6 +181,20 @@ class Monitor:
 
     def _on_paxos_commit(self, version: int, blob: bytes) -> None:
         payload = denc.decode(blob)
+        svc = payload.get("svc") or {}
+        if svc:
+            # service mutations apply on EVERY monitor (leader, peons,
+            # recovery replay) in one KV transaction
+            tx = self.store.get_transaction()
+            if svc.get("config"):
+                self.config_mon.apply(svc["config"], tx)
+            if svc.get("auth"):
+                self.auth_mon.apply(svc["auth"], tx)
+            if svc.get("log"):
+                self.log_mon.apply(svc["log"], tx)
+            self.store.submit_transaction(tx)
+            if svc.get("config"):
+                self.config_mon.push_all()
         inc_d = payload.get("osdmap_inc")
         if inc_d is None:
             return
@@ -278,28 +310,46 @@ class Monitor:
             self.pending_inc = self.osdmap.new_incremental()
         return self.pending_inc
 
+    def queue_svc_op(self, svc: str, op: tuple) -> None:
+        """Stage a service mutation (config/auth/log) for the next
+        paxos round (PaxosService pending analog)."""
+        self.pending_svc.setdefault(svc, []).append(list(op))
+        self._propose_pending()
+
+    def _take_svc(self) -> dict:
+        svc, self.pending_svc = self.pending_svc, {}
+        return svc
+
     def _propose_pending(self) -> None:
         """PaxosService::propose_pending: commit the pending Incremental
-        through paxos, apply it, persist, publish.  Multi-mon: wake the
-        serialized proposal loop (a second mutation arriving while a
-        round is in flight folds into the next pending Incremental)."""
+        and/or service ops through paxos, apply, persist, publish.
+        Multi-mon: wake the serialized proposal loop (a second mutation
+        arriving while a round is in flight folds into the next
+        pending proposal)."""
         if self.multi:
-            if self.pending_inc is not None:
+            if self.pending_inc is not None or self.pending_svc:
                 fut = asyncio.get_event_loop().create_future()
                 self._proposal_waiters.append(fut)
                 self._last_proposal = fut
                 self._proposal_wake.set()
             return
         inc = self.pending_inc
-        if inc is None:
+        svc = self._take_svc()
+        if inc is None and not svc:
             return
         self.pending_inc = None
-        # the on_commit hook applies the incremental to the map and
-        # persists both (same path live and during crash recovery)
-        self.paxos.propose(denc.encode({"osdmap_inc": inc.to_dict()}))
+        payload: dict = {}
+        if inc is not None:
+            payload["osdmap_inc"] = inc.to_dict()
+        if svc:
+            payload["svc"] = svc
+        # the on_commit hook applies the payload to the map/services
+        # and persists (same path live and during crash recovery)
+        self.paxos.propose(denc.encode(payload))
         self.ctx.log.debug("mon", "committed epoch %d"
                            % self.osdmap.epoch)
-        self._publish()
+        if inc is not None:
+            self._publish()
 
     async def _proposal_loop(self) -> None:
         """Leader-side serialized proposer: one paxos round in flight;
@@ -309,7 +359,7 @@ class Monitor:
         while True:
             await self._proposal_wake.wait()
             self._proposal_wake.clear()
-            if self.pending_inc is None:
+            if self.pending_inc is None and not self.pending_svc:
                 continue
             if not (self.is_leader() and self.mpaxos.active):
                 continue    # re-woken after the next election win
@@ -317,8 +367,14 @@ class Monitor:
             waiters = self._proposal_waiters
             self.pending_inc = None
             self._proposal_waiters = []
-            inc.epoch = self.osdmap.epoch + 1
-            blob = denc.encode({"osdmap_inc": inc.to_dict()})
+            payload: dict = {}
+            if inc is not None:
+                inc.epoch = self.osdmap.epoch + 1
+                payload["osdmap_inc"] = inc.to_dict()
+            svc = self._take_svc()
+            if svc:
+                payload["svc"] = svc
+            blob = denc.encode(payload)
             try:
                 await self.mpaxos.propose(blob)
             except (IOError, asyncio.TimeoutError) as e:
@@ -402,6 +458,9 @@ class Monitor:
                                          self.osdmap.epoch)
             self._send_map(conn, msg.start - 1)
             self.subscribers[conn] = self.osdmap.epoch
+            # centralized config rides the subscription (MConfig on
+            # session open, ConfigMonitor::check_sub)
+            self.config_mon.push(conn, conn.peer_entity or "client")
         elif isinstance(msg, MOSDBoot):
             self._handle_boot(conn, msg)
         elif isinstance(msg, MOSDFailure):
@@ -468,6 +527,8 @@ class Monitor:
         self._propose_pending()
         self.ctx.log.info("mon", "osd.%d booted at %s (epoch %d)"
                           % (osd, addr, self.osdmap.epoch))
+        self.log_mon.append("INF", "osd.%d boot (epoch %d)"
+                            % (osd, self.osdmap.epoch))
 
     def _handle_alive_up_thru(self, msg) -> None:
         """OSDMonitor::prepare_alive: record that the osd was alive
@@ -541,6 +602,8 @@ class Monitor:
             return
         self.ctx.log.info("mon", "marking osd.%d down (%d reporters)"
                           % (target, len(reports)))
+        self.log_mon.append("WRN", "osd.%d marked down (%d reporters)"
+                            % (target, len(reports)))
         inc = self._pending()
         inc.new_state[target] = OSD_UP  # xor clears UP
         del self.failure_info[target]
@@ -576,6 +639,7 @@ class Monitor:
                 del self.down_pending_out[osd]
                 changed = True
                 self.ctx.log.info("mon", "marking osd.%d out" % osd)
+                self.log_mon.append("WRN", "osd.%d auto-out" % osd)
         if changed:
             self._propose_pending()
 
@@ -635,6 +699,13 @@ class Monitor:
                                      out={"error": str(e)}))
 
     def _run_command(self, prefix: str, cmd: dict) -> dict:
+        # service command surfaces (ConfigMonitor/AuthMonitor/
+        # HealthMonitor/LogMonitor)
+        for svc in (self.config_mon, self.auth_mon, self.health_mon,
+                    self.log_mon):
+            out = svc.command(prefix, cmd)
+            if out is not None:
+                return out
         if prefix == "osd pool create":
             return self._cmd_pool_create(cmd)
         if prefix == "osd pool rm":

@@ -1,0 +1,281 @@
+"""Monitor services: centralized config, auth registry, health
+checks, and the cluster log.
+
+Analogs of the reference's PaxosService quartet
+(src/mon/ConfigMonitor.cc, AuthMonitor.cc, HealthMonitor.cc,
+LogMonitor.cc) collapsed to the same shape this framework's
+OSDMonitor uses: every mutation is a small op list riding the SAME
+paxos commit stream as map changes ("svc" payload beside
+"osdmap_inc"), applied deterministically on every monitor (leader,
+peons, and crash-recovery replay all run the identical apply path),
+with the full service state persisted in the mon KV per commit.
+
+* ConfigMonitor — the centralized option store (`config set/get/rm/
+  dump`): values are scoped to "global", an entity type ("osd",
+  "mon", "client"), or one daemon ("osd.2"); every commit pushes the
+  resolved per-entity view to subscribed daemons (MConfig), which
+  feed their Config's "mon" source — the layer utils/config.py
+  always had a slot for.
+* AuthMonitor — per-entity secrets + caps (`auth get-or-create/get/
+  ls/del`).  The wire handshake still rides the shared cluster key
+  (msg/auth.py documents that collapse); this registry is the
+  durable, replicated identity database the cephx ticket flow would
+  consume.
+* HealthMonitor — DERIVED state, no paxos writes: aggregates osd
+  liveness, quorum shape, and stuck-pg hints into
+  HEALTH_OK/WARN/ERR + check list (`health`).
+* LogMonitor — the capped cluster log (`log` / `log last`): the mon
+  itself appends lifecycle events (boots, mark-downs, auto-outs), so
+  `log last` answers "what just happened" exactly like
+  `ceph log last`.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..utils import denc
+
+CONFIG_KEY = b"svc:config"
+AUTH_KEY = b"svc:auth"
+LOG_KEY = b"svc:log"
+
+LOG_CAP = 1000
+
+
+class ConfigMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        # who -> {option name -> value}; who = "global" | type | id
+        self.values: dict[str, dict[str, str]] = {}
+
+    # -- persistence / replay ----------------------------------------------
+
+    def load(self) -> None:
+        raw = self.mon.store.get(CONFIG_KEY)
+        if raw is not None:
+            self.values = {w: dict(kv)
+                           for w, kv in denc.decode(raw).items()}
+
+    def apply(self, ops: list, tx) -> None:
+        """Deterministic commit apply (every mon runs this)."""
+        for op in ops:
+            if op[0] == "set":
+                _c, who, name, value = op
+                self.values.setdefault(who, {})[name] = value
+            elif op[0] == "rm":
+                _c, who, name = op
+                self.values.get(who, {}).pop(name, None)
+                if who in self.values and not self.values[who]:
+                    del self.values[who]
+        tx.set(CONFIG_KEY, denc.encode(self.values))
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolved_for(self, entity: str) -> dict[str, str]:
+        """global < type < exact id (ConfigMonitor's mask order)."""
+        etype = entity.split(".", 1)[0]
+        out: dict[str, str] = {}
+        for who in ("global", etype, entity):
+            out.update(self.values.get(who, {}))
+        return out
+
+    def push(self, conn, entity: str) -> None:
+        from ..msg.messages import MConfig
+
+        conn.send(MConfig(values=self.resolved_for(entity)))
+
+    def push_all(self) -> None:
+        """After a config commit: every subscriber gets its fresh
+        resolved view (the reference pushes MConfig on maps_update)."""
+        for conn in list(self.mon.subscribers):
+            if conn.is_open:
+                self.push(conn, conn.peer_entity or "client")
+
+    # -- commands -----------------------------------------------------------
+
+    def command(self, prefix: str, cmd: dict):
+        if prefix == "config set":
+            who = cmd.get("who", "global")
+            name, value = cmd["name"], str(cmd["value"])
+            # validate against the shared schema BEFORE committing: a
+            # poison name/value in the replicated store would chase
+            # every daemon forever (daemons also skip defensively)
+            from ..utils.config import DEFAULT_SCHEMA
+
+            opt = next((o for o in DEFAULT_SCHEMA if o.name == name),
+                       None)
+            if opt is None:
+                raise ValueError("unknown option %r" % name)
+            opt.cast(value)             # raises on a bad value
+            self.mon.queue_svc_op("config",
+                                  ("set", who, name, value))
+            return {}
+        if prefix == "config rm":
+            self.mon.queue_svc_op(
+                "config", ("rm", cmd.get("who", "global"),
+                           cmd["name"]))
+            return {}
+        if prefix == "config get":
+            return {"values": self.resolved_for(cmd.get("who",
+                                                        "global"))}
+        if prefix == "config dump":
+            return {"values": {w: dict(kv)
+                               for w, kv in self.values.items()}}
+        return None
+
+
+class AuthMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        # entity -> {"key": hex str, "caps": {service: capspec}}
+        self.entities: dict[str, dict] = {}
+
+    def load(self) -> None:
+        raw = self.mon.store.get(AUTH_KEY)
+        if raw is not None:
+            self.entities = {e: dict(v)
+                             for e, v in denc.decode(raw).items()}
+
+    def apply(self, ops: list, tx) -> None:
+        for op in ops:
+            if op[0] == "add":
+                _c, entity, key, caps = op
+                self.entities[entity] = {"key": key,
+                                         "caps": dict(caps or {})}
+            elif op[0] == "caps":
+                _c, entity, caps = op
+                if entity in self.entities:
+                    self.entities[entity]["caps"] = dict(caps or {})
+            elif op[0] == "del":
+                self.entities.pop(op[1], None)
+        tx.set(AUTH_KEY, denc.encode(self.entities))
+
+    def command(self, prefix: str, cmd: dict):
+        if prefix == "auth get-or-create":
+            entity = cmd["entity"]
+            ent = self.entities.get(entity)
+            if ent is not None:
+                return {"entity": entity, "key": ent["key"]}
+            # concurrent get-or-create for one entity: the pending
+            # (queued but uncommitted) add must win, or the first
+            # caller gets a key the registry never stores
+            for op in self.mon.pending_svc.get("auth", []):
+                if op[0] == "add" and op[1] == entity:
+                    return {"entity": entity, "key": op[2]}
+            key = os.urandom(16).hex()
+            self.mon.queue_svc_op(
+                "auth", ("add", entity, key,
+                         dict(cmd.get("caps") or {})))
+            return {"entity": entity, "key": key}
+        if prefix == "auth get":
+            ent = self.entities.get(cmd["entity"])
+            if ent is None:
+                raise ValueError("no such entity")
+            return {"entity": cmd["entity"], "key": ent["key"],
+                    "caps": dict(ent.get("caps") or {})}
+        if prefix == "auth caps":
+            if cmd["entity"] not in self.entities:
+                raise ValueError("no such entity")
+            self.mon.queue_svc_op(
+                "auth", ("caps", cmd["entity"],
+                         dict(cmd.get("caps") or {})))
+            return {}
+        if prefix == "auth del":
+            self.mon.queue_svc_op("auth", ("del", cmd["entity"]))
+            return {}
+        if prefix == "auth ls":
+            return {"entities": {
+                e: {"caps": dict(v.get("caps") or {})}
+                for e, v in sorted(self.entities.items())}}
+        return None
+
+
+class HealthMonitor:
+    """Derived checks — recomputed on demand, nothing proposed."""
+
+    def __init__(self, mon):
+        self.mon = mon
+
+    def checks(self) -> dict:
+        m = self.mon.osdmap
+        out: dict[str, dict] = {}
+        down = [o for o in range(m.max_osd)
+                if m.exists(o) and not m.is_up(o)]
+        if down:
+            out["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "%d osds down" % len(down),
+                "detail": ["osd.%d is down" % o for o in down[:10]]}
+        out_osds = [o for o in range(m.max_osd)
+                    if m.exists(o) and m.is_out(o)]
+        if out_osds:
+            out["OSD_OUT"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "%d osds out" % len(out_osds),
+                "detail": []}
+        if self.mon.multi:
+            quorum = (self.mon.elector.quorum
+                      if self.mon.elector else set())
+            total = len(self.mon.monmap)
+            if self.mon.is_leader() and len(quorum) < total:
+                out["MON_DOWN"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": "%d/%d mons in quorum"
+                               % (len(quorum), total),
+                    "detail": []}
+        if not m.pools and m.epoch > 0:
+            pass                       # empty cluster is healthy
+        return out
+
+    def command(self, prefix: str, cmd: dict):
+        if prefix != "health":
+            return None
+        checks = self.checks()
+        if any(c["severity"] == "HEALTH_ERR"
+               for c in checks.values()):
+            status = "HEALTH_ERR"
+        elif checks:
+            status = "HEALTH_WARN"
+        else:
+            status = "HEALTH_OK"
+        return {"status": status, "checks": checks}
+
+
+class LogMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        self.entries: list[dict] = []       # capped ring
+
+    def load(self) -> None:
+        raw = self.mon.store.get(LOG_KEY)
+        if raw is not None:
+            self.entries = [dict(e) for e in denc.decode(raw)]
+
+    def apply(self, ops: list, tx) -> None:
+        for op in ops:
+            if op[0] == "append":
+                self.entries.append(dict(op[1]))
+        if len(self.entries) > LOG_CAP:
+            self.entries = self.entries[-LOG_CAP:]
+        tx.set(LOG_KEY, denc.encode(self.entries))
+
+    def append(self, level: str, message: str,
+               who: str = "mon") -> None:
+        """Mon-side event (boot, mark-down, auto-out ...): queued
+        through paxos so every monitor's log agrees."""
+        self.mon.queue_svc_op("log", ("append", {
+            "stamp": time.time(), "who": who, "level": level,
+            "message": message}))
+
+    def command(self, prefix: str, cmd: dict):
+        if prefix == "log":
+            self.append(cmd.get("level", "INF"),
+                        str(cmd.get("message", "")),
+                        who=cmd.get("who", "client"))
+            return {}
+        if prefix == "log last":
+            n = int(cmd.get("n", 20))
+            return {"lines": self.entries[-n:]}
+        return None

@@ -105,6 +105,16 @@ class MOSDAlive(Message):
 
 
 @register
+class MConfig(Message):
+    """mon -> daemon: the daemon's resolved centralized-config view
+    (MConfig.h / ConfigMonitor push); values feed the config system's
+    'mon' source layer."""
+
+    TYPE = "config"
+    FIELDS = ("values",)
+
+
+@register
 class MMgrReport(Message):
     """Daemon -> mgr perf/state report (MMgrReport.h via
     DaemonServer::handle_report): perf = the daemon's PerfCounters

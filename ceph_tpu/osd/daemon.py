@@ -33,7 +33,7 @@ import time
 
 from ..msg import Messenger
 from ..msg.messenger import ms_compress_from_conf, Policy
-from ..msg.messages import (MMonSubscribe, MOSDAlive, MOSDBoot,
+from ..msg.messages import (MConfig, MMonSubscribe, MOSDAlive, MOSDBoot,
                             MOSDECSubOpRead, MOSDECSubOpReadReply,
                             MOSDECSubOpWrite, MOSDECSubOpWriteReply,
                             MOSDFailure, MOSDMapMsg, MOSDOp,
@@ -178,6 +178,9 @@ class OSD:
             else:           # not started (unit-test direct dispatch)
                 fn()
 
+        if isinstance(msg, MConfig):
+            self.ctx.conf.apply_mon_values(msg.values or {})
+            return True
         if isinstance(msg, MOSDMapMsg):
             self._handle_osd_map(msg)
         elif isinstance(msg, MOSDOp):

@@ -138,9 +138,29 @@ class Config:
                         print(f"ceph-tpu: ignoring {key}: {e}", file=sys.stderr)
 
     def apply_mon_values(self, values: dict[str, Any]) -> None:
-        """Apply centralized values pushed by the monitor config service."""
-        for k, v in values.items():
-            self.set(k, v, source="mon")
+        """Apply the monitor config service's RESOLVED view: the push
+        is authoritative for the whole 'mon' layer, so keys absent
+        from it are cleared (a `config rm` must take effect on
+        running daemons, not only after restart).  Unknown options or
+        uncastable values are skipped — a newer cluster may push
+        options this daemon's schema predates, and a poison value
+        must never sever the dispatch loop."""
+        with self._lock:
+            stale = [n for n, per in self._values.items()
+                     if "mon" in per and n not in values]
+        for n in stale:
+            try:
+                self.rm(n, source="mon")
+            except Exception:
+                pass
+        for k, v in dict(values).items():
+            if k not in self._schema:
+                continue
+            try:
+                self.set(k, v, source="mon")
+            except (ValueError, TypeError, KeyError):
+                continue
+        return
 
     # -- get/set ---------------------------------------------------------
     def set(self, name: str, value: Any, source: str = "runtime") -> None:

@@ -1,0 +1,215 @@
+"""Monitor services: centralized config distribution, auth registry,
+health checks, cluster log (ConfigMonitor/AuthMonitor/HealthMonitor/
+LogMonitor analogs)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mon.monitor import Monitor
+from ceph_tpu.utils.context import Context
+from tests.test_cluster import Cluster, run
+
+
+def test_config_set_get_push_and_persist():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            # centralized set scoped to osds; daemons receive MConfig
+            await c.client.mon_command(
+                "config set", who="osd",
+                name="osd_recovery_max_active", value="3")
+            await c.client.mon_command(
+                "config set", who="global",
+                name="osd_max_pg_log_entries", value="500")
+            out = await c.client.mon_command("config get", who="osd")
+            assert out["values"]["osd_recovery_max_active"] == "3"
+            assert out["values"]["osd_max_pg_log_entries"] == "500"
+            # the push lands on subscribed daemons' 'mon' config layer
+            # (after their next subscription round-trip)
+            t0 = asyncio.get_running_loop().time()
+            while True:
+                if all(o.ctx.conf["osd_recovery_max_active"] == 3
+                       and o.ctx.conf["osd_max_pg_log_entries"] == 500
+                       for o in c.osds):
+                    break
+                assert asyncio.get_running_loop().time() - t0 < 10
+                await asyncio.sleep(0.05)
+            # per-entity beats type scope
+            await c.client.mon_command(
+                "config set", who="osd.1",
+                name="osd_recovery_max_active", value="7")
+            t0 = asyncio.get_running_loop().time()
+            while c.osds[1].ctx.conf["osd_recovery_max_active"] != 7:
+                assert asyncio.get_running_loop().time() - t0 < 10
+                await asyncio.sleep(0.05)
+            assert c.osds[0].ctx.conf["osd_recovery_max_active"] == 3
+            # dump shows raw scopes; rm drops
+            out = await c.client.mon_command("config dump")
+            assert out["values"]["osd.1"][
+                "osd_recovery_max_active"] == "7"
+            await c.client.mon_command(
+                "config rm", who="osd.1",
+                name="osd_recovery_max_active")
+            out = await c.client.mon_command("config dump")
+            assert "osd.1" not in out["values"]
+
+            # persistence: a restarted mon (same store) serves the
+            # same centralized values
+            store = c.mon.store
+            await c.mon.shutdown()
+            reborn = Monitor(Context("mon"), store=store)
+            assert reborn.config_mon.resolved_for("osd.0")[
+                "osd_recovery_max_active"] == "3"
+            c.mon = reborn              # let stop() clean it up
+            await reborn.start()
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_auth_registry_lifecycle():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "auth get-or-create", entity="client.app",
+                caps={"osd": "allow rw", "mon": "allow r"})
+            key = out["key"]
+            assert len(key) == 32
+            # idempotent: same key back
+            out2 = await c.client.mon_command(
+                "auth get-or-create", entity="client.app")
+            assert out2["key"] == key
+            out3 = await c.client.mon_command("auth get",
+                                              entity="client.app")
+            assert out3["caps"]["osd"] == "allow rw"
+            await c.client.mon_command(
+                "auth caps", entity="client.app",
+                caps={"osd": "allow r"})
+            out4 = await c.client.mon_command("auth get",
+                                              entity="client.app")
+            assert out4["caps"]["osd"] == "allow r"
+            ls = await c.client.mon_command("auth ls")
+            assert "client.app" in ls["entities"]
+            await c.client.mon_command("auth del",
+                                       entity="client.app")
+            ls = await c.client.mon_command("auth ls")
+            assert "client.app" not in ls["entities"]
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_health_and_cluster_log():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command("health")
+            assert out["status"] == "HEALTH_OK", out
+            # boots made it into the cluster log
+            log = await c.client.mon_command("log last", n=50)
+            boots = [l for l in log["lines"]
+                     if "boot" in l["message"]]
+            assert len(boots) >= 3
+
+            await c.kill_osd(2)
+            t0 = asyncio.get_running_loop().time()
+            while c.client.osdmap.is_up(2):
+                assert asyncio.get_running_loop().time() - t0 < 30
+                await asyncio.sleep(0.05)
+            out = await c.client.mon_command("health")
+            assert out["status"] == "HEALTH_WARN"
+            assert "OSD_DOWN" in out["checks"] \
+                or "OSD_OUT" in out["checks"], out
+            log = await c.client.mon_command("log last", n=50)
+            assert any("marked down" in l["message"]
+                       for l in log["lines"])
+            # client-injected log line
+            await c.client.mon_command("log",
+                                       message="maintenance start")
+            log = await c.client.mon_command("log last", n=5)
+            assert any(l["message"] == "maintenance start"
+                       for l in log["lines"])
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_config_replicates_across_quorum():
+    from tests.test_mon_quorum import (_monmap, _start_mons,
+                                       _wait_leader)
+
+    async def main():
+        from ceph_tpu.client.rados import RadosClient
+
+        monmap = _monmap(3)
+        mons = await _start_mons(monmap)
+        try:
+            await _wait_leader(mons)
+            cl = RadosClient([a for _n, a in monmap])
+            await cl.connect()
+            await cl.mon_command("config set", who="global",
+                                 name="osd_max_pg_log_entries",
+                                 value="800")
+            await cl.shutdown()
+            # every monitor's replicated service state agrees
+            t0 = asyncio.get_event_loop().time()
+            while True:
+                vals = [m.config_mon.values.get("global", {}).get(
+                    "osd_max_pg_log_entries") for m in mons]
+                if vals == ["800", "800", "800"]:
+                    break
+                assert asyncio.get_event_loop().time() - t0 < 10, vals
+                await asyncio.sleep(0.05)
+        finally:
+            for m in mons:
+                if m is not None:
+                    await m.shutdown()
+
+    run(main())
+
+
+def test_config_rm_reverts_running_daemons_and_bad_values_refused():
+    async def main():
+        from ceph_tpu.client.rados import RadosError
+
+        c = await Cluster(3).start()
+        try:
+            await c.client.mon_command(
+                "config set", who="osd",
+                name="osd_max_pg_log_entries", value="123")
+            t0 = asyncio.get_running_loop().time()
+            while any(o.ctx.conf["osd_max_pg_log_entries"] != 123
+                      for o in c.osds):
+                assert asyncio.get_running_loop().time() - t0 < 10
+                await asyncio.sleep(0.05)
+            # rm reverts RUNNING daemons to the default
+            await c.client.mon_command(
+                "config rm", who="osd",
+                name="osd_max_pg_log_entries")
+            t0 = asyncio.get_running_loop().time()
+            while any(o.ctx.conf["osd_max_pg_log_entries"] == 123
+                      for o in c.osds):
+                assert asyncio.get_running_loop().time() - t0 < 10
+                await asyncio.sleep(0.05)
+            # poison names/values are refused at set time, never
+            # committed to chase daemons forever
+            with pytest.raises(RadosError):
+                await c.client.mon_command(
+                    "config set", who="global",
+                    name="no_such_option", value="1")
+            with pytest.raises(RadosError):
+                await c.client.mon_command(
+                    "config set", who="global",
+                    name="osd_max_pg_log_entries", value="banana")
+            # the cluster still serves
+            out = await c.client.mon_command("health")
+            assert out["status"] == "HEALTH_OK"
+        finally:
+            await c.stop()
+
+    run(main())
