@@ -333,10 +333,12 @@ class ClassHandler:
 def default_handler() -> ClassHandler:
     """The built-in class set, loaded per OSD (the role of the
     OSD's ClassHandler + the cls .so directory)."""
-    from . import lock, rbd, refcount
+    from . import fsmeta, lock, rbd, refcount, rgw
 
     h = ClassHandler()
     lock.register(h)
     refcount.register(h)
     rbd.register(h)
+    fsmeta.register(h)
+    rgw.register(h)
     return h

@@ -1,0 +1,108 @@
+"""CephFS-lite: POSIX-style tree over RADOS (MDS metadata model +
+striped file data; src/mds + src/client condensed analog)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.services.fs import (CephFS, FSError, MDSDaemon,
+                                  NotEmptyError, NotFoundError)
+from tests.test_cluster import Cluster, run
+
+
+async def _fs(c, pool="fs"):
+    out = await c.client.mon_command("osd pool create", pool=pool,
+                                     pg_num=8)
+    await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+    await c.wait_health(out["pool_id"])
+    fs = CephFS(c.client.io_ctx(pool))
+    await fs.mkfs()
+    return fs
+
+
+def test_tree_and_file_io():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            fs = await _fs(c)
+            await fs.mkdir("/home")
+            await fs.mkdir("/home/user")
+            f = await fs.create("/home/user/notes.txt")
+            await f.pwrite(0, b"hello filesystem\n")
+            await f.pwrite(1 << 21, b"far away")     # crosses objects
+            assert (await fs.stat("/home/user/notes.txt"))["size"] \
+                == (1 << 21) + 8
+            g = await fs.open("/home/user/notes.txt")
+            assert await g.pread(0, 17) == b"hello filesystem\n"
+            assert await g.pread(1 << 21, 8) == b"far away"
+            # sparse gap reads zeros
+            assert await g.pread(4096, 16) == b"\0" * 16
+
+            ls = await fs.readdir("/home/user")
+            assert list(ls) == ["notes.txt"]
+            assert ls["notes.txt"]["type"] == "file"
+            ls = await fs.readdir("/")
+            assert "home" in ls
+
+            # exclusive create: a second create of the same name loses
+            with pytest.raises(Exception):
+                await fs.create("/home/user/notes.txt")
+
+            await g.truncate(5)
+            assert await g.pread(0, 100) == b"hello"
+            await fs.unlink("/home/user/notes.txt")
+            with pytest.raises(NotFoundError):
+                await fs.stat("/home/user/notes.txt")
+            with pytest.raises(NotEmptyError):
+                await fs.rmdir("/home")
+            await fs.rmdir("/home/user")
+            await fs.rmdir("/home")
+            assert await fs.readdir("/") == {}
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_rename_and_fsck():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            fs = await _fs(c)
+            await fs.mkdir("/a")
+            await fs.mkdir("/b")
+            f = await fs.create("/a/file")
+            await f.pwrite(0, b"content")
+            await fs.rename("/a/file", "/b/moved")
+            assert "file" not in await fs.readdir("/a")
+            g = await fs.open("/b/moved")
+            assert await g.pread(0, 7) == b"content"
+            # directory rename keeps the subtree reachable
+            await fs.rename("/b", "/c")
+            assert await (await fs.open("/c/moved")).pread(0, 7) \
+                == b"content"
+            out = await fs.fsck()
+            assert out["duplicates"] == {}
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_mds_single_active_failover():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            fs = await _fs(c)
+            io = c.client.io_ctx("fs")
+            a = MDSDaemon(io, "mds.a", renew_interval=0.2)
+            b = MDSDaemon(io, "mds.b", renew_interval=0.2)
+            assert await a.try_become_active()
+            assert not await b.try_become_active()   # standby
+            await a.stop()                            # releases lock
+            assert await b.try_become_active()
+            await b.stop()
+        finally:
+            await c.stop()
+
+    run(main())

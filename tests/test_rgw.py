@@ -1,0 +1,139 @@
+"""RGW-lite: S3-style gateway over RADOS (cls_rgw bucket indexes,
+multipart manifests, HTTP front; src/rgw condensed analog)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.services.rgw import RGW, RGWError, S3Server
+from tests.test_cluster import Cluster, run
+
+
+async def _rgw(c, pool="rgw"):
+    out = await c.client.mon_command("osd pool create", pool=pool,
+                                     pg_num=8)
+    await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+    await c.wait_health(out["pool_id"])
+    return RGW(c.client.io_ctx(pool))
+
+
+def test_bucket_and_object_lifecycle():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            rgw = await _rgw(c)
+            await rgw.create_bucket("photos")
+            with pytest.raises(RGWError):
+                await rgw.create_bucket("photos")    # 409
+            assert await rgw.list_buckets() == ["photos"]
+
+            etag = await rgw.put_object("photos", "2026/cat.jpg",
+                                        b"meow" * 1000)
+            meta = await rgw.head_object("photos", "2026/cat.jpg")
+            assert meta["size"] == 4000 and meta["etag"] == etag
+            assert await rgw.get_object("photos", "2026/cat.jpg") \
+                == b"meow" * 1000
+            # big object splits across RADOS objects transparently
+            big = bytes(range(256)) * (5 << 12)      # 5 MiB
+            await rgw.put_object("photos", "big.bin", big)
+            assert await rgw.get_object("photos", "big.bin") == big
+
+            out = await rgw.list_objects("photos")
+            assert [e["key"] for e in out["entries"]] == \
+                ["2026/cat.jpg", "big.bin"]
+            out = await rgw.list_objects("photos", prefix="2026/")
+            assert [e["key"] for e in out["entries"]] == \
+                ["2026/cat.jpg"]
+
+            with pytest.raises(RGWError):
+                await rgw.delete_bucket("photos")    # not empty
+            await rgw.delete_object("photos", "2026/cat.jpg")
+            await rgw.delete_object("photos", "big.bin")
+            with pytest.raises(RGWError):
+                await rgw.get_object("photos", "big.bin")
+            await rgw.delete_bucket("photos")
+            assert await rgw.list_buckets() == []
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_multipart_upload():
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            rgw = await _rgw(c)
+            await rgw.create_bucket("backups")
+            uid = await rgw.initiate_multipart("backups", "db.dump")
+            p1 = b"A" * 100000
+            p2 = b"B" * 50000
+            p3 = b"C" * 7
+            await rgw.upload_part("backups", "db.dump", uid, 1, p1)
+            await rgw.upload_part("backups", "db.dump", uid, 2, p2)
+            await rgw.upload_part("backups", "db.dump", uid, 3, p3)
+            etag = await rgw.complete_multipart("backups", "db.dump",
+                                                uid, [1, 2, 3])
+            assert etag.endswith("-3")
+            meta = await rgw.head_object("backups", "db.dump")
+            assert meta["size"] == len(p1) + len(p2) + len(p3)
+            assert await rgw.get_object("backups", "db.dump") == \
+                p1 + p2 + p3
+            await rgw.delete_object("backups", "db.dump")
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_s3_http_front():
+    async def main():
+        c = await Cluster(3).start()
+        srv = None
+        try:
+            rgw = await _rgw(c)
+            srv = S3Server(rgw)
+            addr = await srv.start()
+            host, port = addr.rsplit(":", 1)
+
+            async def req(method, path, body=b""):
+                r, w = await asyncio.open_connection(host, int(port))
+                w.write(("%s %s HTTP/1.1\r\nHost: x\r\n"
+                         "Content-Length: %d\r\n\r\n"
+                         % (method, path, len(body))).encode())
+                w.write(body)
+                await w.drain()
+                status = int((await r.readline()).split()[1])
+                hdrs = {}
+                while True:
+                    line = await r.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _s, v = line.decode().partition(":")
+                    hdrs[k.strip().lower()] = v.strip()
+                payload = b""
+                n = int(hdrs.get("content-length", 0) or 0)
+                if n:
+                    payload = await r.readexactly(n)
+                w.close()
+                return status, payload
+
+            assert (await req("PUT", "/media"))[0] == 200
+            st, _ = await req("PUT", "/media/a/b.txt", b"via http")
+            assert st == 200
+            st, body = await req("GET", "/media/a/b.txt")
+            assert st == 200 and body == b"via http"
+            st, body = await req("GET", "/media")
+            assert st == 200 and b"<Key>a/b.txt</Key>" in body
+            st, body = await req("GET", "/")
+            assert st == 200 and b"<Name>media</Name>" in body
+            st, _ = await req("GET", "/media/zzz")
+            assert st == 404
+            assert (await req("DELETE", "/media/a/b.txt"))[0] == 204
+            assert (await req("DELETE", "/media"))[0] == 204
+        finally:
+            if srv is not None:
+                await srv.stop()
+            await c.stop()
+
+    run(main())
