@@ -107,12 +107,14 @@ def bench_crush(n_pgs: int = CRUSH_N_PGS,
     # a tiny dependent slice readback (block_until_ready is unreliable
     # over the tunnel).
     def full_map(ex, iu):
-        st = dm.map_pool_state(
+        # completion barrier: map_pool_state's own overflow-counter
+        # readback already forces the whole device chain (an extra
+        # readback here would bill one more ~130 ms tunnel round trip
+        # that real PCIe hardware does not pay)
+        return dm.map_pool_state(
             0, pool.size, pool.pg_num, pool.pgp_num, pool.pgp_num_mask,
             pool.id, bool(pool.flags & FLAG_HASHPSPOOL), m.osd_weight,
             ex, iu, None, True)
-        np.asarray(st.up[:1])     # sync barrier through the full chain
-        return st
 
     # warm/compile (fast + resolve paths) on PERTURBED inputs: the
     # device tunnel elides repeated identical dispatches, so the warm
@@ -155,8 +157,9 @@ def bench_crush(n_pgs: int = CRUSH_N_PGS,
     exists = (state & OSD_EXISTS) != 0
     isup = (state & OSD_UP) != 0
     t0 = time.perf_counter()
+    # remap's internal counter readback is the completion barrier
+    # (same rationale as full_map)
     st1 = st0.remap(m.osd_weight, exists, isup, None)
-    np.asarray(st1.up[:1])
     t_remap = time.perf_counter() - t0
     up0, up1 = st0.up, st1.up
 
@@ -224,16 +227,18 @@ def bench_decode() -> dict:
         return time.perf_counter() - t0
 
     chained(2)
+    payload = k * 64 * P  # survivor bytes read per reconstruct
     estimates = []
-    for _ in range(3):
+    for _ in range(5):
         t1 = chained(3)
         t2 = chained(23)
         if t2 > t1:
-            estimates.append((t2 - t1) / 20)
+            per = (t2 - t1) / 20
+            if payload / per / (1 << 30) <= 700:   # roofline filter
+                estimates.append(per)
     if not estimates:
         return {}
     per = sorted(estimates)[len(estimates) // 2]
-    payload = k * 64 * P  # survivor bytes read per reconstruct
     return {
         "ec_reconstruct_1shard_gibps": round(
             payload / per / (1 << 30), 1),
@@ -283,21 +288,19 @@ def bench_backend_path() -> dict:
 
     chained(2)
     estimates = []
-    for _ in range(3):
+    for _ in range(5):
         t1 = chained(4)
-        t2 = chained(100)     # long runs: tunnel jitter amortizes
+        t2 = chained(120)     # long runs: tunnel jitter amortizes
         if t2 > t1:
-            estimates.append((t2 - t1) / 96)
+            per = (t2 - t1) / 116
+            if k * N / per / (1 << 30) <= 600:
+                # above the HBM roofline: pipelining artifact, drop
+                estimates.append(per)
     if not estimates:
         return {}
     per = sorted(estimates)[len(estimates) // 2]
     gibps = k * N / per / (1 << 30)
-    out = {"ec_backend_path_gibps": round(gibps, 1)}
-    if gibps > 600:
-        # above the single-chip HBM roofline (~600 GiB/s payload):
-        # tunnel pipelining noise in the slope, not real throughput
-        out["ec_backend_path_note"] = "above HBM roofline: noisy slope"
-    return out
+    return {"ec_backend_path_gibps": round(gibps, 1)}
 
 
 def main() -> None:
@@ -309,6 +312,14 @@ def main() -> None:
     k, m = 8, 3
     matrix = matrices.isa_rs_vandermonde_matrix(k, m)
     rng = np.random.default_rng(0)
+
+    # single-chip payload roofline: encode traffic is (k+m)/k of the
+    # payload at ~819 GB/s HBM -> ~554 GiB/s payload.  Slope samples
+    # implying more than that are tunnel pipelining artifacts (an
+    # inflated SHORT run makes t2-t1 too small) and are discarded
+    # before the median — the round-4 lesson that a committed
+    # artifact must not under- OR over-state the steady state.
+    ROOFLINE = 554.0 * 1.05
 
     gibps = 0.0
     # tile bounded by VMEM: (512+192)*tile*2 (double-buffered) < 16 MiB
@@ -338,13 +349,22 @@ def main() -> None:
             return time.perf_counter() - t0
 
         run_chained(2)    # compile + warm
-        n1, n2 = 4, 100
+        n1, n2 = 4, 150
         estimates = []
-        for _ in range(3):
+        raw_estimates = []
+        for _ in range(5):
             t1 = run_chained(n1)
             t2 = run_chained(n2)
             if t2 > t1:
-                estimates.append((t2 - t1) / (n2 - n1))
+                per = (t2 - t1) / (n2 - n1)
+                raw_estimates.append(per)
+                if payload / per / (1 << 30) <= ROOFLINE:
+                    estimates.append(per)
+        if not estimates:
+            # pathological jitter filtered every sample: fall back to
+            # the unfiltered median rather than committing 0.0 (the
+            # artifact must never silently under-state to nothing)
+            estimates = raw_estimates
         if not estimates:
             continue
         per_iter = sorted(estimates)[len(estimates) // 2]
@@ -356,7 +376,11 @@ def main() -> None:
         "unit": "GiB/s",
         "vs_baseline": round(gibps / BASELINE_GIBPS, 2),
     }
-    extra = {}
+    # the physical context for vs_baseline: one chip is HBM-bound at
+    # ~554 GiB/s payload, and the 493 denominator is a LINEARLY
+    # scaled 64-core host (optimistic for the host) — parity here is
+    # the roofline speaking; BASELINE.md carries the multi-chip model
+    extra = {"vs_hbm_roofline": round(gibps / 554.0, 2)}
     try:
         extra.update(bench_decode())
     except Exception as e:  # secondary metrics never sink the headline

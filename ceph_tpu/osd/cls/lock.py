@@ -18,6 +18,8 @@ Methods (matching cls_lock's surface):
 
 from __future__ import annotations
 
+import time
+
 from ...utils import denc
 from . import (EBUSY, EEXIST, EINVAL, ENOENT, RD, WR, ClsError,
                MethodContext)
@@ -50,7 +52,11 @@ def lock(ctx: MethodContext, inp: dict) -> dict:
     if not name or ltype not in (EXCLUSIVE, SHARED):
         raise ClsError(EINVAL, "bad lock args")
     st = _load(ctx, name)
-    me = {"locker": ctx.entity, "cookie": cookie, "desc": desc}
+    # stamp = primary-side clock at (re)acquire/renew: liveness
+    # watchers (e.g. MDS standby takeover) read it from get_info to
+    # detect a holder that stopped renewing (the lock_duration role)
+    me = {"locker": ctx.entity, "cookie": cookie, "desc": desc,
+          "stamp": time.time()}
     if st is None:
         ctx.create()
         _store(ctx, name, {"type": ltype, "tag": tag, "lockers": [me]})
@@ -62,6 +68,8 @@ def lock(ctx: MethodContext, inp: dict) -> dict:
             # already held by us: cls_lock returns -EEXIST unless the
             # caller asked to renew
             raise ClsError(EEXIST, "already locked by caller")
+        mine[0]["stamp"] = time.time()
+        _store(ctx, name, st)
         return {}
     if st["type"] == EXCLUSIVE or ltype == EXCLUSIVE:
         if st["lockers"]:

@@ -43,6 +43,21 @@ def index_rm(ctx: MethodContext, inp: dict) -> dict:
     return {}
 
 
+def index_get(ctx: MethodContext, inp: dict) -> dict:
+    """Point lookup — O(1) against the omap, where index_list would
+    materialize and sort the whole bucket."""
+    if not ctx.exists():
+        raise ClsError(ENOENT, "no such bucket")
+    key = inp.get("key", "")
+    kb = key.encode()
+    v = ctx.omap_get_vals([kb]).get(kb)
+    if v is None:
+        raise ClsError(ENOENT, "no such key")
+    e = denc.decode(v)
+    e["key"] = key
+    return {"entry": e}
+
+
 def index_list(ctx: MethodContext, inp: dict) -> dict:
     """Ordered listing with marker/prefix/max (the ListBucket
     pagination contract)."""
@@ -86,6 +101,7 @@ def register(h) -> None:
         "bucket_init": (WR, bucket_init),
         "index_put": (WR, index_put),
         "index_rm": (WR, index_rm),
+        "index_get": (RD, index_get),
         "index_list": (RD, index_list),
         "index_stat": (RD, index_stat),
     })

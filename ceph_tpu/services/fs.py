@@ -55,6 +55,10 @@ class NotEmptyError(FSError):
     pass
 
 
+class ExistsError(FSError):
+    pass
+
+
 def _dir_oid(ino: int) -> str:
     return "dir.%x" % ino
 
@@ -142,10 +146,19 @@ class CephFS:
                     exclusive: bool = True) -> None:
         """One atomic dentry insert (cls: fails EEXIST inside the
         OSD, so two racing creates cannot both win)."""
-        await self.io.exec(_dir_oid(dir_ino), "fsmeta", "link",
-                           {"name": name,
-                            "dentry": denc.encode(dentry),
-                            "exclusive": exclusive})
+        from ..client.rados import RadosError
+
+        try:
+            await self.io.exec(_dir_oid(dir_ino), "fsmeta", "link",
+                               {"name": name,
+                                "dentry": denc.encode(dentry),
+                                "exclusive": exclusive})
+        except RadosError as e:
+            if e.code == -17:
+                raise ExistsError(name) from None
+            if e.code == -2:
+                raise NotFoundError("directory removed") from None
+            raise
 
     # -- directory ops ------------------------------------------------------
 
@@ -186,8 +199,11 @@ class CephFS:
                 raise NotEmptyError(path) from None
             raise
         await self.io.omap_rm(_dir_oid(dir_ino), [name.encode()])
+        # the sealed tombstone stays: removing it would let a racing
+        # create() resurrect a fresh (unreachable) dirfrag through
+        # link's ctx.create().  Tombstones are a few bytes each.
         try:
-            await self.io.remove(_dir_oid(d["ino"]))
+            await self.io.truncate(_dir_oid(d["ino"]), 0)
         except Exception:
             pass
 
@@ -343,14 +359,20 @@ class FSFile:
 
             await asyncio.gather(*[rm(o) for o in
                                    {e[0] for e in old} - keep])
+            # EVERY kept object trims to the smallest dropped offset
+            # it holds (under striping more than one object straddles
+            # the cut, and a stale tail would resurface as old bytes
+            # after a later re-extend)
+            cut: dict[int, int] = {}
             for o, oo, _ln, fo in old:
-                if o in keep and fo == size:
-                    try:
-                        await self.fs.io.truncate(
-                            _data_name(self.ino, o), oo)
-                    except Exception:
-                        pass
-                    break
+                if o in keep and fo >= size:
+                    cut[o] = min(cut.get(o, 1 << 62), oo)
+            for o, off in cut.items():
+                try:
+                    await self.fs.io.truncate(
+                        _data_name(self.ino, o), off)
+                except Exception:
+                    pass
         self.size = size
         await self._flush_size()
 
@@ -405,6 +427,7 @@ class MDSDaemon:
 
     async def _renew_loop(self) -> None:
         import asyncio
+        import time as _time
 
         while True:
             await asyncio.sleep(self.renew_interval)
@@ -417,7 +440,33 @@ class MDSDaemon:
                 except Exception:
                     self.active = False
             else:
+                if not await self.try_become_active():
+                    await self._maybe_break_stale(_time.time())
+
+    async def _maybe_break_stale(self, now: float) -> None:
+        """Crash takeover: a holder that stopped renewing (stamp
+        older than 5 renew intervals) is forcibly broken — the
+        break_lock path the reference MDSMonitor uses when an active
+        MDS's beacon lapses."""
+        try:
+            info = await self.io.exec(FS_ROOT_OID, "lock",
+                                      "get_info",
+                                      {"name": "mds_active"})
+        except Exception:
+            return
+        for holder in info.get("lockers", []):
+            stamp = float(holder.get("stamp", 0) or 0)
+            if stamp and now - stamp > 5 * self.renew_interval:
+                try:
+                    await self.io.exec(
+                        FS_ROOT_OID, "lock", "break_lock",
+                        {"name": "mds_active",
+                         "locker": holder["locker"],
+                         "cookie": holder["cookie"]})
+                except Exception:
+                    pass
                 await self.try_become_active()
+                return
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -376,13 +376,17 @@ class Image:
 
             await asyncio.gather(*[
                 rm(o) for o in {e[0] for e in old} - keep])
+            # every kept straddling object trims to its smallest
+            # dropped offset (striping can cut through several)
+            cut: dict[int, int] = {}
             for o, oo, _ln, fo in old:
-                if o in keep and fo == new_size:
-                    try:
-                        await self.io.truncate(self._data_name(o), oo)
-                    except Exception:
-                        pass
-                    break
+                if o in keep and fo >= new_size:
+                    cut[o] = min(cut.get(o, 1 << 62), oo)
+            for o, off in cut.items():
+                try:
+                    await self.io.truncate(self._data_name(o), off)
+                except Exception:
+                    pass
         self._size = new_size
         await self.io.exec(HEADER_PREFIX + self.name, "rbd",
                            "set_size", {"size": new_size})

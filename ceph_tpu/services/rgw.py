@@ -82,13 +82,17 @@ class RGW:
                                {bucket.encode(): b"1"})
 
     async def delete_bucket(self, bucket: str) -> None:
+        from ..client.rados import RadosError
+
         out = await self._index_list(bucket, max=1)
         if out["entries"]:
             raise RGWError("BucketNotEmpty", 409)
         try:
             await self.io.remove(_idx(bucket))
-        except Exception:
-            raise RGWError("NoSuchBucket", 404) from None
+        except RadosError as e:
+            if e.code == -2:
+                raise RGWError("NoSuchBucket", 404) from None
+            raise       # transient faults must NOT read as 404
         await self.io.omap_rm(BUCKETS_OID, [bucket.encode()])
 
     async def list_buckets(self) -> list[str]:
@@ -165,11 +169,18 @@ class RGW:
         return b"".join(parts)
 
     async def head_object(self, bucket: str, key: str) -> dict:
-        out = await self._index_list(bucket, prefix=key, max=2)
-        for e in out["entries"]:
-            if e["key"] == key:
-                return e
-        raise RGWError("NoSuchKey", 404)
+        from ..client.rados import RadosError
+
+        try:
+            out = await self.io.exec(_idx(bucket), "rgw",
+                                     "index_get", {"key": key})
+            return out["entry"]
+        except RadosError as e:
+            if e.code == -2:
+                # bucket or key: disambiguate for correct S3 errors
+                await self.head_bucket(bucket)
+                raise RGWError("NoSuchKey", 404) from None
+            raise
 
     async def delete_object(self, bucket: str, key: str) -> None:
         from ..client.rados import RadosError
@@ -218,6 +229,8 @@ class RGW:
     async def complete_multipart(self, bucket: str, key: str,
                                  upload_id: str,
                                  part_nums: list[int]) -> str:
+        from ..client.rados import RadosError
+
         manifest = [self._part_oid(bucket, key, upload_id, n)
                     for n in sorted(part_nums)]
         total = 0
@@ -229,12 +242,25 @@ class RGW:
                 raise RGWError("InvalidPart", 400) from None
             total += sz
             sigs.append(oid.encode())
+        # like put_object: a completed upload REPLACING an existing
+        # key must reap the previous version's data objects
+        try:
+            old_oids = self._data_oids(
+                bucket, key, await self.head_object(bucket, key))
+        except RGWError:
+            old_oids = []
         etag = hashlib.md5(b"".join(sigs)).hexdigest() + "-%d" % \
             len(manifest)
         meta = {"size": total, "etag": etag, "mtime": time.time(),
                 "manifest": manifest}
-        await self.io.exec(_idx(bucket), "rgw", "index_put",
-                           {"key": key, "meta": meta})
+        try:
+            await self.io.exec(_idx(bucket), "rgw", "index_put",
+                               {"key": key, "meta": meta})
+        except RadosError as e:
+            if e.code == -2:
+                raise RGWError("NoSuchBucket", 404) from None
+            raise
+        await self._reap([o for o in old_oids if o not in manifest])
         return etag
 
 
