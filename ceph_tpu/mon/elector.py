@@ -63,6 +63,7 @@ class ConnectionTracker:
         self.store = store
         self.reports: dict[int, dict] = {}
         self._seen: set[int] = set()     # peers heard from this tick
+        self._ticks = 0                  # boot grace (see tick())
         self._load()
         mine = self.reports.setdefault(
             rank, {"v": 0, "scores": {}})
@@ -103,7 +104,15 @@ class ConnectionTracker:
     def tick(self) -> None:
         """Decay every peer not heard from since the last tick, then
         persist (the reference decays on a halflife; one multiplier
-        per tick is the same shape)."""
+        per tick is the same shape).  The first few ticks are a BOOT
+        GRACE: monitors start staggered, and decaying peers that
+        simply have not finished booting makes every monitor's view
+        diverge at once — contradictory candidate preferences then
+        churn the very first election for many rounds."""
+        self._ticks += 1
+        if self._ticks <= 5:
+            self._seen.clear()
+            return
         mine = self.reports[self.rank]
         changed = False
         for r, s in list(mine["scores"].items()):
@@ -211,12 +220,15 @@ class Elector:
     def _prefer(self, a: int, b: int) -> bool:
         """True when candidate ``a`` should lead over ``b``.  Classic
         and disallow rank by id; connectivity ranks by aggregate
-        reachability, id breaking near-ties (the 0.05 margin keeps
-        score jitter from flapping leadership)."""
+        reachability, id breaking near-ties.  The margin is WIDE
+        (0.2): boot-time score churn must collapse to the stable rank
+        tiebreak (two monitors with diverging views each preferring
+        themselves would livelock a round), while a real partition
+        drags the aggregate down by >= one full reporter's view."""
         if self.strategy == CONNECTIVITY:
             sa, sb = (self.tracker.aggregate(a),
                       self.tracker.aggregate(b))
-            if abs(sa - sb) > 0.05:
+            if abs(sa - sb) > 0.2:
                 return sa > sb
         return a < b
 
