@@ -220,15 +220,18 @@ class Elector:
     def _prefer(self, a: int, b: int) -> bool:
         """True when candidate ``a`` should lead over ``b``.  Classic
         and disallow rank by id; connectivity ranks by aggregate
-        reachability, id breaking near-ties.  The margin is WIDE
-        (0.2): boot-time score churn must collapse to the stable rank
-        tiebreak (two monitors with diverging views each preferring
-        themselves would livelock a round), while a real partition
-        drags the aggregate down by >= one full reporter's view."""
+        reachability, id breaking near-ties.  The margin must damp
+        boot-time score jitter (two monitors with diverging views
+        each preferring themselves would livelock a round) yet SCALE
+        with cluster size: the aggregate is a mean over n reporters,
+        so one fully-partitioned link moves it by ~1/n — a fixed
+        margin would mask real partitions in larger quorums.  0.5/n
+        sits halfway between jitter and a single dead link."""
         if self.strategy == CONNECTIVITY:
             sa, sb = (self.tracker.aggregate(a),
                       self.tracker.aggregate(b))
-            if abs(sa - sb) > 0.2:
+            margin = 0.5 / max(2, len(self.mon.monmap))
+            if abs(sa - sb) > margin:
                 return sa > sb
         return a < b
 

@@ -530,6 +530,34 @@ class Monitor:
         self.log_mon.append("INF", "osd.%d boot (epoch %d)"
                             % (osd, self.osdmap.epoch))
 
+    def _cmd_pg_scrub(self, prefix: str, cmd: dict) -> dict:
+        """`ceph pg scrub|deep-scrub|repair <pgid>` (OSDMonitor
+        forwards the request to the PG's primary; the scrub itself
+        runs asynchronously there).  pgid = "<pool>.<ps-hex>"."""
+        from ..msg.messages import MOSDScrub
+        from ..osd.osdmap import pg_t
+
+        pgid_s = str(cmd.get("pgid", ""))
+        try:
+            pool_s, ps_s = pgid_s.split(".", 1)
+            pgid = pg_t(int(pool_s), int(ps_s, 16))
+        except ValueError:
+            raise ValueError("bad pgid %r (want <pool>.<ps-hex>)"
+                             % pgid_s) from None
+        if pgid.pool not in self.osdmap.pools:
+            raise ValueError("no pool %d" % pgid.pool)
+        _up, _upp, _acting, primary = \
+            self.osdmap.pg_to_up_acting_osds(pgid)
+        if primary < 0 or not self.osdmap.is_up(primary):
+            raise ValueError("pg %s has no live primary" % pgid_s)
+        addr = self.osdmap.osd_addrs.get(primary)
+        self.msgr.send_to(addr, MOSDScrub(
+            pool=pgid.pool, ps=pgid.ps,
+            deep=prefix in ("pg deep-scrub", "pg repair"),
+            repair=prefix == "pg repair"),
+            entity_hint="osd.%d" % primary)
+        return {"scheduled": True, "primary": primary}
+
     def _handle_alive_up_thru(self, msg) -> None:
         """OSDMonitor::prepare_alive: record that the osd was alive
         and primary-capable through the requested epoch.  Peering
@@ -772,6 +800,8 @@ class Monitor:
             return self._cmd_selfmanaged_snap_create(cmd)
         if prefix == "osd snap rm":
             return self._cmd_selfmanaged_snap_rm(cmd)
+        if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
+            return self._cmd_pg_scrub(prefix, cmd)
         if prefix == "status":
             up = sum(1 for o in range(self.osdmap.max_osd)
                      if self.osdmap.is_up(o))

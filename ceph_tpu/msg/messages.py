@@ -226,6 +226,15 @@ class MOSDPGLog(Message):
 
 
 @register
+class MOSDScrub(Message):
+    """mon -> primary OSD: operator-requested scrub of one PG
+    (MOSDScrub.h / the `ceph pg scrub|deep-scrub|repair` flow)."""
+
+    TYPE = "osd_scrub"
+    FIELDS = ("pool", "ps", "deep", "repair")
+
+
+@register
 class MOSDRepScrub(Message):
     """Primary -> replica: build a scrub map for these objects
     (MOSDRepScrub.h); fetch=True also returns the bytes (the repair

@@ -40,7 +40,7 @@ from ..msg.messages import (MConfig, MMonSubscribe, MOSDAlive, MOSDBoot,
                             MOSDOpReply, MOSDPGLog, MOSDPGPush,
                             MOSDPGPushReply, MOSDPGQuery, MOSDPing,
                             MOSDRepOp, MOSDRepOpReply, MOSDRepScrub,
-                            MOSDRepScrubMap, MWatchNotify)
+                            MOSDRepScrubMap, MOSDScrub, MWatchNotify)
 from ..models.crushmap import ITEM_NONE
 from ..store.memstore import MemStore
 from ..store.objectstore import (NotFound, ObjectStore, Transaction,
@@ -204,6 +204,35 @@ class OSD:
             self._handle_ping(conn, msg)
         elif isinstance(msg, MWatchNotify):
             self.watches.handle_ack(conn, msg)
+        elif isinstance(msg, MOSDScrub):
+            # operator-requested scrub (mon `pg scrub|deep-scrub|
+            # repair`): runs asynchronously on the primary.  One
+            # scrub per PG at a time — a retried command must not
+            # interleave two repair passes over the same objects.
+            pg = self.pgs.get(pg_t(msg.pool, msg.ps))
+            if pg is None or not pg.is_primary():
+                # schedule-time race (PG not instantiated yet, or
+                # primaryship moved): visible, like the reference's
+                # no-op scrub scheduling
+                self.ctx.log.info(
+                    "osd", "osd.%d ignoring scrub request for "
+                    "%d.%x (not primary here)"
+                    % (self.whoami, msg.pool, msg.ps))
+            elif getattr(pg, "_scrub_cmd_running", False):
+                self.ctx.log.info(
+                    "osd", "pg %s scrub already running" % pg.pgid)
+            else:
+                pg._scrub_cmd_running = True
+
+                async def run_scrub(pg=pg, deep=bool(msg.deep),
+                                    repair=bool(msg.repair)):
+                    try:
+                        await self.scrubber.scrub_pg(
+                            pg, deep=deep, repair=repair)
+                    finally:
+                        pg._scrub_cmd_running = False
+
+                self.msgr.spawn(run_scrub())
         elif isinstance(msg, MOSDRepScrub):
             q((msg.pool, msg.ps), K_SCRUB,
               lambda: self.scrubber.handle_rep_scrub(conn, msg))

@@ -104,9 +104,15 @@ def test_connectivity_scores_survive_restart():
         mons = await _start_conn_mons(monmap)
         try:
             await _wait_leader(mons, timeout=30)
-            # cut rank 2 off FIRST so live traffic cannot reset the
-            # score, then record the loss (persisted immediately)
-            _partition(mons[0], mons[2])
+            # block only mon.0's OWN sends to mon.2 (one-sided, so no
+            # wrapper survives mon.0's shutdown), then record the
+            # loss (persisted immediately)
+            blocked = monmap[2][1]
+            orig = mons[0].msgr.send_to
+            mons[0].msgr.send_to = (
+                lambda addr, msg, entity_hint="", _o=orig:
+                None if addr == blocked
+                else _o(addr, msg, entity_hint))
             mons[0].elector.tracker.lost(2)
             mons[0].elector.tracker.lost(2)
             score_before = \
@@ -119,14 +125,14 @@ def test_connectivity_scores_survive_restart():
                                      conf_overrides=CONN_CONF),
                              name="mon.0", monmap=monmap,
                              store=store)
-            # the persisted report survived the restart — the
-            # property under test (quorum re-formation under the
-            # leftover partition wrapper is covered elsewhere and is
-            # timing-dependent here)
+            # the persisted report survived deserialization...
             got = reborn.elector.tracker.reports[0]["scores"].get(2)
             assert got is not None and got <= score_before
-            await reborn.shutdown()
-            mons[0] = None
+            # ...and the restarted monitor REJOINS the quorum with
+            # those scores loaded
+            await reborn.start()
+            mons[0] = reborn
+            await _wait_leader(mons, timeout=30)
         finally:
             for m in mons:
                 if m is not None:
