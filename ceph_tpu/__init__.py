@@ -19,6 +19,7 @@ Layer map (mirrors the reference's architecture, see SURVEY.md §1):
   mon/       L4 control plane: paxos-replicated map store, elections
   osd/       L5 data plane: PGs, replicated/EC backends, peering, recovery
   client/    L6 librados-style client: Objecter, libradosstriper
+  testing/   L7 harnesses: LocalCluster, seeded ClusterThrasher
   cli/       L8 tools: crushtool/osdmaptool/rados analogs, vstart
 
 Bit-exactness: CRUSH mapping is bit-identical to the reference semantics

@@ -1,11 +1,15 @@
-"""vstart: a one-command dev cluster (mon + N osds) in one process.
+"""vstart: a one-command dev cluster (mons + N osds) in one process.
 
-Analog of src/vstart.sh for this framework: boots the monitor and N
-MemStore OSDs on loopback TCP, optionally creates pools, then either
-runs a put/get smoke workload or stays up serving until interrupted.
+Analog of src/vstart.sh for this framework, now layered on the shared
+``ceph_tpu.testing.LocalCluster`` harness: boots the monitor quorum
+and N MemStore OSDs on loopback TCP, optionally creates pools, then
+runs a put/get smoke workload, a seeded thrash run (the teuthology
+thrasher analog), or stays up serving until interrupted.
 
     python -m ceph_tpu.cli.vstart --osds 3 --smoke
     python -m ceph_tpu.cli.vstart --osds 3 --pool data --serve
+    python -m ceph_tpu.cli.vstart --osds 3 --mons 3 \\
+        --thrash 5 --seed 42
 """
 
 from __future__ import annotations
@@ -14,91 +18,36 @@ import argparse
 import asyncio
 import sys
 
-from ..client import RadosClient
-from ..mon import Monitor
-from ..osd.daemon import OSD
-from ..utils.context import Context
-
-FAST_CONF = {
-    "heartbeat_interval": 0.5,
-    "heartbeat_grace": 3.0,
-    "mon_osd_down_out_interval": 10.0,
-    "mon_osd_min_down_reporters": 1,
-}
-
-
-def _free_ports(n):
-    import socket
-
-    socks = []
-    for _ in range(n):
-        so = socket.socket()
-        so.bind(("127.0.0.1", 0))
-        socks.append(so)
-    ports = [so.getsockname()[1] for so in socks]
-    for so in socks:
-        so.close()
-    return ports
+from ..testing.cluster import LocalCluster
 
 
 async def run(args) -> int:
-    mons = []
-    if args.mons > 1:
-        monmap = [("mon.%d" % i, "127.0.0.1:%d" % po)
-                  for i, po in enumerate(_free_ports(args.mons))]
-        for name, _a in monmap:
-            mon = Monitor(Context(name, conf_overrides=FAST_CONF),
-                          name=name, monmap=monmap)
-            await mon.start()
-            mons.append(mon)
-            print("%s at %s" % (name, mon.addr))
-        # wait for a leader before using the cluster
-        import asyncio as _aio
-
-        for _ in range(200):
-            if any(m.is_leader() and m.mpaxos.active for m in mons):
-                break
-            await _aio.sleep(0.05)
-        addr = [a for _n, a in monmap]
-        mon = mons[0]
-    else:
-        mon = Monitor(Context("mon", conf_overrides=FAST_CONF))
-        addr = await mon.start()
-        mons = [mon]
-        print("mon.0 at %s" % addr)
-    osds = []
-    for i in range(args.osds):
-        osd = OSD(i, addr, Context("osd.%d" % i,
-                                   conf_overrides=FAST_CONF))
-        oaddr = await osd.start()
-        osds.append(osd)
-        print("osd.%d at %s" % (i, oaddr))
-    for osd in osds:
-        await osd.wait_for_boot()
-    client = RadosClient(addr)
-    await client.connect()
+    cluster = LocalCluster(n_osds=args.osds, n_mons=args.mons,
+                           seed=args.seed)
+    await cluster.start()
+    for mon in cluster.mons:
+        print("%s at %s" % (mon.name, mon.addr))
+    for osd in cluster.osds:
+        print("osd.%d at %s" % (osd.whoami, osd.msgr.addr))
+    client = cluster.client
     print("cluster up at epoch %d" % client.osdmap.epoch)
 
     exporter = None
     if args.exporter_port:
         from ..utils.exporter import cluster_exporter
 
-        exporter = cluster_exporter(mon.ctx, mon)
+        mon0 = cluster.mons[0]
+        exporter = cluster_exporter(mon0.ctx, mon0)
         eaddr = await exporter.start("127.0.0.1", args.exporter_port)
         print("prometheus exporter at http://%s/metrics" % eaddr)
 
     for name in args.pool or []:
-        out = await client.mon_command("osd pool create", pool=name,
-                                       pg_num=args.pg_num,
-                                       size=min(3, args.osds))
-        print("pool %s id=%d" % (name, out["pool_id"]))
+        pid = await cluster.create_pool(name, pg_num=args.pg_num)
+        print("pool %s id=%d" % (name, pid))
 
     rc = 0
     if args.smoke:
-        out = await client.mon_command("osd pool create", pool="smoke",
-                                       pg_num=8,
-                                       size=min(3, args.osds))
-        await client.wait_for_epoch(mon.osdmap.epoch)
+        pid = await cluster.create_pool("smoke", pg_num=8)
         io = client.io_ctx("smoke")
         payload = b"vstart smoke payload " * 64
         for i in range(16):
@@ -112,6 +61,27 @@ async def run(args) -> int:
         print("smoke: 16 objects written+read, %d mismatches; "
               "status=%s" % (bad, status))
         rc = 1 if bad else 0
+    elif args.thrash:
+        from ..testing.thrasher import ClusterThrasher, Workload
+
+        pid = await cluster.create_pool("thrash", pg_num=8)
+        await cluster.wait_health(pid)
+        wl = Workload(client.io_ctx("thrash"),
+                      seed=args.seed or 0).start()
+        thrasher = ClusterThrasher(cluster, seed=args.seed or 0,
+                                   rounds=args.thrash)
+        print("thrash plan (seed=%s): %s"
+              % (args.seed, thrasher.plan))
+        try:
+            await thrasher.run(pid, wl)
+            print("thrash: %d rounds clean, %d acked writes intact"
+                  % (args.thrash, len(wl.acked)))
+        except Exception as e:
+            print("thrash FAILED (replay with --seed %s): %s"
+                  % (args.seed, e))
+            rc = 1
+        finally:
+            await wl.stop()
     elif args.serve:
         print("serving; ctrl-c to stop")
         try:
@@ -122,11 +92,7 @@ async def run(args) -> int:
 
     if exporter is not None:
         await exporter.stop()
-    await client.shutdown()
-    for osd in osds:
-        await osd.shutdown()
-    for m in mons:
-        await m.shutdown()
+    await cluster.stop()
     return rc
 
 
@@ -138,6 +104,12 @@ def main(argv=None) -> int:
     p.add_argument("--pg-num", type=int, default=32)
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--serve", action="store_true")
+    p.add_argument("--thrash", type=int, default=0, metavar="ROUNDS",
+                   help="run ROUNDS of seeded cluster thrashing "
+                        "under a live workload")
+    p.add_argument("--seed", type=int, default=None,
+                   help="deterministic seed for fault injection / "
+                        "thrash scheduling")
     p.add_argument("--exporter-port", type=int, default=0,
                    help="serve Prometheus metrics on this port")
     args = p.parse_args(argv)

@@ -14,13 +14,15 @@ the reference's RESETSESSION handling).
 from __future__ import annotations
 
 import asyncio
+import random
 
 from ..msg import Messenger
 from ..msg.messenger import ms_compress_from_conf
 from ..msg.messages import (MConfig, MMonCommand, MMonCommandAck, MMonSubscribe,
-                            MOSDMapMsg, MOSDOp, MOSDOpReply,
+                            MOSDBackoff, MOSDMapMsg, MOSDOp, MOSDOpReply,
                             MWatchNotify)
 from ..osd.osdmap import OSDMap, consume_map_payload, pg_t
+from ..utils.backoff import ExpBackoff
 from ..utils.context import Context
 
 
@@ -42,7 +44,8 @@ class ObjectNotFound(RadosError):
 
 class _InFlight:
     __slots__ = ("tid", "pool", "oid", "ops", "future", "target",
-                 "pgid", "acting", "snapc", "snapid")
+                 "pgid", "acting", "snapc", "snapid", "backoff",
+                 "next_resend", "first_sent")
 
     def __init__(self, tid, pool, oid, ops, future, snapc=None,
                  snapid=None):
@@ -56,32 +59,53 @@ class _InFlight:
         self.acting: list = []  # acting set at send time
         self.snapc = snapc      # (seq, [snapids desc]) on writes
         self.snapid = snapid    # read-from-snapshot id
+        self.backoff = None     # ExpBackoff ramp (set on first send)
+        self.next_resend = 0.0  # loop.time() the resend tick may fire
+        self.first_sent = 0.0
 
 
 class RadosClient:
     """Cluster handle (librados::Rados / RadosClient)."""
 
+    # op resend ramp: base far above a healthy op round trip so only
+    # genuinely lost ops (dropped frames, dead primaries the map has
+    # not yet condemned) re-fire; cap bounds recovery latency
+    OP_RESEND_BASE = 0.5
+    OP_RESEND_CAP = 5.0
+
     def __init__(self, mon_addr, ctx: Context | None = None,
-                 name: str = "client.0"):
+                 name: str = "client.0", seed: int | None = None):
         self.ctx = ctx or Context(name)
         # mon_addr: one address or the monmap address list; commands
         # and subscriptions fail over across them (MonClient hunting)
         self.mon_addrs = ([mon_addr] if isinstance(mon_addr, str)
                           else list(mon_addr))
         self._mon_i = 0
+        # seeded mode: jittered waits (op resend, mon hunting) draw
+        # from a deterministic stream, for replayable fault schedules
+        self.rng = (random.Random("%s|%s" % (seed, name))
+                    if seed is not None else random.Random())
         from ..msg.auth import AuthContext
         self.msgr = Messenger(
             name, auth=AuthContext.from_conf(self.ctx.conf),
-            compress=ms_compress_from_conf(self.ctx.conf))
+            compress=ms_compress_from_conf(self.ctx.conf), seed=seed)
         self.msgr.add_dispatcher(self)
         # epoch-0 empty map is the universal incremental base
         self.osdmap: OSDMap = OSDMap()
         self._map_event = asyncio.Event()
+        # (epoch, future) waiters resolved by _handle_map — the
+        # event-driven wait_for_epoch (no fixed-interval polling)
+        self._map_waiters: list = []
         self._tid = 0
         self._inflight: dict[int, _InFlight] = {}
         self._cmd_futures: dict[int, asyncio.Future] = {}
         # (pool, oid) -> callback(payload); re-registered on map change
         self._watch_cbs: dict[tuple, object] = {}
+        # (pool, ps) -> (primary_osd, backoff_id): PGs an OSD told us
+        # to stop resending to (MOSDBackoff); cleared on unblock, on a
+        # primary change, or on that OSD's session reset
+        self._backoffs: dict[tuple, tuple] = {}
+        self._resend_task = None
 
     @property
     def mon_addr(self) -> str:
@@ -93,7 +117,12 @@ class RadosClient:
     # -- lifecycle ---------------------------------------------------------
 
     async def connect(self, timeout: float = 10.0) -> None:
+        """Hunt through the monmap until a monitor answers the
+        subscription (MonClient::hunt), pacing attempts with an
+        exponential-backoff ramp + jitter instead of a fixed 2s tick
+        so a mon flap does not synchronize every client's retry."""
         deadline = asyncio.get_running_loop().time() + timeout
+        hunt = ExpBackoff(base=0.3, cap=2.0, rng=self.rng)
         while True:
             self.msgr.send_to(self.mon_addr, MMonSubscribe(start=1),
                               entity_hint="mon.0")
@@ -102,13 +131,17 @@ class RadosClient:
                 raise asyncio.TimeoutError("no monitor reachable")
             try:
                 await asyncio.wait_for(self._map_event.wait(),
-                                       min(2.0, left))
+                                       min(hunt.next_delay(), left))
+                if self._resend_task is None:
+                    self._resend_task = self.msgr.spawn(
+                        self._resend_loop())
                 return
             except asyncio.TimeoutError:
                 self._next_mon()
 
     async def shutdown(self) -> None:
         await self.msgr.shutdown()
+        self._resend_task = None
 
     def io_ctx(self, pool_name: str) -> "IoCtx":
         for pid, pool in (self.osdmap.pools if self.osdmap else {}) \
@@ -131,6 +164,8 @@ class RadosClient:
             fut = self._cmd_futures.pop(msg.tid, None)
             if fut is not None and not fut.done():
                 fut.set_result((msg.result, msg.out))
+        elif isinstance(msg, MOSDBackoff):
+            self._handle_backoff(conn, msg)
         elif isinstance(msg, MWatchNotify):
             cb = self._watch_cbs.get((msg.pool, msg.oid))
             if cb is not None:
@@ -164,7 +199,40 @@ class RadosClient:
             # an OSD session reset dropped our in-memory watches on
             # that primary even if the map is unchanged: re-register
             self._rewatch()
+            # its backoffs died with the session (the reference drops
+            # Backoffs on con reset): resume resending to those PGs
+            osd = next((o for o, a in self.osdmap.osd_addrs.items()
+                        if a == conn.peer_addr), None)
+            if osd is not None:
+                for key in [k for k, (po, _i) in
+                            self._backoffs.items() if po == osd]:
+                    del self._backoffs[key]
         self._scan_requests()
+
+    # -- backoffs (osd_backoff / Objecter Backoff tracking) ----------------
+
+    def _handle_backoff(self, conn, msg: MOSDBackoff) -> None:
+        key = (msg.pool, msg.ps)
+        osd = next((o for o, a in self.osdmap.osd_addrs.items()
+                    if a == conn.peer_addr), -1)
+        if msg.op == "block":
+            cur = self._backoffs.get(key)
+            if cur is None or cur[1] < msg.id:
+                self._backoffs[key] = (osd, msg.id)
+        elif msg.op == "unblock":
+            cur = self._backoffs.get(key)
+            if cur is not None and cur[1] <= msg.id:
+                del self._backoffs[key]
+                # released: re-arm parked ops for an immediate retry
+                now = asyncio.get_running_loop().time()
+                for op in self._inflight.values():
+                    if op.pgid is not None and \
+                            (op.pool, op.pgid.ps) == key:
+                        op.next_resend = now
+
+    def _backed_off(self, op: _InFlight) -> bool:
+        return (op.pgid is not None
+                and (op.pool, op.pgid.ps) in self._backoffs)
 
     # -- maps --------------------------------------------------------------
 
@@ -174,7 +242,30 @@ class RadosClient:
         # any map receipt (even the pre-boot epoch-0 one) proves the
         # mon link is up — connect() must not hang on a fresh cluster
         self._map_event.set()
+        if self._map_waiters:
+            epoch = self.osdmap.epoch
+            still = []
+            for want, fut in self._map_waiters:
+                if epoch >= want:
+                    if not fut.done():
+                        fut.set_result(None)
+                else:
+                    still.append((want, fut))
+            self._map_waiters = still
         if changed and self.osdmap.epoch > 0:
+            # a backoff is scoped to the primary that issued it: a
+            # mapping change hands the PG to a new primary whose ops
+            # must flow (it sends its own backoff if still unready)
+            for key in list(self._backoffs):
+                pool_id, ps = key
+                if pool_id not in self.osdmap.pools:
+                    del self._backoffs[key]
+                    continue
+                _up, _upp, _acting, primary = \
+                    self.osdmap.pg_to_up_acting_osds(
+                        pg_t(pool_id, ps))
+                if primary != self._backoffs[key][0]:
+                    del self._backoffs[key]
             self._scan_requests()
             self._rewatch()
 
@@ -253,6 +344,13 @@ class RadosClient:
         return sorted(set(names))
 
     def _send_op(self, op: _InFlight) -> None:
+        loop = asyncio.get_running_loop()
+        if op.backoff is None:
+            op.backoff = ExpBackoff(base=self.OP_RESEND_BASE,
+                                    cap=self.OP_RESEND_CAP,
+                                    rng=self.rng)
+            op.first_sent = loop.time()
+        op.next_resend = loop.time() + op.backoff.next_delay()
         primary, pgid, acting = self._calc_target(op.pool, op.oid)
         op.target = primary
         op.pgid = pgid
@@ -267,6 +365,36 @@ class RadosClient:
             snapc=op.snapc, snapid=op.snapid, ops=op.ops,
             epoch=self.osdmap.epoch, flags=0),
             entity_hint="osd.%d" % primary)
+
+    async def _resend_loop(self) -> None:
+        """Objecter op-retry ticker: any op still in flight past its
+        jittered exponential-backoff deadline is re-sent (a dropped
+        frame or a silently dead primary otherwise strands it until a
+        map change).  PGs under an active MOSDBackoff are skipped —
+        the OSD parked the op and will answer; resending would spam a
+        peering PG (exactly what backoff exists to stop).
+
+        The same ticker renews the map subscription
+        (MonClient::renew_subs): publication is fire-and-forget, so
+        an epoch silently lost to a partition or dropped frame would
+        otherwise leave this client stale until the next commit."""
+        renew_at = 0.0
+        while True:
+            await asyncio.sleep(0.1)
+            now = asyncio.get_running_loop().time()
+            if now >= renew_at:
+                renew_at = now + self.ctx.conf[
+                    "mon_subscribe_renew_interval"]
+                self.msgr.send_to(
+                    self.mon_addr,
+                    MMonSubscribe(start=self.osdmap.epoch + 1),
+                    entity_hint="mon.0")
+            for op in list(self._inflight.values()):
+                if not op.oid or op.future.done():
+                    continue    # pg-targeted (pgls) ops are fire-once
+                if op.next_resend > now or self._backed_off(op):
+                    continue
+                self._send_op(op)
 
     def _handle_reply(self, msg: MOSDOpReply) -> None:
         op = self._inflight.pop(msg.tid, None)
@@ -290,7 +418,13 @@ class RadosClient:
         cmd.update(args)
         deadline = asyncio.get_running_loop().time() + timeout
         last_exc = None
-        for _attempt in range(4 * len(self.mon_addrs)):
+        # hunting ramp: early retries are quick (a peon redirect
+        # usually resolves in one hop), later ones back off so a
+        # quorum-less cluster is not hammered (MonClient
+        # reopen_session backoff)
+        hunt = ExpBackoff(base=0.5, cap=2.0, rng=self.rng)
+        redirect = ExpBackoff(base=0.1, cap=1.0, rng=self.rng)
+        for _attempt in range(6 * len(self.mon_addrs)):
             left = deadline - asyncio.get_running_loop().time()
             if left <= 0:
                 break
@@ -303,7 +437,7 @@ class RadosClient:
                               entity_hint="mon.0")
             try:
                 result, out = await asyncio.wait_for(
-                    fut, min(2.0, left))
+                    fut, min(max(hunt.next_delay(), 0.5), left))
             except asyncio.TimeoutError as e:
                 last_exc = e
                 self._next_mon()
@@ -316,7 +450,7 @@ class RadosClient:
                     self._mon_i = self.mon_addrs.index(leader)
                 else:
                     self._next_mon()
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(min(redirect.next_delay(), left))
                 continue
             if result != 0:
                 raise RadosError(result, out)
@@ -327,11 +461,20 @@ class RadosClient:
 
     async def wait_for_epoch(self, epoch: int,
                              timeout: float = 10.0) -> None:
-        t0 = asyncio.get_running_loop().time()
-        while self.osdmap is None or self.osdmap.epoch < epoch:
-            if asyncio.get_running_loop().time() - t0 > timeout:
-                raise TimeoutError("epoch %d not reached" % epoch)
-            await asyncio.sleep(0.02)
+        """Event-driven (no polling): _handle_map resolves the waiter
+        the moment the epoch lands."""
+        if self.osdmap is not None and self.osdmap.epoch >= epoch:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._map_waiters.append((epoch, fut))
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError("epoch %d not reached" % epoch) \
+                from None
+        finally:
+            self._map_waiters = [(e, f) for e, f in self._map_waiters
+                                 if f is not fut]
 
 
 class IoCtx:

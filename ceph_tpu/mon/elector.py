@@ -262,6 +262,30 @@ class Elector:
         self.stopped = True
         self._cancel_timer()
 
+    def note_newer_reign(self, epoch: int) -> None:
+        """A PAXOS message arrived stamped with an election epoch
+        newer than any election we took part in: a regime change
+        happened while we were partitioned away (our LEADER/PEON
+        state is stale — a healed ex-leader would otherwise sit in a
+        split brain forever, serving stale reads and never publishing
+        newer maps to its subscribers).  Adopt the newer epoch and
+        force a fresh election so leadership reconverges (the
+        reference's Monitor epoch-mismatch -> bump_epoch path)."""
+        if self.stopped or epoch <= self.epoch:
+            return
+        self.mon.ctx.log.info(
+            "mon", "%s: saw reign epoch %d > ours %d (healed "
+            "partition?): re-electing" % (self.mon.name, epoch,
+                                          self.epoch))
+        self._bump(to_epoch=epoch, electing=True)
+        self.state = ELECTING
+        self.leader = None
+        self.quorum = set()
+        self.deferred_to = None
+        self._defers = set()
+        self.mon.on_lose(-1, self.epoch)
+        self.start_election()
+
     def note_leader_alive(self) -> None:
         """Peon liveness watchdog: each lease receipt re-arms a timer;
         if leases stop (a wedged-but-connected leader that never

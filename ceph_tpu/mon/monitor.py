@@ -279,11 +279,16 @@ class Monitor:
     def on_win(self, epoch: int, quorum: set[int]) -> None:
         async def lead():
             try:
-                await self.mpaxos.leader_collect()
+                await self.mpaxos.leader_collect(reign_epoch=epoch)
             except (IOError, asyncio.TimeoutError) as e:
+                self.mpaxos.active = False
+                if "reign superseded" in str(e):
+                    # a newer election already ran while this reign's
+                    # collect waited: its winner recovers; another
+                    # election here would only churn
+                    return
                 self.ctx.log.info("mon", "%s collect failed: %s"
                                   % (self.name, e))
-                self.mpaxos.active = False
                 self.elector.start_election()
                 return
             self._publish()
@@ -441,7 +446,8 @@ class Monitor:
                     f: getattr(msg, f)
                     for f in ("pn", "version", "blob",
                               "last_committed", "first_committed",
-                              "lease_until", "uncommitted", "epoch")})
+                              "lease_until", "uncommitted", "epoch",
+                              "accepted_pn")})
             return True
         from ..msg.messages import MOSDPGTemp
         if isinstance(msg, (MOSDBoot, MOSDFailure, MOSDAlive,
@@ -454,10 +460,19 @@ class Monitor:
         if isinstance(msg, MMonGetMap):
             self._send_map(conn, msg.have)
         elif isinstance(msg, MMonSubscribe):
-            self.subscribers[conn] = min(msg.start - 1,
-                                         self.osdmap.epoch)
-            self._send_map(conn, msg.start - 1)
-            self.subscribers[conn] = self.osdmap.epoch
+            have = msg.start - 1
+            if have < self.osdmap.epoch or have <= 0:
+                # behind us (or a fresh session, which must get SOME
+                # map back — connect() proves the link by it even on
+                # an epoch-0 cluster)
+                self._send_map(conn, have)
+                self.subscribers[conn] = self.osdmap.epoch
+            else:
+                # renewal from a subscriber at (or past) our epoch:
+                # nothing to send — record ITS epoch so publication
+                # resumes from there once we catch up (a lagging
+                # ex-partitioned mon must not replay stale epochs)
+                self.subscribers[conn] = have
             # centralized config rides the subscription (MConfig on
             # session open, ConfigMonitor::check_sub)
             self.config_mon.push(conn, conn.peer_entity or "client")

@@ -188,7 +188,7 @@ class PaxosRound:
     """Leader-side bookkeeping for one collect or begin phase."""
 
     __slots__ = ("pn", "version", "acks", "done", "uncommitted",
-                 "peer_max_lc")
+                 "peer_max_lc", "superseded")
 
     def __init__(self, pn: int, version: int | None = None):
         self.pn = pn
@@ -197,6 +197,11 @@ class PaxosRound:
         self.done = asyncio.Future()
         self.uncommitted: tuple[int, int, bytes] | None = None
         self.peer_max_lc = 0
+        # highest accepted_pn a peer reported ABOVE our pn: a reign
+        # we were partitioned through promised higher — retry the
+        # collect from a pn past it (Paxos.cc handle_collect OP_LAST
+        # with higher pn semantics)
+        self.superseded = 0
 
 
 class MultiPaxos:
@@ -235,46 +240,68 @@ class MultiPaxos:
 
     # -- leader ------------------------------------------------------------
 
-    async def leader_collect(self) -> None:
-        """Recovery phase after winning an election."""
+    async def leader_collect(self, reign_epoch: int | None = None
+                             ) -> None:
+        """Recovery phase after winning an election.  Retries with a
+        higher pn when a peer's OP_LAST reveals a bigger accepted_pn
+        (an interim reign we were partitioned through promised past
+        us — without the retry every collect is silently ignored and
+        recovery livelocks in 10s election churn).  ``reign_epoch``
+        fences stale queued collects: if another election superseded
+        this reign while we waited for the lock, abort instead of
+        collecting for a dead reign."""
         async with self._lock:
-            pn = self.px._next_pn()
-            self.px.store_accepted_pn(pn)
-            # Latch this reign's pn: _begin proposes at exactly this pn
-            # and refuses if a rival collect has moved accepted_pn past
-            # it (Paxos.cc keeps begin at the collect-phase pn; a stale
-            # co-leader re-using a rival's pn could otherwise commit a
-            # different value at the same version — split brain).
-            self._reign_pn = pn
-            rnd = PaxosRound(pn)
-            rnd.acks.add(self.mon.rank)
-            self._round = rnd
-            for r in self._peers():
-                self.mon.send_paxos(
-                    r, "collect", pn=pn,
-                    last_committed=self.px.last_committed,
-                    first_committed=self.px.first_committed)
-            if len(rnd.acks) < self._majority():
-                await asyncio.wait_for(rnd.done, timeout=10.0)
-            # a peer ahead of us means a previous reign committed past
-            # our log: its OP_LAST triggered a catch-up; wait for those
-            # commits to land before taking over (otherwise we would
-            # re-propose a stale value at an already-taken version and
-            # livelock in election churn)
-            deadline = asyncio.get_event_loop().time() + 10.0
-            while self.px.last_committed < rnd.peer_max_lc:
-                if asyncio.get_event_loop().time() > deadline:
-                    self._round = None
-                    raise IOError("paxos: catch-up from peers "
-                                  "timed out")
-                await asyncio.sleep(0.05)
-            # re-propose any uncommitted value from the previous reign
-            unc = rnd.uncommitted or self.px.uncommitted()
+            el = getattr(self.mon, "elector", None)
+            for _attempt in range(4):
+                if el is not None and reign_epoch is not None \
+                        and el.epoch != reign_epoch:
+                    raise IOError("paxos: reign superseded")
+                pn = self.px._next_pn()
+                self.px.store_accepted_pn(pn)
+                # Latch this reign's pn: _begin proposes at exactly
+                # this pn and refuses if a rival collect has moved
+                # accepted_pn past it (Paxos.cc keeps begin at the
+                # collect-phase pn; a stale co-leader re-using a
+                # rival's pn could otherwise commit a different value
+                # at the same version — split brain).
+                self._reign_pn = pn
+                rnd = PaxosRound(pn)
+                rnd.acks.add(self.mon.rank)
+                self._round = rnd
+                for r in self._peers():
+                    self.mon.send_paxos(
+                        r, "collect", pn=pn,
+                        last_committed=self.px.last_committed,
+                        first_committed=self.px.first_committed)
+                if len(rnd.acks) < self._majority():
+                    await asyncio.wait_for(rnd.done, timeout=10.0)
+                if rnd.superseded > pn:
+                    # adopt the higher promise base and re-collect
+                    self.px.store_accepted_pn(rnd.superseded)
+                    continue
+                # a peer ahead of us means a previous reign committed
+                # past our log: its OP_LAST triggered a catch-up; wait
+                # for those commits to land before taking over
+                # (otherwise we would re-propose a stale value at an
+                # already-taken version and livelock in election churn)
+                deadline = asyncio.get_event_loop().time() + 10.0
+                while self.px.last_committed < rnd.peer_max_lc:
+                    if asyncio.get_event_loop().time() > deadline:
+                        self._round = None
+                        raise IOError("paxos: catch-up from peers "
+                                      "timed out")
+                    await asyncio.sleep(0.05)
+                # re-propose any uncommitted value from the prior reign
+                unc = rnd.uncommitted or self.px.uncommitted()
+                self._round = None
+                self.active = True
+                if unc is not None \
+                        and unc[0] == self.px.last_committed + 1:
+                    await self._begin(unc[2])
+                self._start_lease()
+                return
             self._round = None
-            self.active = True
-            if unc is not None and unc[0] == self.px.last_committed + 1:
-                await self._begin(unc[2])
-            self._start_lease()
+            raise IOError("paxos: collect lost %d pn races" % 4)
 
     async def propose(self, blob: bytes) -> int:
         """Leader-only: replicate one value; returns its version."""
@@ -352,23 +379,49 @@ class MultiPaxos:
             # restarted mon with a stale epoch can still converge
             if op not in ("commit", "catchup") and epoch < el.epoch:
                 return
+            if op == "lease" and epoch > el.epoch:
+                # a steady-state leadership assertion from a reign we
+                # never elected: we were partitioned through a regime
+                # change — rejoin via a fresh election (heals the
+                # stale-ex-leader split brain, whose subscribers
+                # would otherwise never see the newer reign's maps).
+                # Only leases trigger this: in-flight round messages
+                # (collect/last/begin/accept) can legitimately carry
+                # a newer stamp mid-election, and re-electing on them
+                # would churn instead of converge.
+                el.note_newer_reign(epoch)
+                return
             if op in ("begin", "lease") and epoch == el.epoch \
                     and el.leader is not None \
                     and src_rank != el.leader:
                 return
         if op == "collect":
             pn = f["pn"]
-            if pn > self.px.accepted_pn:
+            promised = pn > self.px.accepted_pn
+            if promised:
                 self.px.store_accepted_pn(pn)
-                unc = self.px.uncommitted()
-                self.mon.send_paxos(
-                    src_rank, "last", pn=pn,
-                    last_committed=self.px.last_committed,
-                    uncommitted=(list(unc[:2]) + [unc[2]]
-                                 if unc else None))
+            unc = self.px.uncommitted() if promised else None
+            # ALWAYS reply, echoing our accepted_pn: a silent refusal
+            # of a low pn (a healed ex-leader whose pn generator never
+            # saw the interim reign's promises) would livelock its
+            # recovery — the reply lets it retry from a higher pn
+            self.mon.send_paxos(
+                src_rank, "last", pn=pn,
+                last_committed=self.px.last_committed,
+                accepted_pn=self.px.accepted_pn,
+                uncommitted=(list(unc[:2]) + [unc[2]]
+                             if unc else None))
         elif op == "last":
             rnd = self._round
             if rnd is None or f["pn"] != rnd.pn:
+                return
+            apn = f.get("accepted_pn") or 0
+            if apn > rnd.pn:
+                # the peer promised a higher reign than our collect:
+                # no promise for us — retry past its pn
+                rnd.superseded = max(rnd.superseded, apn)
+                if not rnd.done.done():
+                    rnd.done.set_result(None)
                 return
             rnd.acks.add(src_rank)
             unc = f.get("uncommitted")

@@ -4,6 +4,7 @@ Analog of src/msg/ (Messenger/Connection/Dispatcher/Policy) — see
 messenger.py for the transport and messages.py for the wire types.
 """
 
+from .faults import FaultInjector, FaultRule
 from .message import Message, decode_message, encode_message, register
 from .messenger import Connection, Messenger, Policy
 
@@ -14,4 +15,5 @@ from . import messages  # noqa: F401  (registry side effect)
 __all__ = [
     "Message", "register", "encode_message", "decode_message",
     "Messenger", "Connection", "Policy",
+    "FaultInjector", "FaultRule",
 ]

@@ -45,7 +45,8 @@ class MMonPaxos(Message):
     commit|lease|catchup."""
     TYPE = "mon_paxos"
     FIELDS = ("op", "rank", "pn", "version", "blob", "last_committed",
-              "first_committed", "lease_until", "uncommitted", "epoch")
+              "first_committed", "lease_until", "uncommitted", "epoch",
+              "accepted_pn")
 
 
 # -- monitor <-> anyone ----------------------------------------------------
@@ -168,6 +169,20 @@ class MOSDOp(Message):
 class MOSDOpReply(Message):
     TYPE = "osd_op_reply"
     FIELDS = ("tid", "result", "outs", "epoch", "version")
+
+
+@register
+class MOSDBackoff(Message):
+    """OSD -> client PG backoff (MOSDBackoff.h / the osd_backoff
+    machinery): op = "block" tells the client to stop re-sending ops
+    that target the PG (it is peering / below min_size and the op is
+    parked server-side); op = "unblock" releases it.  id is the OSD's
+    monotonically increasing backoff id — an unblock releases only
+    blocks with id <= its own, so a stale unblock cannot cancel a
+    newer block."""
+
+    TYPE = "osd_backoff"
+    FIELDS = ("pool", "ps", "op", "id", "epoch")
 
 
 @register

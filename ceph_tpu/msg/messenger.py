@@ -25,7 +25,14 @@ state.
 
 Fault injection: set ``inject_socket_failures`` to N>0 to abort roughly
 one in N frame writes (ms_inject_socket_failures,
-src/common/options/global.yaml.in:1242) — the thrasher's lever.
+src/common/options/global.yaml.in:1242), driven by each connection's
+seeded RNG so a failure schedule replays.  For richer, per-peer-pair
+faults (drop/delay/duplicate/reorder/partition) install a
+``faults.FaultInjector`` on ``messenger.fault_injector`` — the
+thrasher's lever.  On connections with a resend policy, drop and
+reorder are escalated to transport aborts (the frame is withheld and
+the session replay path redelivers it): silently losing a frame there
+would break the lossless contract the session machinery guarantees.
 """
 
 from __future__ import annotations
@@ -135,6 +142,9 @@ class Connection:
         self.peer_entity = ""           # learned in handshake
         self.peer_nonce = -1            # detects peer restarts
         self.policy = policy
+        # per-connection seeded RNG: inject_socket_failures draws from
+        # it so a failure schedule is replayable per peer pair
+        self.rng = msgr._conn_rng(peer_addr or "inbound")
         self.out_seq = 0
         self.in_seq = 0
         self.unacked: list[tuple[int, bytes]] = []
@@ -298,29 +308,81 @@ class Connection:
 
     async def _write_frames(self, writer, framer=None,
                             comp=None) -> None:
+        async def emit(tag: int, payload: bytes) -> None:
+            if comp is not None and tag == TAG_MSG:
+                # compress-then-encrypt; 1-byte flag says whether
+                # this frame actually compressed (small or
+                # incompressible payloads ride raw)
+                if len(payload) >= 512:
+                    blob = comp.compress(payload)
+                    payload = (b"\x01" + blob
+                               if len(blob) < len(payload)
+                               else b"\x00" + payload)
+                else:
+                    payload = b"\x00" + payload
+            if framer is not None:
+                # the tag rides as AEAD associated data: relabeled
+                # frames fail the MAC at the receiver
+                payload = framer.seal(payload, bytes([tag]))
+            await _write_frame(writer, tag, payload)
+
+        held: list[tuple[int, bytes]] = []  # reordered frames
         while True:
+            if held and self.out_q.empty():
+                # nothing left to overtake the held frames: flush now
+                # rather than strand them behind an idle queue
+                try:
+                    flush, held = held, []
+                    for htag, hpayload in flush:
+                        await emit(htag, hpayload)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    return
             tag, payload = await self.out_q.get()
             try:
-                if (self.msgr.inject_socket_failures and
-                        random.randrange(
-                            self.msgr.inject_socket_failures) == 0):
-                    raise ConnectionError_("injected socket failure")
-                if comp is not None and tag == TAG_MSG:
-                    # compress-then-encrypt; 1-byte flag says whether
-                    # this frame actually compressed (small or
-                    # incompressible payloads ride raw)
-                    if len(payload) >= 512:
-                        blob = comp.compress(payload)
-                        payload = (b"\x01" + blob
-                                   if len(blob) < len(payload)
-                                   else b"\x00" + payload)
-                    else:
-                        payload = b"\x00" + payload
-                if framer is not None:
-                    # the tag rides as AEAD associated data: relabeled
-                    # frames fail the MAC at the receiver
-                    payload = framer.seal(payload, bytes([tag]))
-                await _write_frame(writer, tag, payload)
+                act = None
+                if tag == TAG_MSG:
+                    if (self.msgr.inject_socket_failures and
+                            self.rng.randrange(
+                                self.msgr.inject_socket_failures) == 0):
+                        raise ConnectionError_(
+                            "injected socket failure")
+                    inj = self.msgr.fault_injector
+                    if inj is not None:
+                        act = inj.on_send(self.msgr.entity,
+                                          self.peer_entity or "?")
+                        if act.abort:
+                            raise ConnectionError_("injected abort")
+                        if act.drop or act.reorder:
+                            if self.policy.resend:
+                                # a lossless session may not silently
+                                # lose or reorder a seq: withhold the
+                                # frame and fault the transport — the
+                                # reconnect replay redelivers it in
+                                # order (ProtocolV2 semantics)
+                                raise ConnectionError_(
+                                    "injected drop (lossless: "
+                                    "escalated to transport fault)")
+                            if act.drop:
+                                continue
+                            held.append((tag, payload))
+                            continue
+                        if act.delay:
+                            # head-of-line latency: later frames queue
+                            # behind (a slow link, not a lost one)
+                            await asyncio.sleep(act.delay)
+                await emit(tag, payload)
+                if act is not None and act.dup:
+                    # re-seal: AEAD counters make byte-identical
+                    # replays unverifiable, so a duplicate is a fresh
+                    # frame carrying the same message (same seq — the
+                    # receiver's dedup absorbs it)
+                    await emit(tag, payload)
+                if held:
+                    flush, held = held, []
+                    for htag, hpayload in flush:
+                        await emit(htag, hpayload)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -348,8 +410,24 @@ class Connection:
             except Exception:
                 return  # transport fault (incl. AEAD reject) -> ends
             if tag == TAG_MSG:
+                inj = self.msgr.fault_injector
+                if inj is not None and not inj.on_recv(
+                        self.peer_entity or "?", self.msgr.entity):
+                    # receive-side partition drop: a single injector
+                    # enforces BOTH directions of a cut even when the
+                    # peer has none installed
+                    if self.policy.resend:
+                        return      # transport fault: replay later
+                    continue        # lossy: the frame vanishes
                 msg = decode_message(payload)  # poison frame = fault
-                dup = msg.seq <= self.in_seq
+                # dedup: a lossless session replays after reconnect,
+                # so anything at-or-below in_seq is a replay dup.  A
+                # lossy transport has no replay — its only duplicate
+                # source is injected back-to-back dup frames, and a
+                # window-based check would misread injected
+                # REORDERING as duplication and silently drop frames
+                dup = (msg.seq <= self.in_seq if self.policy.resend
+                       else msg.seq == self.in_seq)
                 self.in_seq = max(self.in_seq, msg.seq)
                 if self.policy.resend:
                     # ack duplicates too: the original ack may have
@@ -397,19 +475,29 @@ class Messenger:
     """Endpoint owning connections + the dispatch path."""
 
     def __init__(self, entity: str, nonce: int = 0, auth=None,
-                 compress: list[str] | None = None):
+                 compress: list[str] | None = None,
+                 seed: int | None = None):
         self.entity = entity
         self.auth = auth            # AuthContext or None (DummyAuth)
         # on-wire compression preferences (msgr2 compression_onwire
         # role): advertised in the ident, the ACCEPTOR's order picks
         # the common algorithm; empty/None disables
         self.compress_algos = list(compress or [])
+        # seeded mode: every RNG this messenger owns (nonce,
+        # per-connection failure schedules) derives deterministically
+        # from (seed, entity), so a fault run replays exactly
+        self.seed = seed
+        self.rng = (random.Random("%s|%s" % (seed, entity))
+                    if seed is not None else random.Random())
         # the nonce identifies this messenger *instance*: a restarted
         # daemon must present a different one so peers reset sessions
-        self.nonce = nonce if nonce else random.getrandbits(63)
+        self.nonce = nonce if nonce else self.rng.getrandbits(63)
         self.addr: str | None = None
         self.dispatchers: list = []
         self.inject_socket_failures = 0
+        # optional FaultInjector (msg.faults): per-peer-pair frame
+        # drop/delay/dup/reorder rules + bidirectional partitions
+        self.fault_injector = None
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[str, Connection] = {}     # by dial addr
         self._inbound: list[Connection] = []
@@ -426,6 +514,15 @@ class Messenger:
         self.peer_policy: dict[str, Policy] = {}    # by entity type
 
     # -- lifecycle ---------------------------------------------------------
+
+    def _conn_rng(self, peer_key: str) -> random.Random:
+        """A connection's RNG: deterministic per (seed, entity, peer)
+        in seeded mode so each peer pair has an independent,
+        replayable schedule; independent entropy otherwise."""
+        if self.seed is not None:
+            return random.Random("%s|%s|%s" % (self.seed, self.entity,
+                                               peer_key))
+        return random.Random(self.rng.getrandbits(64))
 
     def spawn(self, coro) -> asyncio.Task:
         """ensure_future with a strong reference held until done."""
@@ -519,6 +616,13 @@ class Messenger:
         (n,) = struct.unpack(">I", await reader.readexactly(4))
         peer_blob = await reader.readexactly(n)
         peer = denc.decode(peer_blob)
+        if self.fault_injector is not None and \
+                self.fault_injector.partitioned(
+                    self.entity, peer.get("entity", "?")):
+            # partitioned peers cannot complete a handshake: redials
+            # during a cut fail like an unreachable host would
+            raise ConnectionError_("partitioned from %s"
+                                   % peer.get("entity"))
         # acceptor's preference order picks the wire compressor
         comp = _pick_compressor(peer.get("comp") or [],
                                 self.compress_algos)
@@ -618,6 +722,9 @@ class Messenger:
                 asyncio.TimeoutError, ValueError, KeyError,
                 struct.error, RecursionError, ConnectionError_):
             return False
+        if self.fault_injector is not None and \
+                self.fault_injector.partitioned(self.entity, entity):
+            return False    # partitioned: refuse like a dead host
         nonce = peer.get("nonce", 0)
         policy = self.policy_for(entity)
         # READ-ONLY session peek: the ident reply advertises the

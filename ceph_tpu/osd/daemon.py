@@ -33,7 +33,8 @@ import time
 
 from ..msg import Messenger
 from ..msg.messenger import ms_compress_from_conf, Policy
-from ..msg.messages import (MConfig, MMonSubscribe, MOSDAlive, MOSDBoot,
+from ..msg.messages import (MConfig, MMonSubscribe, MOSDAlive,
+                            MOSDBackoff, MOSDBoot,
                             MOSDECSubOpRead, MOSDECSubOpReadReply,
                             MOSDECSubOpWrite, MOSDECSubOpWriteReply,
                             MOSDFailure, MOSDMapMsg, MOSDOp,
@@ -93,6 +94,7 @@ class OSD:
         self.stopping = False
         self._boot_sent_epoch = -1
         self._rep_tid = 0
+        self._backoff_id = 0        # monotonic MOSDBackoff ids
         self._waiting_for_map: list = []
         # heartbeat state: peer -> last seen stamp
         self.hb_last_rx: dict[int, float] = {}
@@ -112,11 +114,9 @@ class OSD:
         return addr
 
     async def wait_for_boot(self, timeout: float = 10.0) -> None:
-        t0 = time.monotonic()
-        while not self.booted:
-            if time.monotonic() - t0 > timeout:
-                raise TimeoutError("osd.%d did not boot" % self.whoami)
-            await asyncio.sleep(0.02)
+        from ..utils.backoff import wait_for
+        await wait_for(lambda: self.booted, timeout,
+                       what="osd.%d boot" % self.whoami)
 
     async def shutdown(self) -> None:
         self.stopping = True
@@ -134,9 +134,30 @@ class OSD:
 
     async def _mon_watchdog(self) -> None:
         """A peon that stops leading (or a dead mon) leaves our boot
-        unacknowledged: while unbooted, periodically re-broadcast."""
+        unacknowledged: while unbooted, re-broadcast under a jittered
+        exponential ramp (a mon outage must not see every OSD retry
+        in lockstep every second).  While booted, periodically RENEW
+        the map subscription (MonClient::renew_subs): map publication
+        is fire-and-forget, so an epoch silently lost to a partition
+        or dropped frame would otherwise leave this osd behind until
+        the next commit happens to flow."""
+        from ..utils.backoff import ExpBackoff
+        bo = ExpBackoff(base=1.0, cap=8.0, rng=self.msgr.rng)
+        renew_at = 0.0
         while not self.stopping:
-            await asyncio.sleep(1.0)
+            if self.booted:
+                bo.reset()
+                await asyncio.sleep(1.0)
+                now = time.monotonic()
+                if now >= renew_at:
+                    renew_at = now + self.ctx.conf[
+                        "mon_subscribe_renew_interval"]
+                    self.msgr.send_to(
+                        self.mon_addr,
+                        MMonSubscribe(start=self.osdmap.epoch + 1),
+                        entity_hint="mon.0")
+                continue
+            await bo.sleep()
             if not self.booted and self._boot_sent_epoch >= 0:
                 self._boot_sent_epoch = -1
                 self._send_boot()
@@ -420,6 +441,13 @@ class OSD:
             return
         pg.info.same_interval_since = self.osdmap.epoch
         pg.in_flight.clear()
+        # recovery targets that left the up/acting set die with the
+        # interval: peering only refreshes entries for peers it
+        # re-queries, so a departed osd's stale peer_missing would
+        # otherwise read as "recovery outstanding" forever (wedging
+        # active+clean) and re-kick recovery toward a ghost
+        pg.peer_missing = {o: m for o, m in pg.peer_missing.items()
+                           if o in pg.acting or o in pg.up}
         # registrations die with the interval; clients re-watch at the
         # new primary when they see the map change
         self.watches.pg_reset(pg.pool_id, pg.ps)
@@ -1110,9 +1138,33 @@ class OSD:
         self._maybe_clear_pg_temp(pg)
 
     def _requeue_waiters(self, pg: PG) -> None:
+        self._release_backoffs(pg)
         waiting, pg.waiting_for_active = pg.waiting_for_active, []
         for conn, msg in waiting:
             self._handle_op(conn, msg)
+
+    # -- client backoff (PrimaryLogPG add_backoff / osd_backoff) -----------
+
+    def _send_backoff(self, pg: PG, conn) -> None:
+        """Tell the client to stop re-sending ops for this PG: the op
+        is parked here and will be answered when the PG activates.
+        Without this, the Objecter's timeout-resend ramp would spam a
+        peering / below-min-size PG with duplicates."""
+        if conn in pg.backoffs or conn.peer_entity.startswith("osd"):
+            return
+        self._backoff_id += 1
+        pg.backoffs[conn] = self._backoff_id
+        conn.send(MOSDBackoff(pool=pg.pool_id, ps=pg.ps, op="block",
+                              id=self._backoff_id,
+                              epoch=self.osdmap.epoch))
+
+    def _release_backoffs(self, pg: PG) -> None:
+        backoffs, pg.backoffs = pg.backoffs, {}
+        for conn, bid in backoffs.items():
+            if conn.is_open:
+                conn.send(MOSDBackoff(pool=pg.pool_id, ps=pg.ps,
+                                      op="unblock", id=bid,
+                                      epoch=self.osdmap.epoch))
 
     # -- client ops --------------------------------------------------------
 
@@ -1133,16 +1185,19 @@ class OSD:
             return
         if pg.state != STATE_ACTIVE:
             pg.waiting_for_active.append((conn, msg))
+            self._send_backoff(pg, conn)
             return
         if pool.is_erasure():
             if not self._min_size_ok(pg, pool):
                 pg.waiting_for_active.append((conn, msg))
+                self._send_backoff(pg, conn)
                 return
             self.msgr.spawn(self.ec.handle_op(pg, conn, msg))
             return
         writes = any(self._op_is_write(o) for o in msg.ops)
         if not self._min_size_ok(pg, pool):
             pg.waiting_for_active.append((conn, msg))
+            self._send_backoff(pg, conn)
             return
         if any(o["op"] in ("watch", "unwatch", "notify")
                for o in msg.ops):

@@ -825,7 +825,12 @@ class ECPGBackend:
         members = []
         for osd_id in pg.acting:
             if osd_id != ITEM_NONE and osd_id >= 0 \
-                    and osd_id not in members:
+                    and osd_id not in members \
+                    and (osd_id == self.osd.whoami
+                         or self.osd.osdmap.is_up(osd_id)):
+                # map-down members cannot answer: querying them only
+                # burns the sub-read timeout per object — degraded
+                # reads and recovery go straight to live shards
                 members.append(osd_id)
         # per-version shard pools: {ver: {j: (bytes, size)}}
         by_ver: dict[tuple, dict[int, tuple]] = {}
@@ -917,7 +922,8 @@ class ECPGBackend:
         if local is not None:
             return local[4].get(name)
         members = [o for o in pg.acting
-                   if o != ITEM_NONE and 0 <= o != self.osd.whoami]
+                   if o != ITEM_NONE and 0 <= o != self.osd.whoami
+                   and self.osd.osdmap.is_up(o)]
         for osd_id in members:
             rows = (await self._sub_read(pg, oid, [osd_id])) \
                 .get(osd_id) or []

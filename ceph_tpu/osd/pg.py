@@ -165,6 +165,9 @@ class PG:
         self.peering_blocked = False   # a prior rw interval has no
         #                                live member: cannot activate
         self.waiting_up_thru = 0       # epoch our up_thru must reach
+        # conn -> backoff id: clients told to stop resending at this
+        # PG (MOSDBackoff); released when parked ops requeue
+        self.backoffs: dict = {}
 
     # -- identity ----------------------------------------------------------
 

@@ -1,0 +1,265 @@
+"""LocalCluster: one-process mon+OSD+client cluster harness.
+
+The shared substrate under tests/test_cluster.py, the thrasher and
+``python -m ceph_tpu.cli.vstart`` (the vstart.sh /
+qa/standalone/ceph-helpers.sh analog): real daemons, real wire
+protocol over loopback TCP, one event loop for determinism.
+
+Fault surface: every daemon's messenger carries a seeded
+`FaultInjector` (ceph_tpu.msg.faults) when the cluster is built with
+a seed, so partitions and frame faults are scriptable per node and a
+failure schedule replays from its seed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..client import RadosClient
+from ..mon import Monitor
+from ..msg.faults import FaultInjector
+from ..osd.daemon import OSD
+from ..utils.backoff import wait_for
+from ..utils.context import Context
+
+# dev-cluster pacing: tight heartbeats and auto-out so failure
+# handling is observable in seconds, not minutes
+FAST_CONF = {
+    "heartbeat_interval": 0.1,
+    "heartbeat_grace": 0.6,
+    "mon_osd_down_out_interval": 1.0,
+    "mon_osd_min_down_reporters": 1,
+    "osd_pool_default_pg_num": 8,
+    # EC sub-reads that race a just-killed member must widen to the
+    # survivors in ~1s, not the production 10s — at dev-cluster
+    # heartbeat pacing a thrash round would otherwise spend minutes
+    # of recovery time burning timeouts
+    "osd_ec_subop_timeout": 1.0,
+    # publications lost to a partition must be repaired within a
+    # thrash round, not the production 10s renewal period
+    "mon_subscribe_renew_interval": 2.0,
+}
+
+
+def free_ports(n: int) -> list[int]:
+    import socket
+
+    socks = []
+    for _ in range(n):
+        so = socket.socket()
+        so.bind(("127.0.0.1", 0))
+        socks.append(so)
+    ports = [so.getsockname()[1] for so in socks]
+    for so in socks:
+        so.close()
+    return ports
+
+
+class LocalCluster:
+    """n_mons monitors (a real quorum when >1) + n_osds OSDs + one
+    RadosClient.  ``seed`` arms deterministic fault injection: each
+    daemon gets a FaultInjector seeded from (seed, entity) and the
+    client's retry jitter draws from the same stream family."""
+
+    def __init__(self, n_osds: int = 3, n_mons: int = 1,
+                 conf: dict | None = None, seed: int | None = None):
+        self.n_osds = n_osds
+        self.n_mons = n_mons
+        self.conf = dict(FAST_CONF)
+        self.conf.update(conf or {})
+        self.seed = seed
+        self.mons: list[Monitor] = []
+        self.monmap: list[tuple[str, str]] = []
+        self.osds: list[OSD | None] = []
+        self.client: RadosClient | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _install_injector(self, msgr, entity: str) -> FaultInjector:
+        if self.seed is None:
+            inj = FaultInjector(0)
+        else:
+            import zlib
+            inj = FaultInjector(
+                self.seed ^ zlib.crc32(entity.encode()))
+        msgr.fault_injector = inj
+        return inj
+
+    async def start(self) -> "LocalCluster":
+        if self.n_mons > 1:
+            self.monmap = [("mon.%d" % i, "127.0.0.1:%d" % po)
+                           for i, po in
+                           enumerate(free_ports(self.n_mons))]
+            for name, _a in self.monmap:
+                mon = Monitor(Context(name, conf_overrides=self.conf),
+                              name=name, monmap=self.monmap)
+                self._install_injector(mon.msgr, name)
+                await mon.start()
+                self.mons.append(mon)
+            await self.wait_quorum()
+        else:
+            mon = Monitor(Context("mon", conf_overrides=self.conf))
+            self._install_injector(mon.msgr, "mon.0")
+            addr = await mon.start()
+            self.mons = [mon]
+            self.monmap = [("mon.0", addr)]
+        for i in range(self.n_osds):
+            await self._start_osd(i)
+        for osd in self.osds:
+            await osd.wait_for_boot()
+        self.client = RadosClient(self.mon_addrs, seed=self.seed)
+        self._install_injector(self.client.msgr, "client.0")
+        await self.client.connect()
+        return self
+
+    async def _start_osd(self, i: int, store=None) -> OSD:
+        osd = OSD(i, self.mon_addrs,
+                  Context("osd.%d" % i, conf_overrides=self.conf),
+                  store=store)
+        self._install_injector(osd.msgr, "osd.%d" % i)
+        await osd.start()
+        if i < len(self.osds):
+            self.osds[i] = osd
+        else:
+            self.osds.append(osd)
+        return osd
+
+    async def stop(self) -> None:
+        if self.client is not None:
+            await self.client.shutdown()
+        for osd in self.osds:
+            if osd is not None and not osd.stopping:
+                await osd.shutdown()
+        for mon in self.mons:
+            await mon.shutdown()
+
+    @property
+    def mon_addrs(self) -> list[str]:
+        return [a for _n, a in self.monmap]
+
+    @property
+    def live_osds(self) -> list[OSD]:
+        return [o for o in self.osds
+                if o is not None and not o.stopping]
+
+    # -- mon helpers -------------------------------------------------------
+
+    def leader(self) -> Monitor | None:
+        for m in self.mons:
+            if m.is_leader() and (m.mpaxos is None or m.mpaxos.active):
+                return m
+        return None
+
+    async def wait_quorum(self, timeout: float = 20.0) -> Monitor:
+        await wait_for(lambda: self.leader() is not None, timeout,
+                       what="mon quorum")
+        return self.leader()
+
+    def injector(self, entity: str) -> FaultInjector:
+        """The FaultInjector of a daemon's messenger by entity name
+        ("mon.1", "osd.2", "client")."""
+        if entity.startswith("mon"):
+            rank = int(entity.split(".")[1]) if "." in entity else 0
+            return self.mons[rank].msgr.fault_injector
+        if entity.startswith("osd"):
+            return self.osds[int(entity.split(".")[1])] \
+                .msgr.fault_injector
+        return self.client.msgr.fault_injector
+
+    def partition_mon(self, rank: int) -> None:
+        """Cut mon.<rank> off from every peer (mons, osds, clients):
+        a bidirectional network partition enforced by its own
+        injector (outbound frames dropped at send, inbound at
+        receive, redial handshakes refused)."""
+        self.injector("mon.%d" % rank).isolate("mon.%d" % rank)
+
+    def heal_mon(self, rank: int) -> None:
+        self.injector("mon.%d" % rank).rejoin("mon.%d" % rank)
+
+    # -- osd helpers -------------------------------------------------------
+
+    async def kill_osd(self, i: int) -> None:
+        """Hard-stop osd.i, keeping its store (the "disk")."""
+        await self.osds[i].shutdown()
+
+    async def revive_osd(self, i: int,
+                         timeout: float = 20.0) -> OSD:
+        """Restart osd.i on its surviving store with a fresh
+        messenger nonce (the reboot flow peers reset sessions for)."""
+        store = self.osds[i].store
+        osd = await self._start_osd(i, store=store)
+        await osd.wait_for_boot(timeout)
+        return osd
+
+    async def wait_osd_down(self, i: int,
+                            timeout: float = 30.0) -> None:
+        await wait_for(
+            lambda: not self.client.osdmap.is_up(i), timeout,
+            what="osd.%d down in map" % i)
+
+    async def wait_osd_up(self, i: int, timeout: float = 30.0) -> None:
+        await wait_for(lambda: self.client.osdmap.is_up(i), timeout,
+                       what="osd.%d up in map" % i)
+
+    async def mark_out(self, i: int) -> None:
+        await self.client.mon_command("osd out", id=i)
+
+    async def mark_in(self, i: int) -> None:
+        await self.client.mon_command("osd in", id=i)
+
+    # -- pools / health ----------------------------------------------------
+
+    async def create_pool(self, name: str, pg_num: int = 8,
+                          size: int | None = None,
+                          pool_type: str = "replicated",
+                          erasure_code_profile: str | None = None,
+                          ) -> int:
+        kw = {"pool": name, "pg_num": pg_num}
+        if pool_type != "replicated":
+            kw["pool_type"] = pool_type
+            if erasure_code_profile:
+                kw["erasure_code_profile"] = erasure_code_profile
+        else:
+            kw["size"] = (size if size is not None
+                          else min(3, self.n_osds))
+        out = await self.client.mon_command("osd pool create", **kw)
+        leader = self.leader()
+        if leader is not None:
+            await self.client.wait_for_epoch(leader.osdmap.epoch)
+        return out["pool_id"]
+
+    async def wait_health(self, pool_id: int,
+                          timeout: float = 30.0) -> None:
+        """Every PG of the pool active+clean on the current primaries
+        (no missing objects anywhere, epochs converged)."""
+        await wait_for(lambda: self.healthy(pool_id), timeout,
+                       what="pool %d active+clean" % pool_id)
+
+    def healthy(self, pool_id: int) -> bool:
+        from ..osd.osdmap import pg_t
+        from ..osd.pg import STATE_ACTIVE
+
+        m = None
+        for osd in self.live_osds:
+            if osd.osdmap is not None:
+                if m is None or osd.osdmap.epoch > m.epoch:
+                    m = osd.osdmap
+        if m is None or pool_id not in m.pools:
+            return False
+        pool = m.pools[pool_id]
+        alive = {o.whoami: o for o in self.live_osds}
+        for ps in range(pool.pg_num):
+            up, upp, acting, actingp = m.pg_to_up_acting_osds(
+                pg_t(pool_id, ps))
+            if actingp < 0 or actingp not in alive:
+                return False
+            prim = alive[actingp]
+            if prim.osdmap is None or prim.osdmap.epoch != m.epoch:
+                return False
+            pg = prim.pgs.get(pg_t(pool_id, ps))
+            if pg is None or pg.state != STATE_ACTIVE:
+                return False
+            if pg.missing or any(pm for pm in
+                                 pg.peer_missing.values()):
+                return False
+        return True

@@ -1,0 +1,237 @@
+"""ClusterThrasher: seeded failure schedules against a LocalCluster.
+
+The teuthology ``Thrasher`` (qa/tasks/ceph_manager.py) analog: drive
+a live cluster through OSD kills/revives, out/in weight churn,
+monitor partitions and map churn while a client `Workload` keeps
+writing — and assert, after every round, the invariants a storage
+system exists to keep:
+
+* no acknowledged write is ever lost (every acked object reads back
+  byte-identical);
+* PGs reconverge to active+clean;
+* the monitors re-form quorum.
+
+Determinism: the entire action plan (which fault, which victim, how
+long to hold it) is derived up front from ``random.Random(seed)``, so
+a failing run is reproduced by re-running with the seed it printed.
+``ClusterThrasher(cluster, seed=S).plan`` is a pure function of
+(seed, rounds, actions, cluster shape).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+
+class Workload:
+    """Continuous client writes with acked-write tracking.
+
+    Only writes whose ``write_full`` completed are recorded in
+    ``acked`` — an in-flight write lost to a fault is not a violation
+    (the client never saw the ack), a recorded one is."""
+
+    def __init__(self, io, seed: int = 0, prefix: str = "thrash",
+                 pace: float = 0.02):
+        self.io = io
+        self.prefix = prefix
+        self.pace = pace
+        self.rng = random.Random(seed)
+        self.acked: dict[str, bytes] = {}
+        self.write_failures: list[tuple[str, str]] = []
+        self._seq = 0
+        self._stop = False
+        self._task: asyncio.Task | None = None
+
+    def _payload(self, seq: int) -> bytes:
+        # content derives from the seeded rng in sequence order, so a
+        # replay writes identical bytes
+        rep = self.rng.randrange(8, 64)
+        return (b"%s|%d|" % (self.prefix.encode(), seq)) * rep
+
+    async def write_one(self, timeout: float = 30.0) -> str | None:
+        oid = "%s-%d" % (self.prefix, self._seq)
+        data = self._payload(self._seq)
+        self._seq += 1
+        try:
+            await asyncio.wait_for(self.io.write_full(oid, data),
+                                   timeout)
+        except Exception as e:            # unacked: not a loss
+            self.write_failures.append((oid, repr(e)))
+            return None
+        self.acked[oid] = data
+        return oid
+
+    async def _run(self) -> None:
+        while not self._stop:
+            await self.write_one()
+            await asyncio.sleep(self.pace)
+
+    def start(self) -> "Workload":
+        self._task = asyncio.ensure_future(self._run())
+        return self
+
+    async def stop(self) -> None:
+        self._stop = True
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, 35.0)
+            except asyncio.TimeoutError:
+                self._task.cancel()
+            self._task = None
+
+    async def verify(self, sample: int | None = None) -> None:
+        """Acknowledged writes read back byte-identical.  With
+        ``sample``, checks a seeded random subset plus the newest 50
+        (mid-thrash checks stay O(sample) while the acked set grows);
+        without it, every acked write is read back."""
+        items = list(self.acked.items())
+        if sample is not None and len(items) > sample:
+            # independent picker: must not consume self.rng (the
+            # writer derives payload content from it in seq order)
+            picker = random.Random((len(items), sample))
+            chosen = picker.sample(items[:-50], sample - 50) \
+                + items[-50:]
+        else:
+            chosen = items
+        for oid, data in chosen:
+            got = await asyncio.wait_for(self.io.read(oid), 30.0)
+            assert got == data, \
+                "acked write %s lost/corrupt (%d bytes -> %r...)" % (
+                    oid, len(data), bytes(got[:32]))
+
+
+class ClusterThrasher:
+    """Seeded rounds of cluster abuse with invariant checks.
+
+    actions: the action pool the plan draws from —
+      kill_revive   — hard-stop an OSD, write through the hole,
+                      revive it on the same store;
+      out_in        — weight an OSD out (forcing remap + recovery)
+                      and back in;
+      mon_partition — isolate one monitor bidirectionally, keep
+                      writing under the degraded quorum, heal it
+                      (multi-mon clusters only);
+      map_churn     — burn map epochs (pool create/rm) to exercise
+                      client/OSD map-chasing under load.
+    """
+
+    ALL_ACTIONS = ("kill_revive", "out_in", "mon_partition",
+                   "map_churn")
+
+    def __init__(self, cluster, seed: int = 0, rounds: int = 3,
+                 actions: tuple | list | None = None,
+                 hold: float = 0.8):
+        """``actions`` is either None (each round draws from the
+        default pool), or an explicit round list whose items are
+        action names (victim still seeded) or ``(action, arg)``
+        tuples (fully pinned); ``rounds`` is ignored when an explicit
+        list is given."""
+        self.cluster = cluster
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.hold = hold        # seconds a fault is held per round
+        # the full plan is fixed up front: deterministic per seed
+        self.plan = []
+        if actions is not None:
+            for item in actions:
+                if isinstance(item, str):
+                    self.plan.append(self._plan_one(item))
+                else:
+                    action, arg = item
+                    self._plan_one(action)  # burn rng identically
+                    self.plan.append((action, arg))
+        else:
+            pool = self._default_actions()
+            for _ in range(rounds):
+                self.plan.append(
+                    self._plan_one(self.rng.choice(pool)))
+        self.log: list[str] = []
+
+    def _default_actions(self) -> list[str]:
+        acts = ["kill_revive", "out_in", "map_churn"]
+        if self.cluster.n_mons >= 3:
+            acts.append("mon_partition")
+        return acts
+
+    def _plan_one(self, action: str) -> tuple:
+        if action == "kill_revive":
+            return (action, self.rng.randrange(self.cluster.n_osds))
+        if action == "out_in":
+            return (action, self.rng.randrange(self.cluster.n_osds))
+        if action == "mon_partition":
+            # never plan an isolated majority: one rank only
+            return (action, self.rng.randrange(self.cluster.n_mons))
+        if action == "map_churn":
+            return (action, self.rng.randrange(1 << 16))
+        raise ValueError("unknown thrash action %r" % action)
+
+    # -- execution ---------------------------------------------------------
+
+    async def run(self, pool_ids, workloads) -> None:
+        """Execute the plan round by round, checking invariants after
+        each (every pool active+clean, every workload's acked writes
+        intact, quorum re-formed).  ``pool_ids``/``workloads`` accept
+        a single item or a list.  On any failure the seed is printed
+        so the schedule can be replayed exactly."""
+        pool_ids = (list(pool_ids) if isinstance(pool_ids, (list,
+                                                            tuple))
+                    else [pool_ids])
+        workloads = (list(workloads) if isinstance(workloads,
+                                                   (list, tuple))
+                     else [workloads])
+        try:
+            for n, step in enumerate(self.plan):
+                self.log.append("round %d: %s" % (n, (step,)))
+                await self._dispatch(step, workloads[0])
+                await self._check_invariants(pool_ids, workloads)
+        except BaseException:
+            print("THRASH FAILED: seed=%r plan=%r log=%r"
+                  % (self.seed, self.plan, self.log))
+            raise
+
+    async def _dispatch(self, step: tuple, workload: Workload) -> None:
+        action, arg = step
+        c = self.cluster
+        if action == "kill_revive":
+            victim = arg
+            await c.kill_osd(victim)
+            await c.wait_osd_down(victim)
+            await asyncio.sleep(self.hold)      # degraded writes
+            await c.revive_osd(victim)
+            await c.wait_osd_up(victim)
+        elif action == "out_in":
+            victim = arg
+            await c.mark_out(victim)
+            await asyncio.sleep(self.hold)      # remap + backfill
+            await c.mark_in(victim)
+        elif action == "mon_partition":
+            rank = arg
+            c.partition_mon(rank)
+            # a structural leader() check would trust a partitioned
+            # leader that does not yet know it lost quorum: probe
+            # with a real command, which only a mon that can reach a
+            # majority answers (survivors re-elect if the victim led)
+            await asyncio.sleep(self.hold)
+            await c.client.mon_command("status", timeout=30.0)
+            assert (await workload.write_one()) is not None, \
+                "write could not complete under mon partition"
+            c.heal_mon(rank)
+            await c.wait_quorum()
+            await c.client.mon_command("status", timeout=30.0)
+        elif action == "map_churn":
+            name = "churn-%d" % arg
+            await c.client.mon_command("osd pool create", pool=name,
+                                       pg_num=1, size=1)
+            await c.client.mon_command("osd pool rm", pool=name)
+        else:
+            raise ValueError(action)
+
+    async def _check_invariants(self, pool_ids: list,
+                                workloads: list) -> None:
+        c = self.cluster
+        await c.wait_quorum()
+        for pool_id in pool_ids:
+            await c.wait_health(pool_id, timeout=120.0)
+        for wl in workloads:
+            await wl.verify(sample=300)

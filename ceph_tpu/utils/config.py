@@ -233,6 +233,9 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("mon_osd_min_down_reporters", OPT_INT, 1,
            "distinct reporters required to mark an osd down"),
     Option("mon_lease", OPT_FLOAT, 5.0, "paxos lease duration (s)"),
+    Option("mon_subscribe_renew_interval", OPT_FLOAT, 10.0,
+           "map-subscription renewal period (s): repairs silently "
+           "lost publications (partitions, dropped frames)"),
     Option("mon_election_strategy", OPT_STR, "classic",
            "leader election strategy (ElectionLogic modes)",
            enum_allowed=("classic", "disallow", "connectivity")),

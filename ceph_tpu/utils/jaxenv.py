@@ -17,6 +17,23 @@ same fragile recipe, kept here so they cannot drift:
 from __future__ import annotations
 
 
+def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+    """shard_map across JAX versions: new releases export
+    ``jax.shard_map`` (replication checking flag ``check_vma``), older
+    ones only ``jax.experimental.shard_map.shard_map``
+    (``check_rep``).  Checking is disabled either way — pallas_call
+    results carry no replication annotation."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs,
+               out_specs=out_specs, check_rep=False)
+
+
 def force_virtual_cpu_env(env: dict, n_devices: int) -> dict:
     """Mutate ``env`` (an os.environ-like mapping) so a JAX process
     started with it sees an ``n_devices``-device CPU platform once it

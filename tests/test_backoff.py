@@ -1,0 +1,69 @@
+"""ExpBackoff / wait helpers: ramp shape, jitter bounds, determinism."""
+
+import asyncio
+import random
+
+import pytest
+
+from ceph_tpu.utils.backoff import ExpBackoff, event_wait_for, wait_for
+
+
+def test_ramp_doubles_and_caps():
+    bo = ExpBackoff(base=0.1, cap=1.0, rng=random.Random(1))
+    intervals = []
+    for _ in range(8):
+        intervals.append(bo.peek())
+        bo.next_delay()
+    assert intervals[:4] == [0.1, 0.2, 0.4, 0.8]
+    assert all(i == 1.0 for i in intervals[5:])
+    bo.reset()
+    assert bo.peek() == 0.1
+
+
+def test_jitter_within_half_to_full_interval():
+    bo = ExpBackoff(base=0.2, cap=0.2, rng=random.Random(7))
+    for _ in range(50):
+        d = bo.next_delay()
+        assert 0.1 <= d <= 0.2
+
+
+def test_seeded_delays_deterministic():
+    a = ExpBackoff(base=0.05, cap=2.0, rng=random.Random(99))
+    b = ExpBackoff(base=0.05, cap=2.0, rng=random.Random(99))
+    assert [a.next_delay() for _ in range(10)] == \
+        [b.next_delay() for _ in range(10)]
+
+
+def test_wait_for_resolves_and_times_out():
+    async def main():
+        state = {"n": 0}
+
+        def pred():
+            state["n"] += 1
+            return state["n"] >= 3
+
+        await wait_for(pred, timeout=5.0, base=0.001)
+        with pytest.raises(TimeoutError):
+            await wait_for(lambda: False, timeout=0.05, base=0.001,
+                           what="never")
+
+    asyncio.run(main())
+
+
+def test_event_wait_for_wakes_on_signal():
+    async def main():
+        ev = asyncio.Event()
+        state = {"ok": False}
+
+        async def fire():
+            await asyncio.sleep(0.05)
+            state["ok"] = True
+            ev.set()
+
+        asyncio.ensure_future(fire())
+        await event_wait_for(ev, lambda: state["ok"], timeout=5.0)
+        with pytest.raises(TimeoutError):
+            await event_wait_for(asyncio.Event(), lambda: False,
+                                 timeout=0.05, what="never")
+
+    asyncio.run(main())

@@ -3,16 +3,18 @@
 The framework's fake-cluster tier (SURVEY §4.2/§4.3): real daemons and
 real wire protocol over loopback TCP, in-process for determinism —
 the moral equivalent of qa/standalone/ceph-helpers.sh run_mon/run_osd
-plus librados_test_stub's in-process convenience.
+plus librados_test_stub's in-process convenience.  The harness itself
+lives in ceph_tpu.testing.cluster (shared with the thrasher and the
+vstart CLI); this file keeps the end-to-end scenarios.
 """
 
 import asyncio
 
 import pytest
 
-from ceph_tpu.client import ObjectNotFound, RadosClient
-from ceph_tpu.mon import Monitor
+from ceph_tpu.client import ObjectNotFound
 from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.testing.cluster import FAST_CONF, LocalCluster
 from ceph_tpu.utils.context import Context
 
 
@@ -20,87 +22,24 @@ def run(coro, timeout=60):
     return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
 
 
-FAST_CONF = {
-    "heartbeat_interval": 0.1,
-    "heartbeat_grace": 0.6,
-    "mon_osd_down_out_interval": 1.0,
-    "mon_osd_min_down_reporters": 1,
-    "osd_pool_default_pg_num": 8,
-}
-
-
-class Cluster:
-    """Test harness: one mon + n OSDs (vstart.sh analog)."""
+class Cluster(LocalCluster):
+    """Back-compat shim: the scenarios below predate LocalCluster and
+    address the single monitor as ``c.mon``."""
 
     def __init__(self, n_osds=3):
-        self.n_osds = n_osds
-        self.mon = None
-        self.osds = []
-        self.client = None
+        super().__init__(n_osds=n_osds)
 
-    async def start(self):
-        self.mon = Monitor(Context("mon", conf_overrides=FAST_CONF))
-        await self.mon.start()
-        for i in range(self.n_osds):
-            osd = OSD(i, self.mon.addr,
-                      Context("osd.%d" % i, conf_overrides=FAST_CONF))
-            await osd.start()
-            self.osds.append(osd)
-        for osd in self.osds:
-            await osd.wait_for_boot()
-        self.client = RadosClient(self.mon.addr)
-        await self.client.connect()
-        return self
+    @property
+    def mon(self):
+        return self.mons[0]
 
-    async def stop(self):
-        if self.client:
-            await self.client.shutdown()
-        for osd in self.osds:
-            if not osd.stopping:
-                await osd.shutdown()
-        await self.mon.shutdown()
-
-    async def kill_osd(self, i):
-        await self.osds[i].shutdown()
-
-    async def wait_health(self, pool_id, timeout=20):
-        """Wait until every PG of the pool is active and clean on the
-        current primaries."""
-        t0 = asyncio.get_running_loop().time()
-        while True:
-            if self._healthy(pool_id):
-                return
-            if asyncio.get_running_loop().time() - t0 > timeout:
-                raise TimeoutError("pool %d never went clean" % pool_id)
-            await asyncio.sleep(0.05)
-
-    def _healthy(self, pool_id):
-        from ceph_tpu.osd.osdmap import pg_t
-        from ceph_tpu.osd.pg import STATE_ACTIVE
-
-        m = None
-        for osd in self.osds:
-            if not osd.stopping and osd.osdmap is not None:
-                if m is None or osd.osdmap.epoch > m.epoch:
-                    m = osd.osdmap
-        if m is None or pool_id not in m.pools:
-            return False
-        pool = m.pools[pool_id]
-        alive = {o.whoami: o for o in self.osds if not o.stopping}
-        for ps in range(pool.pg_num):
-            up, upp, acting, actingp = m.pg_to_up_acting_osds(
-                pg_t(pool_id, ps))
-            if actingp < 0 or actingp not in alive:
-                return False
-            prim = alive[actingp]
-            if prim.osdmap is None or prim.osdmap.epoch != m.epoch:
-                return False
-            pg = prim.pgs.get(pg_t(pool_id, ps))
-            if pg is None or pg.state != STATE_ACTIVE:
-                return False
-            if pg.missing or any(pm for pm in pg.peer_missing.values()):
-                return False
-        return True
+    @mon.setter
+    def mon(self, value):
+        # some scenarios hand-boot the monitor before start()
+        if self.mons:
+            self.mons[0] = value
+        else:
+            self.mons = [value]
 
 
 def test_cluster_boot_and_pool_create():

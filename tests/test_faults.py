@@ -1,0 +1,317 @@
+"""Fault-injection layer tests: seeded frame faults, lossless-peer
+session replay under drops/duplicates, partitions, and schedule
+determinism (tests/msgr fault coverage the seed never had)."""
+
+import asyncio
+
+from ceph_tpu.msg import FaultInjector, Messenger, Policy
+from ceph_tpu.msg.messages import MOSDOpReply, MPing, MPong
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+class Collector:
+    def __init__(self):
+        self.got = []
+        self.resets = 0
+
+    def ms_dispatch(self, conn, msg):
+        self.got.append(msg)
+        return True
+
+    def ms_handle_reset(self, conn):
+        self.resets += 1
+
+
+class Echo(Collector):
+    def ms_dispatch(self, conn, msg):
+        if isinstance(msg, MPing):
+            conn.send(MPong(stamp=msg.stamp))
+            return True
+        return super().ms_dispatch(conn, msg)
+
+
+async def _lossless_pair(seed=1):
+    server = Messenger("osd.0", seed=seed)
+    server.peer_policy["osd"] = Policy.lossless_peer()
+    await server.bind()
+    sink = Collector()
+    server.add_dispatcher(sink)
+    client = Messenger("osd.1", seed=seed)
+    client.peer_policy["osd"] = Policy.lossless_peer()
+    return server, sink, client
+
+
+async def _drain(sink, n, timeout=30.0):
+    t0 = asyncio.get_running_loop().time()
+    while len(sink.got) < n:
+        assert asyncio.get_running_loop().time() - t0 < timeout, \
+            "only %d/%d messages arrived" % (len(sink.got), n)
+        await asyncio.sleep(0.02)
+
+
+# -- lossless session replay under injected faults -------------------------
+
+
+def test_lossless_replay_under_injected_drops():
+    """Frame drops on a lossless peer escalate to transport faults;
+    _replay_unacked redelivers every message exactly once, in order
+    (the unacked-queue + receiver seq-dedup contract)."""
+
+    async def main():
+        server, sink, client = await _lossless_pair()
+        inj = FaultInjector(seed=123)
+        inj.add_rule(src="osd.1", dst="osd.0", drop=0.25)
+        client.fault_injector = inj
+        conn = client.connect_to(server.addr, entity_hint="osd.0")
+        n = 60
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        await _drain(sink, n)
+        assert [m.tid for m in sink.got] == list(range(n))
+        assert inj.frames_dropped > 0, "schedule injected nothing"
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_lossless_dedup_under_injected_duplicates():
+    """Duplicated frames carry the same seq; the receiver delivers
+    each message exactly once (ProtocolV2 in_seq dedup)."""
+
+    async def main():
+        server, sink, client = await _lossless_pair()
+        inj = FaultInjector(seed=5)
+        inj.add_rule(src="osd.1", dst="osd.0", dup=0.5)
+        client.fault_injector = inj
+        conn = client.connect_to(server.addr, entity_hint="osd.0")
+        n = 40
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        await _drain(sink, n)
+        # exactly once, in order, despite >0 duplicated frames
+        assert [m.tid for m in sink.got] == list(range(n))
+        assert inj.frames_duplicated > 0
+        await asyncio.sleep(0.1)    # late dups must not re-deliver
+        assert len(sink.got) == n
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_lossless_replay_under_drops_and_duplicates():
+    """The satellite case: drops AND duplicates together — replay
+    redelivers the dropped, dedup absorbs both the injected dups and
+    the replay-overlap dups."""
+
+    async def main():
+        server, sink, client = await _lossless_pair()
+        inj = FaultInjector(seed=99)
+        inj.add_rule(src="osd.1", dst="osd.0", drop=0.15, dup=0.3)
+        client.fault_injector = inj
+        conn = client.connect_to(server.addr, entity_hint="osd.0")
+        n = 50
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        await _drain(sink, n)
+        assert [m.tid for m in sink.got] == list(range(n))
+        assert inj.frames_dropped > 0 and inj.frames_duplicated > 0
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_injected_abort_replays_like_socket_failure():
+    """abort rules behave like the legacy inject_socket_failures knob
+    but per-pair and seeded."""
+
+    async def main():
+        server, sink, client = await _lossless_pair()
+        inj = FaultInjector(seed=7)
+        inj.add_rule(src="osd.1", dst="osd.0", abort=0.2)
+        client.fault_injector = inj
+        conn = client.connect_to(server.addr, entity_hint="osd.0")
+        n = 40
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        await _drain(sink, n)
+        assert [m.tid for m in sink.got] == list(range(n))
+        assert inj.aborts > 0
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+# -- lossy-path faults ------------------------------------------------------
+
+
+def test_lossy_drop_and_reorder():
+    """On a lossy connection drops lose frames (callers own retry) and
+    reorder swaps delivery order — neither kills the transport."""
+
+    async def main():
+        server = Messenger("osd.0")
+        await server.bind()
+        sink = Collector()
+        server.add_dispatcher(sink)
+        client = Messenger("client.1")
+        inj = FaultInjector(seed=21)
+        # drop exactly via schedule; reorder the rest aggressively
+        inj.add_rule(src="client.1", dst="osd.0", reorder=0.5)
+        client.fault_injector = inj
+        conn = client.connect_to(server.addr)
+        n = 30
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        await _drain(sink, n)
+        tids = [m.tid for m in sink.got]
+        assert sorted(tids) == list(range(n))
+        if inj.frames_reordered:
+            assert tids != list(range(n)), \
+                "reordered frames still delivered in order"
+        assert conn.is_open
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_partition_blocks_then_heals():
+    """A partition drops traffic in BOTH directions with the injector
+    installed on one side only; healing restores delivery."""
+
+    async def main():
+        server = Messenger("mon.0")
+        inj = FaultInjector(seed=3)
+        server.fault_injector = inj
+        await server.bind()
+        sink = Echo()
+        server.add_dispatcher(sink)
+        client = Messenger("client.1")
+        col = Collector()
+        client.add_dispatcher(col)
+        conn = client.connect_to(server.addr)
+        conn.send(MPing(stamp=1.0))
+        await _drain(col, 1)
+
+        inj.isolate("mon.0")
+        conn.send(MPing(stamp=2.0))
+        await asyncio.sleep(0.3)
+        assert len(col.got) == 1, "frame crossed an active partition"
+
+        inj.rejoin("mon.0")
+        # the lossy transport may have died during the cut: send via
+        # messenger (redials if needed)
+        for _ in range(50):
+            client.send_to(server.addr, MPing(stamp=3.0))
+            if len(col.got) >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(col.got) >= 2, "heal did not restore delivery"
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_partition_refuses_new_handshakes():
+    """Redials during a cut must fail like an unreachable host: no
+    session forms across an active partition."""
+
+    async def main():
+        server = Messenger("mon.0")
+        inj = FaultInjector(seed=4)
+        inj.isolate("mon.0")
+        server.fault_injector = inj
+        await server.bind()
+        server.add_dispatcher(Echo())
+        client = Messenger("client.1")
+        col = Collector()
+        client.add_dispatcher(col)
+        client.send_to(server.addr, MPing(stamp=1.0))
+        await asyncio.sleep(0.4)
+        assert not col.got
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+# -- determinism ------------------------------------------------------------
+
+
+def test_injector_schedule_deterministic():
+    """Same seed + same frame sequence => identical fault schedule."""
+
+    def schedule(seed):
+        inj = FaultInjector(seed=seed)
+        inj.add_rule(src="a.*", dst="b.*", drop=0.2, dup=0.2,
+                     reorder=0.1, delay_p=0.1, delay=0.01)
+        out = []
+        for i in range(200):
+            act = inj.on_send("a.%d" % (i % 3), "b.0")
+            out.append((act.drop, act.dup, act.reorder,
+                        round(act.delay, 9), act.abort))
+        return out, inj.stats()
+
+    s1, st1 = schedule(42)
+    s2, st2 = schedule(42)
+    s3, _ = schedule(43)
+    assert s1 == s2
+    assert st1 == st2
+    assert s1 != s3, "different seeds produced identical schedules"
+
+
+def test_conn_rng_seeded_deterministic():
+    """Per-connection RNGs derive deterministically from
+    (seed, entity, peer): inject_socket_failures schedules replay."""
+    m1 = Messenger("osd.0", seed=77)
+    m2 = Messenger("osd.0", seed=77)
+    a = [m1._conn_rng("127.0.0.1:1234").random() for _ in range(5)]
+    b = [m2._conn_rng("127.0.0.1:1234").random() for _ in range(5)]
+    assert a == b
+    c = [m2._conn_rng("127.0.0.1:9999").random() for _ in range(5)]
+    assert a != c, "different peers must get independent schedules"
+    # seeded nonces are deterministic per (seed, entity) ...
+    assert m1.nonce == m2.nonce
+    # ... but differ across entities (peers must see restarts)
+    assert Messenger("osd.1", seed=77).nonce != m1.nonce
+
+
+def test_socket_failures_use_connection_rng():
+    """The legacy inject_socket_failures knob draws from the
+    connection's seeded RNG, not the module-global random: two runs
+    with one seed abort on the same frame indices."""
+
+    async def main(seed):
+        server = Messenger("osd.0", seed=seed)
+        server.peer_policy["osd"] = Policy.lossless_peer()
+        await server.bind()
+        sink = Collector()
+        server.add_dispatcher(sink)
+        client = Messenger("osd.1", seed=seed)
+        client.peer_policy["osd"] = Policy.lossless_peer()
+        client.inject_socket_failures = 5
+        conn = client.connect_to(server.addr, entity_hint="osd.0")
+        n = 40
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        await _drain(sink, n)
+        assert [m.tid for m in sink.got] == list(range(n))
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main(5))
+    run(main(5))

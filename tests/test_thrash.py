@@ -1,0 +1,189 @@
+"""Cluster thrashing: seeded fault schedules under live client load.
+
+The teuthology thrasher tier (qa/tasks/ceph_manager.py Thrasher
+analog): every test drives a real in-process cluster through faults
+while a workload writes, then asserts the invariants — zero
+acknowledged-write loss, PGs active+clean, quorum re-formed.  On any
+failure the thrasher prints its seed and plan so the schedule can be
+replayed exactly.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.testing import ClusterThrasher, LocalCluster, Workload
+
+SMOKE_SEED = 42
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def test_thrash_plan_deterministic():
+    """The action plan is a pure function of (seed, shape): replaying
+    a failure needs only the seed the failing run printed."""
+
+    class Shape:
+        n_osds = 5
+        n_mons = 3
+
+    p1 = ClusterThrasher(Shape(), seed=7, rounds=12).plan
+    p2 = ClusterThrasher(Shape(), seed=7, rounds=12).plan
+    p3 = ClusterThrasher(Shape(), seed=8, rounds=12).plan
+    assert p1 == p2
+    assert p1 != p3
+    # pinned actions keep seeded victim selection for the rest
+    q1 = ClusterThrasher(Shape(), seed=7,
+                         actions=["kill_revive",
+                                  ("mon_partition", 2),
+                                  "kill_revive"]).plan
+    q2 = ClusterThrasher(Shape(), seed=7,
+                         actions=["kill_revive",
+                                  ("mon_partition", 2),
+                                  "kill_revive"]).plan
+    assert q1 == q2
+    assert q1[1] == ("mon_partition", 2)
+
+
+def test_smoke_thrash_kill_revive_and_mon_partition():
+    """Tier-1 acceptance smoke: 3 rounds of OSD kill/revive plus one
+    monitor partition, all under a live client workload, seeded and
+    deterministic — zero acknowledged-write loss and every PG
+    active+clean at the end."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, n_mons=3,
+                               seed=SMOKE_SEED).start()
+        try:
+            pid = await c.create_pool("data", pg_num=8, size=3)
+            await c.wait_health(pid)
+            wl = Workload(c.client.io_ctx("data"),
+                          seed=SMOKE_SEED).start()
+            actions = ["kill_revive", "kill_revive", "kill_revive",
+                       ("mon_partition", 2)]
+            th = ClusterThrasher(c, seed=SMOKE_SEED, actions=actions)
+            # schedule must replay exactly from the seed
+            assert th.plan == ClusterThrasher(
+                c, seed=SMOKE_SEED, actions=actions).plan
+            await th.run(pid, wl)
+            await wl.stop()
+            # final sweep: every acked write intact, cluster clean
+            assert wl.acked, "workload never completed a write"
+            await wl.verify()
+            await c.wait_health(pid)
+            assert c.leader() is not None
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_client_resend_survives_frame_drops():
+    """Objecter exponential-backoff resend: with the client's frames
+    to OSDs dropped 20% of the time (lossy link — no transport-level
+    replay), every write still completes and reads back."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=9).start()
+        try:
+            pid = await c.create_pool("data", pg_num=8, size=2)
+            await c.wait_health(pid)
+            inj = c.injector("client")
+            inj.add_rule(src="client.0", dst="osd.*", drop=0.2)
+            io = c.client.io_ctx("data")
+            payloads = {}
+            for i in range(15):
+                oid = "drop-%d" % i
+                data = (b"payload-%d|" % i) * 20
+                await asyncio.wait_for(io.write_full(oid, data), 60)
+                payloads[oid] = data
+            assert inj.frames_dropped > 0, "schedule injected nothing"
+            inj.clear_rules()
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_osd_backoff_blocks_resend_until_pg_active():
+    """MOSDBackoff round trip: a PG below min_size parks the op AND
+    tells the client to stop resending; revival reactivates the PG,
+    the OSD unblocks, and the parked write completes."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=13).start()
+        try:
+            pid = await c.create_pool("data", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            await io.write_full("pre", b"before faults")
+            # two of three OSDs die: once auto-out remaps every PG to
+            # the survivor alone, |acting| < min_size blocks IO
+            await c.kill_osd(1)
+            await c.kill_osd(2)
+            await c.wait_osd_down(1)
+            await c.wait_osd_down(2)
+            from ceph_tpu.utils.backoff import wait_for
+            await wait_for(
+                lambda: all(not c.client.osdmap.is_in(o)
+                            for o in (1, 2)), 30,
+                what="auto-out of killed osds")
+            write = asyncio.ensure_future(
+                io.write_full("parked", b"written under backoff"))
+            # the OSD must push back rather than let the client's
+            # resend ramp spam the inactive PG
+            await wait_for(lambda: c.client._backoffs, 30,
+                           what="client received MOSDBackoff block")
+            assert not write.done()
+            await c.revive_osd(1)
+            await c.wait_osd_up(1)
+            await asyncio.wait_for(write, 60)
+            await wait_for(lambda: not c.client._backoffs, 30,
+                           what="backoff released after activate")
+            await c.wait_health(pid, timeout=60)
+            assert await io.read("parked") == b"written under backoff"
+            assert await io.read("pre") == b"before faults"
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_long_thrash_seeded_random_plan():
+    """Extended thrash: a fully seeded random plan (kills, weight
+    churn, mon partitions, map churn) plus low-rate frame drops on
+    the client link, across replicated and EC pools."""
+
+    async def main():
+        c = await LocalCluster(n_osds=4, n_mons=3, seed=1234).start()
+        try:
+            pid = await c.create_pool("data", pg_num=8, size=3)
+            epid = await c.create_pool("ecdata", pg_num=8,
+                                       pool_type="erasure")
+            await c.wait_health(pid)
+            await c.wait_health(epid)
+            c.injector("client").add_rule(src="client.0",
+                                          dst="osd.*", drop=0.05)
+            wl = Workload(c.client.io_ctx("data"), seed=1234,
+                          pace=0.05).start()
+            ewl = Workload(c.client.io_ctx("ecdata"), seed=1235,
+                           prefix="ec", pace=0.05).start()
+            th = ClusterThrasher(c, seed=1234, rounds=8)
+            # both pools go active+clean and both workloads' acked
+            # sets are spot-verified after EVERY round
+            await th.run([pid, epid], [wl, ewl])
+            await wl.stop()
+            await ewl.stop()
+            # final sweep: every acked write (replicated AND EC —
+            # shards lived through kills/outs) reads back intact
+            await wl.verify()
+            await ewl.verify()
+        finally:
+            await c.stop()
+
+    run(main(), timeout=900)
