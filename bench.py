@@ -303,7 +303,108 @@ def bench_backend_path() -> dict:
     return {"ec_backend_path_gibps": round(gibps, 1)}
 
 
+def _pctls(samples: list, unit_s: float = 1e3) -> dict:
+    """p50/p90/p99 of a raw sample list, scaled (default s -> ms)."""
+    if not samples:
+        return {"n": 0}
+    s = sorted(samples)
+    n = len(s)
+
+    def at(p):
+        return round(s[min(n - 1, int(p / 100.0 * n))] * unit_s, 3)
+
+    return {"n": n, "p50": at(50), "p90": at(90), "p99": at(99)}
+
+
+def bench_trace(n_ops: int = 40) -> dict:
+    """--trace mode: boot a LocalCluster, drive replicated + EC
+    writes, and attribute each op's latency stage-by-stage from the
+    merged OpTracker timelines (ceph_tpu.trace) — queue wait,
+    replication sub-op RTT, EC batch wait — plus the device batcher's
+    own flush ring for device dispatch.  Emits percentiles so
+    BENCH_*.json entries carry stage attribution, pinpointing where a
+    future perf PR must aim before it is written."""
+    import asyncio
+    import os
+
+    # the batcher IS the EC write path being attributed; force it on
+    # even off-TPU so the device-dispatch stage is observable (same
+    # override the batcher tests use)
+    os.environ.setdefault("CEPH_TPU_EC_OFFLOAD", "1")
+    from ceph_tpu.testing import LocalCluster
+
+    def _ev(rec: dict) -> dict:
+        """First-occurrence event -> absolute stamp for one record."""
+        out = {}
+        for e in rec["events"]:
+            out.setdefault(e["event"], e["t"])
+        return out
+
+    async def run() -> dict:
+        c = await LocalCluster(
+            n_osds=3,
+            conf={"osd_op_history_size": 4 * n_ops}).start()
+        try:
+            rep = await c.create_pool("trace_rep", pg_num=8, size=3)
+            await c.wait_health(rep)
+            ec = await c.create_pool("trace_ec", pg_num=8,
+                                     pool_type="erasure")
+            await c.wait_health(ec)
+            io_r = c.client.io_ctx("trace_rep")
+            io_e = c.client.io_ctx("trace_ec")
+            payload = b"\xa5" * 4096
+            for i in range(n_ops):
+                await io_r.write_full("r-%d" % i, payload)
+                await io_e.write_full("e-%d" % i, payload)
+            await asyncio.sleep(0.3)       # sub-op records retire
+            stages: dict[str, list] = {
+                "client_rtt": [], "queue_wait": [],
+                "replication_rtt": [], "ec_batch_wait": []}
+            for rec in list(c.client.optracker.historic):
+                if rec.trace is None:
+                    continue
+                for r in c.op_timeline(rec.trace):
+                    ev = _ev(r)
+                    if "client_op" in r["desc"]:
+                        stages["client_rtt"].append(r["age"])
+                    if "osd_op(" not in r["desc"]:
+                        continue
+                    if "queued" in ev and "reached_pg" in ev:
+                        stages["queue_wait"].append(
+                            ev["reached_pg"] - ev["queued"])
+                    end = r["events"][-1]["t"]
+                    if "sub_op_sent" in ev:
+                        stages["replication_rtt"].append(
+                            end - ev["sub_op_sent"])
+                    if "ec_sub_write_sent" in ev:
+                        stages["replication_rtt"].append(
+                            (ev.get("ec_sub_write_acked", end)
+                             - ev["ec_sub_write_sent"]))
+                    if "ec_encode_start" in ev and "ec_encoded" in ev:
+                        stages["ec_batch_wait"].append(
+                            ev["ec_encoded"] - ev["ec_encode_start"])
+            from ceph_tpu.ec.batcher import DeviceBatcher
+            device = list(DeviceBatcher.get().flush_history)
+            return {
+                "metric": "op_stage_latency",
+                "unit": "ms",
+                "n_ops": 2 * n_ops,
+                "stages": {
+                    **{k: _pctls(v) for k, v in stages.items()},
+                    "device_dispatch": _pctls(device),
+                },
+            }
+        finally:
+            await c.stop()
+
+    return asyncio.run(asyncio.wait_for(run(), 300))
+
+
 def main() -> None:
+    if "--trace" in sys.argv:
+        print(json.dumps(bench_trace()))
+        return
+
     import jax
     import jax.numpy as jnp
 

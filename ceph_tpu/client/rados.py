@@ -45,7 +45,7 @@ class ObjectNotFound(RadosError):
 class _InFlight:
     __slots__ = ("tid", "pool", "oid", "ops", "future", "target",
                  "pgid", "acting", "snapc", "snapid", "backoff",
-                 "next_resend", "first_sent")
+                 "next_resend", "first_sent", "trace", "top")
 
     def __init__(self, tid, pool, oid, ops, future, snapc=None,
                  snapid=None):
@@ -62,6 +62,8 @@ class _InFlight:
         self.backoff = None     # ExpBackoff ramp (set on first send)
         self.next_resend = 0.0  # loop.time() the resend tick may fire
         self.first_sent = 0.0
+        self.trace = None       # cross-daemon span id (reqid_t role)
+        self.top = None         # TrackedOp in the client's OpTracker
 
 
 class RadosClient:
@@ -101,11 +103,17 @@ class RadosClient:
         self._cmd_futures: dict[int, asyncio.Future] = {}
         # (pool, oid) -> callback(payload); re-registered on map change
         self._watch_cbs: dict[tuple, object] = {}
-        # (pool, ps) -> (primary_osd, backoff_id): PGs an OSD told us
-        # to stop resending to (MOSDBackoff); cleared on unblock, on a
-        # primary change, or on that OSD's session reset
+        # (pool, ps, oid|None) -> (primary_osd, backoff_id): PGs (oid
+        # None) or single degraded objects an OSD told us to stop
+        # resending to (MOSDBackoff); cleared on unblock, on a primary
+        # change, or on that OSD's session reset
         self._backoffs: dict[tuple, tuple] = {}
         self._resend_task = None
+        # client-side op tracking (Objecter's slice of the op span):
+        # every submit registers with trace id "<entity>:<tid>", which
+        # rides the MOSDOp envelope into the OSD pipeline
+        from ..trace import OpTracker
+        self.optracker = OpTracker(self.ctx, name)
 
     @property
     def mon_addr(self) -> str:
@@ -212,7 +220,8 @@ class RadosClient:
     # -- backoffs (osd_backoff / Objecter Backoff tracking) ----------------
 
     def _handle_backoff(self, conn, msg: MOSDBackoff) -> None:
-        key = (msg.pool, msg.ps)
+        oid = getattr(msg, "oid", None)
+        key = (msg.pool, msg.ps, oid)
         osd = next((o for o, a in self.osdmap.osd_addrs.items()
                     if a == conn.peer_addr), -1)
         if msg.op == "block":
@@ -227,12 +236,18 @@ class RadosClient:
                 now = asyncio.get_running_loop().time()
                 for op in self._inflight.values():
                     if op.pgid is not None and \
-                            (op.pool, op.pgid.ps) == key:
+                            (op.pool, op.pgid.ps) == key[:2] and \
+                            (oid is None or op.oid == oid):
                         op.next_resend = now
 
     def _backed_off(self, op: _InFlight) -> bool:
-        return (op.pgid is not None
-                and (op.pool, op.pgid.ps) in self._backoffs)
+        """Blocked by a PG-wide backoff or an object-scoped one
+        naming this op's oid (the reference's hobject-ranged
+        Backoff::contains check)."""
+        if op.pgid is None:
+            return False
+        return ((op.pool, op.pgid.ps, None) in self._backoffs
+                or (op.pool, op.pgid.ps, op.oid) in self._backoffs)
 
     # -- maps --------------------------------------------------------------
 
@@ -257,7 +272,7 @@ class RadosClient:
             # mapping change hands the PG to a new primary whose ops
             # must flow (it sends its own backoff if still unready)
             for key in list(self._backoffs):
-                pool_id, ps = key
+                pool_id, ps, _oid = key
                 if pool_id not in self.osdmap.pools:
                     del self._backoffs[key]
                     continue
@@ -305,6 +320,12 @@ class RadosClient:
         fut = asyncio.get_running_loop().create_future()
         op = _InFlight(self._tid, pool_id, oid, ops, fut,
                        snapc=snapc, snapid=snapid)
+        op.trace = "%s:%d" % (self.msgr.entity, self._tid)
+        op.top = self.optracker.create(
+            "client_op(tid=%d pool=%d %s [%s])"
+            % (self._tid, pool_id, oid,
+               ",".join(o.get("op", "?") for o in ops)),
+            trace=op.trace)
         self._inflight[self._tid] = op
         self._send_op(op)
         return fut
@@ -356,15 +377,20 @@ class RadosClient:
         op.pgid = pgid
         op.acting = acting
         if primary < 0:
+            if op.top is not None:
+                op.top.mark_event("no_primary")
             return  # no acting primary yet: wait for the next map
         addr = self.osdmap.osd_addrs.get(primary)
         if not addr:
             return
-        self.msgr.send_to(addr, MOSDOp(
+        m = MOSDOp(
             tid=op.tid, pool=op.pool, ps=pgid.ps, oid=op.oid,
             snapc=op.snapc, snapid=op.snapid, ops=op.ops,
-            epoch=self.osdmap.epoch, flags=0),
-            entity_hint="osd.%d" % primary)
+            epoch=self.osdmap.epoch, flags=0)
+        m.trace = op.trace
+        if op.top is not None:
+            op.top.mark_event("sent_osd.%d" % primary)
+        self.msgr.send_to(addr, m, entity_hint="osd.%d" % primary)
 
     async def _resend_loop(self) -> None:
         """Objecter op-retry ticker: any op still in flight past its
@@ -400,6 +426,8 @@ class RadosClient:
         op = self._inflight.pop(msg.tid, None)
         if op is None or op.future.done():
             return
+        if op.top is not None:
+            op.top.finish("reply_r%d" % (msg.result or 0))
         if msg.result == 0:
             op.future.set_result(msg.outs)
         elif msg.result == -2:

@@ -71,6 +71,14 @@ class DeviceBatcher:
         self._pending: dict[tuple, _PendingBatch] = {}
         self.batches_flushed = 0
         self.items_encoded = 0
+        # device-dispatch telemetry: per-flush wall time of the encode
+        # call (the "device dispatch" stage of an op's timeline).
+        # last_flush_s is what an awaiting OSD op samples into its
+        # stage histogram right after encode_async resolves; the ring
+        # feeds bench --trace percentiles
+        self.last_flush_s = 0.0
+        self.flush_seconds = 0.0
+        self.flush_history: list[float] = []   # bounded ring
 
     @classmethod
     def get(cls) -> "DeviceBatcher":
@@ -141,6 +149,8 @@ class DeviceBatcher:
         if pb.timer is not None:
             pb.timer.cancel()
         matrix_key, w = key
+        import time
+        t0 = time.perf_counter()
         try:
             enc = self._encoder(matrix_key, w)
             flat = (pb.arrays[0] if len(pb.arrays) == 1
@@ -155,8 +165,14 @@ class DeviceBatcher:
                     fut.set_exception(
                         IOError("device EC encode failed: %r" % e))
             return
+        dt = time.perf_counter() - t0
         self.batches_flushed += 1
         self.items_encoded += len(pb.arrays)
+        self.last_flush_s = dt
+        self.flush_seconds += dt
+        self.flush_history.append(dt)
+        if len(self.flush_history) > 512:
+            del self.flush_history[:256]
         off = 0
         for arr, fut in zip(pb.arrays, pb.futures):
             n = arr.shape[1]

@@ -136,6 +136,8 @@ class Manager:
         exp.add_gauge("mgr_daemons_reporting",
                       lambda: len(self.daemon_reports),
                       "daemons with a live report")
+        exp.add_gauge("cluster_slow_ops", self._total_slow_ops,
+                      "slow ops summed over every daemon's report")
         exp.add_gauge("balancer_rounds",
                       lambda: self.balancer_rounds,
                       "balancer optimizer runs")
@@ -144,9 +146,23 @@ class Manager:
                       "upmap items committed by the balancer")
         exp.add_renderer(self._render_reports)
 
+    def _total_slow_ops(self) -> int:
+        """Cluster-wide slow-op count aggregated from the per-daemon
+        reports (the mgr-side mirror of the mon's SLOW_OPS input)."""
+        total = 0
+        for rep in self.daemon_reports.values():
+            osd_grp = (rep.get("perf") or {}).get("osd") or {}
+            v = osd_grp.get("slow_ops", 0)
+            if isinstance(v, (int, float)):
+                total += int(v)
+        return total
+
     def _render_reports(self) -> list[str]:
         """Per-daemon series from the MMgrReports (the prometheus
-        module's per-daemon metric families)."""
+        module's per-daemon metric families).  Stage-latency
+        histograms (PerfCounters pow2 buckets) render as labeled
+        Prometheus histogram series."""
+        from ..utils.exporter import hist_lines
         lines: list[str] = []
         pg_totals: dict[str, int] = {}
         for daemon in sorted(self.daemon_reports):
@@ -161,6 +177,12 @@ class Manager:
                         lines.append(
                             "ceph_tpu_daemon_%s_%s%s %g"
                             % (grp, cname, label, val))
+                    elif isinstance(val, dict) \
+                            and "buckets_us_pow2" in val:
+                        lines.extend(hist_lines(
+                            "ceph_tpu_daemon_%s_%s" % (grp, cname),
+                            val["buckets_us_pow2"],
+                            labels='daemon="%s"' % daemon))
             lines.append("ceph_tpu_daemon_num_pgs%s %d"
                          % (label, rep.get("num_pgs") or 0))
             lines.append("ceph_tpu_daemon_num_objects%s %d"

@@ -113,6 +113,13 @@ class Monitor:
         # target osd -> reporter osd -> FailureReport
         self.failure_info: dict[int, dict[int, FailureReport]] = {}
         self.down_pending_out: dict[int, float] = {}
+        # osd -> (slow_op_count, monotonic stamp) from MOSDBeacons:
+        # derived soft state every mon keeps (no paxos write) so the
+        # leader's HealthMonitor can raise/clear SLOW_OPS
+        self.osd_slow_ops: dict[int, tuple[int, float]] = {}
+        # mon-side op tracking (MMonCommand requests)
+        from ..trace import OpTracker
+        self.optracker = OpTracker(self.ctx, name)
         self._tick_task = None
         # PaxosService quartet (ConfigMonitor/AuthMonitor/
         # HealthMonitor/LogMonitor analogs): their mutations ride the
@@ -449,7 +456,14 @@ class Monitor:
                               "lease_until", "uncommitted", "epoch",
                               "accepted_pn")})
             return True
-        from ..msg.messages import MOSDPGTemp
+        from ..msg.messages import MOSDBeacon, MOSDPGTemp
+        if isinstance(msg, MOSDBeacon):
+            # beacons are derived soft state: EVERY mon records them
+            # (no paxos), so whichever mon leads next already holds
+            # the slow-op picture for its health checks
+            self.osd_slow_ops[msg.osd] = (int(msg.slow_ops or 0),
+                                          time.monotonic())
+            return True
         if isinstance(msg, (MOSDBoot, MOSDFailure, MOSDAlive,
                             MOSDPGTemp)) \
                 and self.multi and not self.is_leader():
@@ -691,6 +705,9 @@ class Monitor:
     def _handle_command(self, conn, msg: MMonCommand) -> None:
         cmd = msg.cmd or {}
         prefix = cmd.get("prefix", "")
+        top = self.optracker.create(
+            "mon_command(%s from %s)" % (prefix, msg.src),
+            trace=getattr(msg, "trace", None))
         if self.multi and not self.is_leader():
             # peons redirect to the leader (the reference forwards;
             # redirect keeps the routing stateless).  -EHOSTDOWN tells
@@ -701,33 +718,42 @@ class Monitor:
                               if leader is not None else None)}
             conn.send(MMonCommandAck(tid=msg.tid, result=-112,
                                      out=out))
+            top.finish("redirected")
             return
         if self.multi and not self.mpaxos.active:
             conn.send(MMonCommandAck(tid=msg.tid, result=-112,
                                      out={"leader": None}))
+            top.finish("redirected_inactive")
             return
         if self.multi:
             # mutating commands must ack only after the paxos commit
             # lands (the single-mon path commits synchronously)
             self.msgr.spawn(self._command_async(conn, msg, prefix,
-                                                cmd))
+                                                cmd, top))
             return
         try:
             out = self._run_command(prefix, cmd)
             conn.send(MMonCommandAck(tid=msg.tid, result=0, out=out))
+            top.finish("done")
         except Exception as e:
             conn.send(MMonCommandAck(tid=msg.tid, result=-22,
                                      out={"error": str(e)}))
+            top.finish("error")
 
-    async def _command_async(self, conn, msg, prefix, cmd) -> None:
+    async def _command_async(self, conn, msg, prefix, cmd,
+                             top=None) -> None:
         try:
             self._last_proposal = None
             out = self._run_command(prefix, cmd)
             fut = self._last_proposal
             self._last_proposal = None
             if fut is not None:
+                if top is not None:
+                    top.mark_event("proposal_queued")
                 await asyncio.wait_for(fut, 15.0)
             conn.send(MMonCommandAck(tid=msg.tid, result=0, out=out))
+            if top is not None:
+                top.finish("done")
         except (IOError, asyncio.TimeoutError):
             # quorum lost mid-round: the proposal MAY still commit
             # under a later reign, so a retryable redirect would make
@@ -737,9 +763,13 @@ class Monitor:
                 tid=msg.tid, result=-110,
                 out={"error": "proposal timed out; may have "
                               "committed"}))
+            if top is not None:
+                top.finish("proposal_timeout")
         except Exception as e:
             conn.send(MMonCommandAck(tid=msg.tid, result=-22,
                                      out={"error": str(e)}))
+            if top is not None:
+                top.finish("error")
 
     def _run_command(self, prefix: str, cmd: dict) -> dict:
         # service command surfaces (ConfigMonitor/AuthMonitor/

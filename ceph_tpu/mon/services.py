@@ -22,8 +22,9 @@ with the full service state persisted in the mon KV per commit.
   durable, replicated identity database the cephx ticket flow would
   consume.
 * HealthMonitor — DERIVED state, no paxos writes: aggregates osd
-  liveness, quorum shape, and stuck-pg hints into
-  HEALTH_OK/WARN/ERR + check list (`health`).
+  liveness, quorum shape, stuck-pg hints, and the slow-op counts
+  beaconed by OSDs (SLOW_OPS) into HEALTH_OK/WARN/ERR + check list
+  (`health`).
 * LogMonitor — the capped cluster log (`log` / `log last`): the mon
   itself appends lifecycle events (boots, mark-downs, auto-outs), so
   `log last` answers "what just happened" exactly like
@@ -225,6 +226,30 @@ class HealthMonitor:
                     "summary": "%d/%d mons in quorum"
                                % (len(quorum), total),
                     "detail": []}
+        # SLOW_OPS (the reference's HealthMonitor check fed by
+        # MOSDBeacon slow-op counts): raised while any live beacon
+        # reports in-flight ops past osd_op_complaint_time; clears as
+        # soon as later beacons report zero (or a daemon's beacons go
+        # stale — a dead osd surfaces as OSD_DOWN, not SLOW_OPS)
+        now = time.monotonic()
+        slow_daemons = []
+        slow_total = 0
+        for osd, (n, stamp) in sorted(
+                getattr(self.mon, "osd_slow_ops", {}).items()):
+            if n > 0 and now - stamp < 30.0:
+                slow_daemons.append(osd)
+                slow_total += n
+        if slow_total:
+            out["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "%d slow ops, daemons %s"
+                           % (slow_total,
+                              ["osd.%d" % o
+                               for o in slow_daemons[:10]]),
+                "detail": ["osd.%d has %d ops past the complaint "
+                           "threshold"
+                           % (o, self.mon.osd_slow_ops[o][0])
+                           for o in slow_daemons[:10]]}
         if not m.pools and m.epoch > 0:
             pass                       # empty cluster is healthy
         return out

@@ -20,9 +20,12 @@ outbound and inbound MSG frame and, driven by an explicit
 
 Partitions are separate from probabilistic rules: ``partition(a, b)``
 drops EVERY frame between the two entities in both directions until
-``heal(a, b)``; ``isolate(a)`` cuts ``a`` off from everyone.  Entity
-selectors accept exact names ("mon.1"), type wildcards ("osd.*") and
-"*".
+``heal(a, b)`` — including ACK/CLOSE control frames (``on_control``),
+so a cut looks like a dead host even to the session bookkeeping: no
+stray ACK can retire unacked lossless entries across a partition, and
+no CLOSE can masquerade as an orderly shutdown.  ``isolate(a)`` cuts
+``a`` off from everyone.  Entity selectors accept exact names
+("mon.1"), type wildcards ("osd.*") and "*".
 
 Every decision consumes the injector's RNG in frame order, so a
 failure schedule is replayed exactly by re-running with the same seed
@@ -197,6 +200,18 @@ class FaultInjector:
         the local entity.  Only partitions apply on the receive side:
         probabilistic rules fire once, at the sender, so a schedule is
         a single RNG stream."""
+        if self.partitioned(src, dst):
+            self.frames_dropped += 1
+            return False
+        return True
+
+    def on_control(self, src: str, dst: str) -> bool:
+        """Gate for ACK/CLOSE control frames, both directions.  True =
+        deliver.  Only partitions apply — probabilistic rules stay
+        MSG-only (control frames carry no payload to lose; a partition
+        however must block EVERYTHING, or a stray ACK crossing the cut
+        retires unacked lossless entries and a stray CLOSE tears down
+        a session whose peer should look dead, not departed)."""
         if self.partitioned(src, dst):
             self.frames_dropped += 1
             return False

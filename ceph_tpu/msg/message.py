@@ -47,6 +47,10 @@ class Message:
         # stamped by the messenger on send/receive
         self.seq = 0
         self.src = ""
+        # optional trace id (reqid_t role): set by the sender to tie
+        # this message into a cross-daemon op timeline; propagated in
+        # the envelope, never interpreted by the transport
+        self.trace = None
 
     def to_wire(self) -> dict:
         return {f: getattr(self, f) for f in self.FIELDS}
@@ -71,9 +75,15 @@ MSG_STRUCT_COMPAT = 1
 
 
 def encode_message(msg: Message) -> bytes:
-    return denc.encode_versioned(
-        [msg.TYPE, msg.seq, msg.src, msg.to_wire()],
-        MSG_STRUCT_V, MSG_STRUCT_COMPAT)
+    # the trace id rides as a 5th envelope element: old decoders slice
+    # row[:4] and ignore it, so no compat bump is needed.  Untraced
+    # messages keep the exact 4-element envelope (byte-stable for the
+    # pinned dencoder corpus, and no per-frame cost when not tracing)
+    row = [msg.TYPE, msg.seq, msg.src, msg.to_wire()]
+    trace = getattr(msg, "trace", None)
+    if trace is not None:
+        row.append(trace)
+    return denc.encode_versioned(row, MSG_STRUCT_V, MSG_STRUCT_COMPAT)
 
 
 class UnknownMessage(Message):
@@ -86,9 +96,12 @@ class UnknownMessage(Message):
 
 
 def decode_message(data: bytes | memoryview) -> Message:
+    trace = None
     if bytes(data[:1]) == b"V":
         _v, row = denc.decode_versioned(data, MSG_STRUCT_V)
         mtype, seq, src, fields = row[:4]
+        if len(row) > 4:
+            trace = row[4]
     else:                               # legacy unversioned frame
         mtype, seq, src, fields = denc.decode(data)
     cls = _REGISTRY.get(mtype)
@@ -98,4 +111,5 @@ def decode_message(data: bytes | memoryview) -> Message:
         msg = cls.from_wire(fields)
     msg.seq = seq
     msg.src = src
+    msg.trace = trace
     return msg

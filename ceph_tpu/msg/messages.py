@@ -96,6 +96,17 @@ class MOSDFailure(Message):
 
 
 @register
+class MOSDBeacon(Message):
+    """OSD -> mon liveness/health beacon (MOSDBeacon.h): periodic even
+    while healthy; slow_ops carries the count of in-flight ops older
+    than osd_op_complaint_time so the monitor can raise (and clear)
+    the SLOW_OPS health warning."""
+
+    TYPE = "osd_beacon"
+    FIELDS = ("osd", "epoch", "slow_ops")
+
+
+@register
 class MOSDAlive(Message):
     """OSD -> mon: cancel my pending failure reports, and/or request
     an up_thru bump so a fresh primary can prove its interval could go
@@ -179,10 +190,12 @@ class MOSDBackoff(Message):
     parked server-side); op = "unblock" releases it.  id is the OSD's
     monotonically increasing backoff id — an unblock releases only
     blocks with id <= its own, so a stale unblock cannot cancel a
-    newer block."""
+    newer block.  oid narrows the backoff to ONE degraded object (the
+    reference's hobject-ranged backoffs): ops on other objects of the
+    PG keep flowing; oid=None blocks the whole PG."""
 
     TYPE = "osd_backoff"
-    FIELDS = ("pool", "ps", "op", "id", "epoch")
+    FIELDS = ("pool", "ps", "op", "id", "epoch", "oid")
 
 
 @register

@@ -177,17 +177,26 @@ class Connection:
             return
         self._open = False
         if self._writer is not None:
+            # a partition must also block the graceful CLOSE: the peer
+            # has to see a transport fault (dead host semantics, and
+            # lossless replay stays armed), never an orderly shutdown
+            # crossing a cut
+            inj = self.msgr.fault_injector
+            send_close = (inj is None or inj.on_control(
+                self.msgr.entity, self.peer_entity or "?"))
             try:
-                # best-effort graceful close so the peer resets
-                # promptly; sealed under the transport AEAD so a close
-                # is only believed when it came from the key holder
-                payload = b""
-                if self._framer is not None:
-                    payload = self._framer.seal(
-                        payload, bytes([TAG_CLOSE]))
-                self._writer.write(_HDR.pack(
-                    TAG_CLOSE, len(payload), zlib.crc32(payload))
-                    + payload)
+                if send_close:
+                    # best-effort graceful close so the peer resets
+                    # promptly; sealed under the transport AEAD so a
+                    # close is only believed when it came from the
+                    # key holder
+                    payload = b""
+                    if self._framer is not None:
+                        payload = self._framer.seal(
+                            payload, bytes([TAG_CLOSE]))
+                    self._writer.write(_HDR.pack(
+                        TAG_CLOSE, len(payload), zlib.crc32(payload))
+                        + payload)
                 self._writer.close()
             except Exception:
                 pass
@@ -342,6 +351,16 @@ class Connection:
             tag, payload = await self.out_q.get()
             try:
                 act = None
+                if tag == TAG_ACK:
+                    inj = self.msgr.fault_injector
+                    if inj is not None and not inj.on_control(
+                            self.msgr.entity,
+                            self.peer_entity or "?"):
+                        # partitioned: the ACK is withheld (it would
+                        # retire unacked lossless entries across the
+                        # cut); it regenerates on the next delivered
+                        # MSG after heal
+                        continue
                 if tag == TAG_MSG:
                     if (self.msgr.inject_socket_failures and
                             self.rng.randrange(
@@ -449,10 +468,21 @@ class Connection:
                         traceback.print_exc()
                         return
             elif tag == TAG_ACK:
+                inj = self.msgr.fault_injector
+                if inj is not None and not inj.on_control(
+                        self.peer_entity or "?", self.msgr.entity):
+                    return      # partitioned: transport fault
                 (seq,) = struct.unpack(">Q", payload)
                 self.unacked = [(s, d) for s, d in self.unacked
                                 if s > seq]
             elif tag == TAG_CLOSE:
+                inj = self.msgr.fault_injector
+                if inj is not None and not inj.on_control(
+                        self.peer_entity or "?", self.msgr.entity):
+                    # a CLOSE crossing a partition must read as a
+                    # transport fault, not an orderly shutdown —
+                    # lossless sessions keep their replay state
+                    return
                 raise _PeerClosed()
 
     def _replay_unacked(self) -> None:

@@ -85,8 +85,29 @@ class OSD:
         self.ec = ECPGBackend(self)
         self.scrubber = Scrubber(self)
         self.watches = WatchRegistry(self)
+        # request-level observability (TrackedOp/OpTracker): every
+        # client op / sub-op registers here with its trace id; the
+        # admin socket serves dump_ops_in_flight & friends and the
+        # heartbeat loop beacons the slow-op count to the mon
+        from ..trace import OpTracker
+        self.optracker = OpTracker(self.ctx, "osd.%d" % whoami)
+        self.perf = self.ctx.perf.create("osd")
+        self.perf.add_u64("ops", "client ops completed")
+        self.perf.add_u64("slow_ops",
+                          "in-flight ops past osd_op_complaint_time")
+        self.perf.add_hist("op_queue_wait",
+                           "mClock shard queue wait (us, pow2)")
+        self.perf.add_hist("op_subop_rtt",
+                           "replicated sub-op round trip (us, pow2)")
+        self.perf.add_hist("op_ec_batch_wait",
+                           "EC encode incl device batch wait"
+                           " (us, pow2)")
+        self.perf.add_hist("op_ec_device_dispatch",
+                           "device EC batch flush time (us, pow2)")
+        self._beacon_stamp = 0.0
         # sharded mClock op queue (ShardedOpWQ + mClockScheduler)
         self.sched = OpScheduler(self.ctx)
+        self.sched.on_wait = self._note_queue_wait
         # epoch-0 empty map is the universal incremental base
         self.osdmap: OSDMap = OSDMap()
         self.pgs: dict[pg_t, PG] = {}
@@ -127,6 +148,37 @@ class OSD:
     @property
     def mon_addr(self) -> str:
         return self.mon_addrs[self._mon_i % len(self.mon_addrs)]
+
+    # -- observability helpers ---------------------------------------------
+
+    def _note_queue_wait(self, klass: str, seconds: float) -> None:
+        from .scheduler import K_CLIENT
+        if klass == K_CLIENT:
+            self.perf.hist_sample("op_queue_wait", seconds)
+
+    def _track(self, msg, desc: str):
+        """Register (once) a tracked op for an incoming message; the
+        record rides the message object so park/requeue cycles keep
+        one timeline (OpRequest wraps the Message the same way)."""
+        top = getattr(msg, "_top", None)
+        if top is None:
+            top = self.optracker.create(
+                desc, trace=getattr(msg, "trace", None))
+            msg._top = top
+            top.mark_event("queued")
+        return top
+
+    @staticmethod
+    def _op_event(msg, event: str) -> None:
+        top = getattr(msg, "_top", None)
+        if top is not None:
+            top.mark_event(event)
+
+    @staticmethod
+    def _op_finish(msg, event: str = "done") -> None:
+        top = getattr(msg, "_top", None)
+        if top is not None:
+            top.finish(event)
 
     def _send_mons(self, msg) -> None:
         for i, addr in enumerate(self.mon_addrs):
@@ -205,9 +257,16 @@ class OSD:
         if isinstance(msg, MOSDMapMsg):
             self._handle_osd_map(msg)
         elif isinstance(msg, MOSDOp):
+            ops_s = ",".join(o.get("op", "?")
+                             for o in (msg.ops or []))
+            self._track(msg, "osd_op(%s tid=%s %d.%x %s [%s])"
+                        % (msg.src, msg.tid, msg.pool, msg.ps,
+                           msg.oid, ops_s))
             q((msg.pool, msg.ps), K_CLIENT,
               lambda: self._handle_op(conn, msg))
         elif isinstance(msg, MOSDRepOp):
+            self._track(msg, "rep_op(%s tid=%s %d.%x)"
+                        % (msg.src, msg.tid, msg.pool, msg.ps))
             q((msg.pool, msg.ps), K_CLIENT,
               lambda: self._handle_repop(conn, msg))
         elif isinstance(msg, MOSDRepOpReply):
@@ -260,6 +319,9 @@ class OSD:
         elif isinstance(msg, MOSDRepScrubMap):
             self.scrubber.handle_rep_scrub_map(msg)
         elif isinstance(msg, MOSDECSubOpWrite):
+            self._track(msg, "ec_sub_write(%s tid=%s %d.%x shard=%s)"
+                        % (msg.src, msg.tid, msg.pool, msg.ps,
+                           msg.shard))
             q((msg.pool, msg.ps), K_CLIENT,
               lambda: self.ec.handle_sub_write(conn, msg))
         elif isinstance(msg, MOSDECSubOpWriteReply):
@@ -385,7 +447,15 @@ class OSD:
 
     def _drop_pgs_for_pools(self, pools: set[int]) -> None:
         for pgid in [p for p in self.pgs if p.pool in pools]:
-            del self.pgs[pgid]
+            pg = self.pgs.pop(pgid)
+            # a deleted pool answers nothing: retire tracked state so
+            # parked/in-flight ops don't read as stuck forever
+            for st in pg.in_flight.values():
+                top = st.get("top")
+                if top is not None:
+                    top.finish("aborted_pool_deleted")
+            for _conn, m in pg.waiting_for_active:
+                self._op_finish(m, "dropped_pool_deleted")
 
     def _advance_pg(self, pg: PG, up, upp, acting, actingp) -> None:
         interval_changed = (acting != pg.acting or actingp != pg.primary)
@@ -440,7 +510,21 @@ class OSD:
                     self._start_peering(pg)
             return
         pg.info.same_interval_since = self.osdmap.epoch
+        # repops aborted by the interval change will never be acked:
+        # retire their tracked ops (the client re-targets and resends
+        # on the same map change, so no reply is owed from here)
+        for st in pg.in_flight.values():
+            top = st.get("top")
+            if top is not None:
+                top.finish("aborted_interval_change")
         pg.in_flight.clear()
+        if not pg.is_primary() and pg.waiting_for_active:
+            # parked ops on a demoted primary would wait forever (only
+            # a primary requeues); the client resends to the new
+            # primary on this same map change — drop and retire them
+            parked, pg.waiting_for_active = pg.waiting_for_active, []
+            for _conn, m in parked:
+                self._op_finish(m, "dropped_interval_change")
         # recovery targets that left the up/acting set die with the
         # interval: peering only refreshes entries for peers it
         # re-queries, so a departed osd's stale peer_missing would
@@ -1145,50 +1229,68 @@ class OSD:
 
     # -- client backoff (PrimaryLogPG add_backoff / osd_backoff) -----------
 
-    def _send_backoff(self, pg: PG, conn) -> None:
-        """Tell the client to stop re-sending ops for this PG: the op
-        is parked here and will be answered when the PG activates.
-        Without this, the Objecter's timeout-resend ramp would spam a
-        peering / below-min-size PG with duplicates."""
-        if conn in pg.backoffs or conn.peer_entity.startswith("osd"):
+    def _send_backoff(self, pg: PG, conn, oid: str | None = None) -> None:
+        """Tell the client to stop re-sending ops for this PG (oid
+        None) or one degraded object of it (the reference's
+        hobject-ranged backoffs): the op is parked here and will be
+        answered when the PG activates / the object recovers.  Without
+        this, the Objecter's timeout-resend ramp would spam a peering /
+        below-min-size PG with duplicates.  A PG-wide block supersedes
+        object blocks, so none is sent while one is live."""
+        if conn.peer_entity.startswith("osd"):
+            return
+        if (conn, None) in pg.backoffs or (conn, oid) in pg.backoffs:
             return
         self._backoff_id += 1
-        pg.backoffs[conn] = self._backoff_id
+        pg.backoffs[(conn, oid)] = self._backoff_id
         conn.send(MOSDBackoff(pool=pg.pool_id, ps=pg.ps, op="block",
-                              id=self._backoff_id,
+                              id=self._backoff_id, oid=oid,
                               epoch=self.osdmap.epoch))
 
-    def _release_backoffs(self, pg: PG) -> None:
-        backoffs, pg.backoffs = pg.backoffs, {}
-        for conn, bid in backoffs.items():
+    def _release_backoffs(self, pg: PG, oid: str | None = None) -> None:
+        """Release every backoff (oid None) or just one object's."""
+        if oid is None:
+            backoffs, pg.backoffs = pg.backoffs, {}
+        else:
+            backoffs = {k: v for k, v in pg.backoffs.items()
+                        if k[1] == oid}
+            for k in backoffs:
+                del pg.backoffs[k]
+        for (conn, boid), bid in backoffs.items():
             if conn.is_open:
                 conn.send(MOSDBackoff(pool=pg.pool_id, ps=pg.ps,
-                                      op="unblock", id=bid,
+                                      op="unblock", id=bid, oid=boid,
                                       epoch=self.osdmap.epoch))
 
     # -- client ops --------------------------------------------------------
 
     def _handle_op(self, conn, msg: MOSDOp) -> None:
+        self._op_event(msg, "reached_pg")
         if self.osdmap is None or msg.epoch > self.osdmap.epoch:
+            self._op_event(msg, "waiting_for_map")
             self._waiting_for_map.append((conn, msg))
             return
         pool = self.osdmap.pools.get(msg.pool)
         if pool is None:
             conn.send(MOSDOpReply(tid=msg.tid, result=-2, outs=[],
                                   epoch=self.osdmap.epoch, version=0))
+            self._op_finish(msg, "no_such_pool")
             return
         pgid = pg_t(msg.pool, msg.ps)
         pg = self.pgs.get(pgid)
         if pg is None or not pg.is_primary():
             # not mine: drop — the client resends on map change
             # (Objecter handle_osd_map -> _scan_requests)
+            self._op_finish(msg, "dropped_not_primary")
             return
         if pg.state != STATE_ACTIVE:
+            self._op_event(msg, "waiting_for_active")
             pg.waiting_for_active.append((conn, msg))
             self._send_backoff(pg, conn)
             return
         if pool.is_erasure():
             if not self._min_size_ok(pg, pool):
+                self._op_event(msg, "waiting_for_min_size")
                 pg.waiting_for_active.append((conn, msg))
                 self._send_backoff(pg, conn)
                 return
@@ -1196,6 +1298,7 @@ class OSD:
             return
         writes = any(self._op_is_write(o) for o in msg.ops)
         if not self._min_size_ok(pg, pool):
+            self._op_event(msg, "waiting_for_min_size")
             pg.waiting_for_active.append((conn, msg))
             self._send_backoff(pg, conn)
             return
@@ -1205,7 +1308,12 @@ class OSD:
             return
         oid = msg.oid
         if oid in pg.missing:
+            # object-scoped backoff (the reference's hobject-ranged
+            # add_backoff for degraded objects): only ops on THIS
+            # object pause client-side; the rest of the PG flows
+            self._op_event(msg, "waiting_for_missing_object")
             pg.waiting_for_active.append((conn, msg))
+            self._send_backoff(pg, conn, oid=oid)
             self._kick_recovery(pg)
             return
         if writes and any(oid in (pg.peer_missing.get(o) or {})
@@ -1221,7 +1329,9 @@ class OSD:
             # exempt (their peer_missing is the WHOLE collection; the
             # reference keeps the PG writable through backfill) — the
             # replica apply path tolerates their absent objects.
+            self._op_event(msg, "waiting_for_degraded_object")
             pg.waiting_for_active.append((conn, msg))
+            self._send_backoff(pg, conn, oid=oid)
             self._kick_recovery(pg)
             return
         if writes:
@@ -1233,6 +1343,8 @@ class OSD:
             conn.send(MOSDOpReply(tid=msg.tid, result=result,
                                   outs=outs, epoch=self.osdmap.epoch,
                                   version=0))
+            self.perf.inc("ops")
+            self._op_finish(msg, "read_done")
 
     async def _handle_watch_ops(self, pg: PG, conn, msg) -> None:
         """watch/unwatch/notify ops (PrimaryLogPG do_osd_ops
@@ -1257,6 +1369,7 @@ class OSD:
                 result = -22
         conn.send(MOSDOpReply(tid=msg.tid, result=result, outs=outs,
                               epoch=self.osdmap.epoch, version=0))
+        self._op_finish(msg, "watch_done")
 
     def _min_size_ok(self, pg: PG, pool) -> bool:
         """min_size gating for ALL I/O (PeeringState is_active checks:
@@ -1480,6 +1593,7 @@ class OSD:
         11394).  Snapshot bookkeeping (make_writeable) runs first so
         the clone ops ride the same replicated transaction."""
         from . import snaps as snapmod
+        self._op_event(msg, "started_write")
         epoch = self.osdmap.epoch
         ver = pg.info.last_update[1] + 1
         version = (epoch, ver)
@@ -1590,6 +1704,7 @@ class OSD:
         if result != 0:
             conn.send(MOSDOpReply(tid=msg.tid, result=result, outs=outs,
                                   epoch=epoch, version=0))
+            self._op_finish(msg, "error_reply")
             return
         snapmod.persist_snapset(pg, ho, ss, t)
         entry = LogEntry(
@@ -1604,27 +1719,36 @@ class OSD:
         rep_tid = self._rep_tid
         waiting = set()
         txn_wire = denc.encode(t.to_wire())
+        trace = getattr(msg, "trace", None)
         for osd in pg.acting:
             if osd < 0 or osd == self.whoami:
                 continue
             waiting.add(osd)
-            self._send_osd(osd, MOSDRepOp(
+            rep = MOSDRepOp(
                 pool=pg.pool_id, ps=pg.ps, tid=rep_tid, txn=txn_wire,
                 log_entry=entry.to_wire(), epoch=epoch,
                 min_epoch=pg.info.same_interval_since,
-                pg_trim_to=None))
+                pg_trim_to=None)
+            rep.trace = trace   # sub-op joins the client op's span
+            self._send_osd(osd, rep)
         self.store.apply_transaction(t)
         if not waiting:
             conn.send(MOSDOpReply(tid=msg.tid, result=0, outs=outs,
                                   epoch=epoch, version=ver))
+            self.perf.inc("ops")
+            self._op_finish(msg, "done_no_replicas")
             return
+        self._op_event(msg, "sub_op_sent")
         pg.in_flight[rep_tid] = {
             "waiting": waiting, "conn": conn, "tid": msg.tid,
             "outs": outs, "version": ver,
+            "top": getattr(msg, "_top", None),
+            "t_sub": time.monotonic(),
         }
 
     def _handle_repop(self, conn, msg: MOSDRepOp) -> None:
         """Replica apply (ReplicatedBackend handle_message sub_op)."""
+        self._op_event(msg, "started_apply")
         pgid = pg_t(msg.pool, msg.ps)
         pg = self.pgs.get(pgid)
         if pg is None:
@@ -1660,6 +1784,7 @@ class OSD:
                         raise
         conn.send(MOSDRepOpReply(pool=msg.pool, ps=msg.ps, tid=msg.tid,
                                  result=0, epoch=msg.epoch))
+        self._op_finish(msg, "applied")
 
     def _handle_repop_reply(self, msg: MOSDRepOpReply) -> None:
         pg = self.pgs.get(pg_t(msg.pool, msg.ps))
@@ -1670,12 +1795,22 @@ class OSD:
             return
         sender = int(msg.src.split(".")[1])
         st["waiting"].discard(sender)
+        top = st.get("top")
+        if top is not None:
+            top.mark_event("commit_rec_osd.%d" % sender)
         if not st["waiting"]:
             del pg.in_flight[msg.tid]
+            t_sub = st.get("t_sub")
+            if t_sub is not None:
+                self.perf.hist_sample("op_subop_rtt",
+                                      time.monotonic() - t_sub)
             if st["conn"] is not None:     # internal txns (snap trim)
                 st["conn"].send(MOSDOpReply(
                     tid=st["tid"], result=0, outs=st["outs"],
                     epoch=self.osdmap.epoch, version=st["version"]))
+                self.perf.inc("ops")
+            if top is not None:
+                top.finish("done")
 
     # -- snapshot trim (PrimaryLogPG Trimming / SnapTrimEvent) -------------
 
@@ -1805,8 +1940,19 @@ class OSD:
                                for o in pg.peer_missing)) \
                         and not getattr(pg, "_recovery_flow", False):
                     self._kick_recovery(pg)
+                elif pg.waiting_for_active and not pg.missing:
+                    # safety net against stuck parked ops: an active,
+                    # whole PG with waiters means a requeue edge was
+                    # lost (e.g. the push-reply that should have fired
+                    # it raced an interval flip) — requeue now, gated
+                    # on min_size so a still-degraded PG does not spin
+                    pool = self.osdmap.pools.get(pg.pool_id)
+                    if pool is not None and self._min_size_ok(pg,
+                                                              pool):
+                        self._requeue_waiters(pg)
                 self._maybe_clear_pg_temp(pg)
             self._maybe_send_mgr_report()
+            self._maybe_send_beacon()
             now = time.monotonic()
             grace = conf["heartbeat_grace"]
             # prune state for peers the map says are down, so a later
@@ -1833,6 +1979,29 @@ class OSD:
                     self._send_mons(MOSDFailure(
                         target=osd, failed_for=now - last,
                         epoch=self.osdmap.epoch))
+
+    def _maybe_send_beacon(self) -> None:
+        """MOSDBeacon to the mons: liveness plus the slow-op count
+        (in-flight ops past osd_op_complaint_time).  The monitor's
+        HealthMonitor turns a nonzero cluster total into SLOW_OPS and
+        clears it when a later beacon reports zero."""
+        from ..msg.messages import MOSDBeacon
+        slow = self.optracker.slow_in_flight()
+        self.perf.set("slow_ops", len(slow))
+        now = time.monotonic()
+        if now - self._beacon_stamp < \
+                self.ctx.conf["osd_beacon_report_interval"]:
+            return
+        self._beacon_stamp = now
+        if slow:
+            oldest = max(op.age for op in slow)
+            self.ctx.log.info(
+                "osd", "osd.%d has %d slow ops (oldest %.1fs): %s"
+                % (self.whoami, len(slow), oldest,
+                   slow[0].desc))
+        self._send_mons(MOSDBeacon(osd=self.whoami,
+                                   epoch=self.osdmap.epoch,
+                                   slow_ops=len(slow)))
 
     def _maybe_send_mgr_report(self) -> None:
         """MgrClient::send_report: ship perf counters + a PG state

@@ -152,6 +152,10 @@ class ECPGBackend:
                 conn.send(MOSDOpReply(
                     tid=msg.tid, result=-5, outs=[{"error": repr(e)}],
                     epoch=self.osd.osdmap.epoch, version=0))
+            finally:
+                # every exit retires the tracked op (idempotent): the
+                # success paths already finished it with their stage
+                self.osd._op_finish(msg, "ec_error_reply")
 
     async def _get_snapset(self, pg: PG, oid: str):
         """SnapSet from the local shard's attr, else any member's
@@ -251,11 +255,14 @@ class ECPGBackend:
                     result = -22
             conn.send(MOSDOpReply(tid=msg.tid, result=result, outs=outs,
                                   epoch=epoch, version=0))
+            self.osd.perf.inc("ops")
+            self.osd._op_finish(msg, "ec_read_done")
             return
 
         # write path.  Pure in-place overwrites first try the
         # parity-delta RMW (bytes moved proportional to the touched
         # range, not the object — ECBackend start_rmw's role)
+        self.osd._op_event(msg, "ec_write_started")
         if msg.ops and all(o["op"] == "write" for o in msg.ops):
             res = await self._try_delta_write(pg, msg)
             if res is not None:
@@ -264,6 +271,8 @@ class ECPGBackend:
                     tid=msg.tid, result=0 if ok2 else -11,
                     outs=outs2, epoch=epoch,
                     version=pg.info.last_update[1]))
+                self.osd.perf.inc("ops")
+                self.osd._op_finish(msg, "ec_delta_done")
                 return
         # whole-object RMW fallback
         outs = []
@@ -339,23 +348,42 @@ class ECPGBackend:
                                      xattrs, clone_to=clone_to,
                                      snapset_b=snapset_b,
                                      sna_snaps=sna_snaps,
-                                     whiteout=whiteout)
+                                     whiteout=whiteout,
+                                     top=getattr(msg, "_top", None))
         ver = pg.info.last_update[1]
         conn.send(MOSDOpReply(tid=msg.tid, result=0 if ok else -11,
                               outs=outs, epoch=self.osd.osdmap.epoch,
                               version=ver))
+        self.osd.perf.inc("ops")
+        self.osd._op_finish(msg, "ec_write_done")
 
     # -- write path --------------------------------------------------------
 
-    async def _encode_shards(self, pg: PG, data: bytes
-                             ) -> dict[int, bytes]:
+    async def _encode_shards(self, pg: PG, data: bytes,
+                             top=None) -> dict[int, bytes]:
         """Shard encode for the write path — the device-batched analog
         of ECTransaction::generate_transactions -> ECUtil::encode:
         concurrent writes across PGs aggregate into one TPU dispatch
-        (ceph_tpu.ec.batcher)."""
+        (ceph_tpu.ec.batcher).  The await spans the batch window PLUS
+        the device flush, so its duration is the op's "EC batch wait"
+        stage; the flush the batcher just ran is sampled separately as
+        the "device dispatch" stage."""
+        import time as _time
         codec = self.codec(self.osd.osdmap.pools[pg.pool_id])
         n = codec.get_chunk_count()
-        return await codec.encode_async(set(range(n)), data)
+        if top is not None:
+            top.mark_event("ec_encode_start")
+        t0 = _time.monotonic()
+        shards = await codec.encode_async(set(range(n)), data)
+        self.osd.perf.hist_sample("op_ec_batch_wait",
+                                  _time.monotonic() - t0)
+        if top is not None:
+            top.mark_event("ec_encoded")
+        from ..ec.batcher import DeviceBatcher
+        flush = DeviceBatcher.get().last_flush_s
+        if flush > 0:
+            self.osd.perf.hist_sample("op_ec_device_dispatch", flush)
+        return shards
 
     def _shard_txn(self, pg: PG, ho: hobject_t, shard: bytes, j: int,
                    size: int, version, xattrs: dict | None,
@@ -381,7 +409,8 @@ class ECPGBackend:
                            clone_to: int | None = None,
                            snapset_b: bytes | None = None,
                            sna_snaps: list | None = None,
-                           whiteout: bool = False) -> bool:
+                           whiteout: bool = False,
+                           top=None) -> bool:
         """Encode + distribute one object write; True when every live
         shard acked (ECBackend::try_reads_to_commit).
 
@@ -404,7 +433,7 @@ class ECPGBackend:
         for pm in pg.peer_missing.values():
             pm.pop(oid, None)
         shards = (None if is_delete
-                  else await self._encode_shards(pg, data))
+                  else await self._encode_shards(pg, data, top=top))
         hinfo = None if shards is None else hinfo_bytes(shards)
         ho = hobject_t(oid)
 
@@ -434,11 +463,12 @@ class ECPGBackend:
                 t.omap_setkeys(pg.cid, PGMETA_OID,
                                {snapmod.sna_key(sn, oid): b"1"})
             txns[j] = t
-        return await self._commit_shard_txns(pg, oid, entry, txns)
+        return await self._commit_shard_txns(pg, oid, entry, txns,
+                                             top=top)
 
     async def _commit_shard_txns(self, pg: PG, oid: str, entry,
-                                 txns: dict[int, "Transaction"]
-                                 ) -> bool:
+                                 txns: dict[int, "Transaction"],
+                                 top=None) -> bool:
         """Distribute per-position shard transactions with the
         submit_write ack contract: local apply carries the log/meta
         rows, remotes ride MOSDECSubOpWrite, stragglers become
@@ -473,17 +503,26 @@ class ECPGBackend:
                 self.osd.store.apply_transaction(entryt)
             else:
                 waiting.add(osd_id)
-                self.osd._send_osd(osd_id, MOSDECSubOpWrite(
+                sub = MOSDECSubOpWrite(
                     pool=pg.pool_id, ps=pg.ps, shard=j, tid=tid,
                     txn=denc.encode(t.to_wire()),
-                    log_entry=entry.to_wire(), epoch=epoch))
+                    log_entry=entry.to_wire(), epoch=epoch)
+                # the sub-op joins the client op's cross-daemon span
+                sub.trace = top.trace if top is not None else None
+                self.osd._send_osd(osd_id, sub)
         if waiting:
+            if top is not None:
+                top.mark_event("ec_sub_write_sent")
             try:
                 await asyncio.wait_for(
                     ev.wait(),
                     float(self.osd.ctx.conf["osd_ec_subop_timeout"]))
             except asyncio.TimeoutError:
                 pass
+            if top is not None:
+                top.mark_event("ec_sub_write_acked"
+                               if not st["waiting"]
+                               else "ec_sub_write_timeout")
         self._writes.pop(tid, None)
         behind = set(st["waiting"]) | down_skipped
         if behind:
@@ -747,7 +786,10 @@ class ECPGBackend:
                 t.omap_setkeys(pg.cid, PGMETA_OID,
                                {_snapmod.sna_key(s, msg.oid): b"1"})
             txns[j] = t
-        ok = await self._commit_shard_txns(pg, msg.oid, entry, txns)
+        self.osd._op_event(msg, "ec_delta_rmw")
+        ok = await self._commit_shard_txns(pg, msg.oid, entry, txns,
+                                           top=getattr(msg, "_top",
+                                                       None))
         # the log entry is appended either way: do NOT fall back to the
         # whole-object path after a commit attempt (same durability
         # contract as submit_write: ok = >= k shards persisted)
@@ -775,6 +817,7 @@ class ECPGBackend:
         conn.send(MOSDECSubOpWriteReply(
             pool=msg.pool, ps=msg.ps, shard=msg.shard, tid=msg.tid,
             result=0, epoch=msg.epoch))
+        self.osd._op_finish(msg, "ec_shard_applied")
 
     def handle_sub_write_reply(self, msg: MOSDECSubOpWriteReply) -> None:
         st = self._writes.get(msg.tid)

@@ -68,7 +68,7 @@ class _ClassQ:
         self.r_tag = 0.0
         self.p_tag = 0.0
         self.l_tag = 0.0
-        self.items: list = []          # FIFO of (fn, cost)
+        self.items: list = []          # FIFO of (fn, cost, t_enq)
 
 
 class _Shard:
@@ -81,14 +81,14 @@ class _Shard:
 
     def push(self, klass: str, fn, cost: float) -> None:
         q = self.classes[klass]
+        now = time.monotonic()
         if not q.items:
             # idle -> busy: no banked credit
-            now = time.monotonic()
             q.r_tag = max(q.r_tag, now)
             q.l_tag = max(q.l_tag, now)
             busy_p = [c.p_tag for c in self.classes.values() if c.items]
             q.p_tag = max(q.p_tag, min(busy_p) if busy_p else q.p_tag)
-        q.items.append((fn, cost))
+        q.items.append((fn, cost, now))
         self.size += 1
         self.wake.set()
 
@@ -112,8 +112,9 @@ class _Shard:
         return ("S", max(horizon - now, 0.0005))
 
     def pop(self, klass: str, phase: str):
+        """Returns (fn, queue_wait_seconds)."""
         q = self.classes[klass]
-        fn, cost = q.items.pop(0)
+        fn, cost, t_enq = q.items.pop(0)
         self.size -= 1
         now = time.monotonic()
         if phase == "R":
@@ -126,7 +127,7 @@ class _Shard:
             q.p_tag += cost / q.wgt
             q.l_tag = max(q.l_tag, now) + cost / q.lim
             q.r_tag = max(q.r_tag, now) + cost / q.res
-        return fn
+        return fn, now - t_enq
 
 
 class OpScheduler:
@@ -149,6 +150,12 @@ class OpScheduler:
         self.running = False
         # perf visibility
         self.dispatched = {k: 0 for k in self.profile}
+        # per-class queue-wait books: klass -> [count, sum_seconds];
+        # on_wait(klass, seconds) additionally fires per dequeue so the
+        # OSD can feed its stage-latency histograms (the queue-wait
+        # stage of the op timeline)
+        self.queue_wait = {k: [0, 0.0] for k in self.profile}
+        self.on_wait = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -183,8 +190,16 @@ class OpScheduler:
                 except asyncio.TimeoutError:
                     pass
                 continue
-            fn = sh.pop(val, phase)
+            fn, waited = sh.pop(val, phase)
             self.dispatched[val] += 1
+            book = self.queue_wait[val]
+            book[0] += 1
+            book[1] += waited
+            if self.on_wait is not None:
+                try:
+                    self.on_wait(val, waited)
+                except Exception:
+                    pass    # observability must never sink the worker
             try:
                 r = fn()
                 if asyncio.iscoroutine(r) or isinstance(r, asyncio.Future):

@@ -38,6 +38,12 @@ FAST_CONF = {
     # publications lost to a partition must be repaired within a
     # thrash round, not the production 10s renewal period
     "mon_subscribe_renew_interval": 2.0,
+    # op tracking at dev pacing: an op in flight 5s on a healthy dev
+    # cluster is genuinely stuck (production default is 30s), and
+    # beacons must carry the slow count to the mon within a round
+    "osd_op_complaint_time": 5.0,
+    "osd_beacon_report_interval": 0.25,
+    "osd_op_history_size": 64,
 }
 
 
@@ -107,7 +113,9 @@ class LocalCluster:
             await self._start_osd(i)
         for osd in self.osds:
             await osd.wait_for_boot()
-        self.client = RadosClient(self.mon_addrs, seed=self.seed)
+        self.client = RadosClient(
+            self.mon_addrs, seed=self.seed,
+            ctx=Context("client.0", conf_overrides=self.conf))
         self._install_injector(self.client.msgr, "client.0")
         await self.client.connect()
         return self
@@ -182,11 +190,14 @@ class LocalCluster:
         """Hard-stop osd.i, keeping its store (the "disk")."""
         await self.osds[i].shutdown()
 
-    async def revive_osd(self, i: int,
-                         timeout: float = 20.0) -> OSD:
+    async def revive_osd(self, i: int, timeout: float = 20.0,
+                         wipe: bool = False) -> OSD:
         """Restart osd.i on its surviving store with a fresh
-        messenger nonce (the reboot flow peers reset sessions for)."""
-        store = self.osds[i].store
+        messenger nonce (the reboot flow peers reset sessions for).
+        ``wipe=True`` restarts it on a FRESH store instead (the
+        disk-replacement flow): peering sees an empty osd and
+        backfill must repopulate every PG it serves."""
+        store = None if wipe else self.osds[i].store
         osd = await self._start_osd(i, store=store)
         await osd.wait_for_boot(timeout)
         return osd
@@ -227,6 +238,34 @@ class LocalCluster:
         if leader is not None:
             await self.client.wait_for_epoch(leader.osdmap.epoch)
         return out["pool_id"]
+
+    # -- observability -----------------------------------------------------
+
+    def op_timeline(self, trace: str) -> list[dict]:
+        """Merge every daemon's tracked-op records for one trace id —
+        a completed client write yields the full cross-daemon span:
+        client submit/send, primary queue/execute/sub-op, replica (or
+        EC shard) apply.  Records sort by arrival; in-process daemons
+        share one monotonic clock so stamps are comparable."""
+        out: list[dict] = []
+        trackers = []
+        if self.client is not None:
+            trackers.append(self.client.optracker)
+        trackers += [o.optracker for o in self.live_osds]
+        trackers += [m.optracker for m in self.mons]
+        for tr in trackers:
+            out.extend(tr.find(trace))
+        return sorted(out, key=lambda d: d["initiated"])
+
+    def stuck_ops(self) -> list[dict]:
+        """In-flight ops past the complaint threshold on any live
+        daemon — the thrasher's slow-op oracle: once the cluster is
+        healthy again this must be empty."""
+        out: list[dict] = []
+        for osd in self.live_osds:
+            out.extend(op.dump()
+                       for op in osd.optracker.slow_in_flight())
+        return out
 
     async def wait_health(self, pool_id: int,
                           timeout: float = 30.0) -> None:

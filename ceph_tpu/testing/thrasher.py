@@ -105,19 +105,27 @@ class ClusterThrasher:
     """Seeded rounds of cluster abuse with invariant checks.
 
     actions: the action pool the plan draws from —
-      kill_revive   — hard-stop an OSD, write through the hole,
-                      revive it on the same store;
-      out_in        — weight an OSD out (forcing remap + recovery)
-                      and back in;
-      mon_partition — isolate one monitor bidirectionally, keep
-                      writing under the degraded quorum, heal it
-                      (multi-mon clusters only);
-      map_churn     — burn map epochs (pool create/rm) to exercise
-                      client/OSD map-chasing under load.
+      kill_revive      — hard-stop an OSD, write through the hole,
+                         revive it on the same store;
+      kill_wipe_revive — hard-stop an OSD and revive it on a FRESH
+                         (wiped) store: the disk-replacement flow —
+                         backfill must repopulate it from scratch
+                         while every acked write stays readable;
+      out_in           — weight an OSD out (forcing remap + recovery)
+                         and back in;
+      mon_partition    — isolate one monitor bidirectionally, keep
+                         writing under the degraded quorum, heal it
+                         (multi-mon clusters only);
+      map_churn        — burn map epochs (pool create/rm) to exercise
+                         client/OSD map-chasing under load.
+
+    Slow-op oracle: after every round's health check, no live OSD may
+    still hold an op in flight past osd_op_complaint_time — a healthy
+    cluster with a stuck op means a requeue edge was lost somewhere.
     """
 
-    ALL_ACTIONS = ("kill_revive", "out_in", "mon_partition",
-                   "map_churn")
+    ALL_ACTIONS = ("kill_revive", "kill_wipe_revive", "out_in",
+                   "mon_partition", "map_churn")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -149,13 +157,14 @@ class ClusterThrasher:
         self.log: list[str] = []
 
     def _default_actions(self) -> list[str]:
-        acts = ["kill_revive", "out_in", "map_churn"]
+        acts = ["kill_revive", "kill_wipe_revive", "out_in",
+                "map_churn"]
         if self.cluster.n_mons >= 3:
             acts.append("mon_partition")
         return acts
 
     def _plan_one(self, action: str) -> tuple:
-        if action == "kill_revive":
+        if action in ("kill_revive", "kill_wipe_revive"):
             return (action, self.rng.randrange(self.cluster.n_osds))
         if action == "out_in":
             return (action, self.rng.randrange(self.cluster.n_osds))
@@ -193,12 +202,13 @@ class ClusterThrasher:
     async def _dispatch(self, step: tuple, workload: Workload) -> None:
         action, arg = step
         c = self.cluster
-        if action == "kill_revive":
+        if action in ("kill_revive", "kill_wipe_revive"):
             victim = arg
             await c.kill_osd(victim)
             await c.wait_osd_down(victim)
             await asyncio.sleep(self.hold)      # degraded writes
-            await c.revive_osd(victim)
+            await c.revive_osd(victim,
+                               wipe=(action == "kill_wipe_revive"))
             await c.wait_osd_up(victim)
         elif action == "out_in":
             victim = arg
@@ -235,3 +245,14 @@ class ClusterThrasher:
             await c.wait_health(pool_id, timeout=120.0)
         for wl in workloads:
             await wl.verify(sample=300)
+        # slow-op oracle: the cluster is healthy and every acked write
+        # read back — nothing may still sit in an OSD's in-flight
+        # table past the complaint threshold (a parked op whose
+        # requeue edge was lost would hide here forever)
+        if hasattr(c, "stuck_ops"):
+            stuck = c.stuck_ops()
+            assert not stuck, (
+                "ops stuck past osd_op_complaint_time after the "
+                "cluster went healthy: %r"
+                % [(s["daemon"], s["desc"], round(s["age"], 1))
+                   for s in stuck[:5]])

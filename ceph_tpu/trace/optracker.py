@@ -1,0 +1,172 @@
+"""Per-daemon op tracking: in-flight table + historic rings.
+
+Reference analog: OpTracker (src/common/TrackedOp.h) as wired into
+every daemon through OpRequest (src/osd/OpRequest.h) — ops register on
+arrival, `mark_event` stamps each pipeline stage, completion moves the
+op into a bounded historic ring (plus a separate slow-op ring when it
+exceeded the complaint threshold), and the admin socket serves
+`dump_ops_in_flight` / `dump_historic_ops` / `dump_historic_slow_ops`.
+
+Cross-daemon correlation: every TrackedOp carries a `trace` id (the
+reqid_t role) that the messenger envelope propagates into sub-ops, so
+`find(trace)` across daemons rebuilds one client op's full timeline.
+Stamps are `time.monotonic()` — comparable across the in-process
+daemons of a LocalCluster (one clock), which is what the timeline
+merge relies on.
+
+Slow-op detection (`osd_op_complaint_time` analog): any in-flight op
+older than the complaint threshold counts as slow; daemons report the
+count in beacons and the monitor turns a nonzero cluster total into a
+SLOW_OPS health warning that clears when the ops complete.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+
+class TrackedOp:
+    """One tracked request on one daemon (TrackedOp/OpRequest)."""
+
+    __slots__ = ("tracker", "seq", "trace", "desc", "daemon",
+                 "initiated", "wall", "events", "finished")
+
+    def __init__(self, tracker: "OpTracker", seq: int, desc: str,
+                 trace: str | None):
+        self.tracker = tracker
+        self.seq = seq
+        self.trace = trace
+        self.desc = desc
+        self.daemon = tracker.daemon
+        self.initiated = time.monotonic()
+        self.wall = time.time()
+        self.events: list[tuple[float, str]] = [(self.initiated,
+                                                 "initiated")]
+        self.finished = False
+
+    def mark_event(self, event: str) -> None:
+        if not self.finished:
+            self.events.append((time.monotonic(), event))
+
+    def finish(self, event: str = "done") -> None:
+        """Completion: stamps the final event and retires the op into
+        the tracker's historic ring (idempotent)."""
+        if self.finished:
+            return
+        self.events.append((time.monotonic(), event))
+        self.finished = True
+        self.tracker._retire(self)
+
+    @property
+    def age(self) -> float:
+        """Seconds since arrival (in-flight) or total duration."""
+        end = self.events[-1][0] if self.finished else time.monotonic()
+        return end - self.initiated
+
+    def dump(self) -> dict:
+        return {
+            "trace": self.trace,
+            "desc": self.desc,
+            "daemon": self.daemon,
+            "initiated": self.initiated,
+            "initiated_at": self.wall,
+            "age": self.age,
+            "in_flight": not self.finished,
+            "events": [{"t": t, "rel": t - self.initiated,
+                        "event": e} for t, e in self.events],
+        }
+
+
+class OpTracker:
+    """In-flight table + historic/slow rings for one daemon."""
+
+    def __init__(self, ctx, daemon: str):
+        self.ctx = ctx
+        self.daemon = daemon
+        self._seq = itertools.count(1)
+        self.ops: dict[int, TrackedOp] = {}
+        self.historic: list[TrackedOp] = []
+        self.historic_slow: list[TrackedOp] = []
+        # the context exposes the tracker so the admin socket's builtin
+        # dump commands find it without plumbing (CephContext keeps the
+        # same backref for its admin hooks)
+        ctx.optracker = self
+
+    # -- configuration (live: re-read per call so `config set` acts) ---
+
+    @property
+    def complaint_time(self) -> float:
+        return float(self.ctx.conf.get("osd_op_complaint_time", 30.0))
+
+    # -- lifecycle -----------------------------------------------------
+
+    def create(self, desc: str, trace: str | None = None) -> TrackedOp:
+        op = TrackedOp(self, next(self._seq), desc, trace)
+        self.ops[op.seq] = op
+        return op
+
+    def _retire(self, op: TrackedOp) -> None:
+        self.ops.pop(op.seq, None)
+        self.historic.append(op)
+        cap = int(self.ctx.conf.get("osd_op_history_size", 20))
+        if len(self.historic) > cap:
+            del self.historic[:len(self.historic) - cap]
+        if op.age >= self.complaint_time:
+            self.historic_slow.append(op)
+            scap = int(self.ctx.conf.get(
+                "osd_op_history_slow_op_size", 20))
+            if len(self.historic_slow) > scap:
+                del self.historic_slow[:len(self.historic_slow) - scap]
+
+    # -- slow-op detection ---------------------------------------------
+
+    def slow_in_flight(self) -> list[TrackedOp]:
+        """In-flight ops older than the complaint threshold — the
+        count daemons report in beacons (SLOW_OPS feeds on it)."""
+        limit = self.complaint_time
+        now = time.monotonic()
+        return [op for op in self.ops.values()
+                if now - op.initiated >= limit]
+
+    # -- queries -------------------------------------------------------
+
+    def find(self, trace: str) -> list[dict]:
+        """Every record (in-flight or historic) carrying `trace` —
+        one daemon's slice of a cross-daemon timeline."""
+        out = []
+        seen = set()
+        for op in list(self.ops.values()) + self.historic \
+                + self.historic_slow:
+            if op.trace == trace and id(op) not in seen:
+                seen.add(id(op))
+                out.append(op.dump())
+        return out
+
+    def dump_ops_in_flight(self) -> dict:
+        ops = sorted(self.ops.values(), key=lambda o: o.initiated)
+        return {"num_ops": len(ops),
+                "complaint_time": self.complaint_time,
+                "ops": [op.dump() for op in ops]}
+
+    def dump_historic_ops(self) -> dict:
+        return {"num_ops": len(self.historic),
+                "ops": [op.dump() for op in self.historic]}
+
+    def dump_historic_slow_ops(self) -> dict:
+        return {"num_ops": len(self.historic_slow),
+                "complaint_time": self.complaint_time,
+                "ops": [op.dump() for op in self.historic_slow]}
+
+    # -- admin socket ---------------------------------------------------
+
+    def register_admin(self, admin) -> None:
+        admin.register("dump_ops_in_flight",
+                       lambda a: self.dump_ops_in_flight(),
+                       "show in-flight tracked ops")
+        admin.register("dump_historic_ops",
+                       lambda a: self.dump_historic_ops(),
+                       "show recently completed ops")
+        admin.register("dump_historic_slow_ops",
+                       lambda a: self.dump_historic_slow_ops(),
+                       "show recently completed slow ops")

@@ -51,6 +51,26 @@ class AdminSocket:
         self.register("log dump", lambda a: (ctx.log.dump_recent(), "ok")[1],
                       "dump recent log ring to the daemon log")
 
+        # op-tracker dumps (TrackedOp/OpTracker admin commands): the
+        # tracker registers itself on the context at construction, so
+        # resolve lazily — daemons build their tracker after the
+        # context (and some daemons have none)
+        def tracker():
+            tr = getattr(ctx, "optracker", None)
+            if tr is None:
+                raise RuntimeError("this daemon tracks no ops")
+            return tr
+
+        self.register("dump_ops_in_flight",
+                      lambda a: tracker().dump_ops_in_flight(),
+                      "show in-flight tracked ops")
+        self.register("dump_historic_ops",
+                      lambda a: tracker().dump_historic_ops(),
+                      "show recently completed ops")
+        self.register("dump_historic_slow_ops",
+                      lambda a: tracker().dump_historic_slow_ops(),
+                      "show recently completed slow ops")
+
     # -- server ----------------------------------------------------------
     def start(self) -> None:
         if not self.path:

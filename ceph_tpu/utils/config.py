@@ -250,6 +250,15 @@ DEFAULT_SCHEMA: list[Option] = [
            "assumed per-osd op capacity for mClock tag rates"),
     Option("osd_ec_subop_timeout", OPT_FLOAT, 10.0,
            "deadline for EC sub-op acks before marking peers behind"),
+    Option("osd_op_complaint_time", OPT_FLOAT, 30.0,
+           "age after which an in-flight tracked op counts as slow"
+           " (feeds beacons and the SLOW_OPS health warning)"),
+    Option("osd_op_history_size", OPT_INT, 20,
+           "completed ops kept in the OpTracker historic ring"),
+    Option("osd_op_history_slow_op_size", OPT_INT, 20,
+           "completed slow ops kept in the slow historic ring"),
+    Option("osd_beacon_report_interval", OPT_FLOAT, 1.0,
+           "period of OSD->mon beacons carrying slow-op counts"),
     Option("auth_cluster_required", OPT_STR, "none",
            "cluster auth mode: none | shared (cephx analog)"),
     Option("auth_key", OPT_STR, "",

@@ -24,6 +24,29 @@ def _metric_name(*parts: str) -> str:
     return _NAME_RE.sub("_", "_".join(p for p in parts if p))
 
 
+def hist_lines(base: str, buckets: list,
+               labels: str = "") -> list[str]:
+    """Prometheus histogram series from a PerfCounters power-of-two
+    microsecond histogram (bucket i counts samples < 2^(i+1) µs).
+    `labels` is an optional pre-rendered label body ('daemon="osd.0"')
+    merged into each bucket's le label — the per-daemon form the mgr
+    renders from MMgrReports."""
+    lines = []
+    if not labels:
+        lines.append("# TYPE %s histogram" % base)
+    cum = 0
+    sep = "," if labels else ""
+    for i, n in enumerate(buckets):
+        cum += n
+        lines.append('%s_bucket{%s%sle="%g"} %d'
+                     % (base, labels, sep, float(2 ** (i + 1)), cum))
+    lines.append('%s_bucket{%s%sle="+Inf"} %d'
+                 % (base, labels, sep, cum))
+    lines.append("%s_count{%s} %d" % (base, labels, cum)
+                 if labels else "%s_count %d" % (base, cum))
+    return lines
+
+
 class PrometheusExporter:
     def __init__(self, ctx, prefix: str = "ceph_tpu"):
         self.ctx = ctx
@@ -57,7 +80,11 @@ class PrometheusExporter:
         for group, counters in sorted(dump.items()):
             for cname, val in sorted(counters.items()):
                 base = _metric_name(self.prefix, group, cname)
-                if isinstance(val, dict):
+                if isinstance(val, dict) \
+                        and "buckets_us_pow2" in val:
+                    lines.extend(hist_lines(base,
+                                            val["buckets_us_pow2"]))
+                elif isinstance(val, dict):
                     # avg/time counters dump {avgcount, sum, ...}
                     for sub, sv in sorted(val.items()):
                         if isinstance(sv, (int, float)):

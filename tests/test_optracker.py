@@ -1,0 +1,330 @@
+"""Request-level observability: OpTracker rings, cross-daemon span
+propagation, slow-op detection -> SLOW_OPS health, admin-socket dumps,
+object-scoped backoffs, and stage-histogram rendering.
+
+The acceptance scenario rides here: a thrashed LocalCluster dumps a
+completed client write's timeline with >= 4 distinct stages spanning
+>= 2 daemons, and an artificially stalled op raises SLOW_OPS which
+clears once the op completes.
+"""
+
+import asyncio
+import os
+import time
+
+from ceph_tpu.testing import ClusterThrasher, LocalCluster, Workload
+from ceph_tpu.trace import OpTracker
+from ceph_tpu.utils.backoff import wait_for
+from ceph_tpu.utils.context import Context
+from ceph_tpu.utils.exporter import PrometheusExporter
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+# -- unit: rings, slow detection, envelope -------------------------------
+
+
+def test_tracker_rings_and_slow_detection():
+    ctx = Context("osd.9", conf_overrides={
+        "osd_op_history_size": 4,
+        "osd_op_history_slow_op_size": 2,
+        "osd_op_complaint_time": 0.05,
+    })
+    tr = OpTracker(ctx, "osd.9")
+    assert ctx.optracker is tr
+    # historic ring stays bounded and ordered
+    for i in range(7):
+        tr.create("op-%d" % i, trace="t%d" % i).finish()
+    assert len(tr.ops) == 0
+    assert [o.desc for o in tr.historic] == \
+        ["op-3", "op-4", "op-5", "op-6"]
+    # slow detection: an aging in-flight op crosses the threshold
+    slow = tr.create("stuck", trace="ts")
+    assert tr.slow_in_flight() == []
+    time.sleep(0.06)
+    assert [o.desc for o in tr.slow_in_flight()] == ["stuck"]
+    d = tr.dump_ops_in_flight()
+    assert d["num_ops"] == 1 and d["ops"][0]["in_flight"]
+    # completion retires it into BOTH rings (it exceeded complaint)
+    slow.mark_event("recovered")
+    slow.finish()
+    assert tr.slow_in_flight() == []
+    hist = tr.dump_historic_slow_ops()
+    assert [o["desc"] for o in hist["ops"]] == ["stuck"]
+    events = [e["event"] for e in hist["ops"][0]["events"]]
+    assert events == ["initiated", "recovered", "done"]
+    # find() correlates by trace id across rings
+    assert [o["desc"] for o in tr.find("ts")] == ["stuck"]
+
+
+def test_trace_rides_the_message_envelope():
+    from ceph_tpu.msg.message import decode_message, encode_message
+    from ceph_tpu.msg.messages import MOSDOp
+    from ceph_tpu.utils import denc
+
+    m = MOSDOp(tid=3, pool=1, ps=0, oid="x", snapc=None, snapid=None,
+               ops=[{"op": "stat"}], epoch=5, flags=0)
+    m.trace = "client.0:3"
+    out = decode_message(encode_message(m))
+    assert out.trace == "client.0:3"
+    assert out.tid == 3 and out.oid == "x"
+    # a pre-trace (4-element) envelope still decodes, trace = None
+    legacy = denc.encode_versioned(
+        ["osd_op", 1, "client.0", m.to_wire()], 1, 1)
+    old = decode_message(legacy)
+    assert old.trace is None and old.oid == "x"
+
+
+def test_admin_socket_dump_commands(tmp_path):
+    path = str(tmp_path / "osd.asok")
+    ctx = Context("osd.7", conf_overrides={"admin_socket": path})
+    try:
+        tr = OpTracker(ctx, "osd.7")
+        op = tr.create("osd_op(client.1:9 0.0 obj [write])",
+                       trace="client.1:9")
+        op.mark_event("queued")
+        from ceph_tpu.utils.admin import admin_command
+        d = admin_command(path, "dump_ops_in_flight")
+        assert d["num_ops"] == 1
+        assert d["ops"][0]["trace"] == "client.1:9"
+        op.finish()
+        assert admin_command(path, "dump_ops_in_flight")["num_ops"] == 0
+        h = admin_command(path, "dump_historic_ops")
+        assert h["num_ops"] == 1
+        assert [e["event"] for e in h["ops"][0]["events"]][-1] == "done"
+        assert admin_command(
+            path, "dump_historic_slow_ops")["num_ops"] == 0
+    finally:
+        ctx.shutdown()
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+def test_exporter_renders_stage_histograms():
+    ctx = Context("t")
+    pc = ctx.perf.create("osd")
+    pc.add_hist("op_queue_wait", "queue wait")
+    pc.hist_sample("op_queue_wait", 0.0005)   # ~500 us -> bucket 9
+    pc.hist_sample("op_queue_wait", 0.02)     # ~20 ms
+    body = PrometheusExporter(ctx).render()
+    assert 'ceph_tpu_osd_op_queue_wait_bucket{le="' in body
+    assert 'le="+Inf"} 2' in body
+    assert "ceph_tpu_osd_op_queue_wait_count 2" in body
+
+
+def test_mgr_aggregates_slow_ops_and_hists():
+    from ceph_tpu.mgr import Manager
+
+    mgr = Manager("127.0.0.1:1", Context("mgr"))
+    mgr.daemon_reports = {
+        "osd.0": {"perf": {"osd": {
+            "slow_ops": 2,
+            "op_subop_rtt": {"buckets_us_pow2": [0, 3] + [0] * 30},
+        }}, "pg_states": {}, "num_pgs": 1, "num_objects": 1},
+        "osd.1": {"perf": {"osd": {"slow_ops": 1}},
+                  "pg_states": {}, "num_pgs": 1, "num_objects": 0},
+    }
+    assert mgr._total_slow_ops() == 3
+    lines = "\n".join(mgr._render_reports())
+    assert 'ceph_tpu_daemon_osd_slow_ops{daemon="osd.0"} 2' in lines
+    assert ('ceph_tpu_daemon_osd_op_subop_rtt_bucket'
+            '{daemon="osd.0",le="4"} 3') in lines
+
+
+# -- cluster: span propagation + acceptance scenario ---------------------
+
+
+def _trace_of(client, oid: str) -> str:
+    """Trace id of the most recent completed client op naming oid."""
+    for rec in reversed(client.optracker.historic):
+        if " %s " % oid in rec.desc or "%s " % oid in rec.desc:
+            return rec.trace
+    raise AssertionError("no completed client op for %r" % oid)
+
+
+def test_thrashed_write_timeline_spans_daemons():
+    """Acceptance: after a thrash round, one client write's merged
+    timeline shows the full pipeline — client submit/send, primary
+    queue/execute/replicate, replica apply — >= 4 distinct stages
+    over >= 2 daemons."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=21).start()
+        try:
+            pid = await c.create_pool("data", pg_num=8, size=3)
+            await c.wait_health(pid)
+            wl = Workload(c.client.io_ctx("data"), seed=21).start()
+            th = ClusterThrasher(c, seed=21,
+                                 actions=[("kill_revive", 1)])
+            await th.run(pid, wl)
+            await wl.stop()
+            io = c.client.io_ctx("data")
+            await io.write_full("tl-obj", b"traced write" * 8)
+            await asyncio.sleep(0.3)    # replica records retire
+            trace = _trace_of(c.client, "tl-obj")
+            tl = c.op_timeline(trace)
+            daemons = {rec["daemon"] for rec in tl}
+            events = {e["event"] for rec in tl
+                      for e in rec["events"]}
+            assert len(daemons) >= 2, (daemons, tl)
+            # >= 4 distinct pipeline stages across the span
+            stages = events & {"queued", "reached_pg",
+                               "started_write", "sub_op_sent",
+                               "started_apply", "applied"}
+            assert len(stages) >= 4, (stages, events)
+            # the replica's sub-op record carries the SAME trace id
+            assert any(r["daemon"].startswith("osd")
+                       and "rep_op" in r["desc"] for r in tl), tl
+            assert all(not r["in_flight"] for r in tl), tl
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_ec_write_records_batch_stages():
+    """EC writes mark the device-batcher stages and feed the stage
+    histograms the exporter renders."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("ecd", pg_num=4,
+                                      pool_type="erasure")
+            await c.wait_health(pid)
+            io = c.client.io_ctx("ecd")
+            await io.write_full("eobj", os.urandom(4096))
+            await asyncio.sleep(0.2)
+            trace = _trace_of(c.client, "eobj")
+            tl = c.op_timeline(trace)
+            events = {e["event"] for rec in tl
+                      for e in rec["events"]}
+            assert "ec_encode_start" in events, events
+            assert "ec_encoded" in events, events
+            primary = next(r["daemon"] for r in tl
+                           if "osd_op(" in r["desc"])
+            osd = next(o for o in c.osds
+                       if "osd.%d" % o.whoami == primary)
+            dump = osd.ctx.perf.dump()["osd"]
+            assert sum(dump["op_ec_batch_wait"]
+                       ["buckets_us_pow2"]) >= 1
+            body = PrometheusExporter(osd.ctx).render()
+            assert "ceph_tpu_osd_op_ec_batch_wait_bucket" in body
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_slow_op_raises_and_clears_slow_ops_health():
+    """Acceptance: a stalled write (PG below min_size parks it on the
+    primary) ages past osd_op_complaint_time -> beacons carry the
+    count -> the monitor raises SLOW_OPS; completing the op (revive a
+    replica) clears the warning."""
+
+    async def main():
+        c = await LocalCluster(
+            n_osds=3, conf={"osd_op_complaint_time": 0.75}).start()
+        try:
+            pid = await c.create_pool("data", pg_num=4, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            await io.write_full("pre", b"healthy write")
+            health = await c.client.mon_command("health")
+            assert "SLOW_OPS" not in health["checks"]
+            await c.kill_osd(1)
+            await c.kill_osd(2)
+            await c.wait_osd_down(1)
+            await c.wait_osd_down(2)
+            # |up acting| = 1 < min_size: the write parks primary-side
+            write = asyncio.ensure_future(
+                io.write_full("stalled", b"parked until revival"))
+
+            async def health_has_slow():
+                h = await c.client.mon_command("health")
+                return ("SLOW_OPS" in h["checks"], h)
+
+            t0 = asyncio.get_running_loop().time()
+            while True:
+                got, h = await health_has_slow()
+                if got:
+                    break
+                assert asyncio.get_running_loop().time() - t0 < 30, \
+                    "SLOW_OPS never raised: %r" % (h,)
+                await asyncio.sleep(0.2)
+            assert h["status"] != "HEALTH_OK"
+            assert "slow ops" in h["checks"]["SLOW_OPS"]["summary"]
+            # the primary's tracker agrees
+            assert c.osds[0].optracker.slow_in_flight()
+            # revival completes the op ...
+            await c.revive_osd(1)
+            await c.wait_osd_up(1)
+            await asyncio.wait_for(write, 60)
+            assert await io.read("stalled") == b"parked until revival"
+            # ... and the warning clears on the next zero beacon
+            t0 = asyncio.get_running_loop().time()
+            while True:
+                got, h = await health_has_slow()
+                if not got:
+                    break
+                assert asyncio.get_running_loop().time() - t0 < 30, \
+                    "SLOW_OPS never cleared: %r" % (h,)
+                await asyncio.sleep(0.2)
+            # the stall is preserved for postmortem in the slow ring
+            slow_hist = c.osds[0].optracker.dump_historic_slow_ops()
+            assert slow_hist["num_ops"] >= 1
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_object_scoped_backoff_blocks_one_object_only():
+    """A write to a degraded object gets an hobject-scoped MOSDBackoff:
+    the client pauses resends for THAT object while other objects in
+    the same PG keep flowing; recovery completion releases it."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("data", pg_num=1, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            await io.write_full("objA", b"a" * 64)
+            await io.write_full("objB", b"b" * 64)
+            primary, pgid, acting = c.client._calc_target(pid, "objA")
+            prim = c.osds[primary]
+            from ceph_tpu.osd.osdmap import pg_t
+            pg = prim.pgs[pg_t(pid, pgid.ps)]
+            replica = next(o for o in acting if o != primary)
+            # freeze recovery so the degraded window is observable
+            orig_kick = prim._kick_recovery
+            prim._kick_recovery = lambda pg: None
+            pg.peer_missing[replica] = {"objA": "modify"}
+            w = asyncio.ensure_future(
+                io.write_full("objA", b"A2" * 32))
+            await wait_for(
+                lambda: (pid, pgid.ps, "objA") in c.client._backoffs,
+                15, what="object-scoped backoff at the client")
+            assert not w.done()
+            # same PG, different object: still writable
+            await asyncio.wait_for(io.write_full("objB", b"B2" * 32),
+                                   15)
+            assert not w.done()
+            # "recovery" completes: requeue releases the object block
+            pg.peer_missing.pop(replica, None)
+            prim._kick_recovery = orig_kick
+            prim._requeue_waiters(pg)
+            await asyncio.wait_for(w, 15)
+            await wait_for(
+                lambda: (pid, pgid.ps, "objA")
+                not in c.client._backoffs,
+                15, what="object backoff released")
+            assert await io.read("objA") == b"A2" * 32
+            assert await io.read("objB") == b"B2" * 32
+        finally:
+            await c.stop()
+
+    run(main())

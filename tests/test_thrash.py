@@ -153,6 +153,48 @@ def test_osd_backoff_blocks_resend_until_pg_active():
     run(main())
 
 
+def test_thrash_wipe_revive_backfills_fresh_store():
+    """kill_wipe_revive (disk-replacement flow): an OSD revived on a
+    WIPED store must be repopulated by backfill — every acked write
+    survives, the replacement store actually holds the objects again,
+    and the slow-op oracle (no op stuck past osd_op_complaint_time on
+    a healthy cluster) passes the round."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=77).start()
+        try:
+            pid = await c.create_pool("data", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            pre = {}
+            for i in range(20):
+                data = (b"pre-%d|" % i) * 16
+                await io.write_full("pre-%d" % i, data)
+                pre["pre-%d" % i] = data
+            wl = Workload(io, seed=77).start()
+            th = ClusterThrasher(c, seed=77,
+                                 actions=[("kill_wipe_revive", 1)])
+            await th.run(pid, wl)     # round verify: health + acked
+            await wl.stop()           # writes + slow-op oracle
+            # size=3 over 3 osds: after active+clean, backfill must
+            # have rebuilt EVERY object onto osd.1's fresh store
+            store = c.osds[1].store
+            names = set()
+            for cid in store.list_collections():
+                if cid.is_pg():
+                    names |= {h.name
+                              for h in store.collection_list(cid)}
+            missing = set(pre) - names
+            assert not missing, \
+                "backfill left the wiped store short: %r" % missing
+            for oid, data in pre.items():
+                assert await io.read(oid) == data
+        finally:
+            await c.stop()
+
+    run(main())
+
+
 @pytest.mark.slow
 def test_long_thrash_seeded_random_plan():
     """Extended thrash: a fully seeded random plan (kills, weight
