@@ -1,0 +1,18 @@
+"""Unified TPU device runtime (see runtime.py for the design note).
+
+Both accelerator hot paths — batched EC matmuls (ceph_tpu.ec.batcher)
+and bulk CRUSH mapping (ceph_tpu.parallel.mapping) — route their
+dispatches through the per-process DeviceRuntime: shape-bucketed
+compile cache, pooled staging buffers, weighted admission
+backpressure, and device-loss fallback to the host paths.
+"""
+
+from .runtime import (BufferPool, DeviceBusy, DeviceLost,
+                      DeviceRuntime, DispatchQueue, DispatchTicket,
+                      K_CLIENT_EC, K_MAPPING, K_RECOVERY_EC)
+
+__all__ = [
+    "BufferPool", "DeviceBusy", "DeviceLost", "DeviceRuntime",
+    "DispatchQueue", "DispatchTicket",
+    "K_CLIENT_EC", "K_MAPPING", "K_RECOVERY_EC",
+]

@@ -1,0 +1,534 @@
+"""Per-process TPU device runtime: the shared substrate under both
+accelerator hot paths (batched EC matmuls and bulk CRUSH mapping).
+
+Why a runtime at all (PAPERS: Ragged Paged Attention 2604.15464 for the
+shape-bucket recipe; "GPUs as Storage System Accelerators" 1202.3669
+for admission control): until this layer existed each hot path talked
+to JAX ad hoc — every novel batch width recompiled, staging buffers
+were allocated per flush, and nothing bounded device queue depth, so a
+mapping storm could starve EC writes.  The runtime centralises four
+concerns:
+
+* **shape-bucketed compile cache** — batches pad to power-of-two
+  word-count buckets so steady state hits a handful of jitted
+  programs; `note_program` is the compile counter the acceptance
+  criteria assert against, and `warmup_ec` pre-compiles the common
+  buckets at OSD boot.
+* **HBM staging pool** — bucket-sized arrays leased/released across
+  flushes instead of allocated per flush (`BufferPool`).
+* **dispatch queue with admission backpressure** — bounded in-flight
+  dispatches, weighted-fair across service classes (client-EC /
+  recovery-EC / mapping — the weights mirror the mClock op-scheduler
+  profile, osd/scheduler.py DEVICE_DISPATCH_WEIGHTS); queue-full
+  surfaces as `DeviceBusy` so callers degrade to deadline-flush or
+  the host path instead of piling device work.
+* **device-loss degradation** — a failed/poisoned dispatch flips the
+  runtime to fallback (`available` False: the EC batcher encodes on
+  the host codecs, PoolMapping takes the scalar mapper), OSD beacons
+  carry the flag so the mon raises DEVICE_FALLBACK, and a probe loop
+  retries under ExpBackoff until the device heals.
+
+Every dispatch carries a `DispatchTicket` (class, bucket, bytes,
+enqueue/launch/done stamps) that feeds the exporter
+(`device_dispatch_seconds`, `device_queue_depth`,
+`device_bucket_hit_ratio`) and gives the OpTracker exact per-op flush
+attribution (the ticket IS the op's device-dispatch stage — no more
+sampling the batcher's last flush time).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+
+import numpy as np
+
+# service classes (the device-side analog of the mClock op classes)
+K_CLIENT_EC = "client-ec"
+K_RECOVERY_EC = "recovery-ec"
+K_MAPPING = "mapping"
+
+
+class DeviceBusy(Exception):
+    """Admission rejected: the dispatch queue is at its bound.  The
+    caller degrades (deadline-flush later, or host fallback) instead
+    of stacking more device work."""
+
+
+class DeviceLost(Exception):
+    """A dispatch failed at the device layer (or a fault was
+    injected): the runtime flips to host fallback."""
+
+
+class DispatchTicket:
+    """One device dispatch's identity + timeline.
+
+    Stamps: t_enqueue (admission requested) -> t_admit (queue granted)
+    -> t_launch (dispatch handed to the device) -> t_done.  queue_wait
+    and device_s are the two stages the exporter and the OpTracker
+    attribute separately."""
+
+    __slots__ = ("seq", "klass", "bucket", "nbytes", "t_enqueue",
+                 "t_admit", "t_launch", "t_done", "ok", "error")
+
+    def __init__(self, seq: int, klass: str, bucket: int, nbytes: int):
+        self.seq = seq
+        self.klass = klass
+        self.bucket = bucket
+        self.nbytes = nbytes
+        self.t_enqueue = time.monotonic()
+        self.t_admit = 0.0
+        self.t_launch = 0.0
+        self.t_done = 0.0
+        self.ok = False
+        self.error: str | None = None
+
+    @property
+    def queue_wait(self) -> float:
+        return max(0.0, (self.t_admit or self.t_enqueue)
+                   - self.t_enqueue)
+
+    @property
+    def device_s(self) -> float:
+        """Wall seconds of the device call itself (launch -> done)."""
+        if not self.t_done or not self.t_launch:
+            return 0.0
+        return max(0.0, self.t_done - self.t_launch)
+
+    def dump(self) -> dict:
+        return {"seq": self.seq, "klass": self.klass,
+                "bucket": self.bucket, "bytes": self.nbytes,
+                "queue_wait": self.queue_wait,
+                "device_s": self.device_s, "ok": self.ok,
+                "error": self.error}
+
+
+class BufferPool:
+    """Free-lists of bucket-sized staging arrays keyed (shape, dtype).
+
+    The HBM-buffer-pool role scaled to this build's dispatch layer:
+    flushes stage their padded batch into a leased array instead of
+    allocating per flush, so steady state does zero per-flush
+    allocation (tests pin `misses` flat while `hits` grows).  Leased
+    arrays come back zeroed — bucket padding must be zero for GF
+    bit-parity with the unpadded host encode."""
+
+    def __init__(self, max_per_key: int = 4):
+        self.max_per_key = max_per_key
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.outstanding = 0
+
+    def lease(self, shape: tuple, dtype) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype).str)
+        free = self._free.get(key)
+        if free:
+            arr = free.pop()
+            arr[...] = 0
+            self.hits += 1
+        else:
+            arr = np.zeros(shape, dtype=dtype)
+            self.misses += 1
+        self.outstanding += 1
+        return arr
+
+    def release(self, arr: np.ndarray) -> None:
+        self.outstanding -= 1
+        key = (arr.shape, arr.dtype.str)
+        free = self._free.setdefault(key, [])
+        if len(free) < self.max_per_key:
+            free.append(arr)
+
+    def clear(self) -> None:
+        self._free.clear()
+
+
+class DispatchQueue:
+    """Bounded in-flight dispatches with weighted-fair admission.
+
+    Start-time fair queueing over virtual time: each class keeps a
+    finish tag advanced by cost/weight per grant, waiters are served
+    in tag order — so under contention client-EC (weight 4) gets ~4x
+    the grants of mapping (weight 1), mirroring how mClock shares OSD
+    capacity.  `admit` parks the caller while the queue has room;
+    once `max_queue` waiters are parked further admissions raise
+    DeviceBusy — that is the backpressure edge the batcher and the
+    mapper degrade on."""
+
+    def __init__(self, weights: dict[str, float],
+                 max_inflight: int = 2, max_queue: int = 64):
+        self.weights = dict(weights)
+        self.max_inflight = max(1, int(max_inflight))
+        self.max_queue = max(0, int(max_queue))
+        self.inflight = 0
+        self._vt = 0.0                      # virtual clock
+        self._finish: dict[str, float] = {}
+        self._seq = 0
+        # heap of (finish_tag, seq, klass, cost, future)
+        self._waiters: list = []
+        self.granted = {k: 0 for k in self.weights}
+        self.rejected = 0
+
+    @property
+    def depth(self) -> int:
+        return self.inflight + len(self._waiters)
+
+    def _tag(self, klass: str, cost: float) -> float:
+        w = self.weights.get(klass, 1.0)
+        start = max(self._vt, self._finish.get(klass, 0.0))
+        fin = start + cost / max(w, 1e-9)
+        self._finish[klass] = fin
+        return fin
+
+    def _grant(self, klass: str) -> None:
+        self.inflight += 1
+        self.granted[klass] = self.granted.get(klass, 0) + 1
+
+    def try_admit(self, klass: str, cost: float = 1.0) -> None:
+        """Synchronous, non-blocking admission (the bulk mapper's
+        path — it runs outside a coroutine).  Raises DeviceBusy when
+        a grant would overtake parked waiters or exceed the bound."""
+        if self.inflight >= self.max_inflight or self._waiters:
+            self.rejected += 1
+            raise DeviceBusy("device dispatch queue at depth %d"
+                             % self.depth)
+        self._vt = max(self._vt, self._finish.get(klass, 0.0))
+        self._tag(klass, cost)
+        self._grant(klass)
+
+    async def admit(self, klass: str, cost: float = 1.0) -> None:
+        if self.inflight < self.max_inflight and not self._waiters:
+            self._tag(klass, cost)
+            self._grant(klass)
+            return
+        if len(self._waiters) >= self.max_queue:
+            self.rejected += 1
+            raise DeviceBusy("device dispatch queue full (%d waiting)"
+                             % len(self._waiters))
+        fut = asyncio.get_event_loop().create_future()
+        self._seq += 1
+        heapq.heappush(self._waiters,
+                       (self._tag(klass, cost), self._seq, klass,
+                        cost, fut))
+        await fut
+
+    def release(self) -> None:
+        self.inflight = max(0, self.inflight - 1)
+        while self.inflight < self.max_inflight and self._waiters:
+            tag, _seq, klass, _cost, fut = heapq.heappop(self._waiters)
+            self._vt = max(self._vt, tag)
+            if fut.cancelled():
+                continue
+            self._grant(klass)
+            fut.set_result(None)
+
+
+_MIN_BUCKET = 512          # words: floor so tiny flushes share one program
+_TICKET_RING = 512
+_HIST_BUCKETS = 32         # power-of-two microsecond histogram
+
+
+class DeviceRuntime:
+    """One per process (per event loop, with a loop-less fallback for
+    synchronous callers such as the bulk mapper warming outside
+    asyncio).  Both hot paths route dispatches through here."""
+
+    _global: "DeviceRuntime | None" = None
+
+    def __init__(self, weights: dict[str, float] | None = None,
+                 max_inflight: int = 2, max_queue: int = 64):
+        if weights is None:
+            from ..osd.scheduler import DEVICE_DISPATCH_WEIGHTS
+            weights = DEVICE_DISPATCH_WEIGHTS
+        self.queue = DispatchQueue(weights, max_inflight, max_queue)
+        self.pool = BufferPool()
+        # compile cache bookkeeping: program identity -> compiled once
+        self.programs: set[tuple] = set()
+        self.compile_count = 0
+        self.bucket_hits = 0
+        self.bucket_misses = 0
+        # dispatch telemetry
+        self._seq = 0
+        self.tickets: list[DispatchTicket] = []     # bounded ring
+        self.dispatch_buckets_us = [0] * _HIST_BUCKETS
+        self.dispatches = 0
+        self.dispatch_seconds = 0.0
+        self.host_fallbacks = 0        # flushes served by host codecs
+        # device-loss state
+        self.fallback = False
+        self.fallback_reason: str | None = None
+        self.fallback_count = 0
+        self.heal_count = 0
+        self._fault_budget = 0         # injected failures outstanding
+        self._probe_task = None
+        self._probe_base = 0.05
+        self._probe_cap = 1.0
+        self._listeners: list = []     # on_state_change(fallback: bool)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def get(cls) -> "DeviceRuntime":
+        """Loop-local instance (lifetime tracks the loop, same
+        reasoning as DeviceBatcher.get); synchronous callers with no
+        loop share a process-global instance."""
+        try:
+            loop = asyncio.get_event_loop()
+        except RuntimeError:
+            loop = None
+        if loop is None:
+            if cls._global is None:
+                cls._global = cls()
+            return cls._global
+        inst = getattr(loop, "_ceph_tpu_device_runtime", None)
+        if inst is None:
+            inst = cls()
+            loop._ceph_tpu_device_runtime = inst
+        return inst
+
+    @classmethod
+    def reset(cls) -> "DeviceRuntime":
+        """Fresh instance bound to the current loop (tests)."""
+        inst = cls()
+        try:
+            loop = asyncio.get_event_loop()
+            loop._ceph_tpu_device_runtime = inst
+        except RuntimeError:
+            cls._global = inst
+        return inst
+
+    def configure(self, conf) -> None:
+        """Adopt daemon config (OSD boot): queue bounds + probe ramp."""
+        try:
+            self.queue.max_inflight = max(
+                1, int(conf["device_max_inflight"]))
+            self.queue.max_queue = int(conf["device_queue_len"])
+            self.probe_interval = float(conf["device_probe_interval"])
+            self._probe_base = self.probe_interval / 4.0
+            self._probe_cap = self.probe_interval
+        except (KeyError, TypeError):
+            pass
+
+    # -- shape buckets / compile cache ------------------------------------
+
+    @staticmethod
+    def bucket_for(n_words: int) -> int:
+        """Pad target: next power of two >= n, floored at _MIN_BUCKET
+        so micro-flushes share one program."""
+        n = max(int(n_words), _MIN_BUCKET)
+        return 1 << (n - 1).bit_length()
+
+    def note_program(self, kind: str, key: tuple) -> bool:
+        """Record a program dispatch; True when this (kind, key) had
+        never compiled before.  `compile_count` is the acceptance
+        criterion's counter: a steady-state mixed workload must stay
+        within a handful of distinct programs."""
+        pk = (kind,) + tuple(key)
+        if pk in self.programs:
+            self.bucket_hits += 1
+            return False
+        self.programs.add(pk)
+        self.compile_count += 1
+        self.bucket_misses += 1
+        return True
+
+    @property
+    def bucket_hit_ratio(self) -> float:
+        total = self.bucket_hits + self.bucket_misses
+        return self.bucket_hits / total if total else 1.0
+
+    async def warmup_ec(self, matrix, w: int,
+                        buckets: tuple = (1024, 4096, 16384)) -> None:
+        """Pre-compile the common EC buckets for one coding matrix at
+        boot so the first client flushes hit the cache instead of
+        paying a compile inside the write path."""
+        from ..ec.batcher import DeviceBatcher
+        matrix_key = tuple(tuple(r) for r in matrix)
+        k = len(matrix[0])
+        dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32}[int(w)]
+        for b in buckets:
+            if not self.available:
+                return
+            key = ("ec", matrix_key, int(w), int(b))
+            if key[0:1] + key[1:] in self.programs:
+                continue
+            try:
+                enc = DeviceBatcher._encoder(matrix_key, int(w))
+                buf = self.pool.lease((k, int(b)), dtype)
+                try:
+                    np.asarray(enc(buf))
+                finally:
+                    self.pool.release(buf)
+                self.note_program("ec", (matrix_key, int(w), int(b)))
+            except Exception as e:          # warmup must never wedge boot
+                self.poison(e)
+                return
+            await asyncio.sleep(0)          # yield between compiles
+
+    # -- tickets -----------------------------------------------------------
+
+    def open_ticket(self, klass: str, bucket: int,
+                    nbytes: int) -> DispatchTicket:
+        self._seq += 1
+        return DispatchTicket(self._seq, klass, bucket, nbytes)
+
+    async def admit(self, ticket: DispatchTicket,
+                    cost: float | None = None) -> None:
+        await self.queue.admit(
+            ticket.klass,
+            cost if cost is not None
+            else max(1.0, ticket.nbytes / 65536.0))
+        ticket.t_admit = time.monotonic()
+
+    def try_admit(self, ticket: DispatchTicket,
+                  cost: float | None = None) -> None:
+        self.queue.try_admit(
+            ticket.klass,
+            cost if cost is not None
+            else max(1.0, ticket.nbytes / 65536.0))
+        ticket.t_admit = time.monotonic()
+
+    def launch(self, ticket: DispatchTicket) -> None:
+        """Stamp launch; consumes one injected fault if armed (the
+        deterministic device-loss hook the thrasher uses)."""
+        ticket.t_launch = time.monotonic()
+        if self._fault_budget > 0:
+            self._fault_budget -= 1
+            raise DeviceLost("injected device fault")
+
+    def finish(self, ticket: DispatchTicket, ok: bool = True,
+               error: Exception | None = None) -> None:
+        ticket.t_done = time.monotonic()
+        ticket.ok = ok
+        ticket.error = repr(error) if error is not None else None
+        self.queue.release()
+        self.tickets.append(ticket)
+        if len(self.tickets) > _TICKET_RING:
+            del self.tickets[:_TICKET_RING // 2]
+        if ok:
+            self.dispatches += 1
+            dt = ticket.device_s
+            self.dispatch_seconds += dt
+            us = max(1, int(dt * 1e6))
+            i = min(_HIST_BUCKETS - 1, max(0, us.bit_length() - 1))
+            self.dispatch_buckets_us[i] += 1
+
+    # -- device-loss degradation ------------------------------------------
+
+    @property
+    def available(self) -> bool:
+        return not self.fallback
+
+    def add_listener(self, fn) -> None:
+        """fn(fallback: bool) on every poison/heal transition (the OSD
+        uses it to beacon the state change immediately)."""
+        self._listeners.append(fn)
+
+    def _notify(self) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(self.fallback)
+            except Exception:
+                pass        # observability must never sink the runtime
+
+    def poison(self, reason) -> None:
+        """Flip to host fallback; a probe loop retries the device
+        under ExpBackoff until it heals."""
+        if self.fallback:
+            return
+        self.fallback = True
+        self.fallback_reason = repr(reason)
+        self.fallback_count += 1
+        self._notify()
+        try:
+            loop = asyncio.get_event_loop()
+            if loop.is_running() and self._probe_task is None:
+                self._probe_task = loop.create_task(self._probe_loop())
+        except RuntimeError:
+            pass            # no loop: heal() is manual (sync callers)
+
+    def heal(self) -> None:
+        if not self.fallback:
+            return
+        self.fallback = False
+        self.fallback_reason = None
+        self.heal_count += 1
+        self._notify()
+
+    def inject_fault(self, n: int = 1) -> None:
+        """Arm n deterministic dispatch failures (thrasher hook);
+        probes consume from the same budget, so the runtime stays in
+        fallback until the budget drains (or clear_faults())."""
+        self._fault_budget += int(n)
+
+    def clear_faults(self) -> None:
+        self._fault_budget = 0
+
+    def _run_probe(self) -> None:
+        """One probe dispatch: trivially small device work; raises on
+        failure.  Injected faults make probes fail too, so the
+        fallback window is controllable in tests."""
+        if self._fault_budget > 0:
+            self._fault_budget -= 1
+            raise DeviceLost("injected device fault (probe)")
+        import jax.numpy as jnp
+        np.asarray(jnp.zeros((8,), jnp.uint8) + jnp.uint8(1))
+
+    async def _probe_loop(self) -> None:
+        from ..utils.backoff import ExpBackoff
+        bo = ExpBackoff(base=self._probe_base, cap=self._probe_cap)
+        try:
+            while self.fallback:
+                await bo.sleep()
+                try:
+                    self._run_probe()
+                except Exception:
+                    continue
+                self.heal()
+        finally:
+            self._probe_task = None
+
+    # -- telemetry ---------------------------------------------------------
+
+    def dispatch_pctls(self) -> dict:
+        """p50/p99 (ms) over the ticket ring's device times."""
+        samples = sorted(t.device_s for t in self.tickets if t.ok)
+        if not samples:
+            return {"n": 0}
+        n = len(samples)
+
+        def at(p):
+            return round(samples[min(n - 1, int(p / 100.0 * n))] * 1e3,
+                         4)
+
+        return {"n": n, "p50": at(50), "p99": at(99)}
+
+    def metrics(self) -> dict:
+        return {
+            "device_queue_depth": self.queue.depth,
+            "device_inflight": self.queue.inflight,
+            "device_bucket_hit_ratio": round(self.bucket_hit_ratio, 4),
+            "device_compile_count": self.compile_count,
+            "device_dispatches": self.dispatches,
+            "device_host_fallbacks": self.host_fallbacks,
+            "device_pool_hits": self.pool.hits,
+            "device_pool_misses": self.pool.misses,
+            "device_fallback": int(self.fallback),
+            "device_fallback_count": self.fallback_count,
+            "device_heal_count": self.heal_count,
+            "device_queue_rejected": self.queue.rejected,
+        }
+
+    def prom_lines(self, prefix: str = "ceph_tpu") -> list[str]:
+        """Prometheus exposition lines (utils.exporter renderer)."""
+        from ..utils.exporter import hist_lines
+        lines = []
+        for name, val in sorted(self.metrics().items()):
+            base = "%s_%s" % (prefix, name)
+            lines.append("# TYPE %s gauge" % base)
+            lines.append("%s %g" % (base, float(val)))
+        lines.extend(hist_lines("%s_device_dispatch_seconds" % prefix,
+                                self.dispatch_buckets_us))
+        return lines

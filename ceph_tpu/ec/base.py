@@ -141,15 +141,22 @@ class ErasureCode(ErasureCodeInterface):
         return {8: np.uint8, 16: "<u2", 32: "<u4"}[w]
 
     async def encode_async(self, want_to_encode: set[int],
-                           data: bytes) -> dict[int, bytes]:
+                           data: bytes, klass: str | None = None,
+                           on_ticket=None) -> dict[int, bytes]:
         """encode() with the GF matmul batched onto the device across
         concurrent callers (ECBackend's hot call,
         src/osd/ECTransaction.cc:56 -> encode_chunks).  Falls back to
-        the sync host path when offload is disabled or the codec has
-        no plain matrix form."""
+        the sync host path when offload is disabled, the codec has no
+        plain matrix form, or the device runtime is in fallback.
+
+        klass selects the device dispatch class (client-EC vs
+        recovery-EC admission weights); on_ticket receives the flush's
+        DispatchTicket for exact per-op attribution."""
+        from ..device.runtime import DeviceRuntime, K_CLIENT_EC
         from .batcher import DeviceBatcher, device_offload_enabled
         dm = self._device_matrix()
-        if dm is None or len(data) == 0 or not device_offload_enabled():
+        if dm is None or len(data) == 0 or not device_offload_enabled() \
+                or not DeviceRuntime.get().available:
             return self.encode(want_to_encode, data)
         import numpy as np
         matrix, w = dm
@@ -158,7 +165,9 @@ class ErasureCode(ErasureCodeInterface):
             np.frombuffer(prepared[self.chunk_index(i)],
                           dtype=self._word_dtype(w))
             for i in range(self.get_data_chunk_count())])
-        parity = await DeviceBatcher.get().encode(matrix, w, arr)
+        parity = await DeviceBatcher.get().encode(
+            matrix, w, arr, klass=klass or K_CLIENT_EC,
+            on_ticket=on_ticket)
         out = dict(prepared)
         for i in range(len(matrix)):
             out[self.chunk_index(
@@ -167,15 +176,18 @@ class ErasureCode(ErasureCodeInterface):
 
     async def decode_async(self, want_to_read: set[int],
                            chunks: Mapping[int, bytes],
-                           ) -> dict[int, bytes]:
+                           klass: str | None = None,
+                           on_ticket=None) -> dict[int, bytes]:
         """decode() with the reconstruction matmul batched onto the
         device (the ECBackend degraded-read/recovery call,
         src/osd/ECUtil.cc:12-121).  Reconstruction is an encode with
         the inverted-survivor matrix, so it shares the encode queue."""
+        from ..device.runtime import DeviceRuntime, K_CLIENT_EC
         from .batcher import (DeviceBatcher, device_offload_enabled,
                               reconstruct_matrix)
         dm = self._device_matrix()
         if (dm is None or not device_offload_enabled()
+                or not DeviceRuntime.get().available
                 or self.chunk_mapping
                 or want_to_read <= set(chunks)
                 or any(len(c) == 0 for c in chunks.values())):
@@ -198,7 +210,9 @@ class ErasureCode(ErasureCodeInterface):
         arr = np.stack([
             np.frombuffer(chunks[c], dtype=self._word_dtype(w))
             for c in chosen])
-        words = await DeviceBatcher.get().encode(rows, w, arr)
+        words = await DeviceBatcher.get().encode(
+            rows, w, arr, klass=klass or K_CLIENT_EC,
+            on_ticket=on_ticket)
         out = {}
         for j, e in enumerate(erased):
             out[e] = words[j].tobytes()
@@ -208,10 +222,12 @@ class ErasureCode(ErasureCodeInterface):
         return out
 
     async def decode_concat_async(self, chunks: Mapping[int, bytes],
-                                  ) -> bytes:
+                                  klass: str | None = None,
+                                  on_ticket=None) -> bytes:
         k = self.get_data_chunk_count()
         want = {self.chunk_index(i) for i in range(k)}
-        decoded = await self.decode_async(want, chunks)
+        decoded = await self.decode_async(want, chunks, klass=klass,
+                                          on_ticket=on_ticket)
         return b"".join(decoded[self.chunk_index(i)]
                         for i in range(k))
 

@@ -6,15 +6,30 @@ ride one dispatch.  This is the aggregation layer the reference doesn't
 need (ISA-L encodes synchronously per call inside the OSD thread,
 src/erasure-code/isa/ErasureCodeIsa.cc:129): concurrent `encode_async`
 calls from any number of PGs/objects in the same event loop are queued
-per (coding-matrix, w) key and flushed as ONE device matmul batch —
-either when the pending payload reaches `max_batch_bytes` or when the
-oldest entry has waited `window_us` (deadline flush keeps p99 bounded,
-the way the reference bounds batching with per-op deadlines elsewhere).
+per (coding-matrix, w, service-class) key and flushed as ONE device
+matmul batch — either when the pending payload reaches
+`max_batch_bytes` or when the oldest entry has waited `window_us`
+(deadline flush keeps p99 bounded, the way the reference bounds
+batching with per-op deadlines elsewhere).
 
-Bit-parity: the device path consumes the same coding matrices as the
-numpy host path and the GF(2) bit-plane matmul is exact, so outputs are
-byte-identical (pinned by tests/test_ec_batcher.py against the host
-codecs and transitively by the non-regression corpus).
+Every flush routes through the shared device runtime
+(ceph_tpu.device.runtime):
+
+* the batch pads to a power-of-two word-count **bucket** staged in a
+  pooled buffer, so steady state re-dispatches a handful of compiled
+  programs instead of recompiling per width (zero padding is exact
+  under GF linearity — parity columns of the pad are zeros that are
+  sliced off, so bucket parity is bit-identical to the unpadded host
+  encode, pinned by tests/test_device_runtime.py);
+* admission is weighted-fair across classes (client-EC, recovery-EC,
+  mapping) with bounded in-flight dispatches; queue-full degrades
+  THIS flush to the host codepath rather than stacking device work;
+* a failed dispatch poisons the runtime (host fallback + DEVICE_
+  FALLBACK health via the OSD beacon) and the flush is re-encoded on
+  the host, so awaiting OSD ops never observe the loss;
+* each device flush carries a DispatchTicket delivered to per-item
+  `on_ticket` callbacks — the exact per-op device-dispatch
+  attribution the OpTracker stage histograms consume.
 
 Decode/reconstruct rides the same queue: a reconstruction is an encode
 with the cached inverted matrix (ErasureCodeIsaTableCache's trick), so
@@ -29,6 +44,9 @@ import functools
 import numpy as np
 
 from . import matrices
+from ..device.runtime import (DeviceBusy, DeviceRuntime, K_CLIENT_EC)
+
+_WORD_DTYPE = {8: np.uint8, 16: np.uint16, 32: np.uint32}
 
 
 def device_offload_enabled() -> bool:
@@ -46,12 +64,24 @@ def device_offload_enabled() -> bool:
         return False
 
 
+def host_encode(matrix, w: int, data: np.ndarray) -> np.ndarray:
+    """Synchronous host GF matmul — the fallback codepath when the
+    device is lost or admission pushes back.  [k, n] words -> [m, n]."""
+    from . import gf
+    m = np.asarray(matrix, dtype=np.int64)
+    if int(w) == 8:
+        return gf.matmul_u8(m.astype(np.uint8),
+                            np.ascontiguousarray(data, np.uint8))
+    return gf.matmul_words(m, data, int(w))
+
+
 class _PendingBatch:
-    __slots__ = ("arrays", "futures", "n_words", "timer")
+    __slots__ = ("arrays", "futures", "tickets", "n_words", "timer")
 
     def __init__(self):
         self.arrays: list[np.ndarray] = []   # each [k, n_i] words
         self.futures: list[asyncio.Future] = []
+        self.tickets: list = []              # per-item on_ticket cbs
         self.n_words = 0
         self.timer = None
 
@@ -60,8 +90,8 @@ class DeviceBatcher:
     """Batches GF(2^w) region matmuls across concurrent callers.
 
     One instance per event loop (get() is loop-local); keys are
-    (matrix-tuple, w) so every profile/erasure-signature gets its own
-    stream but shares the flush machinery.
+    (matrix-tuple, w, klass) so every profile/erasure-signature gets
+    its own stream per service class but shares the flush machinery.
     """
 
     def __init__(self, window_us: int = 300,
@@ -71,11 +101,11 @@ class DeviceBatcher:
         self._pending: dict[tuple, _PendingBatch] = {}
         self.batches_flushed = 0
         self.items_encoded = 0
-        # device-dispatch telemetry: per-flush wall time of the encode
-        # call (the "device dispatch" stage of an op's timeline).
-        # last_flush_s is what an awaiting OSD op samples into its
-        # stage histogram right after encode_async resolves; the ring
-        # feeds bench --trace percentiles
+        self.host_flushes = 0        # flushes served by the host path
+        # device-dispatch telemetry: per-flush wall time of the device
+        # call.  Kept for bench --trace and back-compat; per-OP
+        # attribution now rides the dispatch ticket instead of
+        # sampling these.
         self.last_flush_s = 0.0
         self.flush_seconds = 0.0
         self.flush_history: list[float] = []   # bounded ring
@@ -120,10 +150,16 @@ class DeviceBatcher:
                              tile=4096)
 
     async def encode(self, matrix: list[list[int]], w: int,
-                     data: np.ndarray) -> np.ndarray:
+                     data: np.ndarray, klass: str = K_CLIENT_EC,
+                     on_ticket=None) -> np.ndarray:
         """data [k, n] words -> [m, n] parity words, batched with any
-        concurrent callers using the same (matrix, w)."""
-        key = (tuple(tuple(r) for r in matrix), int(w))
+        concurrent callers using the same (matrix, w, klass).
+
+        `on_ticket` (if given) receives the flush's DispatchTicket
+        after the device call — exact per-op dispatch attribution.
+        Host-fallback flushes deliver no ticket (there was no device
+        dispatch to attribute)."""
+        key = (tuple(tuple(r) for r in matrix), int(w), klass)
         loop = asyncio.get_event_loop()
         pb = self._pending.get(key)
         if pb is None:
@@ -132,8 +168,9 @@ class DeviceBatcher:
         fut = loop.create_future()
         pb.arrays.append(np.ascontiguousarray(data))
         pb.futures.append(fut)
+        pb.tickets.append(on_ticket)
         pb.n_words += data.shape[1]
-        word_bytes = {8: 1, 16: 2, 32: 4}[int(w)]
+        word_bytes = _WORD_DTYPE[int(w)]().itemsize
         if (pb.n_words * data.shape[0] * word_bytes
                 >= self.max_batch_bytes):
             self._flush(key)
@@ -143,42 +180,98 @@ class DeviceBatcher:
         return await fut
 
     def _flush(self, key) -> None:
+        """Detach the pending batch and dispatch it as a task (the
+        device path awaits admission, so the flush body is async —
+        call_later fires this sync shim)."""
         pb = self._pending.pop(key, None)
         if pb is None:
             return
         if pb.timer is not None:
             pb.timer.cancel()
-        matrix_key, w = key
+        asyncio.get_event_loop().create_task(self._flush_async(key, pb))
+
+    async def _flush_async(self, key, pb: _PendingBatch) -> None:
+        matrix_key, w, klass = key
+        rt = DeviceRuntime.get()
         import time
-        t0 = time.perf_counter()
-        try:
-            enc = self._encoder(matrix_key, w)
-            flat = (pb.arrays[0] if len(pb.arrays) == 1
-                    else np.concatenate(pb.arrays, axis=1))
-            out = np.asarray(enc(flat))
-        except Exception as e:
-            # a device/compile failure must reach the awaiting OSD ops
-            # (they would otherwise hang forever — submit_write's
-            # sub-op timeout sits AFTER the encode await)
-            for fut in pb.futures:
-                if not fut.cancelled():
-                    fut.set_exception(
-                        IOError("device EC encode failed: %r" % e))
-            return
-        dt = time.perf_counter() - t0
+        k = pb.arrays[0].shape[0]
+        n = pb.n_words
+        dtype = _WORD_DTYPE[int(w)]
+        nbytes = n * k * dtype().itemsize
+        out = None
+        ticket = None
+        use_device = rt.available
+        if use_device:
+            bucket = rt.bucket_for(n)
+            ticket = rt.open_ticket(klass, bucket, nbytes)
+            try:
+                await rt.admit(ticket)
+            except DeviceBusy:
+                # admission pushback: degrade THIS flush to the host
+                # path instead of stacking device work
+                use_device = False
+                ticket = None
+        if use_device:
+            buf = rt.pool.lease((k, bucket), dtype)
+            try:
+                off = 0
+                for arr in pb.arrays:
+                    ni = arr.shape[1]
+                    buf[:, off:off + ni] = arr
+                    off += ni
+                rt.note_program("ec", (matrix_key, int(w), bucket))
+                t0 = time.perf_counter()
+                rt.launch(ticket)       # injected-fault hook
+                enc = self._encoder(matrix_key, int(w))
+                out = np.asarray(enc(buf))[:, :n]
+                rt.finish(ticket, ok=True)
+                dt = time.perf_counter() - t0
+                self.last_flush_s = dt
+                self.flush_seconds += dt
+                self.flush_history.append(dt)
+                if len(self.flush_history) > 512:
+                    del self.flush_history[:256]
+            except Exception as e:
+                # device loss: poison the runtime (host fallback +
+                # DEVICE_FALLBACK health) and serve this flush on the
+                # host so awaiting OSD ops never see the failure
+                rt.finish(ticket, ok=False, error=e)
+                rt.poison(e)
+                ticket = None
+                out = None
+            finally:
+                rt.pool.release(buf)
+        if out is None:
+            try:
+                flat = (pb.arrays[0] if len(pb.arrays) == 1
+                        else np.concatenate(pb.arrays, axis=1))
+                out = host_encode([list(r) for r in matrix_key], w,
+                                  flat)
+                rt.host_fallbacks += 1
+                self.host_flushes += 1
+            except Exception as e:
+                # a host-path failure is a real codec error: it must
+                # reach the awaiting OSD ops (they would otherwise
+                # hang forever — submit_write's sub-op timeout sits
+                # AFTER the encode await)
+                for fut in pb.futures:
+                    if not fut.cancelled():
+                        fut.set_exception(
+                            IOError("EC encode failed: %r" % e))
+                return
         self.batches_flushed += 1
         self.items_encoded += len(pb.arrays)
-        self.last_flush_s = dt
-        self.flush_seconds += dt
-        self.flush_history.append(dt)
-        if len(self.flush_history) > 512:
-            del self.flush_history[:256]
         off = 0
-        for arr, fut in zip(pb.arrays, pb.futures):
-            n = arr.shape[1]
+        for arr, fut, cb in zip(pb.arrays, pb.futures, pb.tickets):
+            ni = arr.shape[1]
             if not fut.cancelled():
-                fut.set_result(out[:, off:off + n])
-            off += n
+                fut.set_result(out[:, off:off + ni])
+            if cb is not None and ticket is not None:
+                try:
+                    cb(ticket)
+                except Exception:
+                    pass    # attribution must never sink the flush
+            off += ni
 
 
 def reconstruct_matrix(k: int, w: int, matrix: list[list[int]],

@@ -114,9 +114,13 @@ class Monitor:
         self.failure_info: dict[int, dict[int, FailureReport]] = {}
         self.down_pending_out: dict[int, float] = {}
         # osd -> (slow_op_count, monotonic stamp) from MOSDBeacons:
-        # derived soft state every mon keeps (no paxos write) so the
-        # leader's HealthMonitor can raise/clear SLOW_OPS
+        # derived soft state every mon keeps; the LEADER additionally
+        # commits transitions into the health service's paxos state so
+        # a freshly elected leader reports SLOW_OPS / DEVICE_FALLBACK
+        # immediately instead of waiting one beacon round (PR-2 gap)
         self.osd_slow_ops: dict[int, tuple[int, float]] = {}
+        # osd -> (device_fallback flag, monotonic stamp)
+        self.osd_device_fallback: dict[int, tuple[int, float]] = {}
         # mon-side op tracking (MMonCommand requests)
         from ..trace import OpTracker
         self.optracker = OpTracker(self.ctx, name)
@@ -139,6 +143,7 @@ class Monitor:
         self.config_mon.load()
         self.auth_mon.load()
         self.log_mon.load()
+        self.health_mon.load()
         self._load()
 
     def _parse_disallowed(self, raw: str) -> set[int]:
@@ -199,6 +204,8 @@ class Monitor:
                 self.auth_mon.apply(svc["auth"], tx)
             if svc.get("log"):
                 self.log_mon.apply(svc["log"], tx)
+            if svc.get("health"):
+                self.health_mon.apply(svc["health"], tx)
             self.store.submit_transaction(tx)
             if svc.get("config"):
                 self.config_mon.push_all()
@@ -458,11 +465,20 @@ class Monitor:
             return True
         from ..msg.messages import MOSDBeacon, MOSDPGTemp
         if isinstance(msg, MOSDBeacon):
-            # beacons are derived soft state: EVERY mon records them
-            # (no paxos), so whichever mon leads next already holds
-            # the slow-op picture for its health checks
-            self.osd_slow_ops[msg.osd] = (int(msg.slow_ops or 0),
-                                          time.monotonic())
+            # beacons are derived soft state: EVERY mon records them,
+            # so whichever mon leads next already holds the picture —
+            # and the current LEADER commits transitions into the
+            # health service's replicated state, so even a mon that
+            # never saw a beacon (fresh boot, healed partition)
+            # reports the warnings immediately on election
+            now = time.monotonic()
+            slow = int(msg.slow_ops or 0)
+            flb = int(msg.device_fallback or 0)
+            self.osd_slow_ops[msg.osd] = (slow, now)
+            self.osd_device_fallback[msg.osd] = (flb, now)
+            if self.is_leader() and \
+                    (not self.multi or self.mpaxos.active):
+                self.health_mon.maybe_commit(msg.osd, slow, flb)
             return True
         if isinstance(msg, (MOSDBoot, MOSDFailure, MOSDAlive,
                             MOSDPGTemp)) \
@@ -992,8 +1008,39 @@ class Monitor:
         elif key == "min_size":
             pool.min_size = int(val)
         elif key == "pg_num":
+            # growth only, and pgp_num stays: children keep their
+            # parent's placement (OSDs split in place — the reference
+            # workflow of raising pg_num first, pgp_num later).  A
+            # shrink would need PG merge machinery this build lacks.
+            if int(val) < pool.pg_num:
+                raise ValueError("pg_num can only grow "
+                                 "(%d -> %s)" % (pool.pg_num, val))
             pool.pg_num = int(val)
+        elif key == "pgp_num":
+            if not 0 < int(val) <= pool.pg_num:
+                raise ValueError("pgp_num must be in (0, pg_num]")
             pool.pgp_num = int(val)
+        elif key == "erasure_code_profile":
+            # profile swap: only onto a profile with the identical
+            # coding parameters (same k/m/technique/w => same matrix).
+            # Swapping the matrix under stored shards would corrupt
+            # every future reconstruction; this is the rename/rollout
+            # path (new profile object, same math), which exercises
+            # codec-cache invalidation on every OSD.
+            new = self.osdmap.erasure_code_profiles.get(str(val))
+            if new is None:
+                raise ValueError("no erasure profile %r" % val)
+            if not pool.erasure_code_profile:
+                raise ValueError("pool %s is not erasure" % pool.name)
+            cur = self.osdmap.erasure_code_profiles.get(
+                pool.erasure_code_profile, {})
+            for fld in ("plugin", "k", "m", "technique", "w"):
+                if str(cur.get(fld, "")) != str(new.get(fld, "")):
+                    raise ValueError(
+                        "profile %r differs from the pool's in %r — "
+                        "swap requires identical coding parameters"
+                        % (val, fld))
+            pool.erasure_code_profile = str(val)
         elif key == "crush_rule":
             pool.crush_rule = int(val)
         elif key == "compression_mode":

@@ -41,6 +41,7 @@ from ..utils import denc
 CONFIG_KEY = b"svc:config"
 AUTH_KEY = b"svc:auth"
 LOG_KEY = b"svc:log"
+HEALTH_KEY = b"svc:health"
 
 LOG_CAP = 1000
 
@@ -194,10 +195,104 @@ class AuthMonitor:
 
 
 class HealthMonitor:
-    """Derived checks — recomputed on demand, nothing proposed."""
+    """Health checks: mostly derived on demand, but beacon-fed soft
+    state (slow-op counts, device-fallback flags) is ALSO committed
+    through paxos by the leader on every transition, so a freshly
+    elected leader — including one that never saw a single beacon —
+    reports SLOW_OPS / DEVICE_FALLBACK immediately instead of waiting
+    one beacon round (closes the PR-2 gap).  Recent soft state wins
+    over the committed snapshot (it is newer by construction); the
+    committed state fills the gap until beacons reach the new
+    leader."""
+
+    # soft-state freshness window: beacons older than this defer to
+    # the committed snapshot / other checks (OSD_DOWN covers daemons
+    # whose beacons stopped entirely)
+    SOFT_TTL = 30.0
 
     def __init__(self, mon):
         self.mon = mon
+        # committed (paxos) snapshot: {"slow": {osd: n},
+        #                              "devflb": {osd: 0|1}}
+        self.persisted: dict = {"slow": {}, "devflb": {}}
+
+    # -- persistence / replay ------------------------------------------
+
+    def load(self) -> None:
+        raw = self.mon.store.get(HEALTH_KEY)
+        if raw is not None:
+            d = denc.decode(raw)
+            self.persisted = {
+                "slow": {int(k): int(v)
+                         for k, v in (d.get("slow") or {}).items()},
+                "devflb": {int(k): int(v)
+                           for k, v in
+                           (d.get("devflb") or {}).items()}}
+
+    def apply(self, ops: list, tx) -> None:
+        """Deterministic commit apply (every mon runs this)."""
+        for op in ops:
+            if op[0] == "slow":
+                _c, osd, n = op
+                if int(n):
+                    self.persisted["slow"][int(osd)] = int(n)
+                else:
+                    self.persisted["slow"].pop(int(osd), None)
+            elif op[0] == "devflb":
+                _c, osd, flag = op
+                if int(flag):
+                    self.persisted["devflb"][int(osd)] = 1
+                else:
+                    self.persisted["devflb"].pop(int(osd), None)
+        tx.set(HEALTH_KEY, denc.encode(
+            {"slow": dict(self.persisted["slow"]),
+             "devflb": dict(self.persisted["devflb"])}))
+
+    def maybe_commit(self, osd: int, slow: int, devflb: int) -> None:
+        """Leader-side: stage a health svc op when a beacon changes
+        the committed picture (transitions only — steady-state
+        beacons cost no paxos rounds).  Pending-queue dedup keeps a
+        beacon burst from stacking identical ops in one proposal."""
+        pend = self.mon.pending_svc.get("health", [])
+
+        def pending_val(kind):
+            for op in reversed(pend):
+                if op[0] == kind and int(op[1]) == osd:
+                    return int(op[2])
+            return None
+
+        cur = pending_val("slow")
+        if cur is None:
+            cur = self.persisted["slow"].get(osd, 0)
+        if int(slow) != cur:
+            self.mon.queue_svc_op("health", ("slow", osd, int(slow)))
+        cur = pending_val("devflb")
+        if cur is None:
+            cur = self.persisted["devflb"].get(osd, 0)
+        if int(devflb) != cur:
+            self.mon.queue_svc_op("health",
+                                  ("devflb", osd, int(devflb)))
+
+    # -- merged beacon views -------------------------------------------
+
+    def _merged(self, soft: dict, committed: dict) -> dict:
+        """osd -> value: fresh soft state wins, committed snapshot
+        fills in for daemons this mon has not heard from; daemons the
+        map says are down are excluded (they surface as OSD_DOWN)."""
+        import time as _t
+        now = _t.monotonic()
+        m = self.mon.osdmap
+        out: dict[int, int] = {}
+        for osd, v in committed.items():
+            if osd < m.max_osd and m.is_up(osd):
+                out[osd] = v
+        for osd, (v, stamp) in soft.items():
+            if now - stamp < self.SOFT_TTL:
+                if v:
+                    out[osd] = v
+                else:
+                    out.pop(osd, None)
+        return out
 
     def checks(self) -> dict:
         m = self.mon.osdmap
@@ -227,18 +322,16 @@ class HealthMonitor:
                                % (len(quorum), total),
                     "detail": []}
         # SLOW_OPS (the reference's HealthMonitor check fed by
-        # MOSDBeacon slow-op counts): raised while any live beacon
-        # reports in-flight ops past osd_op_complaint_time; clears as
-        # soon as later beacons report zero (or a daemon's beacons go
-        # stale — a dead osd surfaces as OSD_DOWN, not SLOW_OPS)
-        now = time.monotonic()
-        slow_daemons = []
-        slow_total = 0
-        for osd, (n, stamp) in sorted(
-                getattr(self.mon, "osd_slow_ops", {}).items()):
-            if n > 0 and now - stamp < 30.0:
-                slow_daemons.append(osd)
-                slow_total += n
+        # MOSDBeacon slow-op counts): raised while any live daemon
+        # reports in-flight ops past osd_op_complaint_time — via a
+        # recent beacon OR the paxos-committed snapshot a previous
+        # leader left (so a fresh leader warns immediately); clears
+        # as soon as later beacons report zero (a dead osd surfaces
+        # as OSD_DOWN, not SLOW_OPS)
+        slow = self._merged(getattr(self.mon, "osd_slow_ops", {}),
+                            self.persisted["slow"])
+        slow_daemons = sorted(o for o, n in slow.items() if n > 0)
+        slow_total = sum(n for n in slow.values() if n > 0)
         if slow_total:
             out["SLOW_OPS"] = {
                 "severity": "HEALTH_WARN",
@@ -247,9 +340,27 @@ class HealthMonitor:
                               ["osd.%d" % o
                                for o in slow_daemons[:10]]),
                 "detail": ["osd.%d has %d ops past the complaint "
-                           "threshold"
-                           % (o, self.mon.osd_slow_ops[o][0])
+                           "threshold" % (o, slow[o])
                            for o in slow_daemons[:10]]}
+        # DEVICE_FALLBACK: a daemon's device runtime lost the
+        # accelerator and is serving EC/mapping from the host paths —
+        # degraded throughput, not degraded durability.  Raised while
+        # any live daemon reports it (beacon or committed snapshot);
+        # clears when the runtime heals and beacons say so.
+        flb = self._merged(
+            getattr(self.mon, "osd_device_fallback", {}),
+            self.persisted["devflb"])
+        flb_daemons = sorted(o for o, v in flb.items() if v)
+        if flb_daemons:
+            out["DEVICE_FALLBACK"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "%d daemons on host fallback (device "
+                           "lost): %s"
+                           % (len(flb_daemons),
+                              ["osd.%d" % o
+                               for o in flb_daemons[:10]]),
+                "detail": ["osd.%d serving EC/mapping on the host "
+                           "paths" % o for o in flb_daemons[:10]]}
         if not m.pools and m.epoch > 0:
             pass                       # empty cluster is healthy
         return out

@@ -100,10 +100,12 @@ class MOSDBeacon(Message):
     """OSD -> mon liveness/health beacon (MOSDBeacon.h): periodic even
     while healthy; slow_ops carries the count of in-flight ops older
     than osd_op_complaint_time so the monitor can raise (and clear)
-    the SLOW_OPS health warning."""
+    the SLOW_OPS health warning; device_fallback reports whether the
+    daemon's device runtime is serving from the host paths (the mon
+    raises DEVICE_FALLBACK while any live daemon reports it)."""
 
     TYPE = "osd_beacon"
-    FIELDS = ("osd", "epoch", "slow_ops")
+    FIELDS = ("osd", "epoch", "slow_ops", "device_fallback")
 
 
 @register

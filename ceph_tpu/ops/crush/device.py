@@ -1427,15 +1427,29 @@ class DeviceMapper:
             seen_levels += 1
         return tuple(sizes) if sizes else (1,)
 
+    @staticmethod
+    def _note_compile(what: str, key: tuple) -> None:
+        """Register a distinct crush program with the device runtime's
+        compile counter.  Keys carry only the program signature (rule,
+        shape, K buckets) — NOT instance identity — so DeviceMapper
+        rebuilds across map epochs do not count as fresh compiles:
+        the counter tracks what the acceptance criteria assert, the
+        number of distinct programs a steady-state workload needs."""
+        from ...device.runtime import DeviceRuntime
+        DeviceRuntime.get().note_program("crush", (what,) + key)
+
     @functools.lru_cache(maxsize=None)
     def _compiled(self, ruleno: int, result_max: int, resolve: bool,
                   full: bool = True):
+        self._note_compile("rule", (ruleno, result_max, resolve, full))
         return jax.jit(self._compile(ruleno, result_max, resolve, full))
 
     @functools.lru_cache(maxsize=None)
     def _compiled_map(self, ruleno: int, result_max: int,
                       can_shift: bool, use_aff: bool, resolve: bool,
                       full: bool = True):
+        self._note_compile("map", (ruleno, result_max, can_shift,
+                                   use_aff, resolve, full))
         core = self._compile(ruleno, result_max, resolve, full)
 
         @jax.jit
@@ -1465,6 +1479,9 @@ class DeviceMapper:
         retries are flagged and settled by the resolve passes, so the
         dense cost is fixed at numrep×_ATTEMPT_TRIES descents instead
         of being dragged by the worst lane's retry count."""
+        self._note_compile("pool", (ruleno, result_max, can_shift,
+                                    use_aff, pgp_num, pgp_mask,
+                                    pool_id, hashps, n, n_chunks))
         core = self._compile(ruleno, result_max, False, full=False)
 
         def chunk(start):
@@ -1609,6 +1626,9 @@ class DeviceMapper:
         10M lanes, BENCH r4 notes); rowcompact reduces the nonzero to
         the npg/ROW*kt padded index space.  kt == 0 is the pure-XLA
         fallback."""
+        self._note_compile("resolve", (ruleno, result_max, can_shift,
+                                       use_aff, K1, K2, K3, npg,
+                                       pg_num, kt))
         from . import pallas_draw
         _pps, _settle, chain = self._resolve_chain_parts(
             ruleno, result_max, can_shift, use_aff, pgp_num, pgp_mask,
@@ -1718,6 +1738,9 @@ class DeviceMapper:
         sequence is bit-identical under reweight DECREASES and
         up/down/affinity changes unless one of its raw result slots
         held a changed OSD (see MapState's validity argument)."""
+        self._note_compile("remap", (ruleno, result_max, can_shift,
+                                     use_aff, KA, K1, K2, K3, npg,
+                                     pg_num, KT))
         from . import pallas_draw
         core = self._compile(ruleno, result_max, False, full=False)
         _pps, settle, chain = self._resolve_chain_parts(

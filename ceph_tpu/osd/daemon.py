@@ -93,6 +93,9 @@ class OSD:
         self.optracker = OpTracker(self.ctx, "osd.%d" % whoami)
         self.perf = self.ctx.perf.create("osd")
         self.perf.add_u64("ops", "client ops completed")
+        self.perf.add_u64("dup_ops",
+                          "client resends answered from the reqid"
+                          " journal")
         self.perf.add_u64("slow_ops",
                           "in-flight ops past osd_op_complaint_time")
         self.perf.add_hist("op_queue_wait",
@@ -119,6 +122,9 @@ class OSD:
         self._waiting_for_map: list = []
         # heartbeat state: peer -> last seen stamp
         self.hb_last_rx: dict[int, float] = {}
+        # last observed pg_num per pool: a growth triggers the local
+        # in-place PG split before mappings recompute
+        self._pool_pg_num: dict[int, int] = {}
         self._tasks = []
 
     # -- lifecycle ---------------------------------------------------------
@@ -128,11 +134,30 @@ class OSD:
         addr = await self.msgr.bind(host, port)
         self.sched.start(self.msgr.spawn)
         self._load_pgs()
+        # device runtime: adopt this daemon's queue bounds and beacon
+        # fallback transitions immediately (a mapping storm or device
+        # loss must reach the mon's health checks within one beacon,
+        # not one reporting interval)
+        from ..device.runtime import DeviceRuntime
+        rt = DeviceRuntime.get()
+        rt.configure(self.ctx.conf)
+        rt.add_listener(self._on_device_state)
         mon = self.msgr.connect_to(self.mon_addr, entity_hint="mon.0")
         mon.send(MMonSubscribe(start=1))
         self._tasks.append(self.msgr.spawn(self._mon_watchdog()))
         self._tasks.append(self.msgr.spawn(self._heartbeat_loop()))
         return addr
+
+    def _on_device_state(self, fallback: bool) -> None:
+        """Device runtime poisoned/healed: beacon the new state now."""
+        if self.stopping or not self.booted:
+            return
+        self.ctx.log.info(
+            "osd", "osd.%d device runtime %s"
+            % (self.whoami, "LOST -> host fallback" if fallback
+               else "healed"))
+        self._beacon_stamp = 0.0        # bypass the report interval
+        self._maybe_send_beacon()
 
     async def wait_for_boot(self, timeout: float = 10.0) -> None:
         from ..utils.backoff import wait_for
@@ -413,12 +438,101 @@ class OSD:
         self._send_mons(MOSDBoot(osd=self.whoami, addr=self.msgr.addr,
                                  epoch=epoch))
 
+    def _split_pgs(self, pool_id: int, pool) -> None:
+        """In-place PG split after a pg_num grow (PG::split_into /
+        OSD::split_pgs condensed).  With pgp_num unchanged a child PG
+        keeps its parent's placement (ceph_stable_mod folds the child
+        ps back onto the parent's pps), so the split is purely local:
+        every acting member deterministically moves each object whose
+        hash now lands in a child into the child's collection, along
+        with the log entries and missing rows naming it.  All members
+        run the identical function on the same map epoch, so child
+        logs/infos agree at the next peering without data movement.
+
+        Also run with no recorded previous pg_num (post-restart): the
+        sweep is idempotent — objects already in the right collection
+        never move.  Clone hobjects ride the generic loop (identity =
+        name+snap; a clone's name hashes with its head)."""
+        for pgid in [p for p in list(self.pgs) if p.pool == pool_id]:
+            pg = self.pgs[pgid]
+            moves: dict[int, list] = {}
+            for ho in self.store.collection_list(pg.cid):
+                if ho.name == "__pgmeta__":
+                    continue
+                target = pool.raw_pg_to_pg(
+                    self.osdmap.object_locator_to_pg(
+                        ho.name, pool_id)).ps
+                if target != pg.ps:
+                    moves.setdefault(target, []).append(ho)
+            if not moves:
+                continue
+            self.ctx.log.info(
+                "osd", "osd.%d splitting pg %s: %d objects -> %s"
+                % (self.whoami, pg.pgid,
+                   sum(len(v) for v in moves.values()),
+                   sorted(moves)))
+            for child_ps, hos in sorted(moves.items()):
+                cid = pg_t(pool_id, child_ps)
+                child = self.pgs.get(cid)
+                if child is None:
+                    child = PG(self, pool_id, child_ps)
+                    child.create_onstore()
+                    self.pgs[cid] = child
+                t = Transaction()
+                moved = {ho.name for ho in hos}
+                for ho in hos:
+                    t.touch(child.cid, ho)
+                    data = self.store.read(pg.cid, ho)
+                    t.write(child.cid, ho, 0, len(data), data)
+                    for k, v in self.store.getattrs(pg.cid,
+                                                    ho).items():
+                        t.setattr(child.cid, ho, k, v)
+                    om = self.store.omap_get(pg.cid, ho)
+                    if om:
+                        t.omap_setkeys(child.cid, ho, om)
+                    t.remove(pg.cid, ho)
+                # the child inherits the parent's log entries for its
+                # objects (delta recovery stays possible) and the
+                # parent's version horizon, so every member's child
+                # agrees at peering
+                have = {e.version for e in child.log.entries}
+                for e in pg.log.entries:
+                    if e.oid in moved and e.version not in have:
+                        child.log.append(e)
+                        child.persist_log_entry(t, e)
+                if pg.info.last_update > child.info.last_update:
+                    child.info.last_update = pg.info.last_update
+                for oid in list(pg.missing):
+                    if oid in moved:
+                        child.missing[oid] = pg.missing.pop(oid)
+                for osd_id, pm in pg.peer_missing.items():
+                    for oid in [o for o in pm if o in moved]:
+                        child.peer_missing.setdefault(
+                            osd_id, {})[oid] = pm.pop(oid)
+                child.persist_meta(t)
+                pg.persist_meta(t)
+                self.store.apply_transaction(t)
+
     def _advance_pgs(self) -> None:
         """Recompute mappings; create/advance PGs (OSD::advance_map).
         Large maps route through the bulk device mapper instead of
         per-PG scalar calls (the ParallelPGMapper role,
         OSDMapMapping.h:18)."""
         m = self.osdmap
+        # pg_num growth: split local PGs BEFORE mappings recompute so
+        # freshly-created children already hold their objects.  An
+        # unknown previous value (first map after boot/restart) runs
+        # the idempotent sweep too — a split may have happened while
+        # this osd was down.
+        for pool_id, pool in m.pools.items():
+            prev = self._pool_pg_num.get(pool_id)
+            if (prev is None and self.pgs) or \
+                    (prev is not None and pool.pg_num > prev):
+                self._split_pgs(pool_id, pool)
+            self._pool_pg_num[pool_id] = pool.pg_num
+        for pool_id in list(self._pool_pg_num):
+            if pool_id not in m.pools:
+                del self._pool_pg_num[pool_id]
         mapping = None
         if sum(p.pg_num for p in m.pools.values()) >= 256:
             try:
@@ -1276,12 +1390,34 @@ class OSD:
                                   epoch=self.osdmap.epoch, version=0))
             self._op_finish(msg, "no_such_pool")
             return
+        if msg.oid:
+            # split retarget: after a pg_num grow the object may now
+            # belong to a child PG the sender's older map cannot see —
+            # drop, the client re-targets on its next map (Objecter
+            # _scan_requests); executing here would strand the write
+            # in the parent PG the readers no longer consult
+            actual = pool.raw_pg_to_pg(
+                self.osdmap.object_locator_to_pg(msg.oid, msg.pool)).ps
+            if actual != msg.ps:
+                self._op_finish(msg, "dropped_wrong_pg_after_split")
+                return
         pgid = pg_t(msg.pool, msg.ps)
         pg = self.pgs.get(pgid)
         if pg is None or not pg.is_primary():
             # not mine: drop — the client resends on map change
             # (Objecter handle_osd_map -> _scan_requests)
             self._op_finish(msg, "dropped_not_primary")
+            return
+        dup = pg.lookup_reqid(msg.src, msg.tid)
+        if dup is not None:
+            # reqid dup detection: a timeout-triggered resend of an
+            # already-committed (possibly non-idempotent) op is
+            # answered from the journal, never re-executed
+            conn.send(MOSDOpReply(
+                tid=msg.tid, result=dup["result"], outs=dup["outs"],
+                epoch=self.osdmap.epoch, version=dup["version"]))
+            self.perf.inc("dup_ops")
+            self._op_finish(msg, "dup_answered_from_journal")
             return
         if pg.state != STATE_ACTIVE:
             self._op_event(msg, "waiting_for_active")
@@ -1715,6 +1851,10 @@ class OSD:
         pg.persist_log_entry(t, entry)
         pg.maybe_trim_log(t)   # rides the replicated txn to replicas
         pg.persist_meta(t)
+        # reqid dup journal rides the same (replicated) transaction:
+        # the mutation and its dup row land atomically everywhere, so
+        # a resend after the reply was lost is answered, not re-run
+        pg.record_reqid(t, msg.src, msg.tid, 0, outs, ver)
         self._rep_tid += 1
         rep_tid = self._rep_tid
         waiting = set()
@@ -1985,6 +2125,7 @@ class OSD:
         (in-flight ops past osd_op_complaint_time).  The monitor's
         HealthMonitor turns a nonzero cluster total into SLOW_OPS and
         clears it when a later beacon reports zero."""
+        from ..device.runtime import DeviceRuntime
         from ..msg.messages import MOSDBeacon
         slow = self.optracker.slow_in_flight()
         self.perf.set("slow_ops", len(slow))
@@ -1999,9 +2140,10 @@ class OSD:
                 "osd", "osd.%d has %d slow ops (oldest %.1fs): %s"
                 % (self.whoami, len(slow), oldest,
                    slow[0].desc))
-        self._send_mons(MOSDBeacon(osd=self.whoami,
-                                   epoch=self.osdmap.epoch,
-                                   slow_ops=len(slow)))
+        self._send_mons(MOSDBeacon(
+            osd=self.whoami, epoch=self.osdmap.epoch,
+            slow_ops=len(slow),
+            device_fallback=int(DeviceRuntime.get().fallback)))
 
     def _maybe_send_mgr_report(self) -> None:
         """MgrClient::send_report: ship perf counters + a PG state

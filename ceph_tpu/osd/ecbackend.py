@@ -113,7 +113,28 @@ class ECPGBackend:
             c = ErasureCodePluginRegistry.instance().factory(
                 plugin, profile)
             self._codecs[prof_name] = c
+            self._maybe_warmup(c)
         return c
+
+    def _maybe_warmup(self, codec) -> None:
+        """First sight of a profile: pre-compile its common device
+        buckets in the background (the runtime's boot warmup) so the
+        first client flushes hit the compile cache instead of paying
+        XLA latency inside the write path."""
+        from ..device.runtime import DeviceRuntime
+        from ..ec.batcher import device_offload_enabled
+        try:
+            if not int(self.osd.ctx.conf["device_warmup"]):
+                return
+        except (KeyError, TypeError, ValueError):
+            pass
+        dm = getattr(codec, "_device_matrix", lambda: None)()
+        if dm is None or not device_offload_enabled():
+            return
+        rt = DeviceRuntime.get()
+        if rt.available:
+            matrix, w = dm
+            self.osd.msgr.spawn(rt.warmup_ec(matrix, w))
 
     class _Locked:
         def __init__(self, backend, key):
@@ -140,9 +161,32 @@ class ECPGBackend:
 
     # -- client op entry ---------------------------------------------------
 
+    def _journal_reply(self, pg: PG, msg, result: int, outs: list,
+                       version: int) -> None:
+        """Persist the reply of a completed EC write into the reqid
+        journal (own txn: shard txns already applied).  Only result 0
+        is journaled — a failed write may legitimately re-execute."""
+        if result != 0:
+            return
+        t = Transaction()
+        pg.record_reqid(t, msg.src, msg.tid, result, outs, version)
+        self.osd.store.apply_transaction(t)
+
     async def handle_op(self, pg: PG, conn, msg) -> None:
         """Primary-side execution of one client op list."""
         async with self.oid_lock(pg, msg.oid):
+            # dup re-check under the oid lock: a resend that queued
+            # behind the original acquires the lock after the first
+            # execution journaled its reply
+            dup = pg.lookup_reqid(msg.src, msg.tid)
+            if dup is not None:
+                conn.send(MOSDOpReply(
+                    tid=msg.tid, result=dup["result"],
+                    outs=dup["outs"], epoch=self.osd.osdmap.epoch,
+                    version=dup["version"]))
+                self.osd.perf.inc("dup_ops")
+                self.osd._op_finish(msg, "dup_answered_from_journal")
+                return
             try:
                 await self._do_op(pg, conn, msg)
             except Exception as e:
@@ -267,6 +311,8 @@ class ECPGBackend:
             res = await self._try_delta_write(pg, msg)
             if res is not None:
                 outs2, ok2 = res
+                self._journal_reply(pg, msg, 0 if ok2 else -11,
+                                    outs2, pg.info.last_update[1])
                 conn.send(MOSDOpReply(
                     tid=msg.tid, result=0 if ok2 else -11,
                     outs=outs2, epoch=epoch,
@@ -351,6 +397,7 @@ class ECPGBackend:
                                      whiteout=whiteout,
                                      top=getattr(msg, "_top", None))
         ver = pg.info.last_update[1]
+        self._journal_reply(pg, msg, 0 if ok else -11, outs, ver)
         conn.send(MOSDOpReply(tid=msg.tid, result=0 if ok else -11,
                               outs=outs, epoch=self.osd.osdmap.epoch,
                               version=ver))
@@ -359,30 +406,45 @@ class ECPGBackend:
 
     # -- write path --------------------------------------------------------
 
+    def _on_dispatch_ticket(self, top):
+        """Per-op device-dispatch attribution callback: the batcher
+        delivers the DispatchTicket of the EXACT flush that carried
+        this op's shards (closing the PR-2 gap where the stage
+        histogram sampled the batcher's last flush time — wrong under
+        heavy interleaving).  Host-fallback flushes deliver none."""
+        def on_ticket(t):
+            self.osd.perf.hist_sample("op_ec_device_dispatch",
+                                      t.device_s)
+            if top is not None:
+                top.mark_event("device_dispatched")
+                top.note("device_ticket", t.dump())
+        return on_ticket
+
     async def _encode_shards(self, pg: PG, data: bytes,
-                             top=None) -> dict[int, bytes]:
+                             top=None,
+                             klass: str | None = None
+                             ) -> dict[int, bytes]:
         """Shard encode for the write path — the device-batched analog
         of ECTransaction::generate_transactions -> ECUtil::encode:
         concurrent writes across PGs aggregate into one TPU dispatch
-        (ceph_tpu.ec.batcher).  The await spans the batch window PLUS
-        the device flush, so its duration is the op's "EC batch wait"
-        stage; the flush the batcher just ran is sampled separately as
-        the "device dispatch" stage."""
+        (ceph_tpu.ec.batcher routed through the device runtime).  The
+        await spans the batch window PLUS the device flush, so its
+        duration is the op's "EC batch wait" stage; the flush that
+        actually carried the shards reports itself through the
+        dispatch ticket as the "device dispatch" stage."""
         import time as _time
         codec = self.codec(self.osd.osdmap.pools[pg.pool_id])
         n = codec.get_chunk_count()
         if top is not None:
             top.mark_event("ec_encode_start")
         t0 = _time.monotonic()
-        shards = await codec.encode_async(set(range(n)), data)
+        shards = await codec.encode_async(
+            set(range(n)), data, klass=klass,
+            on_ticket=self._on_dispatch_ticket(top))
         self.osd.perf.hist_sample("op_ec_batch_wait",
                                   _time.monotonic() - t0)
         if top is not None:
             top.mark_event("ec_encoded")
-        from ..ec.batcher import DeviceBatcher
-        flush = DeviceBatcher.get().last_flush_s
-        if flush > 0:
-            self.osd.perf.hist_sample("op_ec_device_dispatch", flush)
         return shards
 
     def _shard_txn(self, pg: PG, ho: hobject_t, shard: bytes, j: int,
@@ -1083,7 +1145,9 @@ class ECPGBackend:
                     pushes.append({"oid": oid, "delete": True})
                     continue
                 n = codec.get_chunk_count()
-                shards = await codec.encode_async(set(range(n)), data)
+                from ..device.runtime import K_RECOVERY_EC
+                shards = await codec.encode_async(
+                    set(range(n)), data, klass=K_RECOVERY_EC)
                 # user xattrs: local shard first, else the attrs the
                 # surviving shards returned with the read replies (the
                 # primary's own shard may be missing too)
@@ -1110,7 +1174,7 @@ class ECPGBackend:
                         if cd is None:
                             continue
                         cshards = await codec.encode_async(
-                            set(range(n)), cd)
+                            set(range(n)), cd, klass=K_RECOVERY_EC)
                         ca = dict(cattrs or {})
                         ca[SIZE_XATTR] = b"%d" % len(cd)
                         ca[SHARD_XATTR] = b"%d" % j
@@ -1154,8 +1218,9 @@ class ECPGBackend:
                     codec = self.codec(
                         self.osd.osdmap.pools[pg.pool_id])
                     n = codec.get_chunk_count()
+                    from ..device.runtime import K_RECOVERY_EC
                     shards = await codec.encode_async(
-                        set(range(n)), data)
+                        set(range(n)), data, klass=K_RECOVERY_EC)
                     t = self._shard_txn(pg, ho, shards[j], j,
                                         len(data), ver, None,
                                         hinfo_bytes(shards))
@@ -1176,8 +1241,9 @@ class ECPGBackend:
                     codec = self.codec(
                         self.osd.osdmap.pools[pg.pool_id])
                     n = codec.get_chunk_count()
+                    from ..device.runtime import K_RECOVERY_EC
                     cshards = await codec.encode_async(
-                        set(range(n)), cd)
+                        set(range(n)), cd, klass=K_RECOVERY_EC)
                     ct = self._shard_txn(pg, cho, cshards[j], j,
                                          len(cd), cver, None,
                                          hinfo_bytes(cshards))

@@ -168,6 +168,15 @@ class PG:
         # conn -> backoff id: clients told to stop resending at this
         # PG (MOSDBackoff); released when parked ops requeue
         self.backoffs: dict = {}
+        # reqid dup-detection journal (PrimaryLogPG osd_reqid_t dedup,
+        # PGLog pg_log_dup_t role): (client entity, tid) -> the reply
+        # already sent, so a timeout-triggered RESEND of a
+        # non-idempotent op (append-style cls methods) is answered
+        # from the journal instead of re-executed.  Bounded FIFO,
+        # persisted in the pgmeta omap within the same transaction as
+        # the write it journals.
+        self.reqid_journal: dict[tuple[str, int], dict] = {}
+        self.reqid_order: list[tuple[str, int]] = []
 
     # -- identity ----------------------------------------------------------
 
@@ -190,6 +199,40 @@ class PG:
         t.omap_setkeys(self.cid, PGMETA_OID, {
             b"log." + ev_key(e.version): denc.encode(e.to_wire()),
         })
+
+    # -- reqid dup journal -------------------------------------------------
+
+    @staticmethod
+    def _reqid_row(src: str, tid: int) -> bytes:
+        return b"dup.%s.%d" % (src.encode(), int(tid))
+
+    def record_reqid(self, t: Transaction, src: str, tid,
+                     result: int, outs: list, version: int) -> None:
+        """Journal one completed client write's reply, riding the same
+        transaction as the write itself (atomic: a replayed store
+        never has the mutation without its dup row or vice versa)."""
+        if not src or tid is None:
+            return
+        key = (src, int(tid))
+        entry = {"result": int(result), "outs": list(outs or []),
+                 "version": int(version)}
+        if key not in self.reqid_journal:
+            self.reqid_order.append(key)
+        self.reqid_journal[key] = entry
+        t.omap_setkeys(self.cid, PGMETA_OID,
+                       {self._reqid_row(*key): denc.encode(entry)})
+        cap = int(self.osd.ctx.conf.get("osd_pg_log_dups_tracked",
+                                        128))
+        while len(self.reqid_order) > cap:
+            old = self.reqid_order.pop(0)
+            self.reqid_journal.pop(old, None)
+            t.omap_rmkeys(self.cid, PGMETA_OID,
+                          [self._reqid_row(*old)])
+
+    def lookup_reqid(self, src: str, tid) -> dict | None:
+        if not src or tid is None:
+            return None
+        return self.reqid_journal.get((src, int(tid)))
 
     def maybe_trim_log(self, t: Transaction) -> None:
         """Bound the log after appending a WRITE entry (never call
@@ -244,6 +287,14 @@ class PG:
         for k, v in sorted(data.items()):
             if k.startswith(b"log."):
                 entries.append(LogEntry.from_wire(denc.decode(v)))
+            elif k.startswith(b"dup."):
+                try:
+                    src, tid_s = k[4:].rsplit(b".", 1)
+                    key = (src.decode(), int(tid_s))
+                except (ValueError, UnicodeDecodeError):
+                    continue
+                self.reqid_journal[key] = dict(denc.decode(v))
+                self.reqid_order.append(key)
         self.log.entries = entries
         self.log.tail = self.info.log_tail
         return True

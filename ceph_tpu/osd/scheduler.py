@@ -55,6 +55,17 @@ DEFAULT_PROFILE = {
     K_SNAPTRIM: (0.05, 1.0, 0.50),
 }
 
+# Device dispatch-queue shares (ceph_tpu.device.runtime): the same
+# client/recovery proportions as the mClock profile above, plus the
+# bulk-mapping class — client EC flushes outrank recovery encodes,
+# which outrank whole-pool remap passes, so a mapping storm cannot
+# starve client writes of the accelerator.
+DEVICE_DISPATCH_WEIGHTS = {
+    "client-ec": DEFAULT_PROFILE[K_CLIENT][1],      # 4.0
+    "recovery-ec": DEFAULT_PROFILE[K_RECOVERY][1],  # 2.0
+    "mapping": 1.0,
+}
+
 
 class _ClassQ:
     __slots__ = ("res", "wgt", "lim", "r_tag", "p_tag", "l_tag",

@@ -12,12 +12,22 @@ by recomputing only the excepted PGs through the host scalar pipeline.
 
 Falls back to the scalar pipeline per-PG when the crush map is outside
 the device scope (non-straw2 buckets, multi-choose rules).
+
+Device dispatches route through the shared device runtime
+(ceph_tpu.device.runtime): each pool pass is admitted under the
+"mapping" class (weight below client/recovery EC, so a full-cluster
+remap cannot starve EC writes of the accelerator), carries a
+DispatchTicket for the exporter, and degrades to the scalar host
+pipeline when admission pushes back (DeviceBusy) or the runtime is in
+device-loss fallback.  A dispatch failure poisons the runtime and
+this build finishes on the host path.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from ..device.runtime import DeviceBusy, DeviceRuntime, K_MAPPING
 from ..models.crushmap import ITEM_NONE
 from ..ops.crush.hashes import hash32_2_v
 from ..osd.osdmap import OSD_EXISTS, OSD_UP, OSDMap, PGPool, pg_t
@@ -53,29 +63,68 @@ class OSDMapMapping:
     """Caches up/acting for every PG of every pool (OSDMapMapping.h:174)
     as dense arrays."""
 
-    def __init__(self, osdmap: OSDMap, device_mapper=None):
+    def __init__(self, osdmap: OSDMap, device_mapper=None,
+                 runtime=None):
         self.epoch = osdmap.epoch
         self.pools: dict[int, PoolMapping] = {}
-        self._build(osdmap, device_mapper)
+        self.device_pools = 0      # pools mapped on device this build
+        self.scalar_pools = 0      # pools that fell back to host
+        self._build(osdmap, device_mapper, runtime)
 
-    def _build(self, osdmap: OSDMap, device_mapper) -> None:
+    def _build(self, osdmap: OSDMap, device_mapper, runtime) -> None:
         state = np.asarray(osdmap.osd_state, dtype=np.int32)
         exists = (state & OSD_EXISTS) != 0
         isup = (state & OSD_UP) != 0
         aff = (np.asarray(osdmap.osd_primary_affinity, dtype=np.int32)
                if osdmap.osd_primary_affinity is not None else None)
         dm = device_mapper
+        rt = runtime or DeviceRuntime.get()
         for pool in osdmap.pools.values():
             try:
+                if not rt.available:
+                    raise ValueError("device runtime in fallback")
                 if dm is None:
                     dm = osdmap.device_mapper()
-                up, prim = self._map_pool_device(osdmap, pool, dm,
-                                                 exists, isup, aff)
-            except ValueError:
+                up, prim = self._map_pool_ticketed(
+                    osdmap, pool, dm, rt, exists, isup, aff)
+            except (ValueError, DeviceBusy):
+                # outside device scope, admission pushback, or
+                # device-loss fallback: the scalar pipeline is the
+                # always-correct degradation
                 up, prim = self._map_pool_scalar(osdmap, pool)
+                self.scalar_pools += 1
+            else:
+                self.device_pools += 1
             pm = PoolMapping(pool, up, prim)
             self._apply_exceptions(osdmap, pool, pm)
             self.pools[pool.id] = pm
+
+    def _map_pool_ticketed(self, osdmap, pool, dm, rt,
+                           exists, isup, aff):
+        """One pool pass under a mapping-class dispatch ticket.  Sync
+        context (map advance runs outside any op coroutine), so
+        admission is the non-blocking form — a full dispatch queue
+        degrades this pass to the scalar path rather than queueing
+        device work behind EC flushes."""
+        ticket = rt.open_ticket(K_MAPPING,
+                                rt.bucket_for(pool.pg_num),
+                                pool.pg_num * pool.size * 4)
+        rt.try_admit(ticket)
+        try:
+            rt.launch(ticket)       # injected-fault hook
+            up, prim = self._map_pool_device(osdmap, pool, dm,
+                                             exists, isup, aff)
+        except ValueError:
+            # map outside device scope: a scalar-fallback condition,
+            # not a device loss
+            rt.finish(ticket, ok=False)
+            raise
+        except Exception as e:      # DeviceLost + real device faults
+            rt.finish(ticket, ok=False, error=e)
+            rt.poison(e)
+            raise ValueError("device mapping dispatch failed") from e
+        rt.finish(ticket, ok=True)
+        return up, prim
 
     # -- vectorized pool mapping ------------------------------------------
 

@@ -117,7 +117,19 @@ class ClusterThrasher:
                          writing under the degraded quorum, heal it
                          (multi-mon clusters only);
       map_churn        — burn map epochs (pool create/rm) to exercise
-                         client/OSD map-chasing under load.
+                         client/OSD map-chasing under load;
+      pg_num_grow      — double a thrashed pool's pg_num (capped):
+                         every OSD splits its PGs in place while the
+                         workload keeps writing;
+      ec_profile_swap  — roll the thrashed EC pool onto a freshly
+                         committed profile with identical coding
+                         parameters (rename/rollout path: codec cache
+                         invalidation on every OSD, zero data risk);
+      device_fallback  — poison the device runtime mid-round: the
+                         workload must complete on the host codec /
+                         scalar-mapper paths with zero lost acked
+                         writes, DEVICE_FALLBACK must raise, and the
+                         probe loop must heal it (warning clears).
 
     Slow-op oracle: after every round's health check, no live OSD may
     still hold an op in flight past osd_op_complaint_time — a healthy
@@ -125,7 +137,8 @@ class ClusterThrasher:
     """
 
     ALL_ACTIONS = ("kill_revive", "kill_wipe_revive", "out_in",
-                   "mon_partition", "map_churn")
+                   "mon_partition", "map_churn", "pg_num_grow",
+                   "ec_profile_swap", "device_fallback")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -155,6 +168,7 @@ class ClusterThrasher:
                 self.plan.append(
                     self._plan_one(self.rng.choice(pool)))
         self.log: list[str] = []
+        self._pool_ids: list = []
 
     def _default_actions(self) -> list[str]:
         acts = ["kill_revive", "kill_wipe_revive", "out_in",
@@ -171,7 +185,8 @@ class ClusterThrasher:
         if action == "mon_partition":
             # never plan an isolated majority: one rank only
             return (action, self.rng.randrange(self.cluster.n_mons))
-        if action == "map_churn":
+        if action in ("map_churn", "pg_num_grow", "ec_profile_swap",
+                      "device_fallback"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -189,6 +204,7 @@ class ClusterThrasher:
         workloads = (list(workloads) if isinstance(workloads,
                                                    (list, tuple))
                      else [workloads])
+        self._pool_ids = pool_ids
         try:
             for n, step in enumerate(self.plan):
                 self.log.append("round %d: %s" % (n, (step,)))
@@ -234,8 +250,75 @@ class ClusterThrasher:
             await c.client.mon_command("osd pool create", pool=name,
                                        pg_num=1, size=1)
             await c.client.mon_command("osd pool rm", pool=name)
+        elif action == "pg_num_grow":
+            pid = self._pool_ids[arg % len(self._pool_ids)]
+            pool = c.client.osdmap.pools.get(pid)
+            if pool is None:
+                return
+            new = min(pool.pg_num * 2, 64)
+            if new <= pool.pg_num:
+                return              # already at the cap
+            self.log.append("pg_num %s: %d -> %d"
+                            % (pool.name, pool.pg_num, new))
+            await c.client.mon_command("osd pool set", pool=pool.name,
+                                       var="pg_num", val=new)
+            await asyncio.sleep(self.hold)   # writes ride the split
+        elif action == "ec_profile_swap":
+            pid = next(
+                (p for p in self._pool_ids
+                 if (c.client.osdmap.pools.get(p) is not None
+                     and c.client.osdmap.pools[p]
+                     .erasure_code_profile)), None)
+            if pid is None:
+                return              # no EC pool under thrash
+            pool = c.client.osdmap.pools[pid]
+            cur = dict(c.client.osdmap.erasure_code_profiles.get(
+                pool.erasure_code_profile) or {})
+            if not cur:
+                return
+            name = "thrash-swap-%d" % arg
+            await c.client.mon_command("osd erasure-code-profile set",
+                                       name=name, profile=cur)
+            await c.client.mon_command("osd pool set", pool=pool.name,
+                                       var="erasure_code_profile",
+                                       val=name)
+            self.log.append("ec profile %s -> %s"
+                            % (pool.erasure_code_profile, name))
+            assert (await workload.write_one()) is not None, \
+                "write could not complete after EC profile swap"
+        elif action == "device_fallback":
+            from ..device.runtime import DeviceRuntime
+            rt = DeviceRuntime.get()
+            rt.inject_fault(1 << 30)     # probes keep failing too
+            rt.poison("thrash: device_fallback round")
+            # the workload must keep completing on the host paths
+            for _ in range(5):
+                assert (await workload.write_one()) is not None, \
+                    "write could not complete on the host fallback"
+            await self._wait_health_check(c, "DEVICE_FALLBACK", True)
+            rt.clear_faults()            # next probe heals
+            await self._wait_health_check(c, "DEVICE_FALLBACK", False)
+            assert not rt.fallback, "runtime did not heal"
         else:
             raise ValueError(action)
+
+    @staticmethod
+    async def _wait_health_check(c, check: str, present: bool,
+                                 timeout: float = 30.0) -> None:
+        """Poll the leading monitor's health checks until `check` is
+        (or is no longer) raised."""
+        from ..utils.backoff import wait_for
+
+        def pred():
+            leader = c.leader()
+            if leader is None:
+                return False
+            return (check in leader.health_mon.checks()) == present
+
+        await wait_for(pred, timeout,
+                       what="%s %s" % (check,
+                                       "raised" if present
+                                       else "cleared"))
 
     async def _check_invariants(self, pool_ids: list,
                                 workloads: list) -> None:

@@ -30,7 +30,7 @@ class TrackedOp:
     """One tracked request on one daemon (TrackedOp/OpRequest)."""
 
     __slots__ = ("tracker", "seq", "trace", "desc", "daemon",
-                 "initiated", "wall", "events", "finished")
+                 "initiated", "wall", "events", "finished", "meta")
 
     def __init__(self, tracker: "OpTracker", seq: int, desc: str,
                  trace: str | None):
@@ -44,10 +44,20 @@ class TrackedOp:
         self.events: list[tuple[float, str]] = [(self.initiated,
                                                  "initiated")]
         self.finished = False
+        self.meta: dict | None = None
 
     def mark_event(self, event: str) -> None:
         if not self.finished:
             self.events.append((time.monotonic(), event))
+
+    def note(self, key: str, value) -> None:
+        """Attach structured attribution to the op (e.g. the device
+        DispatchTicket of the flush that carried its shards): rides
+        the dump so timelines show exactly which dispatch served the
+        op, not a sampled approximation."""
+        if self.meta is None:
+            self.meta = {}
+        self.meta.setdefault(key, []).append(value)
 
     def finish(self, event: str = "done") -> None:
         """Completion: stamps the final event and retires the op into
@@ -65,7 +75,7 @@ class TrackedOp:
         return end - self.initiated
 
     def dump(self) -> dict:
-        return {
+        out = {
             "trace": self.trace,
             "desc": self.desc,
             "daemon": self.daemon,
@@ -76,6 +86,9 @@ class TrackedOp:
             "events": [{"t": t, "rel": t - self.initiated,
                         "event": e} for t, e in self.events],
         }
+        if self.meta:
+            out["meta"] = self.meta
+        return out
 
 
 class OpTracker:

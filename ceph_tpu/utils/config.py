@@ -291,4 +291,18 @@ DEFAULT_SCHEMA: list[Option] = [
            enum_allowed=("auto", "host", "jax", "native")),
     Option("ec_backend", OPT_STR, "auto", "erasure-code compute backend",
            enum_allowed=("auto", "host", "jax", "native")),
+    # -- device runtime (ceph_tpu.device) -------------------------------
+    Option("device_max_inflight", OPT_INT, 2,
+           "max concurrent device dispatches (runtime admission bound)"),
+    Option("device_queue_len", OPT_INT, 64,
+           "dispatch-queue waiters before admission raises DeviceBusy"),
+    Option("device_probe_interval", OPT_FLOAT, 1.0,
+           "cap of the probe backoff while the device runtime is in"
+           " host-fallback (ExpBackoff heal probes)"),
+    Option("device_warmup", OPT_INT, 1,
+           "pre-compile common EC shape buckets when a profile's codec"
+           " is first built (0 disables)"),
+    Option("osd_pg_log_dups_tracked", OPT_INT, 128,
+           "reqid (client,tid) dup-detection journal entries kept per"
+           " PG (PrimaryLogPG osd_reqid_t dedup analog)"),
 ]

@@ -141,10 +141,22 @@ class PrometheusExporter:
             self._server = None
 
 
+def device_runtime_lines(prefix: str = "ceph_tpu") -> list[str]:
+    """Device-runtime metric family (ceph_tpu.device): queue depth,
+    bucket hit ratio, compile count, fallback state, and the
+    device_dispatch_seconds histogram — every dispatch ticket feeds
+    these, so the accelerator's behavior is scrapeable beside the
+    daemon counters."""
+    from ..device.runtime import DeviceRuntime
+    return DeviceRuntime.get().prom_lines(prefix)
+
+
 def cluster_exporter(ctx, mon) -> PrometheusExporter:
     """Exporter pre-wired with the mgr prometheus module's core
-    cluster gauges, fed from a monitor's map."""
+    cluster gauges, fed from a monitor's map, plus the process's
+    device-runtime series."""
     exp = PrometheusExporter(ctx)
+    exp.add_renderer(device_runtime_lines)
     exp.add_gauge("ceph_osdmap_epoch", lambda: mon.osdmap.epoch,
                   "current osdmap epoch")
     exp.add_gauge("ceph_osd_count", lambda: mon.osdmap.max_osd,

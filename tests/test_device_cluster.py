@@ -1,0 +1,275 @@
+"""Cluster-level behavior of the device runtime + the PR's satellite
+machinery: device-loss thrashing (host-path completion, DEVICE_FALLBACK
+raise/clear), pg_num growth with in-place PG splits, EC profile
+rollout, reqid dup detection, and the mon's paxos-persisted
+beacon-derived health state."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.device.runtime import DeviceRuntime
+from ceph_tpu.testing import ClusterThrasher, LocalCluster, Workload
+
+
+@pytest.fixture(autouse=True)
+def _offload(monkeypatch):
+    # exercise the device EC path on the CPU backend, like the
+    # batcher tests
+    monkeypatch.setenv("CEPH_TPU_EC_OFFLOAD", "1")
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+class _CaptureConn:
+    """Minimal conn stub for direct OSD handler calls."""
+
+    def __init__(self):
+        self.sent = []
+        self.peer_entity = "client.test"
+        self.is_open = True
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+
+# -- device-loss thrash round ---------------------------------------------
+
+
+def test_device_fallback_thrash_round():
+    """Poisoning the runtime mid-round degrades EC writes to the host
+    codec path with ZERO lost acked writes, raises DEVICE_FALLBACK at
+    the mon, and the probe loop heals it (warning clears)."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=1212).start()
+        try:
+            rt = DeviceRuntime.get()
+            rt._probe_base = 0.02
+            rt._probe_cap = 0.1
+            pid = await c.create_pool("ecdev", pg_num=4,
+                                      pool_type="erasure")
+            await c.wait_health(pid)
+            wl = Workload(c.client.io_ctx("ecdev"), seed=5,
+                          prefix="devthrash").start()
+            th = ClusterThrasher(c, seed=9,
+                                 actions=[("device_fallback", 0)])
+            await th.run(pid, wl)
+            await wl.stop()
+            await wl.verify()           # every acked write intact
+            assert wl.acked, "workload never acked a write"
+            assert not rt.fallback
+            assert rt.fallback_count == 1 and rt.heal_count == 1
+        finally:
+            await c.stop()
+
+    run(coro=main(), timeout=300)
+
+
+# -- pg_num growth (in-place split) ---------------------------------------
+
+
+def test_pg_num_grow_splits_in_place():
+    """Doubling pg_num splits PGs locally on every member: objects
+    written before the grow stay readable at their new PG homes, and
+    writes keep completing through the transition."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=77).start()
+        try:
+            pid = await c.create_pool("grow", pg_num=4, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("grow")
+            payloads = {}
+            for i in range(40):
+                oid = "grow-%d" % i
+                payloads[oid] = (b"g%d|" % i) * 37
+                await io.write_full(oid, payloads[oid])
+            await c.client.mon_command("osd pool set", pool="grow",
+                                       var="pg_num", val=8)
+            # client + osds chase the new map; children peer
+            await c.wait_health(pid, timeout=60.0)
+            pool = c.client.osdmap.pools[pid]
+            assert pool.pg_num == 8
+            assert pool.pgp_num == 4       # placement unchanged
+            for oid, data in payloads.items():
+                got = await asyncio.wait_for(io.read(oid), 30.0)
+                assert got == data, oid
+            # writes flow at the new pg_num (and land in child PGs)
+            await io.write_full("grow-after", b"post-split")
+            assert await io.read("grow-after") == b"post-split"
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_thrash_pg_num_grow_and_ec_profile_swap():
+    """Thrasher rounds: grow the replicated pool's pg_num and roll
+    the EC pool onto a cloned profile, all under live load with the
+    standard invariants (zero acked-write loss, active+clean)."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=31).start()
+        try:
+            rep = await c.create_pool("trep", pg_num=4, size=3)
+            ec = await c.create_pool("tec", pg_num=4,
+                                     pool_type="erasure")
+            await c.wait_health(rep)
+            await c.wait_health(ec)
+            wl_r = Workload(c.client.io_ctx("trep"), seed=3,
+                            prefix="rg").start()
+            wl_e = Workload(c.client.io_ctx("tec"), seed=4,
+                            prefix="eg").start()
+            th = ClusterThrasher(
+                c, seed=13,
+                actions=[("pg_num_grow", 0), ("ec_profile_swap", 7)])
+            await th.run([rep, ec], [wl_r, wl_e])
+            await wl_r.stop()
+            await wl_e.stop()
+            await wl_r.verify()
+            await wl_e.verify()
+            pool = c.client.osdmap.pools[ec]
+            assert pool.erasure_code_profile == "thrash-swap-7"
+        finally:
+            await c.stop()
+
+    run(coro=main(), timeout=300)
+
+
+# -- reqid dup detection ---------------------------------------------------
+
+
+def test_reqid_dup_resend_answered_from_journal():
+    """A byte-identical resend of a committed non-idempotent write is
+    answered from the PG's reqid journal — same result/version, no
+    second execution (the PG log does not advance)."""
+    from ceph_tpu.msg.messages import MOSDOp
+    from ceph_tpu.osd.osdmap import pg_t
+
+    from ceph_tpu.utils.backoff import wait_for
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("dup", pg_num=4, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("dup")
+            await io.write_full("seed-obj", b"seed")
+            # the object's primary OSD on the current map
+            m = c.client.osdmap
+            pool = m.pools[pid]
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg("dup-obj", pid))
+            ps = pgid.ps
+            _up, _upp, _acting, prim = m.pg_to_up_acting_osds(pgid)
+            osd = c.osds[prim]
+            pg = osd.pgs[pg_t(pid, ps)]
+
+            def mk_op(tid):
+                mm = MOSDOp(tid=tid, pool=pid, ps=ps, oid="dup-obj",
+                            snapc=None,
+                            ops=[{"op": "call", "cls": "refcount",
+                                  "method": "get",
+                                  "input": {"tag": "t1"}}],
+                            epoch=osd.osdmap.epoch, flags=0)
+                mm.src = "client.test"
+                return mm
+
+            conn = _CaptureConn()
+            osd._handle_op(conn, mk_op(901))
+            # the reply lands after the replicas ack the repop
+            await wait_for(lambda: len(conn.sent) == 1, 20.0,
+                           what="first reply")
+            first = conn.sent[0]
+            assert first.result == 0
+            v_after_first = pg.info.last_update
+            assert pg.lookup_reqid("client.test", 901) is not None
+
+            # the resend: answered from the journal, not re-executed
+            osd._handle_op(conn, mk_op(901))
+            assert len(conn.sent) == 2     # synchronous journal hit
+            dup = conn.sent[1]
+            assert dup.result == first.result
+            assert dup.version == first.version
+            assert pg.info.last_update == v_after_first
+            assert osd.ctx.perf.dump()["osd"]["dup_ops"] == 1
+
+            # the journal answered instead of re-running the cls op:
+            # the PG log carries exactly ONE entry for the object
+            assert sum(1 for e in pg.log.entries
+                       if e.oid == "dup-obj") == 1
+            out = await io.exec("dup-obj", "refcount", "read")
+            assert out.get("refs") == ["t1"]
+
+            # journal survives a restart (persisted in pgmeta omap)
+            await c.kill_osd(prim)
+            await c.wait_osd_down(prim)
+            await c.revive_osd(prim)
+            osd2 = c.osds[prim]
+            await wait_for(
+                lambda: (pg_t(pid, ps) in osd2.pgs
+                         and osd2.pgs[pg_t(pid, ps)].lookup_reqid(
+                             "client.test", 901) is not None),
+                20.0, what="journal reload")
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- mon: persisted beacon-derived health ---------------------------------
+
+
+def test_health_state_survives_leader_change():
+    """Beacon-derived slow-op / device-fallback state is committed
+    through paxos: a monitor that never saw a single beacon (fresh
+    instance over the same store — the freshly-elected-leader shape)
+    reports SLOW_OPS and DEVICE_FALLBACK immediately."""
+    from ceph_tpu.mon import Monitor
+    from ceph_tpu.msg.messages import MOSDBeacon, MOSDBoot
+    from ceph_tpu.utils.context import Context
+
+    async def main():
+        mon = Monitor(Context("mon"))
+        await mon.start()
+        try:
+            mon.ms_dispatch(None, MOSDBoot(osd=0,
+                                           addr="127.0.0.1:9999",
+                                           epoch=0))
+            assert mon.osdmap.is_up(0)
+            mon.ms_dispatch(None, MOSDBeacon(osd=0, epoch=1,
+                                             slow_ops=7,
+                                             device_fallback=1))
+            assert mon.health_mon.persisted["slow"].get(0) == 7
+            assert mon.health_mon.persisted["devflb"].get(0) == 1
+            checks = mon.health_mon.checks()
+            assert "SLOW_OPS" in checks
+            assert "DEVICE_FALLBACK" in checks
+            # steady-state beacons commit nothing new
+            before = mon.paxos.last_committed
+            mon.ms_dispatch(None, MOSDBeacon(osd=0, epoch=1,
+                                             slow_ops=7,
+                                             device_fallback=1))
+            assert mon.paxos.last_committed == before
+
+            # the "fresh leader" (same store, zero beacons seen)
+            mon2 = Monitor(Context("mon"), store=mon.store)
+            assert not mon2.osd_slow_ops
+            checks2 = mon2.health_mon.checks()
+            assert "SLOW_OPS" in checks2, checks2
+            assert "7 slow ops" in checks2["SLOW_OPS"]["summary"]
+            assert "DEVICE_FALLBACK" in checks2
+
+            # clearing beacons retire the committed state too
+            mon.ms_dispatch(None, MOSDBeacon(osd=0, epoch=1,
+                                             slow_ops=0,
+                                             device_fallback=0))
+            assert not mon.health_mon.persisted["slow"]
+            assert "SLOW_OPS" not in mon.health_mon.checks()
+        finally:
+            await mon.shutdown()
+
+    run(main())

@@ -1,0 +1,364 @@
+"""Unified device runtime: shape-bucket bit-parity, staging-pool
+reuse, weighted admission backpressure, device-loss fallback/heal, and
+the compile-count budget of a mixed EC + mapping workload.
+
+CEPH_TPU_EC_OFFLOAD=1 exercises the device path on the CPU backend —
+the programs are identical on TPU (same recipe as test_ec_batcher)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.device.runtime import (DeviceBusy, DeviceRuntime,
+                                     DispatchQueue, K_CLIENT_EC,
+                                     K_MAPPING, K_RECOVERY_EC)
+from ceph_tpu.ec.batcher import DeviceBatcher, host_encode
+from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+
+
+@pytest.fixture(autouse=True)
+def _offload(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_EC_OFFLOAD", "1")
+
+
+def _codec(plugin, **profile):
+    prof = {k: str(v) for k, v in profile.items()}
+    return ErasureCodePluginRegistry.instance().factory(plugin, prof)
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+# -- shape buckets ---------------------------------------------------------
+
+
+def test_bucket_for_pow2_floor():
+    assert DeviceRuntime.bucket_for(1) == 512
+    assert DeviceRuntime.bucket_for(512) == 512
+    assert DeviceRuntime.bucket_for(513) == 1024
+    assert DeviceRuntime.bucket_for(100_000) == 131072
+
+
+def test_bucket_padding_bit_parity():
+    """Bucket-padded device encode is byte-identical to the unpadded
+    host codecs for awkward (non-bucket) sizes — GF zero columns are
+    exact, and the runtime slices the pad back off."""
+    codec = _codec("isa", technique="reed_sol_van", k=5, m=2)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(11)
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        for size in (100, 4096, 37_123, 100_001, 5000, 120):
+            data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            host = codec.encode(set(range(n)), data)
+            dev = await codec.encode_async(set(range(n)), data)
+            for i in host:
+                assert dev[i] == host[i], (size, i)
+        assert rt.dispatches >= 6
+        # the six sizes fold into four pow2 buckets: the last two
+        # flushes land in already-compiled programs
+        assert rt.bucket_hits >= 2
+        return rt
+
+    rt = run(main())
+    assert rt.compile_count <= 4
+
+
+def test_host_encode_matches_device_math():
+    """The fallback host matmul agrees with the codec host path (it
+    IS what serves flushes during device loss)."""
+    from ceph_tpu.ec import matrices
+    k, m = 4, 2
+    matrix = matrices.isa_rs_vandermonde_matrix(k, m)
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (k, 8192), dtype=np.uint8)
+    out = host_encode(matrix, 8, data)
+    codec = _codec("isa", technique="reed_sol_van", k=k, m=m)
+    host = codec.encode_chunks(
+        {i: data[i].tobytes() for i in range(k)})
+    for i in range(m):
+        assert out[i].tobytes() == host[k + i]
+
+
+# -- staging pool ----------------------------------------------------------
+
+
+def test_pool_reuse_no_steady_state_allocation():
+    """Sequential same-size flushes lease the same staging buffer:
+    pool misses stay flat after the first flush while hits grow."""
+    codec = _codec("jerasure", technique="reed_sol_van", k=2, m=1)
+    n = codec.get_chunk_count()
+    data = b"\xa5" * 20_000
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        for _ in range(8):
+            await codec.encode_async(set(range(n)), data)
+        return rt
+
+    rt = run(main())
+    assert rt.pool.misses == 1, rt.pool.misses
+    assert rt.pool.hits == 7
+    assert rt.pool.outstanding == 0
+
+
+# -- admission backpressure ------------------------------------------------
+
+
+def test_backpressure_ordering_class_weights():
+    """Under contention the dispatch queue grants in weighted-fair
+    order: client-EC (weight 4) clears its backlog ahead of mapping
+    (weight 1), mirroring the mClock shares."""
+
+    async def main():
+        q = DispatchQueue({K_CLIENT_EC: 4.0, K_RECOVERY_EC: 2.0,
+                           K_MAPPING: 1.0}, max_inflight=1,
+                          max_queue=16)
+        q.try_admit(K_MAPPING)          # saturate the single slot
+        order = []
+
+        async def waiter(klass):
+            await q.admit(klass)
+            order.append(klass)
+
+        tasks = []
+        for _ in range(4):              # enqueue alternating classes
+            tasks.append(asyncio.ensure_future(waiter(K_MAPPING)))
+            tasks.append(asyncio.ensure_future(waiter(K_CLIENT_EC)))
+        await asyncio.sleep(0)
+        for _ in range(8):
+            q.release()
+            await asyncio.sleep(0)
+        q.release()
+        await asyncio.gather(*tasks)
+        return order
+
+    order = run(main())
+    assert len(order) == 8
+    # the client class finishes its 4 grants within the first 5 slots
+    assert order[:3] == [K_CLIENT_EC] * 3
+    assert order.index(K_MAPPING) >= 3
+    assert sorted(order[:5]).count(K_CLIENT_EC) == 4
+
+
+def test_queue_full_raises_device_busy():
+    async def main():
+        q = DispatchQueue({K_CLIENT_EC: 4.0}, max_inflight=1,
+                          max_queue=1)
+        q.try_admit(K_CLIENT_EC)
+        t = asyncio.ensure_future(q.admit(K_CLIENT_EC))
+        await asyncio.sleep(0)
+        with pytest.raises(DeviceBusy):
+            await q.admit(K_CLIENT_EC)      # waiter slot taken
+        with pytest.raises(DeviceBusy):
+            q.try_admit(K_CLIENT_EC)        # sync form pushes back too
+        q.release()
+        await t
+        q.release()
+        assert q.rejected == 2
+
+    run(main())
+
+
+# -- device-loss fallback / heal ------------------------------------------
+
+
+def test_fallback_and_heal_roundtrip():
+    """An injected dispatch fault poisons the runtime: the in-flight
+    flush is re-encoded on the host (callers never see the loss),
+    subsequent encodes take the host path, and once the fault clears
+    the probe loop heals the runtime and dispatches go back to the
+    device."""
+    codec = _codec("isa", technique="reed_sol_van", k=4, m=2)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, 30_000, dtype=np.uint8).tobytes()
+    host = codec.encode(set(range(n)), data)
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        rt._probe_base = 0.01
+        rt._probe_cap = 0.05
+        rt.inject_fault(1 << 30)
+        out = await codec.encode_async(set(range(n)), data)
+        for i in host:
+            assert out[i] == host[i], i     # host fallback, exact
+        assert rt.fallback
+        assert rt.host_fallbacks >= 1
+        # while poisoned, encodes bypass the batcher entirely
+        out2 = await codec.encode_async(set(range(n)), data)
+        assert out2[n - 1] == host[n - 1]
+        rt.clear_faults()                   # next probe heals
+        for _ in range(200):
+            if not rt.fallback:
+                break
+            await asyncio.sleep(0.02)
+        assert not rt.fallback, "probe loop did not heal the runtime"
+        assert rt.heal_count == 1
+        before = rt.dispatches
+        out3 = await codec.encode_async(set(range(n)), data)
+        assert out3[0] == host[0]
+        assert rt.dispatches == before + 1  # back on the device
+
+    run(main())
+
+
+def test_mapping_scalar_fallback_when_poisoned():
+    """A poisoned runtime degrades bulk mapping to the scalar host
+    pipeline — results identical, zero device dispatches."""
+    from ceph_tpu.parallel.mapping import OSDMapMapping
+    m = _small_map()
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        dev = OSDMapMapping(m)
+        assert dev.device_pools == 1 and dev.scalar_pools == 0
+        rt.poison("test")
+        scal = OSDMapMapping(m)
+        assert scal.device_pools == 0 and scal.scalar_pools == 1
+        from ceph_tpu.osd.osdmap import pg_t
+        for ps in range(m.pools[1].pg_num):
+            assert dev.get(pg_t(1, ps)) == scal.get(pg_t(1, ps)), ps
+
+    run(main())
+
+
+def _small_map(n_osds: int = 12, pg_num: int = 64):
+    """Tiny straw2 host/osd map in device scope (bench_crush shape)."""
+    from ceph_tpu.models.crushmap import (CHOOSELEAF_FIRSTN, EMIT,
+                                          STRAW2, TAKE, CrushMap)
+    from ceph_tpu.osd.osdmap import (OSD_EXISTS, OSD_UP, Incremental,
+                                     OSDMap, PGPool)
+    per_host = 4
+    hosts = n_osds // per_host
+    crush = CrushMap()
+    host_ids = []
+    for h in range(hosts):
+        items = list(range(h * per_host, (h + 1) * per_host))
+        b = crush.add_bucket(STRAW2, 1, items, [0x10000] * per_host,
+                             id=-(h + 2))
+        host_ids.append(b.id)
+    crush.add_bucket(STRAW2, 2, host_ids,
+                     [crush.buckets[h].weight for h in host_ids],
+                     id=-1)
+    crush.add_rule([(TAKE, -1, 0), (CHOOSELEAF_FIRSTN, 0, 1),
+                    (EMIT, 0, 0)], id=0)
+    m = OSDMap()
+    inc = Incremental(epoch=1)
+    inc.new_max_osd = n_osds
+    inc.new_crush = crush
+    inc.new_pools[1] = PGPool(id=1, name="mix", pg_num=pg_num, size=3,
+                              crush_rule=0)
+    m.apply_incremental(inc)
+    inc = m.new_incremental()
+    for o in range(n_osds):
+        inc.new_state[o] = OSD_EXISTS | OSD_UP
+        inc.new_weight[o] = 0x10000
+    m.apply_incremental(inc)
+    return m
+
+
+# -- compile budget (acceptance criterion) ---------------------------------
+
+
+def test_mixed_workload_compile_budget():
+    """Steady-state mixed workload — concurrent EC writes at two
+    sizes plus a full-pool device remap — stays within 8 distinct
+    compiled programs (the runtime's compile counter is the
+    arbiter), and re-running the same workload compiles nothing
+    new."""
+    codec = _codec("isa", technique="reed_sol_van", k=8, m=3)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(9)
+    objs = [rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            for size in (4096, 4096, 16384, 4096, 16384, 4096)]
+    m = _small_map()
+    pool = m.pools[1]
+
+    async def workload(rt):
+        outs = await asyncio.gather(*[
+            codec.encode_async(set(range(n)), d) for d in objs])
+        assert len(outs) == len(objs)
+        from ceph_tpu.parallel.mapping import OSDMapMapping
+        mapping = OSDMapMapping(m)
+        assert mapping.device_pools == 1
+        return rt.compile_count
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        first = await workload(rt)
+        assert first <= 8, (first, sorted(rt.programs))
+        again = await workload(rt)
+        assert again == first, "steady state recompiled"
+        assert rt.bucket_hits >= 1
+
+    run(main())
+
+
+# -- tickets / exporter ----------------------------------------------------
+
+
+def test_dispatch_ticket_attribution():
+    """on_ticket delivers the exact flush's ticket: pow2 bucket, the
+    requested class, and stamps ordered enqueue <= admit <= launch <=
+    done."""
+    codec = _codec("jerasure", technique="reed_sol_van", k=3, m=2)
+    n = codec.get_chunk_count()
+    got = []
+
+    async def main():
+        DeviceRuntime.reset()
+        data = b"t" * 9000
+        await codec.encode_async(set(range(n)), data,
+                                 klass=K_RECOVERY_EC,
+                                 on_ticket=got.append)
+
+    run(main())
+    assert len(got) == 1
+    t = got[0]
+    assert t.klass == K_RECOVERY_EC
+    assert t.bucket & (t.bucket - 1) == 0
+    assert t.t_enqueue <= t.t_admit <= t.t_launch <= t.t_done
+    assert t.ok and t.device_s >= 0.0
+    d = t.dump()
+    assert d["klass"] == K_RECOVERY_EC and d["ok"]
+
+
+def test_exporter_device_series():
+    """The runtime renders the ISSUE-named metric families."""
+    codec = _codec("jerasure", technique="reed_sol_van", k=2, m=1)
+    n = codec.get_chunk_count()
+
+    async def main():
+        DeviceRuntime.reset()
+        await codec.encode_async(set(range(n)), b"z" * 4096)
+        from ceph_tpu.utils.exporter import device_runtime_lines
+        return "\n".join(device_runtime_lines())
+
+    text = run(main())
+    for name in ("ceph_tpu_device_dispatch_seconds",
+                 "ceph_tpu_device_queue_depth",
+                 "ceph_tpu_device_bucket_hit_ratio",
+                 "ceph_tpu_device_compile_count",
+                 "ceph_tpu_device_fallback"):
+        assert name in text, name
+
+
+def test_warmup_precompiles_buckets():
+    async def main():
+        rt = DeviceRuntime.reset()
+        from ceph_tpu.ec import matrices
+        matrix = matrices.isa_rs_vandermonde_matrix(2, 1)
+        await rt.warmup_ec(matrix, 8, buckets=(1024, 4096))
+        compiled = rt.compile_count
+        assert compiled == 2
+        # a flush landing in a warmed bucket is a hit, not a compile
+        codec = _codec("isa", technique="reed_sol_van", k=2, m=1)
+        await codec.encode_async({0, 1, 2}, b"w" * 1500)  # 750w -> 1024
+        assert rt.compile_count == compiled
+        assert rt.bucket_hits >= 1
+
+    run(main())
