@@ -400,6 +400,65 @@ def bench_trace(n_ops: int = 40) -> dict:
     return asyncio.run(asyncio.wait_for(run(), 300))
 
 
+def bench_stats(seconds: float = 4.0) -> dict:
+    """--stats mode: boot a LocalCluster WITH a manager, drive a
+    mixed read/write workload, and report what the cluster statistics
+    plane observed — the PGMap digest's per-pool usage, client IO and
+    recovery rates, pg states, and the cluster op-size histogram.
+    This is the `ceph -s` / `rados df` surface as JSON: use it to
+    sanity-check that rate derivation tracks a known offered load."""
+    import asyncio
+
+    from ceph_tpu.testing import LocalCluster
+
+    async def run() -> dict:
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            pid = await c.create_pool("stats", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("stats")
+            payload = b"\x5a" * 8192
+            n = 0
+            peak_io = {}
+            status_io = None
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                await io.write_full("s-%d" % (n % 64), payload)
+                if n % 4 == 0:
+                    await io.read("s-%d" % (n % 64))
+                n += 1
+                if n % 50 == 0:
+                    # sample the digest's rate view DURING the load
+                    d = c.digest()
+                    t = (d or {}).get("totals") or {}
+                    if t.get("write_ops_s", 0) > \
+                            peak_io.get("write_ops_s", 0):
+                        peak_io = {k: t[k] for k in t
+                                   if k.endswith("_s")}
+                        st = await c.client.mon_command("status")
+                        status_io = (st.get("pgmap") or {}).get("io")
+            wall = time.perf_counter() - t0
+            await asyncio.sleep(1.0)    # the tail report lands
+            dig = c.digest() or {}
+            return {
+                "metric": "cluster_stats_plane",
+                "offered_write_ops": n,
+                "offered_write_ops_s": round(n / wall, 1),
+                "seconds": round(wall, 2),
+                "peak_io_rates": peak_io,
+                "status_io_under_load": status_io,
+                "digest_totals": dig.get("totals"),
+                "pg_states": dig.get("pg_states"),
+                "num_pgs": dig.get("num_pgs"),
+                "op_size_hist_bytes_pow2":
+                    dig.get("op_size_hist_bytes_pow2"),
+            }
+        finally:
+            await c.stop()
+
+    return asyncio.run(asyncio.wait_for(run(), 300))
+
+
 def bench_device(n_objs: int = 48, rounds: int = 8,
                  obj_bytes: int = 1 << 20) -> dict:
     """--device mode: drive the cluster's actual EC write path — the
@@ -492,6 +551,9 @@ def main() -> None:
         return
     if "--device" in sys.argv:
         print(json.dumps(bench_device()))
+        return
+    if "--stats" in sys.argv:
+        print(json.dumps(bench_stats()))
         return
 
     import jax

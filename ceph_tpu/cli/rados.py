@@ -25,11 +25,27 @@ async def _run(args) -> int:
     await client.connect()
     try:
         if args.cmd == "df":
-            out = await client.mon_command("status")
-            print("pools: %s  osds: %d up: %d in: %d (epoch %d)"
-                  % (out["pools"], out["num_osds"],
-                     out["num_up_osds"], out["num_in_osds"],
-                     out["epoch"]))
+            # real per-pool usage from the cluster's PGMap digest
+            # (the reference's `rados df` table)
+            out = await client.mon_command("df")
+            cols = ("POOL_NAME", "OBJECTS", "BYTES", "DEGRADED",
+                    "MISPLACED", "RD_OPS/S", "WR_OPS/S")
+            fmt = "%-16s %10s %12s %9s %10s %9s %9s"
+            print(fmt % cols)
+            for row in out.get("pools") or []:
+                print(fmt % (row["name"], row["objects"],
+                             row["bytes"], row["degraded"],
+                             row["misplaced"],
+                             "%.1f" % row["read_ops_s"],
+                             "%.1f" % row["write_ops_s"]))
+            total = out.get("total") or {}
+            print(fmt % ("TOTAL", total.get("objects", 0),
+                         total.get("bytes", 0),
+                         total.get("degraded", 0),
+                         total.get("misplaced", 0), "", ""))
+            if not out.get("stats_available"):
+                print("(no mgr digest yet: counts read as zero "
+                      "until a manager reports)")
             return 0
         io = client.io_ctx(args.pool)
         if args.snap:

@@ -24,10 +24,12 @@ import asyncio
 from ..msg import Messenger
 from ..msg.messenger import ms_compress_from_conf
 from ..msg.messages import (MConfig, MMgrReport, MMonCommand, MMonCommandAck,
-                            MMonGetMap, MMonSubscribe, MOSDMapMsg)
+                            MMonGetMap, MMonMgrDigest, MMonSubscribe,
+                            MOSDMapMsg)
 from ..osd.osdmap import OSDMap, consume_map_payload
 from ..utils.context import Context
 from ..utils.exporter import PrometheusExporter
+from .pgmap import PGMap, RATE_KEYS
 
 
 class Manager:
@@ -48,6 +50,14 @@ class Manager:
         self.balancer_changes = 0
         # daemon -> {"perf": .., "pg_states": .., "stamp": ..}
         self.daemon_reports: dict[str, dict] = {}
+        # cluster statistics plane: per-PG stat rows folded into the
+        # PGMap; a periodic digest feeds the monitors (status/df/
+        # pool-stats + PG_* health)
+        self.pgmap = PGMap(stale_after=float(
+            self.ctx.conf.get("mgr_stats_stale_after", 15.0)))
+        self.stats_period = float(
+            self.ctx.conf.get("mgr_stats_period", 1.0))
+        self.digests_sent = 0
         self.exporter = PrometheusExporter(self.ctx)
         self._tid = 0
         self._cmd_futures: dict[int, asyncio.Future] = {}
@@ -65,6 +75,7 @@ class Manager:
         self.http_addr = await self.exporter.start(host, http_port)
         self._register_cluster_gauges()
         self._tasks.append(self.msgr.spawn(self._balancer_loop()))
+        self._tasks.append(self.msgr.spawn(self._stats_loop()))
         self.ctx.log.info("mgr", "mgr serving at %s (metrics %s)"
                           % (addr, self.http_addr))
         return addr
@@ -87,14 +98,17 @@ class Manager:
                 self.osdmap, msg.full, msg.incrementals)
             return True
         if isinstance(msg, MMgrReport):
+            now = asyncio.get_event_loop().time()
             self.daemon_reports[msg.daemon] = {
                 "perf": msg.perf or {},
                 "pg_states": msg.pg_states or {},
                 "num_pgs": msg.num_pgs or 0,
                 "num_objects": msg.num_objects or 0,
                 "epoch": msg.epoch,
-                "stamp": asyncio.get_event_loop().time(),
+                "stamp": now,
             }
+            self.pgmap.apply_report(msg.daemon, msg.pg_stats,
+                                    msg.osd_stats, now)
             return True
         if isinstance(msg, MMonCommandAck):
             fut = self._cmd_futures.pop(msg.tid, None)
@@ -145,6 +159,7 @@ class Manager:
                       lambda: self.balancer_changes,
                       "upmap items committed by the balancer")
         exp.add_renderer(self._render_reports)
+        exp.add_renderer(self._render_pgmap)
 
     def _total_slow_ops(self) -> int:
         """Cluster-wide slow-op count aggregated from the per-daemon
@@ -161,9 +176,19 @@ class Manager:
         """Per-daemon series from the MMgrReports (the prometheus
         module's per-daemon metric families).  Stage-latency
         histograms (PerfCounters pow2 buckets) render as labeled
-        Prometheus histogram series."""
+        Prometheus histogram series.  Every family gets exactly one
+        `# TYPE` line (the exposition-format requirement the exporter
+        lint pins)."""
         from ..utils.exporter import hist_lines
         lines: list[str] = []
+        typed: set[str] = set()
+
+        def emit(family: str, label: str, value, kind="gauge"):
+            if family not in typed:
+                typed.add(family)
+                lines.append("# TYPE %s %s" % (family, kind))
+            lines.append("%s%s %g" % (family, label, value))
+
         pg_totals: dict[str, int] = {}
         for daemon in sorted(self.daemon_reports):
             rep = self.daemon_reports[daemon]
@@ -174,25 +199,85 @@ class Manager:
                     continue
                 for cname, val in sorted(counters.items()):
                     if isinstance(val, (int, float)):
-                        lines.append(
-                            "ceph_tpu_daemon_%s_%s%s %g"
-                            % (grp, cname, label, val))
+                        emit("ceph_tpu_daemon_%s_%s" % (grp, cname),
+                             label, val, kind="counter")
                     elif isinstance(val, dict) \
                             and "buckets_us_pow2" in val:
                         lines.extend(hist_lines(
                             "ceph_tpu_daemon_%s_%s" % (grp, cname),
                             val["buckets_us_pow2"],
-                            labels='daemon="%s"' % daemon))
-            lines.append("ceph_tpu_daemon_num_pgs%s %d"
-                         % (label, rep.get("num_pgs") or 0))
-            lines.append("ceph_tpu_daemon_num_objects%s %d"
-                         % (label, rep.get("num_objects") or 0))
+                            labels='daemon="%s"' % daemon,
+                            typed=typed))
+            emit("ceph_tpu_daemon_num_pgs", label,
+                 rep.get("num_pgs") or 0)
+            emit("ceph_tpu_daemon_num_objects", label,
+                 rep.get("num_objects") or 0)
             for state, n in (rep.get("pg_states") or {}).items():
                 pg_totals[state] = pg_totals.get(state, 0) + n
         for state in sorted(pg_totals):
-            lines.append('ceph_tpu_pg_state{state="%s"} %d'
-                         % (state, pg_totals[state]))
+            emit("ceph_tpu_pg_state", '{state="%s"}' % state,
+                 pg_totals[state])
         return lines
+
+    def _render_pgmap(self) -> list[str]:
+        """PGMap-derived families: per-pool usage + IO/recovery rates
+        and cluster totals — the `ceph -s` io:/recovery: lines and
+        `df` columns as scrapeable series, plus the cluster op-size
+        histogram the workload-aware warmup feeds on."""
+        now = asyncio.get_event_loop().time()
+        pools = set(self.osdmap.pools)
+        per_pool = self.pgmap.pool_totals(now, pools)
+        lines: list[str] = []
+        gauges = ("objects", "bytes", "degraded", "misplaced",
+                  "unfound") + RATE_KEYS
+        for g in gauges:
+            fam = "ceph_tpu_pool_%s" % g
+            lines.append("# TYPE %s gauge" % fam)
+            for pid in sorted(per_pool):
+                name = (self.osdmap.pools[pid].name
+                        if pid in self.osdmap.pools else str(pid))
+                lines.append('%s{pool="%s",pool_id="%d"} %g'
+                             % (fam, name, pid, per_pool[pid][g]))
+        totals = {g: sum(r[g] for r in per_pool.values())
+                  for g in gauges}
+        for g in gauges:
+            fam = "ceph_tpu_cluster_%s" % g
+            lines.append("# TYPE %s gauge" % fam)
+            lines.append("%s %g" % (fam, totals[g]))
+        hist = self.pgmap.op_size_hist(now)
+        if hist:
+            fam = "ceph_tpu_cluster_op_size_bytes"
+            lines.append("# TYPE %s histogram" % fam)
+            cum = 0
+            for i, n in enumerate(hist):
+                cum += n
+                lines.append('%s_bucket{le="%g"} %d'
+                             % (fam, float(1 << (i + 1)), cum))
+            lines.append('%s_bucket{le="+Inf"} %d' % (fam, cum))
+            lines.append("%s_count %d" % (fam, cum))
+        return lines
+
+    # -- stats loop (PGMap digest -> monitors) -----------------------------
+
+    async def _stats_loop(self) -> None:
+        """Periodically fold the PGMap into a digest and broadcast it
+        to every monitor (MgrStatMonitor's report flow, broadcast like
+        beacons so whichever mon leads next already holds it)."""
+        while True:
+            await asyncio.sleep(self.stats_period)
+            if not self.daemon_reports:
+                continue
+            now = asyncio.get_event_loop().time()
+            try:
+                digest = self.pgmap.digest(now, self.osdmap)
+            except Exception as e:
+                self.ctx.log.info("mgr", "digest failed: %r" % e)
+                continue
+            msg_fields = dict(digest=digest, epoch=self.osdmap.epoch)
+            for i, addr in enumerate(self.mon_addrs):
+                self.msgr.send_to(addr, MMonMgrDigest(**msg_fields),
+                                  entity_hint="mon.%d" % i)
+            self.digests_sent += 1
 
     # -- balancer loop -----------------------------------------------------
 

@@ -1,0 +1,154 @@
+"""PGMap: the mgr's fold of every OSD's per-PG stat rows.
+
+Condensed analog of src/mon/PGMap.{h,cc} as maintained by the
+MgrStatMonitor pipeline (OSD MPGStats -> DaemonServer -> PGMap
+apply_incremental): primaries ship a stat row per PG they serve
+(object/byte counts, degraded/misplaced/unfound tallies, cumulative
+client-IO and recovery counters) inside their MMgrReports; this class
+keeps the latest row per PG, derives **rates** from the delta between
+two consecutive reports of the same primary (PGMap's pool_statfs
+delta machinery), and renders:
+
+* per-pool and cluster-wide totals (objects, bytes, degraded,
+  misplaced, unfound) — the `df` / `osd pool stats` surface;
+* client read/write ops/s + bytes/s and recovery objects/s + bytes/s
+  — the `ceph -s` io: / recovery: lines;
+* the digest the mgr periodically sends the monitors (MMonMgrDigest),
+  from which the mon serves `status`/`df` and raises PG_DEGRADED /
+  PG_AVAILABILITY.
+
+Counter resets (primary restart or failover) surface as negative
+deltas and clamp to zero — exactly one digest period of undercounted
+rate, never a negative or wildly inflated one.
+"""
+
+from __future__ import annotations
+
+RATE_COUNTERS = ("read_ops", "read_bytes", "write_ops", "write_bytes",
+                 "recovery_ops", "recovery_bytes")
+
+# digest keys carrying the per-second forms of RATE_COUNTERS
+RATE_KEYS = tuple(c + "_s" for c in RATE_COUNTERS)
+
+
+class PGMap:
+    def __init__(self, stale_after: float = 15.0):
+        self.stale_after = float(stale_after)
+        # pgid -> latest stat row (+ "_from" daemon, "_stamp")
+        self.pg_stats: dict[str, dict] = {}
+        # pgid -> {counter_s: rate} derived from the last two reports
+        self.rates: dict[str, dict] = {}
+        # daemon -> {"op_size_hist_bytes_pow2": [...], "_stamp": t}
+        self.osd_stats: dict[str, dict] = {}
+
+    # -- ingest ------------------------------------------------------------
+
+    def apply_report(self, daemon: str, pg_stats: list | None,
+                     osd_stats: dict | None, stamp: float) -> None:
+        """Fold one daemon's report in.  `stamp` is the receiver's
+        clock at arrival (injectable for exact-delta tests)."""
+        if osd_stats:
+            row = dict(osd_stats)
+            row["_stamp"] = stamp
+            self.osd_stats[daemon] = row
+        for st in pg_stats or []:
+            pgid = st.get("pgid")
+            if not pgid:
+                continue
+            prev = self.pg_stats.get(pgid)
+            cur = dict(st)
+            cur["_from"] = daemon
+            cur["_stamp"] = stamp
+            if prev is not None and prev["_from"] == daemon:
+                dt = stamp - prev["_stamp"]
+                if dt > 0:
+                    self.rates[pgid] = {
+                        c + "_s": max(0.0, (cur.get(c, 0)
+                                            - prev.get(c, 0)) / dt)
+                        for c in RATE_COUNTERS}
+            else:
+                # new PG or a primary change: no comparable base —
+                # rates restart from the next delta
+                self.rates.pop(pgid, None)
+            self.pg_stats[pgid] = cur
+
+    # -- views -------------------------------------------------------------
+
+    def _live_rows(self, now: float, pools: set | None):
+        for pgid, st in self.pg_stats.items():
+            if now - st["_stamp"] > self.stale_after:
+                continue            # dead primary's last report
+            if pools is not None and st.get("pool") not in pools:
+                continue            # pool deleted since the report
+            yield pgid, st
+
+    def pool_totals(self, now: float,
+                    pools: set | None = None) -> dict[int, dict]:
+        """Per-pool sums of the live stat rows + their rates."""
+        out: dict[int, dict] = {}
+        for pgid, st in self._live_rows(now, pools):
+            row = out.setdefault(st["pool"], {
+                "num_pgs": 0, "objects": 0, "bytes": 0,
+                "degraded": 0, "misplaced": 0, "unfound": 0,
+                "log_size": 0,
+                **{k: 0.0 for k in RATE_KEYS}})
+            row["num_pgs"] += 1
+            row["objects"] += st.get("num_objects", 0)
+            row["bytes"] += st.get("num_bytes", 0)
+            row["degraded"] += st.get("degraded", 0)
+            row["misplaced"] += st.get("misplaced", 0)
+            row["unfound"] += st.get("unfound", 0)
+            row["log_size"] += st.get("log_size", 0)
+            rt = self.rates.get(pgid)
+            if rt:
+                for k in RATE_KEYS:
+                    row[k] += rt.get(k, 0.0)
+        return out
+
+    def pg_state_counts(self, now: float,
+                        pools: set | None = None) -> dict[str, int]:
+        states: dict[str, int] = {}
+        for _pgid, st in self._live_rows(now, pools):
+            s = st.get("state", "unknown")
+            states[s] = states.get(s, 0) + 1
+        return states
+
+    def op_size_hist(self, now: float) -> list[int]:
+        """Element-wise sum of every live daemon's op-size histogram
+        (pow2 byte buckets)."""
+        total: list[int] = []
+        for row in self.osd_stats.values():
+            if now - row["_stamp"] > self.stale_after:
+                continue
+            hist = row.get("op_size_hist_bytes_pow2") or []
+            if len(hist) > len(total):
+                total.extend([0] * (len(hist) - len(total)))
+            for i, n in enumerate(hist):
+                total[i] += n
+        return total
+
+    def digest(self, now: float, osdmap=None) -> dict:
+        """The mon-bound digest (MMonMgrDigest payload): everything
+        `status`/`df`/`osd pool stats` and the PG_* health checks
+        need, with no raw per-PG rows (bounded size)."""
+        pools = set(osdmap.pools) if osdmap is not None else None
+        per_pool = self.pool_totals(now, pools)
+        states = self.pg_state_counts(now, pools)
+        totals = {
+            "objects": 0, "bytes": 0, "degraded": 0,
+            "misplaced": 0, "unfound": 0,
+            **{k: 0.0 for k in RATE_KEYS}}
+        for row in per_pool.values():
+            for k in totals:
+                totals[k] += row[k]
+        inactive = sum(n for s, n in states.items()
+                       if s not in ("active", "replica"))
+        return {
+            "num_pgs": sum(r["num_pgs"] for r in per_pool.values()),
+            "pg_states": states,
+            "pools": {int(pid): row
+                      for pid, row in per_pool.items()},
+            "totals": totals,
+            "inactive_pgs": inactive,
+            "op_size_hist_bytes_pow2": self.op_size_hist(now),
+        }

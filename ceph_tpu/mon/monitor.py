@@ -121,6 +121,12 @@ class Monitor:
         self.osd_slow_ops: dict[int, tuple[int, float]] = {}
         # osd -> (device_fallback flag, monotonic stamp)
         self.osd_device_fallback: dict[int, tuple[int, float]] = {}
+        # latest PGMap digest from the mgr (MMonMgrDigest): soft state
+        # every mon keeps (broadcast like beacons); feeds status/df/
+        # pool-stats and the PG_DEGRADED / PG_AVAILABILITY checks; the
+        # leader commits raise/clear edges into the health svc state
+        self.mgr_digest: dict | None = None
+        self.mgr_digest_stamp = 0.0
         # mon-side op tracking (MMonCommand requests)
         from ..trace import OpTracker
         self.optracker = OpTracker(self.ctx, name)
@@ -463,7 +469,18 @@ class Monitor:
                               "lease_until", "uncommitted", "epoch",
                               "accepted_pn")})
             return True
-        from ..msg.messages import MOSDBeacon, MOSDPGTemp
+        from ..msg.messages import (MMonMgrDigest, MOSDBeacon,
+                                    MOSDPGTemp)
+        if isinstance(msg, MMonMgrDigest):
+            self.mgr_digest = msg.digest or {}
+            self.mgr_digest_stamp = time.monotonic()
+            if self.is_leader() and \
+                    (not self.multi or self.mpaxos.active):
+                totals = self.mgr_digest.get("totals") or {}
+                self.health_mon.maybe_commit_digest(
+                    int(totals.get("degraded") or 0),
+                    int(self.mgr_digest.get("inactive_pgs") or 0))
+            return True
         if isinstance(msg, MOSDBeacon):
             # beacons are derived soft state: EVERY mon records them,
             # so whichever mon leads next already holds the picture —
@@ -864,17 +881,111 @@ class Monitor:
         if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
             return self._cmd_pg_scrub(prefix, cmd)
         if prefix == "status":
-            up = sum(1 for o in range(self.osdmap.max_osd)
-                     if self.osdmap.is_up(o))
-            inn = sum(1 for o in range(self.osdmap.max_osd)
-                      if self.osdmap.is_in(o))
-            return {"epoch": self.osdmap.epoch, "fsid": self.fsid,
-                    "num_osds": self.osdmap.max_osd, "num_up_osds": up,
-                    "num_in_osds": inn,
-                    "pools": sorted(self.osdmap.pools)}
+            return self._cmd_status()
+        if prefix == "df":
+            return self._cmd_df()
+        if prefix == "osd pool stats":
+            return self._cmd_pool_stats(cmd)
         if prefix == "osd dump":
             return self.osdmap.to_dict()
         raise ValueError("unknown command %r" % prefix)
+
+    # -- cluster stats surfaces (PGMap digest consumers) -------------------
+
+    def _digest_fresh(self) -> dict | None:
+        """The mgr's PGMap digest when recent enough to serve (stale
+        digests — mgr dead, never registered — surface as absent
+        sections, never as frozen numbers)."""
+        if self.mgr_digest is None:
+            return None
+        ttl = self.health_mon.SOFT_TTL
+        if time.monotonic() - self.mgr_digest_stamp > ttl:
+            return None
+        return self.mgr_digest
+
+    def _cmd_status(self) -> dict:
+        """`ceph -s`: mon/osd summary plus the PGMap data/io sections
+        the digest carries (pg states, object+byte totals, client IO
+        and recovery rates)."""
+        up = sum(1 for o in range(self.osdmap.max_osd)
+                 if self.osdmap.is_up(o))
+        inn = sum(1 for o in range(self.osdmap.max_osd)
+                  if self.osdmap.is_in(o))
+        out = {"epoch": self.osdmap.epoch, "fsid": self.fsid,
+               "num_osds": self.osdmap.max_osd, "num_up_osds": up,
+               "num_in_osds": inn,
+               "pools": sorted(self.osdmap.pools)}
+        health = self.health_mon.command("health", {})
+        out["health"] = health["status"]
+        out["checks"] = sorted(health["checks"])
+        dig = self._digest_fresh()
+        if dig is not None:
+            totals = dig.get("totals") or {}
+            out["pgmap"] = {
+                "num_pgs": dig.get("num_pgs", 0),
+                "pg_states": dict(dig.get("pg_states") or {}),
+                "data": {
+                    "objects": int(totals.get("objects") or 0),
+                    "bytes": int(totals.get("bytes") or 0),
+                    "degraded": int(totals.get("degraded") or 0),
+                    "misplaced": int(totals.get("misplaced") or 0),
+                    "unfound": int(totals.get("unfound") or 0),
+                },
+                "io": {
+                    "read_ops_s": float(
+                        totals.get("read_ops_s") or 0.0),
+                    "write_ops_s": float(
+                        totals.get("write_ops_s") or 0.0),
+                    "read_bytes_s": float(
+                        totals.get("read_bytes_s") or 0.0),
+                    "write_bytes_s": float(
+                        totals.get("write_bytes_s") or 0.0),
+                    "recovery_ops_s": float(
+                        totals.get("recovery_ops_s") or 0.0),
+                    "recovery_bytes_s": float(
+                        totals.get("recovery_bytes_s") or 0.0),
+                },
+            }
+        return out
+
+    def _pool_digest_rows(self) -> list[dict]:
+        dig = self._digest_fresh()
+        pools_dig = (dig.get("pools") or {}) if dig else {}
+        rows = []
+        for pid in sorted(self.osdmap.pools):
+            pool = self.osdmap.pools[pid]
+            row = {"id": pid, "name": pool.name}
+            st = pools_dig.get(pid) or pools_dig.get(str(pid)) or {}
+            for k in ("objects", "bytes", "degraded", "misplaced",
+                      "unfound", "num_pgs"):
+                row[k] = int(st.get(k) or 0)
+            for k in ("read_ops_s", "write_ops_s", "read_bytes_s",
+                      "write_bytes_s", "recovery_ops_s",
+                      "recovery_bytes_s"):
+                row[k] = float(st.get(k) or 0.0)
+            rows.append(row)
+        return rows
+
+    def _cmd_df(self) -> dict:
+        """`rados df`: real per-pool usage from the PGMap digest (the
+        pre-stats build aliased `status` here)."""
+        rows = self._pool_digest_rows()
+        total = {k: sum(r[k] for r in rows)
+                 for k in ("objects", "bytes", "degraded",
+                           "misplaced", "unfound")}
+        return {"pools": rows, "total": total,
+                "stats_available": self._digest_fresh() is not None}
+
+    def _cmd_pool_stats(self, cmd: dict) -> dict:
+        """`ceph osd pool stats [pool]`: per-pool client IO and
+        recovery rates."""
+        rows = self._pool_digest_rows()
+        want = cmd.get("pool")
+        if want:
+            rows = [r for r in rows if r["name"] == want]
+            if not rows:
+                raise ValueError("pool %r does not exist" % want)
+        return {"pools": rows}
 
     def _pool_id(self, name: str) -> int:
         for pid, pool in self.osdmap.pools.items():

@@ -213,8 +213,11 @@ class HealthMonitor:
     def __init__(self, mon):
         self.mon = mon
         # committed (paxos) snapshot: {"slow": {osd: n},
-        #                              "devflb": {osd: 0|1}}
-        self.persisted: dict = {"slow": {}, "devflb": {}}
+        #                              "devflb": {osd: 0|1},
+        #                              "pgdeg": n degraded objects,
+        #                              "pgavail": n inactive pgs}
+        self.persisted: dict = {"slow": {}, "devflb": {},
+                                "pgdeg": 0, "pgavail": 0}
 
     # -- persistence / replay ------------------------------------------
 
@@ -227,7 +230,9 @@ class HealthMonitor:
                          for k, v in (d.get("slow") or {}).items()},
                 "devflb": {int(k): int(v)
                            for k, v in
-                           (d.get("devflb") or {}).items()}}
+                           (d.get("devflb") or {}).items()},
+                "pgdeg": int(d.get("pgdeg") or 0),
+                "pgavail": int(d.get("pgavail") or 0)}
 
     def apply(self, ops: list, tx) -> None:
         """Deterministic commit apply (every mon runs this)."""
@@ -244,9 +249,13 @@ class HealthMonitor:
                     self.persisted["devflb"][int(osd)] = 1
                 else:
                     self.persisted["devflb"].pop(int(osd), None)
+            elif op[0] in ("pgdeg", "pgavail"):
+                self.persisted[op[0]] = int(op[1])
         tx.set(HEALTH_KEY, denc.encode(
             {"slow": dict(self.persisted["slow"]),
-             "devflb": dict(self.persisted["devflb"])}))
+             "devflb": dict(self.persisted["devflb"]),
+             "pgdeg": int(self.persisted["pgdeg"]),
+             "pgavail": int(self.persisted["pgavail"])}))
 
     def maybe_commit(self, osd: int, slow: int, devflb: int) -> None:
         """Leader-side: stage a health svc op when a beacon changes
@@ -272,6 +281,33 @@ class HealthMonitor:
         if int(devflb) != cur:
             self.mon.queue_svc_op("health",
                                   ("devflb", osd, int(devflb)))
+
+    def maybe_commit_digest(self, degraded: int,
+                            inactive: int) -> None:
+        """Leader-side: persist PGMap-digest transitions (degraded
+        objects / inactive PGs raise-and-clear) through paxos, like
+        the beacon-fed checks — a freshly elected leader that never
+        saw a digest reports PG_DEGRADED / PG_AVAILABILITY
+        immediately.  Only the raised/cleared EDGE commits (a jittery
+        nonzero count does not burn a paxos round per digest)."""
+        pend = self.mon.pending_svc.get("health", [])
+
+        def pending_val(kind):
+            for op in reversed(pend):
+                if op[0] == kind:
+                    return int(op[1])
+            return None
+
+        for kind, val in (("pgdeg", int(degraded)),
+                          ("pgavail", int(inactive))):
+            cur = pending_val(kind)
+            if cur is None:
+                cur = int(self.persisted[kind])
+            # commit on raise/clear edges and on big count moves; a
+            # steady nonzero that wobbles (recovery draining) only
+            # commits when it crosses zero
+            if (val > 0) != (cur > 0):
+                self.mon.queue_svc_op("health", (kind, val))
 
     # -- merged beacon views -------------------------------------------
 
@@ -361,6 +397,43 @@ class HealthMonitor:
                                for o in flb_daemons[:10]]),
                 "detail": ["osd.%d serving EC/mapping on the host "
                            "paths" % o for o in flb_daemons[:10]]}
+        # PG_DEGRADED / PG_AVAILABILITY (the reference's PGMap-fed
+        # health checks): a fresh mgr digest wins; the paxos-committed
+        # snapshot a previous leader left fills in until digests reach
+        # this mon (so a fresh leader warns immediately)
+        import time as _t
+        dig = getattr(self.mon, "mgr_digest", None)
+        dig_stamp = getattr(self.mon, "mgr_digest_stamp", 0.0)
+        fresh = (dig is not None
+                 and _t.monotonic() - dig_stamp < self.SOFT_TTL)
+        if fresh:
+            totals = dig.get("totals") or {}
+            degraded = int(totals.get("degraded") or 0)
+            unfound = int(totals.get("unfound") or 0)
+            inactive = int(dig.get("inactive_pgs") or 0)
+        else:
+            degraded = int(self.persisted["pgdeg"])
+            unfound = 0
+            inactive = int(self.persisted["pgavail"])
+        if degraded or unfound:
+            detail = ["%d object copies degraded" % degraded]
+            if unfound:
+                detail.append("%d objects unfound" % unfound)
+            out["PG_DEGRADED"] = {
+                "severity": ("HEALTH_ERR" if unfound
+                             else "HEALTH_WARN"),
+                "summary": "Degraded data redundancy: %d objects "
+                           "degraded%s"
+                           % (degraded,
+                              (", %d unfound" % unfound)
+                              if unfound else ""),
+                "detail": detail}
+        if inactive:
+            out["PG_AVAILABILITY"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "Reduced data availability: %d pgs "
+                           "inactive" % inactive,
+                "detail": []}
         if not m.pools and m.epoch > 0:
             pass                       # empty cluster is healthy
         return out

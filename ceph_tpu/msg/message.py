@@ -74,15 +74,20 @@ MSG_STRUCT_V = 1
 MSG_STRUCT_COMPAT = 1
 
 
-def encode_message(msg: Message) -> bytes:
-    # the trace id rides as a 5th envelope element: old decoders slice
-    # row[:4] and ignore it, so no compat bump is needed.  Untraced
+def encode_message(msg: Message, stamp: float | None = None) -> bytes:
+    # the trace id rides as a 5th envelope element and the sender's
+    # monotonic send stamp as a 6th: old decoders slice row[:4] and
+    # ignore both, so no compat bump is needed.  Untraced, unstamped
     # messages keep the exact 4-element envelope (byte-stable for the
-    # pinned dencoder corpus, and no per-frame cost when not tracing)
+    # pinned dencoder corpus); the messenger passes `stamp` on live
+    # frames so receivers can estimate per-peer clock offsets (the
+    # multi-host span-merge prerequisite).
     row = [msg.TYPE, msg.seq, msg.src, msg.to_wire()]
     trace = getattr(msg, "trace", None)
-    if trace is not None:
+    if trace is not None or stamp is not None:
         row.append(trace)
+    if stamp is not None:
+        row.append(stamp)
     return denc.encode_versioned(row, MSG_STRUCT_V, MSG_STRUCT_COMPAT)
 
 
@@ -97,11 +102,14 @@ class UnknownMessage(Message):
 
 def decode_message(data: bytes | memoryview) -> Message:
     trace = None
+    stamp = None
     if bytes(data[:1]) == b"V":
         _v, row = denc.decode_versioned(data, MSG_STRUCT_V)
         mtype, seq, src, fields = row[:4]
         if len(row) > 4:
             trace = row[4]
+        if len(row) > 5:
+            stamp = row[5]
     else:                               # legacy unversioned frame
         mtype, seq, src, fields = denc.decode(data)
     cls = _REGISTRY.get(mtype)
@@ -112,4 +120,5 @@ def decode_message(data: bytes | memoryview) -> Message:
     msg.seq = seq
     msg.src = src
     msg.trace = trace
+    msg.send_stamp = stamp
     return msg

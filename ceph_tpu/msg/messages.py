@@ -133,11 +133,29 @@ class MMgrReport(Message):
     """Daemon -> mgr perf/state report (MMgrReport.h via
     DaemonServer::handle_report): perf = the daemon's PerfCounters
     dump; pg_states = {state_name: count} for the PGs it is primary
-    of; num_pgs/num_objects round out the health summary."""
+    of; num_pgs/num_objects round out the health summary; pg_stats
+    carries the per-PG stat rows of every PG the daemon is primary for
+    (the MPGStats slice — object/byte counts, degraded/misplaced/
+    unfound tallies, cumulative client-IO and recovery counters);
+    osd_stats carries daemon-wide extras (the op-size histogram)."""
 
     TYPE = "mgr_report"
     FIELDS = ("daemon", "epoch", "perf", "pg_states", "num_pgs",
-              "num_objects")
+              "num_objects", "pg_stats", "osd_stats")
+
+
+@register
+class MMonMgrDigest(Message):
+    """mgr -> mon PGMap digest (the reverse MMonMgrReport/
+    MgrStatMonitor flow): per-pool usage + IO/recovery rates, the
+    cluster pg-state summary, and the degraded/misplaced/unfound
+    totals the mon folds into `status`, `df`, `osd pool stats` and
+    the PG_DEGRADED / PG_AVAILABILITY health checks.  Broadcast to
+    every mon (like beacons) so whichever mon leads next already
+    holds the picture."""
+
+    TYPE = "mon_mgr_digest"
+    FIELDS = ("digest", "epoch")
 
 
 @register

@@ -40,6 +40,7 @@ from __future__ import annotations
 import asyncio
 import random
 import struct
+import time
 import zlib
 
 from .message import (Message, UnknownMessage, decode_message,
@@ -166,7 +167,9 @@ class Connection:
         self.out_seq += 1
         msg.seq = self.out_seq
         msg.src = self.msgr.entity
-        data = encode_message(msg)
+        # frames carry the sender's monotonic clock so the receiver
+        # can estimate this peer's clock offset (multi-host span merge)
+        data = encode_message(msg, stamp=self.msgr.now())
         if self.policy.resend:
             self.unacked.append((msg.seq, data))
         self.out_q.put_nowait((TAG_MSG, data))
@@ -439,6 +442,8 @@ class Connection:
                         return      # transport fault: replay later
                     continue        # lossy: the frame vanishes
                 msg = decode_message(payload)  # poison frame = fault
+                self.msgr.note_peer_clock(
+                    msg.src, getattr(msg, "send_stamp", None))
                 # dedup: a lossless session replays after reconnect,
                 # so anything at-or-below in_seq is a replay dup.  A
                 # lossy transport has no replay — its only duplicate
@@ -542,6 +547,27 @@ class Messenger:
         self._shutting_down = False
         self.default_policy = Policy.lossy_client()
         self.peer_policy: dict[str, Policy] = {}    # by entity type
+        # clock-offset estimation (the cephadm time-sync / OSD
+        # heartbeat skew-check role, minimally): every received frame
+        # carries the sender's monotonic send stamp; `stamp - now()`
+        # underestimates (peer_clock - my_clock) by the network
+        # latency, so the max over frames converges on the true
+        # offset.  `clock_skew` shifts THIS daemon's advertised clock
+        # (test hook for injected skew).
+        self.clock_skew = 0.0
+        self.clock_offsets: dict[str, float] = {}   # peer entity -> s
+
+    def now(self) -> float:
+        """This daemon's (possibly skewed) monotonic clock."""
+        return time.monotonic() + self.clock_skew
+
+    def note_peer_clock(self, src: str, stamp) -> None:
+        if stamp is None or not src or src == self.entity:
+            return
+        est = float(stamp) - self.now()
+        cur = self.clock_offsets.get(src)
+        if cur is None or est > cur:
+            self.clock_offsets[src] = est
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -108,6 +108,11 @@ class OSD:
         self.perf.add_hist("op_ec_device_dispatch",
                            "device EC batch flush time (us, pow2)")
         self._beacon_stamp = 0.0
+        # client write-size histogram (pow2 byte buckets, cumulative):
+        # reported to the mgr for the cluster op-size profile and used
+        # to derive workload-aware device warmup buckets (bucket i
+        # counts writes of [2^i, 2^(i+1)) payload bytes)
+        self.op_size_hist: list[int] = [0] * 32
         # sharded mClock op queue (ShardedOpWQ + mClockScheduler)
         self.sched = OpScheduler(self.ctx)
         self.sched.on_wait = self._note_queue_wait
@@ -180,6 +185,15 @@ class OSD:
         from .scheduler import K_CLIENT
         if klass == K_CLIENT:
             self.perf.hist_sample("op_queue_wait", seconds)
+
+    def note_op_size(self, nbytes: int) -> None:
+        """Record one client write's payload size in the pow2
+        histogram (feeds workload-aware device warmup + the mgr)."""
+        if nbytes <= 0:
+            return
+        i = min(len(self.op_size_hist) - 1,
+                max(0, int(nbytes).bit_length() - 1))
+        self.op_size_hist[i] += 1
 
     def _track(self, msg, desc: str):
         """Register (once) a tracked op for an incoming message; the
@@ -1011,6 +1025,14 @@ class OSD:
         self.ctx.log.debug(
             "osd", "pg %s active on osd.%d acting=%s missing=%d"
             % (pg.pgid, self.whoami, pg.acting, len(pg.missing)))
+        if pg.missing or any(pg.peer_missing.values()):
+            # stat-worthy transition: this interval starts degraded /
+            # misplaced — report NOW, not at the next periodic tick,
+            # so the stats plane observes the rise even when recovery
+            # drains it faster than the report cadence (the reference
+            # sends MPGStats on pg stat changes for the same reason)
+            self._mgr_report_stamp = 0.0
+            self._maybe_send_mgr_report()
         self._kick_recovery(pg)
         self._maybe_snap_trim(pg)
         if not pg.missing:
@@ -1205,6 +1227,8 @@ class OSD:
                         return
                     pushes = [self._make_push(pg, oid, op)
                               for oid, op in part]
+                    pg.stats.note_recovery(0, sum(
+                        len(p.get("data") or b"") for p in pushes))
                     self._send_osd(osd, MOSDPGPush(
                         pool=pg.pool_id, ps=pg.ps,
                         epoch=self.osdmap.epoch, pushes=pushes))
@@ -1313,6 +1337,11 @@ class OSD:
         pg.info.last_complete = pg.info.last_update
         pg.persist_meta(t)
         self.store.apply_transaction(t)
+        if pg.is_primary():
+            # primary pulled its own missing objects: recovery
+            # progress counted here (peer pushes count on the reply)
+            pg.stats.note_recovery(len(done), sum(
+                len(p.get("data") or b"") for p in msg.pushes))
         conn.send(MOSDPGPushReply(pool=msg.pool, ps=msg.ps,
                                   epoch=msg.epoch, oids=done))
         if pg.is_primary() and not pg.missing:
@@ -1327,8 +1356,11 @@ class OSD:
         sender = int(msg.src.split(".")[1])
         pm = pg.peer_missing.get(sender)
         if pm:
+            recovered = 0
             for oid in msg.oids:
-                pm.pop(oid, None)
+                if pm.pop(oid, None) is not None:
+                    recovered += 1
+            pg.stats.note_recovery(recovered)
             # degraded-object writes park until their replicas are
             # whole again: re-gate them now
             if pg.waiting_for_active and pg.state == STATE_ACTIVE:
@@ -1480,6 +1512,9 @@ class OSD:
                                   outs=outs, epoch=self.osdmap.epoch,
                                   version=0))
             self.perf.inc("ops")
+            pg.stats.note_read(sum(
+                len(o.get("data") or b"") for o in outs
+                if isinstance(o, dict)))
             self._op_finish(msg, "read_done")
 
     async def _handle_watch_ops(self, pg: PG, conn, msg) -> None:
@@ -1855,6 +1890,9 @@ class OSD:
         # the mutation and its dup row land atomically everywhere, so
         # a resend after the reply was lost is answered, not re-run
         pg.record_reqid(t, msg.src, msg.tid, 0, outs, ver)
+        wbytes = sum(len(op.get("data") or b"") for op in msg.ops
+                     if isinstance(op, dict))
+        self.note_op_size(wbytes)
         self._rep_tid += 1
         rep_tid = self._rep_tid
         waiting = set()
@@ -1876,12 +1914,13 @@ class OSD:
             conn.send(MOSDOpReply(tid=msg.tid, result=0, outs=outs,
                                   epoch=epoch, version=ver))
             self.perf.inc("ops")
+            pg.stats.note_write(wbytes)
             self._op_finish(msg, "done_no_replicas")
             return
         self._op_event(msg, "sub_op_sent")
         pg.in_flight[rep_tid] = {
             "waiting": waiting, "conn": conn, "tid": msg.tid,
-            "outs": outs, "version": ver,
+            "outs": outs, "version": ver, "bytes": wbytes,
             "top": getattr(msg, "_top", None),
             "t_sub": time.monotonic(),
         }
@@ -1949,6 +1988,7 @@ class OSD:
                     tid=st["tid"], result=0, outs=st["outs"],
                     epoch=self.osdmap.epoch, version=st["version"]))
                 self.perf.inc("ops")
+                pg.stats.note_write(st.get("bytes", 0))
             if top is not None:
                 top.finish("done")
 
@@ -2145,14 +2185,103 @@ class OSD:
             slow_ops=len(slow),
             device_fallback=int(DeviceRuntime.get().fallback)))
 
+    def _obj_logical_size(self, pg: PG, ho, is_ec: bool) -> int:
+        """Logical object bytes: an EC shard records the full logical
+        size in its SIZE_XATTR; replicated objects report the stored
+        size (compression keeps the logical size in its own attr)."""
+        if is_ec:
+            from .ecbackend import SIZE_XATTR
+            try:
+                return int(self.store.getattr(pg.cid, ho, SIZE_XATTR))
+            except (NotFound, ValueError):
+                pass
+        try:
+            return self._stat_decompressed(pg, ho)
+        except NotFound:
+            return 0
+
+    def _pg_stat(self, pg: PG) -> dict:
+        """One primary PG's stat row (pg_stat_t condensed): object and
+        byte counts from the store, degraded / misplaced / unfound
+        tallies from the peering state, and the cumulative PGStats
+        counters the mgr derives rates from.
+
+        * degraded — object copies below the pool's target redundancy:
+          acting-set holes (down members count num_objects whole) plus
+          every missing entry on the primary or a live acting member.
+        * misplaced — copies that exist safely but sit on the wrong
+          OSD: outstanding entries for up-but-not-acting targets (the
+          pg_temp-pinned backfill flow a pgp_num change drives).
+        * unfound — missing objects no known source can provide."""
+        from ..store.objectstore import NOSNAP as _NS
+        pool = self.osdmap.pools.get(pg.pool_id)
+        is_ec = pool is not None and pool.is_erasure()
+        num_objects = 0
+        num_bytes = 0
+        for h in self.store.collection_list(pg.cid):
+            if h.name == "__pgmeta__" or h.snap != _NS:
+                continue
+            num_objects += 1
+            num_bytes += self._obj_logical_size(pg, h, is_ec)
+        target = pool.size if pool is not None else len(pg.acting)
+        live = [o for o in pg.acting
+                if 0 <= o != ITEM_NONE and self.osdmap.is_up(o)]
+        # misplaced vs degraded: outstanding copies for an acting
+        # member are MISPLACED when a full prior-interval holder is
+        # still up outside the acting set (remap/backfill — the data
+        # exists, it just sits on the wrong osd); with no live
+        # ex-member the redundancy is genuinely reduced -> DEGRADED
+        prev_up = [o for o in getattr(pg, "prev_acting", [])
+                   if 0 <= o != ITEM_NONE and o not in pg.acting
+                   and self.osdmap.is_up(o)]
+        missing_copies = len(pg.missing)
+        misplaced = 0
+        for o, pm in pg.peer_missing.items():
+            if o in pg.acting:
+                if o in live:
+                    if prev_up:
+                        misplaced += len(pm)
+                    else:
+                        missing_copies += len(pm)
+            else:
+                misplaced += len(pm)
+        degraded = (num_objects * max(0, target - len(live))
+                    + missing_copies)
+        # unfound: a primary-missing object with no live peer claiming
+        # a complete copy (conservative but cheap approximation of the
+        # reference's might_have_unfound walk)
+        unfound = 0
+        if pg.missing:
+            have_src = any(
+                not pg.peer_missing.get(o)
+                for o in pg.peer_info
+                if o != self.whoami and self.osdmap.is_up(o))
+            unfound = 0 if have_src else len(pg.missing)
+        from .pg import STATE_INITIAL, STATE_PEERING
+        names = {STATE_ACTIVE: "active", STATE_REPLICA: "replica",
+                 STATE_PEERING: "peering", STATE_INITIAL: "creating"}
+        return {
+            "pgid": pg.pgid, "pool": pg.pool_id,
+            "state": names.get(pg.state, "unknown"),
+            "num_objects": num_objects, "num_bytes": num_bytes,
+            "degraded": degraded, "misplaced": misplaced,
+            "unfound": unfound,
+            "log_size": len(pg.log.entries),
+            **pg.stats.to_wire(),
+        }
+
     def _maybe_send_mgr_report(self) -> None:
-        """MgrClient::send_report: ship perf counters + a PG state
-        summary to the active manager recorded in the map."""
+        """MgrClient::send_report: ship perf counters, a PG state
+        summary, AND the per-PG stat rows of every PG this osd is
+        primary for (the MPGStats slice riding the report — the
+        OSD::ms_handle->MgrClient pipeline the mgr folds into its
+        PGMap)."""
         addr = getattr(self.osdmap, "mgr_addr", "")
         if not addr:
             return
         now = time.monotonic()
-        if now - getattr(self, "_mgr_report_stamp", 0.0) < 2.0:
+        if now - getattr(self, "_mgr_report_stamp", 0.0) < \
+                self.ctx.conf.get("osd_mgr_report_interval", 2.0):
             return
         self._mgr_report_stamp = now
         from ..msg.messages import MMgrReport
@@ -2161,6 +2290,7 @@ class OSD:
                  STATE_PEERING: "peering", STATE_INITIAL: "creating"}
         states: dict[str, int] = {}
         num_objects = 0
+        pg_stats: list[dict] = []
         for pg in self.pgs.values():
             st = names.get(pg.state, "unknown")
             states[st] = states.get(st, 0) + 1
@@ -2169,13 +2299,16 @@ class OSD:
                                      for o in pg.peer_missing):
                     states["recovering"] = \
                         states.get("recovering", 0) + 1
-                num_objects += sum(
-                    1 for h in self.store.collection_list(pg.cid)
-                    if h.name != "__pgmeta__")
+                row = self._pg_stat(pg)
+                pg_stats.append(row)
+                num_objects += row["num_objects"]
         self.msgr.send_to(addr, MMgrReport(
             daemon="osd.%d" % self.whoami, epoch=self.osdmap.epoch,
             perf=self.ctx.perf.dump(), pg_states=states,
-            num_pgs=len(self.pgs), num_objects=num_objects),
+            num_pgs=len(self.pgs), num_objects=num_objects,
+            pg_stats=pg_stats,
+            osd_stats={"op_size_hist_bytes_pow2":
+                       list(self.op_size_hist)}),
             entity_hint="mgr")
 
     def _handle_ping(self, conn, msg: MOSDPing) -> None:

@@ -73,6 +73,29 @@ def _parse_ver(raw: bytes) -> tuple[int, int]:
     return (int(a), int(b))
 
 
+def derive_warmup_buckets(op_size_hist: list[int] | None, k: int,
+                          w: int, top: int = 3) -> tuple | None:
+    """Workload-aware device warmup: map the daemon's client
+    write-size histogram (pow2 byte buckets — op_size_hist[i] counts
+    writes of [2^i, 2^(i+1)) bytes) onto the runtime's word-count
+    buckets for a k-chunk, w-bit codec, keeping the `top` most
+    frequent.  Returns None when there is no history (caller falls
+    back to the static default list)."""
+    if not op_size_hist or not any(op_size_hist):
+        return None
+    from ..device.runtime import DeviceRuntime
+    word_bytes = max(1, int(w) // 8)
+    ranked = sorted(
+        (i for i, n in enumerate(op_size_hist) if n > 0),
+        key=lambda i: (-op_size_hist[i], i))[:top]
+    buckets = set()
+    for i in ranked:
+        payload = 1 << (i + 1)          # bucket upper bound, bytes
+        chunk_words = -(-payload // (k * word_bytes))   # ceil div
+        buckets.add(DeviceRuntime.bucket_for(chunk_words))
+    return tuple(sorted(buckets))
+
+
 class _OidLock:
     """Refcounted per-oid lock so the registry stays bounded."""
 
@@ -134,7 +157,17 @@ class ECPGBackend:
         rt = DeviceRuntime.get()
         if rt.available:
             matrix, w = dm
-            self.osd.msgr.spawn(rt.warmup_ec(matrix, w))
+            # workload-aware buckets from the daemon's op-size
+            # histogram when history exists; the static default list
+            # otherwise (first boot, cold daemon)
+            derived = derive_warmup_buckets(
+                getattr(self.osd, "op_size_hist", None),
+                k=len(matrix[0]), w=w)
+            if derived:
+                self.osd.msgr.spawn(
+                    rt.warmup_ec(matrix, w, buckets=derived))
+            else:
+                self.osd.msgr.spawn(rt.warmup_ec(matrix, w))
 
     class _Locked:
         def __init__(self, backend, key):
@@ -300,6 +333,9 @@ class ECPGBackend:
             conn.send(MOSDOpReply(tid=msg.tid, result=result, outs=outs,
                                   epoch=epoch, version=0))
             self.osd.perf.inc("ops")
+            pg.stats.note_read(sum(
+                len(o.get("data") or b"") for o in outs
+                if isinstance(o, dict)))
             self.osd._op_finish(msg, "ec_read_done")
             return
 
@@ -307,6 +343,9 @@ class ECPGBackend:
         # parity-delta RMW (bytes moved proportional to the touched
         # range, not the object — ECBackend start_rmw's role)
         self.osd._op_event(msg, "ec_write_started")
+        wbytes = sum(len(o.get("data") or b"") for o in msg.ops
+                     if isinstance(o, dict))
+        self.osd.note_op_size(wbytes)
         if msg.ops and all(o["op"] == "write" for o in msg.ops):
             res = await self._try_delta_write(pg, msg)
             if res is not None:
@@ -318,6 +357,8 @@ class ECPGBackend:
                     outs=outs2, epoch=epoch,
                     version=pg.info.last_update[1]))
                 self.osd.perf.inc("ops")
+                if ok2:
+                    pg.stats.note_write(wbytes)
                 self.osd._op_finish(msg, "ec_delta_done")
                 return
         # whole-object RMW fallback
@@ -402,6 +443,8 @@ class ECPGBackend:
                               outs=outs, epoch=self.osd.osdmap.epoch,
                               version=ver))
         self.osd.perf.inc("ops")
+        if ok:
+            pg.stats.note_write(wbytes)
         self.osd._op_finish(msg, "ec_write_done")
 
     # -- write path --------------------------------------------------------
@@ -1185,6 +1228,8 @@ class ECPGBackend:
                                        "data": cshards[j],
                                        "attrs": ca, "omap": {}})
         if pushes:
+            pg.stats.note_recovery(0, sum(
+                len(p.get("data") or b"") for p in pushes))
             self.osd._send_osd(osd_id, MOSDPGPush(
                 pool=pg.pool_id, ps=pg.ps,
                 epoch=self.osd.osdmap.epoch, pushes=pushes))
@@ -1225,6 +1270,7 @@ class ECPGBackend:
                                         len(data), ver, None,
                                         hinfo_bytes(shards))
                 pg.missing.pop(oid, None)
+                pg.stats.note_recovery(1)
                 pg.persist_meta(t)
                 self.osd.store.apply_transaction(t)
                 # rebuild local clone shards listed by the snapset

@@ -126,6 +126,41 @@ class PGInfo:
         return info
 
 
+class PGStats:
+    """Cumulative per-PG I/O + recovery counters (pg_stat_t's counter
+    slice, object_stat_sum_t role): the primary accumulates these on
+    its op/recovery paths and ships them in the periodic mgr report
+    (the MPGStats flow); the mgr's PGMap derives rates from deltas
+    between two reports.  Counters are NOT persisted — a restarted or
+    newly promoted primary restarts from zero, and the rate derivation
+    clamps the resulting negative delta to 0 (exactly the reference's
+    reported-epoch reset behavior)."""
+
+    COUNTERS = ("read_ops", "read_bytes", "write_ops", "write_bytes",
+                "recovery_ops", "recovery_bytes")
+
+    __slots__ = COUNTERS
+
+    def __init__(self):
+        for c in self.COUNTERS:
+            setattr(self, c, 0)
+
+    def note_read(self, nbytes: int) -> None:
+        self.read_ops += 1
+        self.read_bytes += int(nbytes)
+
+    def note_write(self, nbytes: int) -> None:
+        self.write_ops += 1
+        self.write_bytes += int(nbytes)
+
+    def note_recovery(self, nobjects: int, nbytes: int = 0) -> None:
+        self.recovery_ops += int(nobjects)
+        self.recovery_bytes += int(nbytes)
+
+    def to_wire(self) -> dict:
+        return {c: getattr(self, c) for c in self.COUNTERS}
+
+
 # PG lifecycle states (PeeringState.h state names, flattened)
 STATE_INITIAL = "initial"
 STATE_PEERING = "peering"
@@ -177,6 +212,9 @@ class PG:
         # the write it journals.
         self.reqid_journal: dict[tuple[str, int], dict] = {}
         self.reqid_order: list[tuple[str, int]] = []
+        # cumulative client-I/O + recovery counters this primary
+        # accumulated (PGStats above); reported to the mgr
+        self.stats = PGStats()
 
     # -- identity ----------------------------------------------------------
 

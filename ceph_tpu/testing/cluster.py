@@ -44,6 +44,11 @@ FAST_CONF = {
     "osd_op_complaint_time": 5.0,
     "osd_beacon_report_interval": 0.25,
     "osd_op_history_size": 64,
+    # stats plane at dev pacing: per-PG stat rows and PGMap digests
+    # must cross OSD -> mgr -> mon within a thrash round
+    "osd_mgr_report_interval": 0.3,
+    "mgr_stats_period": 0.25,
+    "mgr_stats_stale_after": 5.0,
 }
 
 
@@ -68,15 +73,18 @@ class LocalCluster:
     client's retry jitter draws from the same stream family."""
 
     def __init__(self, n_osds: int = 3, n_mons: int = 1,
-                 conf: dict | None = None, seed: int | None = None):
+                 conf: dict | None = None, seed: int | None = None,
+                 with_mgr: bool = False):
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.conf = dict(FAST_CONF)
         self.conf.update(conf or {})
         self.seed = seed
+        self.with_mgr = with_mgr
         self.mons: list[Monitor] = []
         self.monmap: list[tuple[str, str]] = []
         self.osds: list[OSD | None] = []
+        self.mgr = None
         self.client: RadosClient | None = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -113,6 +121,17 @@ class LocalCluster:
             await self._start_osd(i)
         for osd in self.osds:
             await osd.wait_for_boot()
+        if self.with_mgr:
+            from ..mgr import Manager
+            self.mgr = Manager(self.mon_addrs,
+                               Context("mgr",
+                                       conf_overrides=self.conf))
+            # the autonomous balancer would move PGs mid-thrash:
+            # deterministic harness runs keep it off (enable
+            # explicitly in balancer-focused tests)
+            self.mgr.balancer_enabled = False
+            self._install_injector(self.mgr.msgr, "mgr")
+            await self.mgr.start()
         self.client = RadosClient(
             self.mon_addrs, seed=self.seed,
             ctx=Context("client.0", conf_overrides=self.conf))
@@ -135,6 +154,8 @@ class LocalCluster:
     async def stop(self) -> None:
         if self.client is not None:
             await self.client.shutdown()
+        if self.mgr is not None:
+            await self.mgr.shutdown()
         for osd in self.osds:
             if osd is not None and not osd.stopping:
                 await osd.shutdown()
@@ -172,6 +193,8 @@ class LocalCluster:
         if entity.startswith("osd"):
             return self.osds[int(entity.split(".")[1])] \
                 .msgr.fault_injector
+        if entity.startswith("mgr") and self.mgr is not None:
+            return self.mgr.msgr.fault_injector
         return self.client.msgr.fault_injector
 
     def partition_mon(self, rank: int) -> None:
@@ -241,12 +264,69 @@ class LocalCluster:
 
     # -- observability -----------------------------------------------------
 
+    def set_clock_skew(self, entity: str, seconds: float) -> None:
+        """Skew one daemon's clock (test hook for the offset
+        normalization): both its op-tracker stamps and its outgoing
+        frame stamps read monotonic()+seconds, exactly what a
+        misaligned host clock would present."""
+        if entity.startswith("osd"):
+            d = self.osds[int(entity.split(".")[1])]
+            d.msgr.clock_skew = seconds
+            d.optracker.clock_skew = seconds
+        elif entity.startswith("mon"):
+            rank = int(entity.split(".")[1]) if "." in entity else 0
+            self.mons[rank].msgr.clock_skew = seconds
+            self.mons[rank].optracker.clock_skew = seconds
+        else:
+            self.client.msgr.clock_skew = seconds
+            self.client.optracker.clock_skew = seconds
+
+    def clock_offsets(self) -> dict[str, float]:
+        """Per-daemon clock offset relative to the CLIENT's clock,
+        solved from the per-peer estimates every messenger accumulates
+        off frame send stamps (offset underestimates by one-way
+        latency; the max over frames converges).  Daemons the client
+        never exchanged frames with resolve transitively (replica ->
+        primary -> client)."""
+        msgrs = {}
+        if self.client is not None:
+            msgrs[self.client.msgr.entity] = self.client.msgr
+        for o in self.live_osds:
+            msgrs[o.msgr.entity] = o.msgr
+        for m in self.mons:
+            msgrs[m.msgr.entity] = m.msgr
+        if self.mgr is not None:
+            msgrs[self.mgr.msgr.entity] = self.mgr.msgr
+        ref = (self.client.msgr.entity if self.client is not None
+               else next(iter(msgrs), None))
+        offsets: dict[str, float] = {ref: 0.0} if ref else {}
+        # fixed-point sweep over both edge directions: m heard from s
+        # with estimate (clock_s - clock_m)
+        for _ in range(len(msgrs) + 1):
+            changed = False
+            for ent, msgr in msgrs.items():
+                for src, est in msgr.clock_offsets.items():
+                    if ent in offsets and src not in offsets \
+                            and src in msgrs:
+                        offsets[src] = offsets[ent] + est
+                        changed = True
+                    elif src in offsets and ent not in offsets:
+                        offsets[ent] = offsets[src] - est
+                        changed = True
+            if not changed:
+                break
+        return offsets
+
     def op_timeline(self, trace: str) -> list[dict]:
         """Merge every daemon's tracked-op records for one trace id —
         a completed client write yields the full cross-daemon span:
         client submit/send, primary queue/execute/sub-op, replica (or
-        EC shard) apply.  Records sort by arrival; in-process daemons
-        share one monotonic clock so stamps are comparable."""
+        EC shard) apply.  Stamps are normalized to the client's clock
+        using the per-daemon offsets estimated from message send/recv
+        stamps, so stage ordering survives skewed per-daemon clocks
+        (the multi-host deployment shape); in-process daemons share
+        one clock and normalize by ~0."""
+        offsets = self.clock_offsets()
         out: list[dict] = []
         trackers = []
         if self.client is not None:
@@ -254,7 +334,16 @@ class LocalCluster:
         trackers += [o.optracker for o in self.live_osds]
         trackers += [m.optracker for m in self.mons]
         for tr in trackers:
-            out.extend(tr.find(trace))
+            for rec in tr.find(trace):
+                off = offsets.get(rec.get("daemon"), 0.0)
+                if off:
+                    rec = dict(rec)
+                    rec["initiated"] = rec["initiated"] - off
+                    rec["events"] = [
+                        {**e, "t": e["t"] - off}
+                        for e in rec["events"]]
+                    rec["clock_offset"] = off
+                out.append(rec)
         return sorted(out, key=lambda d: d["initiated"])
 
     def stuck_ops(self) -> list[dict]:
@@ -302,3 +391,86 @@ class LocalCluster:
                                  pg.peer_missing.values()):
                 return False
         return True
+
+    # -- cluster statistics plane (PGMap digest oracles) -------------------
+
+    def digest(self) -> dict | None:
+        """The freshest PGMap digest any live mon holds — the
+        STATS-PLANE view of the cluster (OSD report -> mgr PGMap ->
+        mon digest), deliberately not daemon-internal state, so
+        oracles built on it exercise the whole pipeline."""
+        best = None
+        best_stamp = -1.0
+        for m in self.mons:
+            d = getattr(m, "mgr_digest", None)
+            if d is not None and m.mgr_digest_stamp > best_stamp:
+                best, best_stamp = d, m.mgr_digest_stamp
+        return best
+
+    def _digest_total(self, key: str):
+        d = self.digest()
+        if d is None:
+            return None
+        return (d.get("totals") or {}).get(key)
+
+    def degraded_objects(self):
+        """Degraded object-copy count from the digest (None until a
+        digest arrives)."""
+        v = self._digest_total("degraded")
+        return None if v is None else int(v)
+
+    def misplaced_objects(self):
+        v = self._digest_total("misplaced")
+        return None if v is None else int(v)
+
+    def client_io_rate(self) -> float:
+        """Client write+read ops/s from the digest (0.0 pre-digest)."""
+        d = self.digest()
+        if d is None:
+            return 0.0
+        t = d.get("totals") or {}
+        return (float(t.get("read_ops_s") or 0.0)
+                + float(t.get("write_ops_s") or 0.0))
+
+    def recovery_rate(self) -> float:
+        """Recovery objects/s from the digest (0.0 pre-digest)."""
+        v = self._digest_total("recovery_ops_s")
+        return 0.0 if v is None else float(v)
+
+    async def wait_stats(self, pred, timeout: float = 30.0,
+                         what: str = "stats condition") -> None:
+        """Poll the digest until `pred(digest)` holds (pred receives
+        the freshest digest, possibly None)."""
+        await wait_for(lambda: pred(self.digest()), timeout,
+                       what=what)
+
+    async def wait_degraded_drained(
+            self, timeout: float = 120.0) -> dict:
+        """Stats oracle: wait until the digest reports EXACTLY zero
+        degraded + misplaced objects, sampling the recovery rate on
+        the way.  Returns {"max_degraded", "max_misplaced",
+        "max_recovery_rate", "samples_degraded"} so callers can
+        additionally assert the drain showed a live recovery rate."""
+        import time as _t
+        obs = {"max_degraded": 0, "max_misplaced": 0,
+               "max_recovery_rate": 0.0, "samples_degraded": 0}
+        deadline = _t.monotonic() + timeout
+        while True:
+            d = self.digest()
+            if d is not None:
+                deg = self.degraded_objects() or 0
+                mis = self.misplaced_objects() or 0
+                obs["max_degraded"] = max(obs["max_degraded"], deg)
+                obs["max_misplaced"] = max(obs["max_misplaced"], mis)
+                obs["max_recovery_rate"] = max(
+                    obs["max_recovery_rate"], self.recovery_rate())
+                if deg or mis:
+                    obs["samples_degraded"] += 1
+                else:
+                    return obs      # drained (or never degraded)
+            if _t.monotonic() > deadline:
+                raise TimeoutError(
+                    "degraded/misplaced never drained to zero: %r "
+                    "(digest totals %r)"
+                    % (obs, (d or {}).get("totals")))
+            await asyncio.sleep(0.1)

@@ -121,6 +121,12 @@ class ClusterThrasher:
       pg_num_grow      — double a thrashed pool's pg_num (capped):
                          every OSD splits its PGs in place while the
                          workload keeps writing;
+      pgp_num_grow     — grow pg_num then raise pgp_num to match on a
+                         replicated thrashed pool: children take their
+                         own placement, acting sets reshuffle, and
+                         REAL backfill data movement must drain
+                         (stats oracle: misplaced rises then hits 0)
+                         with no lost acked writes;
       ec_profile_swap  — roll the thrashed EC pool onto a freshly
                          committed profile with identical coding
                          parameters (rename/rollout path: codec cache
@@ -138,7 +144,8 @@ class ClusterThrasher:
 
     ALL_ACTIONS = ("kill_revive", "kill_wipe_revive", "out_in",
                    "mon_partition", "map_churn", "pg_num_grow",
-                   "ec_profile_swap", "device_fallback")
+                   "pgp_num_grow", "ec_profile_swap",
+                   "device_fallback")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -185,8 +192,8 @@ class ClusterThrasher:
         if action == "mon_partition":
             # never plan an isolated majority: one rank only
             return (action, self.rng.randrange(self.cluster.n_mons))
-        if action in ("map_churn", "pg_num_grow", "ec_profile_swap",
-                      "device_fallback"):
+        if action in ("map_churn", "pg_num_grow", "pgp_num_grow",
+                      "ec_profile_swap", "device_fallback"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -263,6 +270,37 @@ class ClusterThrasher:
             await c.client.mon_command("osd pool set", pool=pool.name,
                                        var="pg_num", val=new)
             await asyncio.sleep(self.hold)   # writes ride the split
+        elif action == "pgp_num_grow":
+            # backfill-aware placement growth: raise pg_num first
+            # (in-place split, no movement), then raise pgp_num to
+            # match — children get their OWN placement, the acting
+            # sets reshuffle, and REAL data movement (pg_temp-pinned
+            # backfill) must drain while the workload keeps writing.
+            # Replicated pools only (EC acting sets are positional;
+            # pinning them is out of scope, ROADMAP PR-3).
+            pid = next(
+                (p for p in self._pool_ids
+                 if (c.client.osdmap.pools.get(p) is not None
+                     and not c.client.osdmap.pools[p]
+                     .erasure_code_profile)), None)
+            if pid is None:
+                return              # no replicated pool under thrash
+            pool = c.client.osdmap.pools[pid]
+            target_pg = pool.pg_num
+            if pool.pgp_num >= pool.pg_num:
+                target_pg = min(pool.pg_num * 2, 64)
+                if target_pg <= pool.pg_num:
+                    return          # already at the cap
+                await c.client.mon_command(
+                    "osd pool set", pool=pool.name,
+                    var="pg_num", val=target_pg)
+                await asyncio.sleep(self.hold)  # splits land
+            self.log.append("pgp_num %s: %d -> %d"
+                            % (pool.name, pool.pgp_num, target_pg))
+            await c.client.mon_command(
+                "osd pool set", pool=pool.name,
+                var="pgp_num", val=target_pg)
+            await asyncio.sleep(self.hold)   # movement under load
         elif action == "ec_profile_swap":
             pid = next(
                 (p for p in self._pool_ids
@@ -339,3 +377,26 @@ class ClusterThrasher:
                 "cluster went healthy: %r"
                 % [(s["daemon"], s["desc"], round(s["age"], 1))
                    for s in stuck[:5]])
+        # stats-plane oracle (clusters running a mgr): the PGMap
+        # digest — OSD stat rows -> mgr -> mon, never internal state —
+        # must drain its degraded + misplaced counts to EXACTLY zero
+        # once healthy, and a drain that was visibly degraded for
+        # several samples must have shown a nonzero recovery rate
+        # (data moved; the stats plane saw it move)
+        if getattr(c, "mgr", None) is not None \
+                and hasattr(c, "wait_degraded_drained"):
+            obs = await c.wait_degraded_drained(timeout=120.0)
+            assert (c.degraded_objects() or 0) == 0, obs
+            if obs["samples_degraded"] >= 3 \
+                    and obs["max_recovery_rate"] <= 0.0:
+                # the rate window can trail the drain by one report
+                # period: give it a beat before calling it a miss
+                for _ in range(30):
+                    if c.recovery_rate() > 0.0:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise AssertionError(
+                        "degraded objects drained but the stats "
+                        "plane never showed a recovery rate: %r"
+                        % obs)

@@ -39,7 +39,7 @@ class TrackedOp:
         self.trace = trace
         self.desc = desc
         self.daemon = tracker.daemon
-        self.initiated = time.monotonic()
+        self.initiated = tracker.now()
         self.wall = time.time()
         self.events: list[tuple[float, str]] = [(self.initiated,
                                                  "initiated")]
@@ -48,7 +48,7 @@ class TrackedOp:
 
     def mark_event(self, event: str) -> None:
         if not self.finished:
-            self.events.append((time.monotonic(), event))
+            self.events.append((self.tracker.now(), event))
 
     def note(self, key: str, value) -> None:
         """Attach structured attribution to the op (e.g. the device
@@ -64,14 +64,15 @@ class TrackedOp:
         the tracker's historic ring (idempotent)."""
         if self.finished:
             return
-        self.events.append((time.monotonic(), event))
+        self.events.append((self.tracker.now(), event))
         self.finished = True
         self.tracker._retire(self)
 
     @property
     def age(self) -> float:
         """Seconds since arrival (in-flight) or total duration."""
-        end = self.events[-1][0] if self.finished else time.monotonic()
+        end = (self.events[-1][0] if self.finished
+               else self.tracker.now())
         return end - self.initiated
 
     def dump(self) -> dict:
@@ -101,10 +102,17 @@ class OpTracker:
         self.ops: dict[int, TrackedOp] = {}
         self.historic: list[TrackedOp] = []
         self.historic_slow: list[TrackedOp] = []
+        # stamps read this daemon's clock: skewable (test hook) so the
+        # timeline merge can prove its offset normalization against an
+        # artificially skewed daemon
+        self.clock_skew = 0.0
         # the context exposes the tracker so the admin socket's builtin
         # dump commands find it without plumbing (CephContext keeps the
         # same backref for its admin hooks)
         ctx.optracker = self
+
+    def now(self) -> float:
+        return time.monotonic() + self.clock_skew
 
     # -- configuration (live: re-read per call so `config set` acts) ---
 

@@ -305,4 +305,13 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_pg_log_dups_tracked", OPT_INT, 128,
            "reqid (client,tid) dup-detection journal entries kept per"
            " PG (PrimaryLogPG osd_reqid_t dedup analog)"),
+    Option("osd_mgr_report_interval", OPT_FLOAT, 2.0,
+           "seconds between MMgrReports (perf counters + per-PG stat"
+           " rows) to the active manager"),
+    Option("mgr_stats_period", OPT_FLOAT, 1.0,
+           "seconds between the mgr's PGMap digests to the monitors"
+           " (feeds status/df/pool-stats and PG_* health checks)"),
+    Option("mgr_stats_stale_after", OPT_FLOAT, 15.0,
+           "per-PG stat rows older than this are dropped from the"
+           " PGMap (a dead primary's last report must age out)"),
 ]

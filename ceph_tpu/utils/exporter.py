@@ -25,14 +25,22 @@ def _metric_name(*parts: str) -> str:
 
 
 def hist_lines(base: str, buckets: list,
-               labels: str = "") -> list[str]:
+               labels: str = "", typed: set | None = None) -> list[str]:
     """Prometheus histogram series from a PerfCounters power-of-two
     microsecond histogram (bucket i counts samples < 2^(i+1) µs).
     `labels` is an optional pre-rendered label body ('daemon="osd.0"')
     merged into each bucket's le label — the per-daemon form the mgr
-    renders from MMgrReports."""
+    renders from MMgrReports.  `typed` is an optional cross-call set
+    of family names that already emitted their `# TYPE` line: the
+    family's TYPE is emitted exactly once even when the same base
+    renders for many daemons (the exposition-format rule the lint
+    pins)."""
     lines = []
-    if not labels:
+    if typed is not None:
+        if base not in typed:
+            typed.add(base)
+            lines.append("# TYPE %s histogram" % base)
+    elif not labels:
         lines.append("# TYPE %s histogram" % base)
     cum = 0
     sep = "," if labels else ""
@@ -139,6 +147,62 @@ class PrometheusExporter:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?\s+(?P<value>\S+)$")
+
+
+def validate_exposition(text: str) -> list[str]:
+    """Lint an exposition document (text format 0.0.4): every emitted
+    series must carry a valid metric name and belong to a family that
+    declared a `# TYPE` line before its first sample (histogram
+    `_bucket`/`_count`/`_sum` suffixes resolve to their base family).
+    Returns a list of human-readable violations — empty means clean.
+    Guards the growing series surface: a family added without a TYPE
+    line breaks real Prometheus servers only at scrape time; this
+    makes it a unit-test failure instead."""
+    errors: list[str] = []
+    typed: set[str] = set()
+    for ln, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                if not _VALID_NAME_RE.match(parts[2]):
+                    errors.append("line %d: bad family name %r"
+                                  % (ln, parts[2]))
+                typed.add(parts[2])
+            continue
+        m = _SERIES_RE.match(line)
+        if m is None:
+            errors.append("line %d: unparseable series %r"
+                          % (ln, line))
+            continue
+        name = m.group("name")
+        if not _VALID_NAME_RE.match(name):
+            errors.append("line %d: bad metric name %r" % (ln, name))
+            continue
+        family = name
+        for suffix in ("_bucket", "_count", "_sum"):
+            if name.endswith(suffix) and name[:-len(suffix)] in typed:
+                family = name[:-len(suffix)]
+                break
+        if family not in typed:
+            errors.append("line %d: series %r has no # TYPE line"
+                          % (ln, name))
+        try:
+            float(m.group("value"))
+        except ValueError:
+            errors.append("line %d: non-numeric value %r"
+                          % (ln, m.group("value")))
+    return errors
+
+
+_VALID_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 
 def device_runtime_lines(prefix: str = "ceph_tpu") -> list[str]:

@@ -1,0 +1,495 @@
+"""Cluster statistics plane: PGMap aggregation, rate derivation,
+status/df surfaces, stats-driven health checks, and clock-offset
+timeline normalization.
+
+Mirrors the reference's MPGStats -> MgrStatMonitor -> PGMap pipeline
+(SURVEY L5/L6): primaries accumulate per-PG stat rows, ship them in
+MMgrReports, the mgr folds them into a PGMap with delta-based rates,
+and a digest feeds the mon's `status`/`df`/`osd pool stats` commands
+plus the PG_DEGRADED / PG_AVAILABILITY health checks (paxos-committed
+like SLOW_OPS, so a fresh leader warns immediately).
+"""
+
+import asyncio
+
+from ceph_tpu.mgr.pgmap import PGMap
+from ceph_tpu.testing import LocalCluster, Workload
+from ceph_tpu.utils.backoff import wait_for
+
+
+def run(coro, timeout=240):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+# -- PGMap rate derivation (pure unit) --------------------------------------
+
+
+def _row(pgid, pool, **kw):
+    base = {"pgid": pgid, "pool": pool, "state": "active",
+            "num_objects": 0, "num_bytes": 0, "degraded": 0,
+            "misplaced": 0, "unfound": 0, "log_size": 0,
+            "read_ops": 0, "read_bytes": 0, "write_ops": 0,
+            "write_bytes": 0, "recovery_ops": 0, "recovery_bytes": 0}
+    base.update(kw)
+    return base
+
+
+def test_pgmap_rate_derivation_exact():
+    """Two reports with a known counter delta and stamp delta produce
+    EXACT per-second rates (the PGMap::apply_incremental delta
+    machinery)."""
+    pm = PGMap(stale_after=1e9)
+    pm.apply_report("osd.0", [_row("1.0", 1, write_ops=100,
+                                   write_bytes=1 << 20,
+                                   recovery_ops=10)],
+                    None, stamp=100.0)
+    pm.apply_report("osd.0", [_row("1.0", 1, write_ops=150,
+                                   write_bytes=3 << 20,
+                                   recovery_ops=40,
+                                   num_objects=7, num_bytes=4096)],
+                    None, stamp=110.0)
+    rates = pm.rates["1.0"]
+    assert rates["write_ops_s"] == 5.0
+    assert rates["write_bytes_s"] == float(2 << 20) / 10.0
+    assert rates["recovery_ops_s"] == 3.0
+    assert rates["read_ops_s"] == 0.0
+    pools = pm.pool_totals(now=110.0)
+    assert pools[1]["write_ops_s"] == 5.0
+    assert pools[1]["objects"] == 7
+    assert pools[1]["bytes"] == 4096
+    dig = pm.digest(now=110.0)
+    assert dig["totals"]["write_ops_s"] == 5.0
+    assert dig["num_pgs"] == 1
+    assert dig["pg_states"] == {"active": 1}
+
+
+def test_pgmap_reset_and_primary_change_never_go_negative():
+    """A primary restart (counters restart from zero) or a primary
+    CHANGE (rows from a different daemon) must never produce negative
+    rates — the delta clamps to zero / the base resets."""
+    pm = PGMap(stale_after=1e9)
+    pm.apply_report("osd.0", [_row("1.0", 1, write_ops=1000)],
+                    None, stamp=10.0)
+    # same primary, counter reset (restart): clamp, not negative
+    pm.apply_report("osd.0", [_row("1.0", 1, write_ops=5)],
+                    None, stamp=20.0)
+    assert pm.rates["1.0"]["write_ops_s"] == 0.0
+    # primary change: no comparable base -> rates reset entirely
+    pm.apply_report("osd.1", [_row("1.0", 1, write_ops=50)],
+                    None, stamp=30.0)
+    assert "1.0" not in pm.rates
+    # the next report from the NEW primary derives cleanly
+    pm.apply_report("osd.1", [_row("1.0", 1, write_ops=80)],
+                    None, stamp=40.0)
+    assert pm.rates["1.0"]["write_ops_s"] == 3.0
+
+
+def test_pgmap_prunes_stale_and_deleted_pools():
+    """Rows from a dead primary age out; rows of a deleted pool are
+    excluded the moment the map loses the pool (map_churn must not
+    leave ghost pools in `df`)."""
+    pm = PGMap(stale_after=5.0)
+    pm.apply_report("osd.0", [_row("1.0", 1, num_objects=4)],
+                    None, stamp=100.0)
+    pm.apply_report("osd.1", [_row("2.0", 2, num_objects=9)],
+                    None, stamp=103.0)
+    pools = pm.pool_totals(now=104.0)
+    assert pools[1]["objects"] == 4 and pools[2]["objects"] == 9
+    # pool filter (deleted pool 2)
+    pools = pm.pool_totals(now=104.0, pools={1})
+    assert 2 not in pools
+    # staleness (osd.0's row is >5s old)
+    pools = pm.pool_totals(now=106.0)
+    assert 1 not in pools and pools[2]["objects"] == 9
+
+
+# -- op-size histogram + workload-aware warmup ------------------------------
+
+
+def test_warmup_buckets_derived_from_op_size_hist():
+    from ceph_tpu.device.runtime import DeviceRuntime
+    from ceph_tpu.osd.ecbackend import derive_warmup_buckets
+
+    # no history -> None (caller keeps the static default list)
+    assert derive_warmup_buckets(None, k=2, w=8) is None
+    assert derive_warmup_buckets([0] * 32, k=2, w=8) is None
+    # dominant 4 KiB writes (bucket 12 = [4096, 8192)), k=2 w=8:
+    # chunk words = 8192/2 = 4096 -> bucket_for(4096)
+    hist = [0] * 32
+    hist[12] = 500
+    hist[16] = 20          # minority 64 KiB-class writes
+    out = derive_warmup_buckets(hist, k=2, w=8)
+    assert DeviceRuntime.bucket_for(8192 // 2) in out
+    assert DeviceRuntime.bucket_for((1 << 17) // 2) in out
+    assert out == tuple(sorted(out))
+    # top-N bounding: many populated buckets keep only the heaviest
+    hist = [1] * 32
+    hist[10] = 100
+    out = derive_warmup_buckets(hist, k=4, w=8, top=1)
+    assert len(out) == 1
+
+
+def test_osd_op_size_histogram_accumulates():
+    from ceph_tpu.osd.daemon import OSD
+    hist_note = OSD.note_op_size
+
+    class Shim:
+        op_size_hist = [0] * 32
+
+    s = Shim()
+    hist_note(s, 4096)          # bit_length(4096)-1 == 12
+    hist_note(s, 5000)
+    hist_note(s, 100)
+    hist_note(s, 0)             # ignored
+    assert s.op_size_hist[12] == 2
+    assert s.op_size_hist[6] == 1
+    assert sum(s.op_size_hist) == 3
+
+
+# -- PG_DEGRADED: paxos-committed, survives a leader change -----------------
+
+
+def test_pg_degraded_health_survives_leader_change():
+    """A PGMap digest reporting degraded objects commits the raise
+    edge through paxos: a monitor that never saw a single digest
+    (fresh instance over the same store — the freshly-elected-leader
+    shape) reports PG_DEGRADED immediately; a clearing digest retires
+    the committed state too."""
+    from ceph_tpu.mon import Monitor
+    from ceph_tpu.msg.messages import MMonMgrDigest
+    from ceph_tpu.utils.context import Context
+
+    async def main():
+        mon = Monitor(Context("mon"))
+        await mon.start()
+        try:
+            mon.ms_dispatch(None, MMonMgrDigest(
+                digest={"totals": {"degraded": 12},
+                        "inactive_pgs": 2}, epoch=1))
+            assert mon.health_mon.persisted["pgdeg"] == 12
+            assert mon.health_mon.persisted["pgavail"] == 2
+            checks = mon.health_mon.checks()
+            assert "PG_DEGRADED" in checks
+            assert "12 objects degraded" in \
+                checks["PG_DEGRADED"]["summary"]
+            assert "PG_AVAILABILITY" in checks
+            # steady-state digests (count wobbles, still nonzero)
+            # commit nothing new — no paxos churn per digest
+            before = mon.paxos.last_committed
+            mon.ms_dispatch(None, MMonMgrDigest(
+                digest={"totals": {"degraded": 9},
+                        "inactive_pgs": 1}, epoch=1))
+            assert mon.paxos.last_committed == before
+
+            # the "fresh leader": same store, zero digests seen
+            mon2 = Monitor(Context("mon"), store=mon.store)
+            assert mon2.mgr_digest is None
+            checks2 = mon2.health_mon.checks()
+            assert "PG_DEGRADED" in checks2, checks2
+            assert "PG_AVAILABILITY" in checks2
+
+            # a clearing digest retires the committed state
+            mon.ms_dispatch(None, MMonMgrDigest(
+                digest={"totals": {"degraded": 0},
+                        "inactive_pgs": 0}, epoch=1))
+            assert mon.health_mon.persisted["pgdeg"] == 0
+            assert "PG_DEGRADED" not in mon.health_mon.checks()
+        finally:
+            await mon.shutdown()
+
+    run(main())
+
+
+# -- exporter lint ----------------------------------------------------------
+
+
+def test_exporter_lint_validates_and_catches():
+    from ceph_tpu.utils.exporter import validate_exposition
+
+    good = "\n".join([
+        "# HELP x_total things",
+        "# TYPE x_total counter",
+        "x_total 3",
+        "# TYPE h histogram",
+        'h_bucket{le="2"} 1',
+        'h_bucket{le="+Inf"} 2',
+        "h_count 2",
+        "# TYPE g gauge",
+        'g{pool="a",pool_id="1"} 1.5',
+    ])
+    assert validate_exposition(good) == []
+    # missing TYPE line
+    assert validate_exposition("orphan_series 1")
+    # invalid metric name
+    assert validate_exposition("# TYPE 9bad gauge\n9bad 1")
+    # non-numeric value
+    assert validate_exposition("# TYPE x gauge\nx NaNope")
+
+
+def test_live_exposition_passes_lint():
+    """Every series the exporter + mgr render — daemon perf counters,
+    labeled histograms, PGMap pool/cluster families, device runtime —
+    carries a `# TYPE` line and a valid name (guards the growing
+    surface)."""
+    from ceph_tpu.utils.exporter import validate_exposition
+
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            pid = await c.create_pool("lint", pg_num=4, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("lint")
+            for i in range(12):
+                await io.write_full("o-%d" % i, b"x" * 2048)
+            await wait_for(
+                lambda: len(c.mgr.daemon_reports) >= 3
+                and c.digest() is not None,
+                20.0, what="mgr reports + digest")
+            body = c.mgr.exporter.render()
+            errors = validate_exposition(body)
+            assert not errors, errors[:10]
+            # the new PGMap families are actually present
+            assert "ceph_tpu_pool_objects" in body
+            assert "ceph_tpu_cluster_write_ops_s" in body
+            assert "ceph_tpu_cluster_op_size_bytes_bucket" in body
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- the stats plane end to end (acceptance bullet) -------------------------
+
+
+def test_stats_plane_kill_revive_round():
+    """After a kill/revive thrash round, asserted ONLY from the stats
+    plane (OSD stat rows -> mgr PGMap -> mon digest), never internal
+    state: PG_DEGRADED raises while degraded objects > 0, the
+    degraded count drains to exactly 0 when healthy, `status` reports
+    a nonzero client IO rate during the workload, and a nonzero
+    recovery rate was visible while draining."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True,
+                               seed=1234).start()
+        try:
+            pid = await c.create_pool("data", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("data")
+            wl = Workload(io, seed=1).start()
+            # client IO rate surfaces through `status` (the `ceph -s`
+            # io: line), fed by the digest
+            await wait_for(lambda: c.client_io_rate() > 0.0, 30.0,
+                           what="client io rate in digest")
+            st = await c.client.mon_command("status")
+            assert st["pgmap"]["io"]["write_ops_s"] > 0.0, st["pgmap"]
+            assert st["pgmap"]["data"]["objects"] >= 0
+            assert st["health"] in ("HEALTH_OK", "HEALTH_WARN")
+
+            await c.kill_osd(1)
+            await c.wait_osd_down(1)
+            # degraded rises in the digest and PG_DEGRADED raises
+            await c.wait_stats(
+                lambda d: d is not None
+                and (d.get("totals") or {}).get("degraded", 0) > 0,
+                30.0, what="degraded objects in digest")
+            await wait_for(
+                lambda: (c.leader() is not None
+                         and "PG_DEGRADED"
+                         in c.leader().health_mon.checks()),
+                30.0, what="PG_DEGRADED raised")
+
+            await c.revive_osd(1)
+            await c.wait_osd_up(1)
+            await wl.stop()
+            await c.wait_health(pid, timeout=90.0)
+            obs = await c.wait_degraded_drained(timeout=90.0)
+            assert c.degraded_objects() == 0
+            assert obs["max_degraded"] > 0, obs
+            assert obs["max_recovery_rate"] > 0.0, obs
+            await wait_for(
+                lambda: "PG_DEGRADED"
+                not in c.leader().health_mon.checks(),
+                30.0, what="PG_DEGRADED cleared")
+            await wl.verify()
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_pgp_num_grow_backfill_misplaced_drains():
+    """Backfill-aware pgp_num growth (ROADMAP PR-3 gap): growing
+    pg_num (in-place split) then pgp_num (children take their own
+    placement) drives REAL data movement — the stats plane must show
+    the misplaced count rise and drain to exactly zero, with every
+    acked write still readable."""
+
+    async def main():
+        # modest mClock capacity paces backfill enough for the stats
+        # plane to observe the transient (memstore recovery is
+        # otherwise faster than a report interval)
+        c = await LocalCluster(
+            n_osds=4, with_mgr=True, seed=77,
+            conf={"osd_mclock_capacity_iops": 120.0}).start()
+        try:
+            pid = await c.create_pool("grow", pg_num=4, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("grow")
+            wl = Workload(io, seed=3).start()
+            for i in range(60):
+                await io.write_full("pre-%d" % i, b"m" * 2048)
+            await c.client.mon_command("osd pool set", pool="grow",
+                                       var="pg_num", val=8)
+            await asyncio.sleep(1.0)
+            await c.client.mon_command("osd pool set", pool="grow",
+                                       var="pgp_num", val=8)
+            # movement must become visible as misplaced (remapped
+            # copies that exist on up ex-members), then drain
+            saw = {"mis": 0}
+
+            def observe(d):
+                if d is not None:
+                    saw["mis"] = max(saw["mis"],
+                                     c.misplaced_objects() or 0)
+                return saw["mis"] > 0
+
+            await c.wait_stats(observe, 60.0,
+                               what="misplaced objects in digest")
+            await wl.stop()
+            await c.wait_health(pid, timeout=120.0)
+            await c.wait_degraded_drained(timeout=120.0)
+            assert c.misplaced_objects() == 0
+            assert c.degraded_objects() == 0
+            await wl.verify()
+            for i in range(60):
+                assert (await io.read("pre-%d" % i)) == b"m" * 2048
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_thrasher_stats_oracle_round():
+    """The thrasher's stats-driven oracle: with a mgr present, every
+    round additionally waits for the PGMap digest to drain degraded +
+    misplaced to exactly zero (and demands a visible recovery rate
+    when the drain was real).  One kill_revive plus one pgp_num_grow
+    round under live load exercises both the degraded and the
+    misplaced paths."""
+    from ceph_tpu.testing import ClusterThrasher
+
+    async def main():
+        c = await LocalCluster(
+            n_osds=4, with_mgr=True, seed=99,
+            conf={"osd_mclock_capacity_iops": 150.0}).start()
+        try:
+            pid = await c.create_pool("thr", pg_num=4, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("thr")
+            wl = Workload(io, seed=5).start()
+            th = ClusterThrasher(
+                c, seed=99,
+                actions=["kill_revive", "pgp_num_grow"])
+            await th.run(pid, wl)
+            await wl.stop()
+            assert (c.degraded_objects() or 0) == 0
+            assert (c.misplaced_objects() or 0) == 0
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- df / osd pool stats command surfaces -----------------------------------
+
+
+def test_df_and_pool_stats_commands():
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            pid = await c.create_pool("alpha", pg_num=4, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("alpha")
+            payload = b"d" * 4096
+            for i in range(20):
+                await io.write_full("o-%d" % i, payload)
+            await c.wait_stats(
+                lambda d: d is not None
+                and (d.get("totals") or {}).get("objects", 0) >= 20,
+                30.0, what="objects in digest")
+            df = await c.client.mon_command("df")
+            assert df["stats_available"]
+            rows = {r["name"]: r for r in df["pools"]}
+            assert rows["alpha"]["objects"] == 20
+            assert rows["alpha"]["bytes"] == 20 * len(payload)
+            assert rows["alpha"]["degraded"] == 0
+            assert df["total"]["objects"] == 20
+            ps = await c.client.mon_command("osd pool stats",
+                                            pool="alpha")
+            assert ps["pools"][0]["name"] == "alpha"
+            assert "write_ops_s" in ps["pools"][0]
+            # unknown pool -> error
+            from ceph_tpu.client.rados import RadosError
+            try:
+                await c.client.mon_command("osd pool stats",
+                                           pool="nope")
+                raise AssertionError("expected an error")
+            except RadosError:
+                pass
+
+            # the rados CLI df renders from the same digest
+            import argparse
+            from ceph_tpu.cli.rados import _run
+            ns = argparse.Namespace(
+                mon=",".join(c.mon_addrs), pool="alpha", snap=None,
+                size=4096, cmd="df", args=[])
+            assert await _run(ns) == 0
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- clock-offset timeline normalization ------------------------------------
+
+
+def test_op_timeline_normalizes_skewed_clocks():
+    """PR-2 multi-host span gap, closed minimally: per-daemon clock
+    offsets are estimated from message send/recv stamps and
+    normalized out of the merged timeline, so stage ordering survives
+    daemons whose monotonic clocks disagree by SECONDS."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("skew", pg_num=4, size=3)
+            await c.wait_health(pid)
+            c.set_clock_skew("osd.0", 5.0)
+            c.set_clock_skew("osd.1", -3.0)
+            c.set_clock_skew("osd.2", 11.0)
+            io = c.client.io_ctx("skew")
+            for i in range(10):
+                await io.write_full("o-%d" % i, b"z" * 512)
+            await asyncio.sleep(0.3)    # sub-op records retire
+            offsets = c.clock_offsets()
+            assert abs(offsets["osd.0"] - 5.0) < 0.5, offsets
+            assert abs(offsets["osd.1"] + 3.0) < 0.5, offsets
+            assert abs(offsets["osd.2"] - 11.0) < 0.5, offsets
+            rec = [r for r in c.client.optracker.historic
+                   if r.trace][-1]
+            tl = c.op_timeline(rec.trace)
+            daemons = {r["daemon"] for r in tl}
+            assert "client.0" in daemons and len(daemons) >= 3, tl
+            # normalized: the whole span collapses back to real time
+            # (unnormalized, the skews would spread it over >8s) and
+            # the client's submit comes first again
+            t0 = tl[0]["initiated"]
+            span = max(e["t"] for r in tl for e in r["events"]) - t0
+            assert span < 1.0, span
+            assert tl[0]["daemon"] == "client.0", [
+                (r["daemon"], r["initiated"]) for r in tl]
+        finally:
+            await c.stop()
+
+    run(main())
