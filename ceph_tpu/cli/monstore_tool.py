@@ -87,10 +87,13 @@ def cmd_dump(db: SQLiteKV) -> dict:
         out["paxos_first"] = paxos_vers[0]
         out["paxos_last"] = paxos_vers[-1]
     for label, key in (("config", b"svc:config"),
-                       ("auth", b"svc:auth"), ("log", b"svc:log")):
+                       ("auth", b"svc:auth"), ("log", b"svc:log"),
+                       ("crash", b"svc:crash")):
         raw = db.get(key)
         if raw is not None:
             v = denc.decode(raw)
+            if label == "log" and isinstance(v, dict):
+                v = v.get("entries") or []
             out["svc_%s_entries" % label] = len(v)
     fulls = [k for k in keys if k.startswith(b"osdmap:full:")]
     incs = [k for k in keys if k.startswith(b"osdmap:inc:")]
@@ -116,6 +119,7 @@ def _build_parser() -> argparse.ArgumentParser:
     sub.add_parser("show-auth")
     lg = sub.add_parser("show-log")
     lg.add_argument("n", nargs="?", type=int, default=20)
+    sub.add_parser("show-crashes")
     return p
 
 
@@ -182,9 +186,21 @@ def main(argv=None) -> int:
         if args.cmd == "show-log":
             raw = db.get(b"svc:log")
             lines = denc.decode(raw) if raw else []
+            if isinstance(lines, dict):     # clog-era format
+                lines = lines.get("entries") or []
             for e in lines[-args.n:]:
                 print("%(stamp).3f %(who)s %(level)s: %(message)s"
                       % e)
+            return 0
+        if args.cmd == "show-crashes":
+            raw = db.get(b"svc:crash")
+            reports = denc.decode(raw) if raw else {}
+            for cid in sorted(reports):
+                r = reports[cid]
+                print("%s %s %s: %s%s"
+                      % (cid, r.get("entity"), r.get("exc_type"),
+                         r.get("exc_msg"),
+                         " [archived]" if r.get("archived") else ""))
             return 0
         return 2
     finally:

@@ -5,9 +5,11 @@ Analog of src/tools/rados (rados put/get/ls/rm/stat/df/bench):
     python -m ceph_tpu.cli.rados -m HOST:PORT[,HOST:PORT...] \\
         -p POOL put NAME FILE | get NAME FILE | ls | rm NAME \\
         | stat NAME | df | bench SECONDS write [--size N] \\
-        | mksnap SNAP | rmsnap SNAP | lssnap
+        | mksnap SNAP | rmsnap SNAP | lssnap | report [OUT.json]
 
     Reads honor -s/--snap SNAPNAME (rados -s, snapshot reads).
+    `report` writes the one-call diagnostics bundle (status, health,
+    df, osd dump, recent cluster log, crash list) as JSON.
 """
 
 from __future__ import annotations
@@ -43,9 +45,50 @@ async def _run(args) -> int:
                          total.get("bytes", 0),
                          total.get("degraded", 0),
                          total.get("misplaced", 0), "", ""))
+            osds = out.get("osds") or []
+            if osds:
+                # raw-capacity axis: per-OSD store statfs (bytes on
+                # the device, not logical x replication)
+                ofmt = "%-10s %14s %14s %14s %7s"
+                print()
+                print(ofmt % ("OSD", "USED", "AVAIL", "TOTAL",
+                              "%USE"))
+                for row in osds:
+                    print(ofmt % (row["name"], row["used"],
+                                  row["available"], row["total"],
+                                  "%.2f" % (100.0 * row["util"])))
+                print(ofmt % ("RAW TOTAL", out.get("raw_used", 0),
+                              "", out.get("raw_total", 0), ""))
             if not out.get("stats_available"):
                 print("(no mgr digest yet: counts read as zero "
                       "until a manager reports)")
+            return 0
+        if args.cmd == "report":
+            # one-call diagnostics bundle (the `ceph report` role):
+            # every mon-served surface in one JSON artifact — the
+            # thing you attach to a bug
+            import json
+
+            rep = {"generated_at": time.time()}
+            for key, prefix, kw in (
+                    ("status", "status", {}),
+                    ("health", "health", {}),
+                    ("df", "df", {}),
+                    ("osd_dump", "osd dump", {}),
+                    ("log_last", "log last", {"n": 100}),
+                    ("crashes", "crash ls", {})):
+                try:
+                    rep[key] = await client.mon_command(prefix, **kw)
+                except Exception as e:
+                    rep[key] = {"error": repr(e)}
+            blob = json.dumps(rep, indent=2, default=str,
+                              sort_keys=True)
+            if args.args:
+                with open(args.args[0], "w") as f:
+                    f.write(blob + "\n")
+                print("wrote report to %s" % args.args[0])
+            else:
+                print(blob)
             return 0
         io = client.io_ctx(args.pool)
         if args.snap:

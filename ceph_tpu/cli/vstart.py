@@ -77,8 +77,24 @@ async def run(args) -> int:
             print("thrash: %d rounds clean, %d acked writes intact"
                   % (args.thrash, len(wl.acked)))
         except Exception as e:
-            print("thrash FAILED (replay with --seed %s): %s"
-                  % (args.seed, e))
+            # self-reporting failure: the full diagnostics bundle
+            # (per-daemon perf/ops/ring tails, mon health/log/crash
+            # state, pgmap digest, merged op timelines) lands in a
+            # temp file — the artifact to attach to the bug
+            import json
+            import os
+            import tempfile
+
+            fd, path = tempfile.mkstemp(prefix="ceph_tpu_diag_",
+                                        suffix=".json")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(cluster.collect_diagnostics(), f,
+                              indent=2, default=str, sort_keys=True)
+            except Exception as de:
+                path = "(diagnostics collection failed: %r)" % de
+            print("thrash FAILED (replay with --seed %s): %s\n"
+                  "diagnostics bundle: %s" % (args.seed, e, path))
             rc = 1
         finally:
             await wl.stop()

@@ -59,9 +59,18 @@ class Manager:
             self.ctx.conf.get("mgr_stats_period", 1.0))
         self.digests_sent = 0
         self.exporter = PrometheusExporter(self.ctx)
+        # cluster-log handle: mgr events ride the same
+        # LogClient -> MLog -> LogMonitor pipeline as OSD events
+        from ..trace import LogClient
+        self.clog = LogClient(self.ctx, "mgr",
+                              send_fn=self._broadcast_mons)
         self._tid = 0
         self._cmd_futures: dict[int, asyncio.Future] = {}
         self._tasks: list = []
+
+    def _broadcast_mons(self, msg) -> None:
+        for i, addr in enumerate(self.mon_addrs):
+            self.msgr.send_to(addr, msg, entity_hint="mon.%d" % i)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -72,6 +81,7 @@ class Manager:
                                    entity_hint="mon.0")
         mon.send(MMonSubscribe(start=1))
         await self._register()
+        self.clog.info("mgr active at %s" % self.msgr.addr)
         self.http_addr = await self.exporter.start(host, http_port)
         self._register_cluster_gauges()
         self._tasks.append(self.msgr.spawn(self._balancer_loop()))
@@ -92,6 +102,10 @@ class Manager:
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, MConfig):
             self.ctx.conf.apply_mon_values(msg.values or {})
+            return True
+        from ..msg.messages import MLogAck
+        if isinstance(msg, MLogAck):
+            self.clog.handle_ack(msg.who, int(msg.last or 0))
             return True
         if isinstance(msg, MOSDMapMsg):
             self.osdmap, _ = consume_map_payload(
@@ -160,6 +174,7 @@ class Manager:
                       "upmap items committed by the balancer")
         exp.add_renderer(self._render_reports)
         exp.add_renderer(self._render_pgmap)
+        exp.add_renderer(self._render_event_plane)
 
     def _total_slow_ops(self) -> int:
         """Cluster-wide slow-op count aggregated from the per-daemon
@@ -257,6 +272,36 @@ class Manager:
             lines.append("%s_count %d" % (fam, cum))
         return lines
 
+    def _render_event_plane(self) -> list[str]:
+        """Cluster-log emission counters
+        (ceph_tpu_log_messages_total{daemon,level}) from every
+        daemon's clog handle (shipped in MMgrReport osd_stats; the
+        mgr contributes its own handle directly) plus the per-OSD
+        statfs axis (raw capacity/utilization)."""
+        now = asyncio.get_event_loop().time()
+        rows = self.pgmap.live_osd_stats(now)
+        lines: list[str] = []
+        fam = "ceph_tpu_log_messages_total"
+        lines.append("# TYPE %s counter" % fam)
+        clog_rows = {d: (row.get("log_messages") or {})
+                     for d, row in rows.items()}
+        clog_rows["mgr"] = self.clog.counts_wire()
+        for daemon in sorted(clog_rows):
+            for level in sorted(clog_rows[daemon]):
+                lines.append(
+                    '%s{daemon="%s",level="%s"} %d'
+                    % (fam, daemon, level, clog_rows[daemon][level]))
+        for fam, key in (("ceph_tpu_osd_statfs_total_bytes", "total"),
+                         ("ceph_tpu_osd_statfs_used_bytes", "used")):
+            lines.append("# TYPE %s gauge" % fam)
+            for daemon in sorted(rows):
+                sf = rows[daemon].get("statfs")
+                if sf:
+                    lines.append('%s{daemon="%s"} %d'
+                                 % (fam, daemon,
+                                    int(sf.get(key) or 0)))
+        return lines
+
     # -- stats loop (PGMap digest -> monitors) -----------------------------
 
     async def _stats_loop(self) -> None:
@@ -265,6 +310,7 @@ class Manager:
         beacons so whichever mon leads next already holds it)."""
         while True:
             await asyncio.sleep(self.stats_period)
+            self.clog.flush()       # re-send unacked clog entries
             if not self.daemon_reports:
                 continue
             now = asyncio.get_event_loop().time()

@@ -113,6 +113,12 @@ class PGMap:
             states[s] = states.get(s, 0) + 1
         return states
 
+    def live_osd_stats(self, now: float) -> dict[str, dict]:
+        """Per-daemon extras (statfs, clog counters) from reports
+        still within the staleness window."""
+        return {d: row for d, row in self.osd_stats.items()
+                if now - row["_stamp"] <= self.stale_after}
+
     def op_size_hist(self, now: float) -> list[int]:
         """Element-wise sum of every live daemon's op-size histogram
         (pow2 byte buckets)."""
@@ -143,6 +149,14 @@ class PGMap:
                 totals[k] += row[k]
         inactive = sum(n for s, n in states.items()
                        if s not in ("active", "replica"))
+        # per-OSD raw capacity (the statfs axis `df` renders): bounded
+        # — one small row per reporting daemon, never per-PG data
+        osd_rows = {}
+        for d, row in self.live_osd_stats(now).items():
+            sf = row.get("statfs")
+            if sf:
+                osd_rows[d] = {"total": int(sf.get("total") or 0),
+                               "used": int(sf.get("used") or 0)}
         return {
             "num_pgs": sum(r["num_pgs"] for r in per_pool.values()),
             "pg_states": states,
@@ -151,4 +165,5 @@ class PGMap:
             "totals": totals,
             "inactive_pgs": inactive,
             "op_size_hist_bytes_pow2": self.op_size_hist(now),
+            "osd_stats": osd_rows,
         }

@@ -128,19 +128,31 @@ class Monitor:
         self.mgr_digest: dict | None = None
         self.mgr_digest_stamp = 0.0
         # mon-side op tracking (MMonCommand requests)
-        from ..trace import OpTracker
+        from ..trace import LogClient, OpTracker
         self.optracker = OpTracker(self.ctx, name)
+        # the mon's own cluster-log handle: boot/mark-down/auto-out
+        # and health-edge events ride the same seq/ack/resend path as
+        # every other daemon's clog (a peon forwards to the leader)
+        self.clog = LogClient(self.ctx, name,
+                              send_fn=self._clog_send)
+        # who -> conn that last delivered its MLog / MCrashReport:
+        # the ack route back once the paxos commit applies here
+        self._log_ack_routes: dict = {}
+        self._crash_ack_routes: dict = {}
         self._tick_task = None
-        # PaxosService quartet (ConfigMonitor/AuthMonitor/
-        # HealthMonitor/LogMonitor analogs): their mutations ride the
-        # same paxos stream as map changes via pending_svc
+        # PaxosService quintet (ConfigMonitor/AuthMonitor/
+        # HealthMonitor/LogMonitor/CrashMonitor analogs): their
+        # mutations ride the same paxos stream as map changes via
+        # pending_svc
         from .services import (AuthMonitor, ConfigMonitor,
-                               HealthMonitor, LogMonitor)
+                               CrashMonitor, HealthMonitor,
+                               LogMonitor)
 
         self.config_mon = ConfigMonitor(self)
         self.auth_mon = AuthMonitor(self)
         self.health_mon = HealthMonitor(self)
         self.log_mon = LogMonitor(self)
+        self.crash_mon = CrashMonitor(self)
         self.pending_svc: dict[str, list] = {}
         # service state loads BEFORE _load(): crash recovery replays
         # a pending blob through the same apply path, which rewrites
@@ -150,6 +162,7 @@ class Monitor:
         self.auth_mon.load()
         self.log_mon.load()
         self.health_mon.load()
+        self.crash_mon.load()
         self._load()
 
     def _parse_disallowed(self, raw: str) -> set[int]:
@@ -212,9 +225,18 @@ class Monitor:
                 self.log_mon.apply(svc["log"], tx)
             if svc.get("health"):
                 self.health_mon.apply(svc["health"], tx)
+            if svc.get("crash"):
+                self.crash_mon.apply(svc["crash"], tx)
             self.store.submit_transaction(tx)
             if svc.get("config"):
                 self.config_mon.push_all()
+            # committed = durable on a quorum: ack clog entries and
+            # crash reports back to their senders (every mon applies
+            # the commit; whichever holds the sender's conn acks)
+            if svc.get("log"):
+                self._ack_log_commit(svc["log"])
+            if svc.get("crash"):
+                self._ack_crash_commit(svc["crash"])
         inc_d = payload.get("osdmap_inc")
         if inc_d is None:
             return
@@ -469,8 +491,19 @@ class Monitor:
                               "lease_until", "uncommitted", "epoch",
                               "accepted_pn")})
             return True
-        from ..msg.messages import (MMonMgrDigest, MOSDBeacon,
+        from ..msg.messages import (MCrashReport, MLog, MLogAck,
+                                    MMonMgrDigest, MOSDBeacon,
                                     MOSDPGTemp)
+        if isinstance(msg, MLog):
+            self._handle_log(conn, msg.entries or [])
+            return True
+        if isinstance(msg, MLogAck):
+            # ack for entries this (peon) mon forwarded to the leader
+            self.clog.handle_ack(msg.who, int(msg.last or 0))
+            return True
+        if isinstance(msg, MCrashReport):
+            self._handle_crash_report(conn, msg.reports or [])
+            return True
         if isinstance(msg, MMonMgrDigest):
             self.mgr_digest = msg.digest or {}
             self.mgr_digest_stamp = time.monotonic()
@@ -545,6 +578,124 @@ class Monitor:
                 return
             if rank != self.rank:
                 self.elector.peer_lost(rank)
+
+    # -- cluster log + crash telemetry (LogClient -> LogMonitor /
+    # MCrashReport -> CrashMonitor pipelines) ------------------------------
+
+    def _clog_send(self, msg) -> None:
+        """The mon's OWN clog route: the leader commits locally; a
+        peon forwards to the leader over the mon-mon link (entries
+        stay pending in the LogClient and the tick re-flush retries
+        until a leader is known and acks)."""
+        if self.is_leader() and (not self.multi
+                                 or self.mpaxos.active):
+            self._handle_log(None, msg.entries or [])
+            return
+        leader = (self.elector.leader
+                  if self.elector is not None else None)
+        if leader is not None and leader != self.rank:
+            self.msgr.send_to(self._rank_addr(leader), msg,
+                              entity_hint="mon.%d" % leader)
+
+    def _handle_log(self, conn, entries: list) -> None:
+        """One daemon's MLog batch: every mon records the ack route;
+        only the active leader queues unseen entries through paxos
+        (dedup against both the committed last_seq and the not-yet-
+        proposed pending queue, so a re-flush racing its own proposal
+        stacks nothing)."""
+        by_who: dict[str, list] = {}
+        for e in entries:
+            who = e.get("who")
+            if who:
+                by_who.setdefault(who, []).append(e)
+        leading = self.is_leader() and (not self.multi
+                                        or self.mpaxos.active)
+        for who, batch in by_who.items():
+            if conn is not None:
+                self._log_ack_routes[who] = conn
+            committed = self.log_mon.last_seq.get(who, 0)
+            top = max(int(e.get("seq") or 0) for e in batch)
+            if committed >= top:
+                # resend raced (or outlived) its ack: re-ack now
+                self._send_log_ack(who, committed)
+                continue
+            if not leading:
+                continue
+            pend = max((int(op[1].get("seq") or 0)
+                        for op in self.pending_svc.get("log", [])
+                        if op[0] == "append"
+                        and op[1].get("who") == who), default=0)
+            base = max(committed, pend)
+            for e in sorted(batch,
+                            key=lambda e: int(e.get("seq") or 0)):
+                if int(e.get("seq") or 0) > base:
+                    self.queue_svc_op("log", ("append", dict(e)))
+
+    def _ack_log_commit(self, ops: list) -> None:
+        tops: dict[str, int] = {}
+        for op in ops:
+            if op[0] == "append":
+                who = op[1].get("who")
+                seq = int(op[1].get("seq") or 0)
+                if who and seq:
+                    tops[who] = max(tops.get(who, 0), seq)
+        for who, seq in tops.items():
+            self._send_log_ack(who, seq)
+
+    def _send_log_ack(self, who: str, last: int) -> None:
+        from ..msg.messages import MLogAck
+        if who == self.name:
+            self.clog.handle_ack(who, last)
+            return
+        conn = self._log_ack_routes.get(who)
+        if conn is not None and conn.is_open:
+            conn.send(MLogAck(who=who, last=last))
+
+    def _handle_crash_report(self, conn, reports: list) -> None:
+        """Pending crash reports from a rebooted daemon: ack ids the
+        committed table already holds (the resend path), and — on the
+        leader — commit unseen ones plus the cluster-log event that
+        makes the crash operator-visible in `log last`."""
+        from ..msg.messages import MCrashReportAck
+        known: list[str] = []
+        fresh: list[dict] = []
+        pend = {op[1].get("crash_id")
+                for op in self.pending_svc.get("crash", [])
+                if op[0] == "add"}
+        for r in reports:
+            cid = r.get("crash_id")
+            if not cid:
+                continue
+            if conn is not None:
+                self._crash_ack_routes[cid] = conn
+            if cid in self.crash_mon.reports:
+                known.append(cid)
+            elif cid not in pend:
+                fresh.append(r)
+        if known and conn is not None and conn.is_open:
+            conn.send(MCrashReportAck(crash_ids=known))
+        if not (self.is_leader()
+                and (not self.multi or self.mpaxos.active)):
+            return
+        for r in fresh:
+            self.queue_svc_op("crash", ("add", dict(r)))
+            self.log_mon.append(
+                "WRN", "daemon %s crashed: %s: %s (crash id %s)"
+                % (r.get("entity"), r.get("exc_type"),
+                   r.get("exc_msg"), r.get("crash_id")))
+
+    def _ack_crash_commit(self, ops: list) -> None:
+        from ..msg.messages import MCrashReportAck
+        by_conn: dict = {}
+        for op in ops:
+            if op[0] != "add":
+                continue
+            cid = op[1].get("crash_id")
+            conn = self._crash_ack_routes.pop(cid, None)
+            if conn is not None and conn.is_open:
+                by_conn.setdefault(id(conn), (conn, []))[1].append(cid)
+        for conn, cids in by_conn.values():
+            conn.send(MCrashReportAck(crash_ids=cids))
 
     def _handle_pg_temp(self, msg) -> None:
         """OSDMonitor::prepare_pgtemp: commit requested pg_temp
@@ -717,6 +868,9 @@ class Monitor:
                 # pings keep scores meaningful between elections
                 # (steady-state paxos is a leader-centred star)
                 self.send_election("ping", self.elector.epoch)
+        # re-flush unacked clog entries: a leader election or dropped
+        # frame between emit and commit loses nothing
+        self.clog.flush()
         now = time.monotonic()
         interval = self.ctx.conf["mon_osd_down_out_interval"]
         changed = False
@@ -806,12 +960,21 @@ class Monitor:
 
     def _run_command(self, prefix: str, cmd: dict) -> dict:
         # service command surfaces (ConfigMonitor/AuthMonitor/
-        # HealthMonitor/LogMonitor)
+        # HealthMonitor/LogMonitor/CrashMonitor)
         for svc in (self.config_mon, self.auth_mon, self.health_mon,
-                    self.log_mon):
+                    self.log_mon, self.crash_mon):
             out = svc.command(prefix, cmd)
             if out is not None:
                 return out
+        if prefix in _AUDIT_PREFIXES:
+            # command provenance on the audit channel (the reference
+            # mon's audit clog): only state-mutating prefixes — an
+            # audit entry per status poll would burn a paxos round
+            # each
+            self.log_mon.append(
+                "INF", "cmd: %s %s" % (prefix, {
+                    k: v for k, v in cmd.items() if k != "prefix"}),
+                channel="audit")
         if prefix == "osd pool create":
             return self._cmd_pool_create(cmd)
         if prefix == "osd pool rm":
@@ -820,6 +983,8 @@ class Monitor:
             inc = self._pending()
             inc.old_pools.append(pid)
             self._propose_pending()
+            self.log_mon.append("INF", "pool '%s' (id %d) removed"
+                                % (name, pid))
             return {}
         if prefix == "osd pool set":
             return self._cmd_pool_set(cmd)
@@ -968,13 +1133,25 @@ class Monitor:
 
     def _cmd_df(self) -> dict:
         """`rados df`: real per-pool usage from the PGMap digest (the
-        pre-stats build aliased `status` here)."""
+        pre-stats build aliased `status` here), plus the per-OSD
+        raw-capacity axis (store statfs riding MMgrReport)."""
         rows = self._pool_digest_rows()
         total = {k: sum(r[k] for r in rows)
                  for k in ("objects", "bytes", "degraded",
                            "misplaced", "unfound")}
-        return {"pools": rows, "total": total,
-                "stats_available": self._digest_fresh() is not None}
+        dig = self._digest_fresh()
+        osd_rows = []
+        for daemon, sf in sorted(
+                ((dig.get("osd_stats") or {}) if dig else {}).items()):
+            t = int(sf.get("total") or 0)
+            u = int(sf.get("used") or 0)
+            osd_rows.append({"name": daemon, "total": t, "used": u,
+                             "available": max(0, t - u),
+                             "util": (float(u) / t) if t else 0.0})
+        return {"pools": rows, "total": total, "osds": osd_rows,
+                "raw_total": sum(r["total"] for r in osd_rows),
+                "raw_used": sum(r["used"] for r in osd_rows),
+                "stats_available": dig is not None}
 
     def _cmd_pool_stats(self, cmd: dict) -> dict:
         """`ceph osd pool stats [pool]`: per-pool client IO and
@@ -1031,6 +1208,9 @@ class Monitor:
         inc = self._pending()
         inc.new_pools[pid] = pool
         self._propose_pending()
+        self.log_mon.append(
+            "INF", "pool '%s' created (id %d, %s, pg_num %d)"
+            % (name, pid, ptype, pg_num))
         return {"pool_id": pid}
 
     # -- snapshots (OSDMonitor pool snap / selfmanaged snap commands,
@@ -1171,4 +1351,21 @@ class Monitor:
         inc = self._pending()
         inc.new_pools[pid] = pool
         self._propose_pending()
+        if key == "erasure_code_profile":
+            self.log_mon.append(
+                "INF", "pool '%s' erasure profile rolled to '%s'"
+                % (pool.name, val))
         return {}
+
+
+# state-mutating command prefixes that leave an audit-channel clog
+# entry (the reference mon logs every command to the audit channel;
+# read-only polls are exempt here — each audit entry costs a paxos
+# commit)
+_AUDIT_PREFIXES = frozenset((
+    "osd pool create", "osd pool rm", "osd pool set",
+    "osd erasure-code-profile set", "osd out", "osd in", "osd down",
+    "osd pool mksnap", "osd pool rmsnap", "osd snap create",
+    "osd snap rm", "config set", "config rm", "crash archive",
+    "crash archive-all", "crash rm", "mgr register",
+))

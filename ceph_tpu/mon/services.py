@@ -42,6 +42,7 @@ CONFIG_KEY = b"svc:config"
 AUTH_KEY = b"svc:auth"
 LOG_KEY = b"svc:log"
 HEALTH_KEY = b"svc:health"
+CRASH_KEY = b"svc:crash"
 
 LOG_CAP = 1000
 
@@ -275,12 +276,32 @@ class HealthMonitor:
             cur = self.persisted["slow"].get(osd, 0)
         if int(slow) != cur:
             self.mon.queue_svc_op("health", ("slow", osd, int(slow)))
+            # raise/clear edges are cluster-log events (the reference
+            # clogs every health-check transition): committed beside
+            # the health op, so every mon's `log last` shows them
+            if (int(slow) > 0) != (cur > 0):
+                if int(slow):
+                    self.mon.log_mon.append(
+                        "WRN", "Health check failed: %d slow ops on "
+                        "osd.%d (SLOW_OPS)" % (int(slow), osd))
+                else:
+                    self.mon.log_mon.append(
+                        "INF", "Health check cleared: SLOW_OPS "
+                        "(osd.%d)" % osd)
         cur = pending_val("devflb")
         if cur is None:
             cur = self.persisted["devflb"].get(osd, 0)
         if int(devflb) != cur:
             self.mon.queue_svc_op("health",
                                   ("devflb", osd, int(devflb)))
+            if int(devflb):
+                self.mon.log_mon.append(
+                    "WRN", "Health check failed: osd.%d on host "
+                    "fallback (DEVICE_FALLBACK)" % osd)
+            else:
+                self.mon.log_mon.append(
+                    "INF", "Health check cleared: DEVICE_FALLBACK "
+                    "(osd.%d)" % osd)
 
     def maybe_commit_digest(self, degraded: int,
                             inactive: int) -> None:
@@ -308,6 +329,18 @@ class HealthMonitor:
             # commits when it crosses zero
             if (val > 0) != (cur > 0):
                 self.mon.queue_svc_op("health", (kind, val))
+                check = ("PG_DEGRADED" if kind == "pgdeg"
+                         else "PG_AVAILABILITY")
+                if val:
+                    what = ("%d objects degraded" % val
+                            if kind == "pgdeg"
+                            else "%d pgs inactive" % val)
+                    self.mon.log_mon.append(
+                        "WRN", "Health check failed: %s (%s)"
+                        % (what, check))
+                else:
+                    self.mon.log_mon.append(
+                        "INF", "Health check cleared: %s" % check)
 
     # -- merged beacon views -------------------------------------------
 
@@ -434,6 +467,27 @@ class HealthMonitor:
                 "summary": "Reduced data availability: %d pgs "
                            "inactive" % inactive,
                 "detail": []}
+        # RECENT_CRASH (the crash module's health check): any
+        # un-archived crash report newer than mon_crash_warn_age.
+        # The crash table is itself paxos-committed, so a freshly
+        # elected leader warns with no extra edge state — the same
+        # fresh-leader guarantee SLOW_OPS needs `persisted` for.
+        crash_mon = getattr(self.mon, "crash_mon", None)
+        if crash_mon is not None:
+            warn_age = float(self.mon.ctx.conf.get(
+                "mon_crash_warn_age", 14 * 24 * 3600.0))
+            recent = crash_mon.unarchived(max_age=warn_age)
+            if recent:
+                out["RECENT_CRASH"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": "%d recent crash(es): daemons %s"
+                               % (len(recent),
+                                  sorted({str(r.get("entity"))
+                                          for r in recent})[:10]),
+                    "detail": ["%s crashed: %s: %s"
+                               % (r.get("entity"), r.get("exc_type"),
+                                  r.get("exc_msg"))
+                               for r in recent[:10]]}
         if not m.pools and m.epoch > 0:
             pass                       # empty cluster is healthy
         return out
@@ -453,38 +507,164 @@ class HealthMonitor:
 
 
 class LogMonitor:
+    """The capped cluster log, fed from two directions: direct
+    mon-side appends (boot, mark-down, auto-out, health edges — via
+    the mon's own LogClient) and MLog batches from every daemon's
+    clog handle.  Entries are paxos-committed, so `log last` is
+    identical on every monitor and survives leader elections;
+    ``last_seq`` (per who) makes the apply idempotent against the
+    LogClient's resend-until-acked delivery."""
+
     def __init__(self, mon):
         self.mon = mon
         self.entries: list[dict] = []       # capped ring
+        self.last_seq: dict[str, int] = {}  # who -> committed seq
 
     def load(self) -> None:
         raw = self.mon.store.get(LOG_KEY)
-        if raw is not None:
-            self.entries = [dict(e) for e in denc.decode(raw)]
+        if raw is None:
+            return
+        d = denc.decode(raw)
+        if isinstance(d, dict):
+            self.entries = [dict(e) for e in (d.get("entries") or [])]
+            self.last_seq = {w: int(s)
+                             for w, s in (d.get("seq") or {}).items()}
+        else:                               # pre-clog bare list
+            self.entries = [dict(e) for e in d]
 
     def apply(self, ops: list, tx) -> None:
         for op in ops:
-            if op[0] == "append":
-                self.entries.append(dict(op[1]))
+            if op[0] != "append":
+                continue
+            e = dict(op[1])
+            who = e.get("who") or "?"
+            seq = int(e.get("seq") or 0)
+            if seq:
+                # resend dedup: a LogClient re-flush racing its own
+                # ack must not commit the entry twice
+                if seq <= self.last_seq.get(who, 0):
+                    continue
+                self.last_seq[who] = seq
+            self.entries.append(e)
         if len(self.entries) > LOG_CAP:
             self.entries = self.entries[-LOG_CAP:]
-        tx.set(LOG_KEY, denc.encode(self.entries))
+        tx.set(LOG_KEY, denc.encode({"entries": self.entries,
+                                     "seq": self.last_seq}))
 
-    def append(self, level: str, message: str,
-               who: str = "mon") -> None:
-        """Mon-side event (boot, mark-down, auto-out ...): queued
-        through paxos so every monitor's log agrees."""
+    def append(self, level: str, message: str, who: str | None = None,
+               channel: str = "cluster") -> None:
+        """Mon-side event (boot, mark-down, auto-out, health edges):
+        routed through the mon's own clog handle so it gets a seq and
+        the resend-until-acked delivery like every other daemon's
+        entries; an explicit `who` (the client `log` command) queues
+        directly (the command layer owns its own retry semantics)."""
+        clog = getattr(self.mon, "clog", None)
+        if who is None and clog is not None:
+            clog.queue(level, message, channel)
+            clog.flush()
+            return
         self.mon.queue_svc_op("log", ("append", {
-            "stamp": time.time(), "who": who, "level": level,
-            "message": message}))
+            "stamp": time.time(), "who": who or self.mon.name,
+            "channel": channel, "level": level, "message": message}))
 
     def command(self, prefix: str, cmd: dict):
         if prefix == "log":
             self.append(cmd.get("level", "INF"),
                         str(cmd.get("message", "")),
-                        who=cmd.get("who", "client"))
+                        who=cmd.get("who", "client"),
+                        channel=cmd.get("channel", "cluster"))
             return {}
         if prefix == "log last":
             n = int(cmd.get("n", 20))
-            return {"lines": self.entries[-n:]}
+            lines = self.entries
+            level = cmd.get("level")
+            if level:
+                lines = [e for e in lines if e.get("level") == level]
+            channel = cmd.get("channel")
+            if channel:
+                lines = [e for e in lines
+                         if e.get("channel", "cluster") == channel]
+            return {"lines": lines[-n:]}
+        return None
+
+
+class CrashMonitor:
+    """Paxos-committed crash table (the crash mgr module's store +
+    `crash ls/info/archive` surface).  Because the table itself rides
+    the same commit stream as map changes, a freshly elected leader
+    that never heard a single report still serves `crash ls` and
+    raises RECENT_CRASH immediately — the SLOW_OPS fresh-leader shape
+    without separate edge state."""
+
+    def __init__(self, mon):
+        self.mon = mon
+        self.reports: dict[str, dict] = {}   # crash_id -> report
+
+    def load(self) -> None:
+        raw = self.mon.store.get(CRASH_KEY)
+        if raw is not None:
+            self.reports = {k: dict(v)
+                            for k, v in denc.decode(raw).items()}
+
+    def apply(self, ops: list, tx) -> None:
+        for op in ops:
+            if op[0] == "add":
+                r = dict(op[1])
+                cid = r.get("crash_id")
+                if cid and cid not in self.reports:
+                    r.setdefault("archived", 0)
+                    self.reports[cid] = r
+            elif op[0] == "archive":
+                r = self.reports.get(op[1])
+                if r is not None:
+                    r["archived"] = 1
+            elif op[0] == "rm":
+                self.reports.pop(op[1], None)
+        tx.set(CRASH_KEY, denc.encode(self.reports))
+
+    def unarchived(self, max_age: float | None = None) -> list[dict]:
+        """Un-archived reports (optionally only those newer than
+        max_age seconds) — the RECENT_CRASH input."""
+        now = time.time()
+        out = [r for r in self.reports.values()
+               if not r.get("archived")
+               and (max_age is None
+                    or now - float(r.get("timestamp") or 0) <= max_age)]
+        out.sort(key=lambda r: float(r.get("timestamp") or 0))
+        return out
+
+    def _summary(self, r: dict) -> dict:
+        return {"crash_id": r.get("crash_id"),
+                "entity": r.get("entity"),
+                "timestamp": r.get("timestamp"),
+                "exc_type": r.get("exc_type"),
+                "exc_msg": r.get("exc_msg"),
+                "archived": bool(r.get("archived"))}
+
+    def command(self, prefix: str, cmd: dict):
+        if prefix == "crash ls":
+            rows = sorted(self.reports.values(),
+                          key=lambda r: float(r.get("timestamp") or 0))
+            return {"crashes": [self._summary(r) for r in rows]}
+        if prefix == "crash ls-new":
+            return {"crashes": [self._summary(r)
+                                for r in self.unarchived()]}
+        if prefix == "crash info":
+            r = self.reports.get(cmd.get("id"))
+            if r is None:
+                raise ValueError("no crash %r" % cmd.get("id"))
+            return dict(r)
+        if prefix == "crash archive":
+            if cmd.get("id") not in self.reports:
+                raise ValueError("no crash %r" % cmd.get("id"))
+            self.mon.queue_svc_op("crash", ("archive", cmd["id"]))
+            return {}
+        if prefix == "crash archive-all":
+            for cid, r in sorted(self.reports.items()):
+                if not r.get("archived"):
+                    self.mon.queue_svc_op("crash", ("archive", cid))
+            return {}
+        if prefix == "crash rm":
+            self.mon.queue_svc_op("crash", ("rm", cmd.get("id")))
+            return {}
         return None

@@ -159,6 +159,49 @@ class MMonMgrDigest(Message):
 
 
 @register
+class MLog(Message):
+    """Daemon -> mon cluster-log batch (MLog.h / LogClient flow):
+    entries = [{seq, stamp, who, channel, level, message}, ...].
+    Broadcast to every mon (like beacons); the leader commits unseen
+    entries through paxos (LogMonitor dedups by (who, seq)) and the
+    mon that observes the commit acks with MLogAck so the client can
+    retire them.  Unacked entries are re-flushed periodically — a
+    leader election between emit and commit loses nothing."""
+
+    TYPE = "log"
+    FIELDS = ("entries",)
+
+
+@register
+class MLogAck(Message):
+    """mon -> daemon: entries of `who` up to seq `last` are
+    paxos-committed (MLogAck.h)."""
+
+    TYPE = "log_ack"
+    FIELDS = ("who", "last")
+
+
+@register
+class MCrashReport(Message):
+    """Daemon -> mon pending crash reports (the ceph-crash agent's
+    POST, as a message): reports = [crash report dicts].  Broadcast to
+    every mon; the leader commits unseen crash_ids into the
+    paxos-committed crash table, and any mon that sees them committed
+    acks their ids so the daemon can clear its store copy."""
+
+    TYPE = "crash_report"
+    FIELDS = ("reports",)
+
+
+@register
+class MCrashReportAck(Message):
+    """mon -> daemon: these crash_ids are in the committed table."""
+
+    TYPE = "crash_report_ack"
+    FIELDS = ("crash_ids",)
+
+
+@register
 class MOSDPGTemp(Message):
     """OSD -> mon pg_temp request (MOSDPGTemp.h / OSDMonitor
     prepare_pgtemp): pgs = [[pool, ps, [osds...]], ...]; an empty osd

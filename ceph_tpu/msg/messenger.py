@@ -551,11 +551,25 @@ class Messenger:
         # heartbeat skew-check role, minimally): every received frame
         # carries the sender's monotonic send stamp; `stamp - now()`
         # underestimates (peer_clock - my_clock) by the network
-        # latency, so the max over frames converges on the true
-        # offset.  `clock_skew` shifts THIS daemon's advertised clock
-        # (test hook for injected skew).
+        # latency, so new maxima are adopted immediately — but a pure
+        # max never decays, so a peer whose clock DRIFTS back down
+        # would stay pinned at its stale high-water mark.  Lower
+        # estimates therefore blend in with an EWMA: fresh frames
+        # pull the estimate down at CLOCK_DECAY per frame, bounded
+        # below only by the (sub-ms on loopback) latency noise floor.
+        # `clock_skew` shifts THIS daemon's advertised clock (test
+        # hook for injected skew/drift).
         self.clock_skew = 0.0
         self.clock_offsets: dict[str, float] = {}   # peer entity -> s
+        # optional crash capture: when set, an exception escaping a
+        # spawned task is handed here (the daemon writes a crash
+        # report) instead of dying unobserved as an "exception was
+        # never retrieved" warning at GC time
+        self.crash_hook = None
+
+    # per-frame EWMA weight for downward (drift) corrections; upward
+    # corrections apply immediately (strictly better information)
+    CLOCK_DECAY = 0.2
 
     def now(self) -> float:
         """This daemon's (possibly skewed) monotonic clock."""
@@ -568,6 +582,9 @@ class Messenger:
         cur = self.clock_offsets.get(src)
         if cur is None or est > cur:
             self.clock_offsets[src] = est
+        else:
+            self.clock_offsets[src] = \
+                cur + self.CLOCK_DECAY * (est - cur)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -584,7 +601,19 @@ class Messenger:
         """ensure_future with a strong reference held until done."""
         task = asyncio.ensure_future(coro)
         self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+
+        def _done(t: asyncio.Task) -> None:
+            self._tasks.discard(t)
+            if self.crash_hook is None or t.cancelled():
+                return      # no hook: keep asyncio's GC-time warning
+            exc = t.exception()
+            if exc is not None:
+                try:
+                    self.crash_hook(exc)
+                except Exception:
+                    pass    # the crash path must never crash
+
+        task.add_done_callback(_done)
         return task
 
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:

@@ -89,8 +89,22 @@ class OSD:
         # client op / sub-op registers here with its trace id; the
         # admin socket serves dump_ops_in_flight & friends and the
         # heartbeat loop beacons the slow-op count to the mon
-        from ..trace import OpTracker
+        from ..trace import LogClient, OpTracker
         self.optracker = OpTracker(self.ctx, "osd.%d" % whoami)
+        # cluster-log handle (LogClient): daemon events reach the
+        # mon's LogMonitor (paxos-committed `log last`); entries are
+        # broadcast like beacons and re-flushed until a mon acks the
+        # commit
+        self.clog = LogClient(self.ctx, "osd.%d" % whoami,
+                              send_fn=self._send_mons)
+        # crash reports recovered from the store at mount, shipped to
+        # the mons until acked (MCrashReport -> crash table)
+        self._crash_pending: list[dict] = []
+        self._crash_ship_stamp = 0.0
+        # unhandled exceptions escaping spawned tasks become crash
+        # reports in the daemon's own store (the post-mortem artifact
+        # that survives the process)
+        self.msgr.crash_hook = self._record_crash
         self.perf = self.ctx.perf.create("osd")
         self.perf.add_u64("ops", "client ops completed")
         self.perf.add_u64("dup_ops",
@@ -136,6 +150,14 @@ class OSD:
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self.store.mount()
+        # previous incarnation's crash reports (the reboot ships them
+        # to the mons; the paxos-committed ack clears them here)
+        from ..utils import crash as crashmod
+        self._crash_pending = crashmod.pending_crashes(self.store)
+        if self._crash_pending:
+            self.ctx.log.info(
+                "osd", "osd.%d found %d pending crash report(s)"
+                % (self.whoami, len(self._crash_pending)))
         addr = await self.msgr.bind(host, port)
         self.sched.start(self.msgr.spawn)
         self._load_pgs()
@@ -154,15 +176,85 @@ class OSD:
         return addr
 
     def _on_device_state(self, fallback: bool) -> None:
-        """Device runtime poisoned/healed: beacon the new state now."""
+        """Device runtime poisoned/healed: beacon the new state now,
+        and tell the cluster log (the daemon-origin side of the
+        DEVICE_FALLBACK story; the mon clogs the health edge)."""
         if self.stopping or not self.booted:
             return
         self.ctx.log.info(
             "osd", "osd.%d device runtime %s"
             % (self.whoami, "LOST -> host fallback" if fallback
                else "healed"))
+        if fallback:
+            self.clog.warn("osd.%d device runtime lost, serving from "
+                           "host paths" % self.whoami)
+        else:
+            self.clog.info("osd.%d device runtime healed"
+                           % self.whoami)
         self._beacon_stamp = 0.0        # bypass the report interval
         self._maybe_send_beacon()
+
+    # -- crash telemetry (utils.crash + the mon's crash table) -------------
+
+    def _record_crash(self, exc: BaseException) -> str | None:
+        """Write a crash report — stack, LogRing tail, identity —
+        into this daemon's OWN store (the artifact that survives the
+        process), queued for shipping to the mons."""
+        from ..utils import crash as crashmod
+        try:
+            report = crashmod.build_report(
+                "osd.%d" % self.whoami, exc,
+                fsid=getattr(self.osdmap, "fsid", "") or "",
+                epoch=self.osdmap.epoch if self.osdmap else 0,
+                ring=self.ctx.log.ring,
+                tail=int(self.ctx.conf.get("osd_crash_ring_tail",
+                                           100)))
+            crashmod.save_crash(self.store, report)
+        except Exception:
+            return None     # the crash path must never crash
+        self._crash_pending.append(report)
+        self.ctx.log.error(
+            "osd", "osd.%d crash recorded (%s): %s: %s"
+            % (self.whoami, report["crash_id"],
+               report["exc_type"], report["exc_msg"]))
+        return report["crash_id"]
+
+    def simulate_crash(self, exc: BaseException) -> str | None:
+        """Test/thrasher hook: die on an injected exception exactly
+        like an unhandled one — raise it for a real traceback, record
+        the report, leave the daemon to be hard-stopped by the
+        caller."""
+        try:
+            raise exc
+        except type(exc) as caught:
+            return self._record_crash(caught)
+
+    def _maybe_ship_crashes(self) -> None:
+        """Re-broadcast pending crash reports to every mon until the
+        committed-table ack clears them (paced like beacons)."""
+        if not self._crash_pending:
+            return
+        now = time.monotonic()
+        if now - self._crash_ship_stamp < \
+                self.ctx.conf["osd_beacon_report_interval"]:
+            return
+        self._crash_ship_stamp = now
+        from ..msg.messages import MCrashReport
+        self._send_mons(MCrashReport(
+            reports=[dict(r) for r in self._crash_pending]))
+
+    def _handle_crash_ack(self, crash_ids) -> None:
+        from ..utils import crash as crashmod
+        acked = set(crash_ids or [])
+        if not acked:
+            return
+        for r in list(self._crash_pending):
+            if r.get("crash_id") in acked:
+                self._crash_pending.remove(r)
+                try:
+                    crashmod.remove_crash(self.store, r["crash_id"])
+                except Exception:
+                    pass
 
     async def wait_for_boot(self, timeout: float = 10.0) -> None:
         from ..utils.backoff import wait_for
@@ -292,6 +384,13 @@ class OSD:
 
         if isinstance(msg, MConfig):
             self.ctx.conf.apply_mon_values(msg.values or {})
+            return True
+        from ..msg.messages import MCrashReportAck, MLogAck
+        if isinstance(msg, MLogAck):
+            self.clog.handle_ack(msg.who, int(msg.last or 0))
+            return True
+        if isinstance(msg, MCrashReportAck):
+            self._handle_crash_ack(msg.crash_ids)
             return True
         if isinstance(msg, MOSDMapMsg):
             self._handle_osd_map(msg)
@@ -2133,6 +2232,10 @@ class OSD:
                 self._maybe_clear_pg_temp(pg)
             self._maybe_send_mgr_report()
             self._maybe_send_beacon()
+            # event plane: re-flush unacked clog entries and pending
+            # crash reports (delivery survives leader elections)
+            self.clog.flush()
+            self._maybe_ship_crashes()
             now = time.monotonic()
             grace = conf["heartbeat_grace"]
             # prune state for peers the map says are down, so a later
@@ -2302,13 +2405,22 @@ class OSD:
                 row = self._pg_stat(pg)
                 pg_stats.append(row)
                 num_objects += row["num_objects"]
+        try:
+            statfs = self.store.statfs()
+        except Exception:
+            statfs = None
         self.msgr.send_to(addr, MMgrReport(
             daemon="osd.%d" % self.whoami, epoch=self.osdmap.epoch,
             perf=self.ctx.perf.dump(), pg_states=states,
             num_pgs=len(self.pgs), num_objects=num_objects,
             pg_stats=pg_stats,
             osd_stats={"op_size_hist_bytes_pow2":
-                       list(self.op_size_hist)}),
+                       list(self.op_size_hist),
+                       # raw-capacity axis for `df` + the exporter
+                       "statfs": statfs,
+                       # clog emission counters
+                       # (ceph_tpu_log_messages_total)
+                       "log_messages": self.clog.counts_wire()}),
             entity_hint="mgr")
 
     def _handle_ping(self, conn, msg: MOSDPing) -> None:

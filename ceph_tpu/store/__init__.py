@@ -14,7 +14,8 @@ def create_store(conf, whoami: int = 0):
     if kind == "memstore":
         from .memstore import MemStore
 
-        return MemStore(path)
+        return MemStore(path,
+                        device_bytes=conf["memstore_device_bytes"])
     if kind == "kstore":
         from .kstore import KStore
         from .kv import MemKV

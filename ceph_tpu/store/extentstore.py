@@ -214,6 +214,15 @@ class ExtentStore(ObjectStore):
         self._colls = {}
         self._overlay = {}
 
+    def statfs(self) -> dict:
+        """Real device capacity vs the allocator's free-space view
+        (omap/onode KV bytes ride the DB, not the device — the same
+        split BlueStore's statfs reports)."""
+        total = int(self.dev.size)
+        free = int(self.alloc.free_bytes)
+        used = max(0, total - free)
+        return {"total": total, "used": used, "available": free}
+
     def _replay_wal(self) -> None:
         """Apply committed-but-unapplied deferred writes.  Runs before
         the allocator rebuild, so a record targeting since-freed blocks

@@ -118,6 +118,11 @@ class KStore(ObjectStore):
         self.db.close()
         self._mem.umount()
 
+    def statfs(self) -> dict:
+        """The in-RAM image mirrors the KV contents exactly, so its
+        usage accounting is this store's too."""
+        return self._mem.statfs()
+
     def _load(self) -> None:
         for _k, v in self.db.iterate(_CPREF, _CPREF + b"\xff"):
             cidname, bits = denc.decode(v)

@@ -77,11 +77,14 @@ class _Collection:
 
 
 class MemStore(ObjectStore):
-    def __init__(self, path: str = ""):
+    def __init__(self, path: str = "", device_bytes: int = 1 << 30):
         super().__init__(path)
         self._colls: dict[coll_t, _Collection] = {}
         self._lock = threading.RLock()
         self._mounted = False
+        # nominal "device" size the statfs axis reports against (RAM
+        # has no real capacity edge; df still needs a denominator)
+        self.device_bytes = int(device_bytes)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -241,6 +244,24 @@ class MemStore(ObjectStore):
                     c.objects[newoid] = o
             else:
                 raise StoreError("unknown op %r" % (code,))
+
+    # -- statfs ------------------------------------------------------------
+
+    def statfs(self) -> dict:
+        """Bytes actually held (data + xattrs + omap) against the
+        nominal device size."""
+        used = 0
+        with self._lock:
+            for c in self._colls.values():
+                for o in c.objects.values():
+                    used += len(o.data) + len(o.omap_header)
+                    for k, v in o.xattrs.items():
+                        used += len(k) + len(v)
+                    for k, v in o.omap.items():
+                        used += len(k) + len(v)
+        total = max(self.device_bytes, used)
+        return {"total": total, "used": used,
+                "available": total - used}
 
     # -- reads -------------------------------------------------------------
 

@@ -299,6 +299,13 @@ class ObjectStore:
     def apply_transaction(self, tx: Transaction) -> None:
         self.queue_transactions([tx])
 
+    def statfs(self) -> dict:
+        """Raw-capacity view {"total", "used", "available"} in bytes
+        (store_statfs_t): the per-OSD axis `df` renders and MMgrReport
+        ships.  RAM engines report against a nominal device size;
+        ExtentStore reports its real block device + allocator state."""
+        raise NotImplementedError
+
     # reads
     def exists(self, cid: coll_t, oid: hobject_t) -> bool:
         raise NotImplementedError

@@ -213,6 +213,18 @@ class LocalCluster:
         """Hard-stop osd.i, keeping its store (the "disk")."""
         await self.osds[i].shutdown()
 
+    async def crash_osd(self, i: int,
+                        message: str = "injected crash") -> str | None:
+        """Crash osd.i on an injected exception: the daemon writes a
+        crash report (stack + LogRing tail) into its OWN store, then
+        hard-stops — the post-mortem flow the mon's crash table and
+        RECENT_CRASH exist for.  Returns the crash_id (the report
+        ships on the next boot from the surviving store)."""
+        osd = self.osds[i]
+        cid = osd.simulate_crash(RuntimeError(message))
+        await osd.shutdown()
+        return cid
+
     async def revive_osd(self, i: int, timeout: float = 20.0,
                          wipe: bool = False) -> OSD:
         """Restart osd.i on its surviving store with a fresh
@@ -331,7 +343,11 @@ class LocalCluster:
         trackers = []
         if self.client is not None:
             trackers.append(self.client.optracker)
-        trackers += [o.optracker for o in self.live_osds]
+        # dead daemons contribute too (their historic rings survive
+        # the stop — the diagnostics bundle merges a crashed
+        # daemon's slice of the span); offsets default to 0 for
+        # daemons no longer exchanging frames
+        trackers += [o.optracker for o in self.osds if o is not None]
         trackers += [m.optracker for m in self.mons]
         for tr in trackers:
             for rec in tr.find(trace):
@@ -354,6 +370,74 @@ class LocalCluster:
         for osd in self.live_osds:
             out.extend(op.dump()
                        for op in osd.optracker.slow_in_flight())
+        return out
+
+    def collect_diagnostics(self, traces: list | None = None) -> dict:
+        """The one-call diagnostics bundle: per-daemon perf dumps,
+        in-flight/historic ops, LogRing tails (INCLUDING dead
+        daemons' — the post-mortem context a crash would otherwise
+        take with it), mon health/log/crash state, the pgmap digest,
+        and merged cross-daemon op timelines — one JSON-able artifact
+        to attach to any bug.  ``traces`` picks the op timelines to
+        merge; by default the client's most recent historic ops."""
+        import time as _t
+
+        from ..utils.crash import pending_crashes, ring_tail
+
+        out: dict = {"generated_at": _t.time(), "seed": self.seed,
+                     "daemons": {}, "mons": {}}
+        for osd in self.osds:
+            if osd is None:
+                continue
+            name = "osd.%d" % osd.whoami
+            d: dict = {"alive": not osd.stopping,
+                       "epoch": osd.osdmap.epoch if osd.osdmap else 0,
+                       "perf": osd.ctx.perf.dump(),
+                       "ops_in_flight":
+                           osd.optracker.dump_ops_in_flight(),
+                       "historic_slow_ops":
+                           osd.optracker.dump_historic_slow_ops(),
+                       "ring_tail": ring_tail(osd.ctx.log.ring, 200),
+                       "clog_pending": osd.clog.num_pending,
+                       "clog_counts": dict(osd.clog.counts)}
+            try:
+                d["statfs"] = osd.store.statfs()
+                d["pending_crash_reports"] = [
+                    r.get("crash_id")
+                    for r in pending_crashes(osd.store)]
+            except Exception:
+                pass
+            out["daemons"][name] = d
+        for m in self.mons:
+            health = m.health_mon.command("health", {})
+            out["mons"][m.name] = {
+                "leader": m.is_leader(),
+                "epoch": m.osdmap.epoch,
+                "health": health,
+                "log_last": m.log_mon.entries[-100:],
+                "crashes": [m.crash_mon._summary(r)
+                            for r in m.crash_mon.reports.values()],
+                "ring_tail": ring_tail(m.ctx.log.ring, 100)}
+        if self.mgr is not None:
+            out["mgr"] = {
+                "daemons_reporting": sorted(
+                    self.mgr.daemon_reports),
+                "digests_sent": self.mgr.digests_sent,
+                "clog_pending": self.mgr.clog.num_pending}
+        out["pgmap_digest"] = self.digest()
+        out["stuck_ops"] = self.stuck_ops()
+        out["clock_offsets"] = self.clock_offsets()
+        if self.client is not None:
+            out["client"] = {
+                "epoch": self.client.osdmap.epoch,
+                "ops_in_flight":
+                    self.client.optracker.dump_ops_in_flight()}
+            if traces is None:
+                traces = [r.trace
+                          for r in self.client.optracker.historic[-3:]
+                          if r.trace]
+        out["op_timelines"] = {t: self.op_timeline(t)
+                               for t in (traces or [])}
         return out
 
     async def wait_health(self, pool_id: int,

@@ -135,17 +135,27 @@ class ClusterThrasher:
                          workload must complete on the host codec /
                          scalar-mapper paths with zero lost acked
                          writes, DEVICE_FALLBACK must raise, and the
-                         probe loop must heal it (warning clears).
+                         probe loop must heal it (warning clears);
+      osd_crash        — crash an OSD on an injected exception: the
+                         report must survive in its store, surface in
+                         the committed `crash ls` after revive, raise
+                         RECENT_CRASH, and clear via `crash archive`.
 
     Slow-op oracle: after every round's health check, no live OSD may
     still hold an op in flight past osd_op_complaint_time — a healthy
     cluster with a stuck op means a requeue edge was lost somewhere.
+
+    Event-plane oracles: every healthy round must end with ZERO
+    un-archived crash reports in the committed table and no ERR-level
+    entries in the committed cluster log (any ERR is an unexplained
+    failure); kill/revive rounds must leave the victim's
+    marked-down -> boot clog sequence committed in order.
     """
 
     ALL_ACTIONS = ("kill_revive", "kill_wipe_revive", "out_in",
                    "mon_partition", "map_churn", "pg_num_grow",
                    "pgp_num_grow", "ec_profile_swap",
-                   "device_fallback")
+                   "device_fallback", "osd_crash")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -185,7 +195,8 @@ class ClusterThrasher:
         return acts
 
     def _plan_one(self, action: str) -> tuple:
-        if action in ("kill_revive", "kill_wipe_revive"):
+        if action in ("kill_revive", "kill_wipe_revive",
+                      "osd_crash"):
             return (action, self.rng.randrange(self.cluster.n_osds))
         if action == "out_in":
             return (action, self.rng.randrange(self.cluster.n_osds))
@@ -233,6 +244,28 @@ class ClusterThrasher:
             await c.revive_osd(victim,
                                wipe=(action == "kill_wipe_revive"))
             await c.wait_osd_up(victim)
+            # the event plane must record the round: a committed
+            # marked-down entry for the victim, then a boot entry
+            # AFTER it — the same sequence on every mon, since both
+            # are paxos-committed (deterministic modulo stamps)
+            await self._wait_clog_down_boot(c, victim)
+        elif action == "osd_crash":
+            victim = arg
+            cid = await c.crash_osd(
+                victim, "thrash: injected crash on osd.%d" % victim)
+            assert cid is not None, "crash report was not recorded"
+            await c.wait_osd_down(victim)
+            await asyncio.sleep(self.hold)      # degraded writes
+            await c.revive_osd(victim)
+            await c.wait_osd_up(victim)
+            # the report survives the daemon (store-persisted),
+            # reaches the committed table, raises RECENT_CRASH, and
+            # clears via archive
+            await self._wait_crash_listed(c, cid)
+            await self._wait_health_check(c, "RECENT_CRASH", True)
+            await c.client.mon_command("crash archive", id=cid)
+            await self._wait_health_check(c, "RECENT_CRASH", False)
+            await self._wait_clog_down_boot(c, victim)
         elif action == "out_in":
             victim = arg
             await c.mark_out(victim)
@@ -341,6 +374,48 @@ class ClusterThrasher:
             raise ValueError(action)
 
     @staticmethod
+    async def _wait_crash_listed(c, crash_id: str,
+                                 timeout: float = 30.0) -> None:
+        """Poll until the crash report is in the COMMITTED table of
+        the leading mon (shipped from the revived daemon's store and
+        paxos-committed)."""
+        from ..utils.backoff import wait_for
+
+        def pred():
+            leader = c.leader()
+            return (leader is not None
+                    and crash_id in leader.crash_mon.reports)
+
+        await wait_for(pred, timeout,
+                       what="crash %s in committed table" % crash_id)
+
+    @staticmethod
+    async def _wait_clog_down_boot(c, victim: int,
+                                   timeout: float = 30.0) -> None:
+        """The committed cluster log must show the victim's
+        marked-down entry followed by its boot entry (the expected
+        event sequence of a kill/revive round, identical on every mon
+        because both entries are paxos-committed)."""
+        from ..utils.backoff import wait_for
+
+        def pred():
+            leader = c.leader()
+            if leader is None:
+                return False
+            down_i = boot_i = -1
+            for i, e in enumerate(leader.log_mon.entries):
+                msg = e.get("message", "")
+                if "osd.%d marked down" % victim in msg:
+                    down_i = i
+                elif "osd.%d boot" % victim in msg:
+                    boot_i = i
+            return 0 <= down_i < boot_i
+
+        await wait_for(pred, timeout,
+                       what="clog down->boot sequence for osd.%d"
+                            % victim)
+
+    @staticmethod
     async def _wait_health_check(c, check: str, present: bool,
                                  timeout: float = 30.0) -> None:
         """Poll the leading monitor's health checks until `check` is
@@ -377,6 +452,25 @@ class ClusterThrasher:
                 "cluster went healthy: %r"
                 % [(s["daemon"], s["desc"], round(s["age"], 1))
                    for s in stuck[:5]])
+        # event-plane oracles: a healthy round ends with ZERO
+        # un-archived crash reports in the committed table (a crash
+        # round archives its own before getting here) and no
+        # ERR-level clog entries — the framework reserves ERR for
+        # genuinely unexplained failures, so any ERR is a bug
+        leader = c.leader()
+        if leader is not None and hasattr(leader, "crash_mon"):
+            pending = [r.get("crash_id")
+                       for r in leader.crash_mon.unarchived()]
+            assert not pending, (
+                "healthy round ended with un-archived crash "
+                "reports: %r" % pending)
+            errs = [e for e in leader.log_mon.entries
+                    if e.get("level") == "ERR"]
+            assert not errs, (
+                "unexplained ERR-level cluster log entries after a "
+                "healthy round: %r"
+                % [(e.get("who"), e.get("message"))
+                   for e in errs[:5]])
         # stats-plane oracle (clusters running a mgr): the PGMap
         # digest — OSD stat rows -> mgr -> mon, never internal state —
         # must drain its degraded + misplaced counts to EXACTLY zero

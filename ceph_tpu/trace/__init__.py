@@ -9,6 +9,7 @@ path (client -> mClock queue -> PG -> replicated/EC sub-ops -> device
 EC batch -> commit) is reconstructable after the fact.
 """
 
+from .logclient import LogClient
 from .optracker import OpTracker, TrackedOp
 
-__all__ = ["OpTracker", "TrackedOp"]
+__all__ = ["LogClient", "OpTracker", "TrackedOp"]

@@ -314,4 +314,14 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("mgr_stats_stale_after", OPT_FLOAT, 15.0,
            "per-PG stat rows older than this are dropped from the"
            " PGMap (a dead primary's last report must age out)"),
+    Option("mon_crash_warn_age", OPT_FLOAT, 14 * 24 * 3600.0,
+           "un-archived crash reports newer than this raise the"
+           " RECENT_CRASH health warning (mgr/crash warn_recent_"
+           "interval role)"),
+    Option("memstore_device_bytes", OPT_INT, 1 << 30,
+           "nominal device size RAM stores report in statfs (the"
+           " df raw-capacity denominator)"),
+    Option("osd_crash_ring_tail", OPT_INT, 100,
+           "LogRing entries captured into a crash report (the"
+           " post-mortem high-verbosity context)"),
 ]

@@ -1,0 +1,492 @@
+"""Cluster event plane: LogClient -> LogMonitor clog pipeline, crash
+telemetry (store-persisted reports -> paxos-committed crash table ->
+RECENT_CRASH), statfs raw-capacity `df` axis, exporter counters, and
+the one-call diagnostics bundle.
+
+The commit shape under test is the PR-3/PR-4 one: every operator-
+visible event is paxos-committed, so `log last` and `crash ls` are
+identical on every monitor and survive leader elections — a freshly
+elected leader that never heard a beacon, digest, MLog, or crash
+report still serves the full picture.
+"""
+
+import asyncio
+
+from ceph_tpu.testing import ClusterThrasher, LocalCluster, Workload
+from ceph_tpu.utils.backoff import wait_for
+from ceph_tpu.utils.context import Context
+
+
+def run(coro, timeout=240):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def _survivor_leader(c, excl):
+    """The active leader among mons other than `excl` (a partitioned
+    ex-leader keeps claiming leadership until its lease lapses, so
+    the structural c.leader() would still return it)."""
+    for m in c.mons:
+        if m is not excl and m.is_leader() and m.mpaxos.active:
+            return m
+    return None
+
+
+def _log_tail(mon, n=300):
+    """The committed log as comparable tuples (modulo stamps)."""
+    return [(e.get("who"), e.get("channel", "cluster"),
+             e.get("level"), e.get("message"))
+            for e in mon.log_mon.entries[-n:]]
+
+
+# -- LogClient unit: lint, batching, acks -----------------------------------
+
+
+def test_logclient_lint_ack_and_counts():
+    from ceph_tpu.trace.logclient import LogClient
+
+    sent = []
+    clog = LogClient(Context("t"), "osd.7",
+                     send_fn=lambda m: sent.append(m))
+    # the emit lint: unregistered channel / severity raise at the
+    # call site
+    import pytest
+    with pytest.raises(ValueError):
+        clog.queue("WRN", "x", channel="syslog")
+    with pytest.raises(ValueError):
+        clog.queue("WARNING", "x")
+    clog.warn("first")
+    clog.info("second", channel="audit")
+    assert [e["seq"] for e in sent[-1].entries] == [1, 2]
+    assert clog.num_pending == 2
+    assert clog.counts["WRN"] == 1 and clog.counts["INF"] == 1
+    # a foreign ack is ignored; ours retires entries up to `last`
+    clog.handle_ack("osd.8", 99)
+    assert clog.num_pending == 2
+    clog.handle_ack("osd.7", 1)
+    assert [e["seq"] for e in clog.pending] == [2]
+    # re-flush resends only the unacked tail
+    clog.flush()
+    assert [e["seq"] for e in sent[-1].entries] == [2]
+    assert clog.counts_wire() == {"WRN": 1, "INF": 1}
+
+
+# -- crash report store round trip (unit) -----------------------------------
+
+
+def test_crash_report_store_roundtrip():
+    from ceph_tpu.store.memstore import MemStore
+    from ceph_tpu.utils import crash as crashmod
+
+    store = MemStore()
+    store.mount()
+    ctx = Context("t")
+    ctx.log.debug("osd", "pre-crash context line", level=5)
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        rep = crashmod.build_report("osd.3", e, fsid="f", epoch=9,
+                                    ring=ctx.log.ring)
+    assert rep["exc_type"] == "RuntimeError"
+    assert any("boom" in ln for ln in rep["backtrace"])
+    assert any("pre-crash context line" in ln
+               for ln in rep["ring_tail"])
+    assert rep["entity"] == "osd.3" and rep["epoch"] == 9
+    crashmod.save_crash(store, rep)
+    # a second report beside it
+    try:
+        raise ValueError("second")
+    except ValueError as e:
+        rep2 = crashmod.build_report("osd.3", e)
+    crashmod.save_crash(store, rep2)
+    got = crashmod.pending_crashes(store)
+    assert {r["crash_id"] for r in got} == {rep["crash_id"],
+                                            rep2["crash_id"]}
+    crashmod.remove_crash(store, rep["crash_id"])
+    got = crashmod.pending_crashes(store)
+    assert [r["crash_id"] for r in got] == [rep2["crash_id"]]
+
+
+# -- clog pipeline: daemon emit -> paxos commit -> log last -----------------
+
+
+def test_clog_pipeline_commit_ack_and_audit():
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            await c.create_pool("evt", pg_num=4)
+            mon = c.mons[0]
+            # pool create left both a cluster event and an audit
+            # entry (command provenance)
+            out = await c.client.mon_command("log last", n=50)
+            assert any("pool 'evt' created" in e["message"]
+                       for e in out["lines"]), out
+            out = await c.client.mon_command("log last", n=50,
+                                             channel="audit")
+            assert any("osd pool create" in e["message"]
+                       for e in out["lines"]), out
+            # daemon-origin entry: osd clog -> MLog -> paxos commit
+            c.osds[1].clog.warn("thermal event on osd.1")
+            await wait_for(
+                lambda: any(e.get("message")
+                            == "thermal event on osd.1"
+                            for e in mon.log_mon.entries),
+                15, what="osd clog entry committed")
+            entry = next(e for e in mon.log_mon.entries
+                         if e["message"] == "thermal event on osd.1")
+            assert entry["who"] == "osd.1"
+            assert entry["level"] == "WRN"
+            assert entry["seq"] >= 1
+            # the commit was acked back: nothing left pending
+            await wait_for(lambda: c.osds[1].clog.num_pending == 0,
+                           15, what="clog entries acked")
+            # severity filter on the command surface
+            out = await c.client.mon_command("log last", n=50,
+                                             level="WRN")
+            assert all(e["level"] == "WRN" for e in out["lines"])
+            assert any("thermal event" in e["message"]
+                       for e in out["lines"])
+            # resend after the ack commits nothing twice (the
+            # (who, seq) dedup): force a duplicate flush
+            n_before = len([e for e in mon.log_mon.entries
+                            if e["message"]
+                            == "thermal event on osd.1"])
+            c.osds[1].clog.pending = [dict(entry)]
+            c.osds[1].clog.flush()
+            c.osds[1].clog.pending = []
+            await asyncio.sleep(0.3)
+            n_after = len([e for e in mon.log_mon.entries
+                           if e["message"]
+                           == "thermal event on osd.1"])
+            assert n_after == n_before == 1
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_clog_identical_across_mons_and_elections():
+    """The ordering contract: an entry committed on the leader is
+    served by `log last` on a peer AND on a freshly elected leader —
+    the whole committed sequence is identical on every monitor."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, n_mons=3, seed=11).start()
+        try:
+            await c.create_pool("evt", pg_num=4)
+            c.osds[2].clog.warn("entry-one from osd.2")
+            await wait_for(
+                lambda: all(any(e.get("message")
+                                == "entry-one from osd.2"
+                                for e in m.log_mon.entries)
+                            for m in c.mons),
+                20, what="entry committed on every mon")
+            old = c.leader()
+            c.partition_mon(old.rank)
+            await wait_for(
+                lambda: _survivor_leader(c, old) is not None,
+                30, what="fresh leader elected")
+            fresh = _survivor_leader(c, old)
+            # the fresh leader serves the pre-election entry...
+            assert any(e.get("message") == "entry-one from osd.2"
+                       for e in fresh.log_mon.entries)
+            # ...and commits new ones while the ex-leader is dark
+            c.osds[2].clog.info("entry-two after election")
+            await wait_for(
+                lambda: any(e.get("message")
+                            == "entry-two after election"
+                            for e in fresh.log_mon.entries),
+                20, what="post-election entry committed")
+            await wait_for(lambda: c.osds[2].clog.num_pending == 0,
+                           20, what="post-election entry acked")
+            c.heal_mon(old.rank)
+            await wait_for(
+                lambda: all(any(e.get("message")
+                                == "entry-two after election"
+                                for e in m.log_mon.entries)
+                            for m in c.mons),
+                30, what="healed mon caught up")
+            tails = [_log_tail(m) for m in c.mons]
+            assert tails[0] == tails[1] == tails[2], (
+                [len(t) for t in tails])
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- crash telemetry round trip ---------------------------------------------
+
+
+def test_crash_roundtrip_recent_crash_and_archive():
+    """Injected exception -> report in the daemon's store -> survives
+    the daemon restart -> committed `crash ls` -> RECENT_CRASH ->
+    `crash archive` clears it and the ack empties the store."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("evt", pg_num=4)
+            await c.wait_health(pid)
+            from ceph_tpu.utils.crash import pending_crashes
+            store = c.osds[0].store
+            cid = await c.crash_osd(0, "injected thermal runaway")
+            assert cid is not None
+            # the report survives in the dead daemon's store
+            assert [r["crash_id"]
+                    for r in pending_crashes(store)] == [cid]
+            await c.wait_osd_down(0)
+            await c.revive_osd(0)
+            await c.wait_osd_up(0)
+            mon = c.mons[0]
+            await wait_for(lambda: cid in mon.crash_mon.reports,
+                           20, what="crash report committed")
+            # surfaces: crash ls / crash info / health / clog
+            out = await c.client.mon_command("crash ls")
+            assert [r["crash_id"] for r in out["crashes"]] == [cid]
+            assert out["crashes"][0]["entity"] == "osd.0"
+            info = await c.client.mon_command("crash info", id=cid)
+            assert info["exc_type"] == "RuntimeError"
+            assert any("injected thermal runaway" in ln
+                       for ln in info["backtrace"])
+            assert info["ring_tail"], "LogRing tail missing"
+            health = await c.client.mon_command("health")
+            assert "RECENT_CRASH" in health["checks"], health
+            log = await c.client.mon_command("log last", n=50)
+            assert any("daemon osd.0 crashed" in e["message"]
+                       for e in log["lines"])
+            # the committed-table ack cleared the daemon's store copy
+            await wait_for(
+                lambda: not pending_crashes(c.osds[0].store),
+                20, what="store copy acked away")
+            # archive clears the warning (and ls-new)
+            await c.client.mon_command("crash archive", id=cid)
+            await wait_for(
+                lambda: "RECENT_CRASH"
+                not in mon.health_mon.checks(),
+                15, what="RECENT_CRASH cleared")
+            out = await c.client.mon_command("crash ls-new")
+            assert out["crashes"] == []
+            out = await c.client.mon_command("crash ls")
+            assert out["crashes"][0]["archived"] is True
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- statfs / df raw-capacity axis ------------------------------------------
+
+
+def test_statfs_memstore_and_extentstore():
+    from ceph_tpu.store.extentstore import ExtentStore
+    from ceph_tpu.store.memstore import MemStore
+    from ceph_tpu.store.objectstore import (Transaction, coll_t,
+                                            hobject_t)
+
+    for store in (MemStore(device_bytes=1 << 20), ExtentStore()):
+        store.mount()
+        sf0 = store.statfs()
+        assert sf0["total"] > 0
+        assert sf0["used"] + sf0["available"] <= sf0["total"] \
+            or sf0["used"] <= sf0["total"]
+        t = Transaction()
+        cid = coll_t.pg(1, 0)
+        t.create_collection(cid)
+        ho = hobject_t("obj")
+        t.touch(cid, ho)
+        t.write(cid, ho, 0, 8192, b"x" * 8192)
+        store.apply_transaction(t)
+        sf1 = store.statfs()
+        assert sf1["used"] >= sf0["used"] + 8192, (sf0, sf1)
+        assert sf1["total"] >= sf1["used"]
+
+
+def test_df_per_osd_capacity_axis():
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            pid = await c.create_pool("cap", pg_num=4)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("cap")
+            for i in range(16):
+                await io.write_full("o-%d" % i, b"z" * 4096)
+
+            def df_has_osds():
+                d = c.digest()
+                return d is not None and len(
+                    d.get("osd_stats") or {}) == 3
+
+            await wait_for(df_has_osds, 20,
+                           what="statfs rows in the digest")
+            out = await c.client.mon_command("df")
+            assert len(out["osds"]) == 3, out
+            for row in out["osds"]:
+                assert row["total"] > 0
+                assert row["used"] > 0, row
+                assert 0.0 <= row["util"] <= 1.0
+                assert row["available"] == row["total"] - row["used"]
+            assert out["raw_total"] == sum(r["total"]
+                                           for r in out["osds"])
+            assert out["raw_used"] > 0
+            # the CLI renders the same table
+            import argparse
+
+            from ceph_tpu.cli.rados import _run
+            ns = argparse.Namespace(
+                mon=",".join(c.mon_addrs), pool="cap", snap=None,
+                size=4096, cmd="df", args=[])
+            assert await _run(ns) == 0
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- exporter: clog counters + statfs families ------------------------------
+
+
+def test_exporter_event_plane_families():
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            pid = await c.create_pool("exp", pg_num=4)
+            await c.wait_health(pid)
+            c.osds[0].clog.warn("exporter probe")
+            await wait_for(lambda: c.osds[0].clog.num_pending == 0,
+                           15, what="clog acked")
+
+            def counters_reported():
+                now = asyncio.get_event_loop().time()
+                rows = c.mgr.pgmap.live_osd_stats(now)
+                return any((r.get("log_messages") or {}).get("WRN")
+                           for r in rows.values())
+
+            await wait_for(counters_reported, 20,
+                           what="clog counters reach the mgr")
+            text = c.mgr.exporter.render()
+            from ceph_tpu.utils.exporter import validate_exposition
+            assert validate_exposition(text) == []
+            assert 'ceph_tpu_log_messages_total{daemon="osd.0"' \
+                in text
+            assert 'level="WRN"' in text
+            assert "ceph_tpu_osd_statfs_total_bytes" in text
+            assert "ceph_tpu_osd_statfs_used_bytes" in text
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- thrasher: osd_crash action + event-plane oracles -----------------------
+
+
+def test_thrash_osd_crash_action():
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=23).start()
+        try:
+            pid = await c.create_pool("thrash", pg_num=8)
+            await c.wait_health(pid)
+            wl = Workload(c.client.io_ctx("thrash"), seed=23).start()
+            th = ClusterThrasher(c, seed=23,
+                                 actions=[("osd_crash", 1),
+                                          ("kill_wipe_revive", 2)])
+            await th.run(pid, wl)
+            await wl.stop()
+            # the round archived its own crash; the oracles held
+            leader = c.leader()
+            assert leader.crash_mon.reports, "crash never committed"
+            assert not leader.crash_mon.unarchived()
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- acceptance: the end-to-end crash drill ---------------------------------
+
+
+def test_crash_drill_end_to_end():
+    """ISSUE 5 acceptance: crash an OSD mid-round with an injected
+    exception; after revive the report appears in `crash ls` on a
+    FRESHLY ELECTED leader (paxos-committed), RECENT_CRASH raises and
+    clears via `crash archive`, `log last` shows the identical
+    committed event sequence on every mon, and the diagnostics bundle
+    contains the dead daemon's ring tail plus the merged op
+    timeline."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, n_mons=3, with_mgr=True,
+                               seed=42).start()
+        try:
+            pid = await c.create_pool("drill", pg_num=8)
+            await c.wait_health(pid)
+            wl = Workload(c.client.io_ctx("drill"), seed=42).start()
+            await asyncio.sleep(0.5)        # writes in flight
+            cid = await c.crash_osd(1, "drill: injected crash")
+            assert cid is not None
+            await c.wait_osd_down(1)
+            # the diagnostics bundle, collected while the daemon is
+            # DEAD: its ring tail and its slice of the op timelines
+            # are still there
+            diag = c.collect_diagnostics()
+            dead = diag["daemons"]["osd.1"]
+            assert dead["alive"] is False
+            assert dead["ring_tail"], "dead daemon's ring tail lost"
+            assert cid in dead["pending_crash_reports"]
+            assert diag["op_timelines"], "no merged op timelines"
+            spans = [{r["daemon"] for r in tl}
+                     for tl in diag["op_timelines"].values()]
+            assert any(len(s) >= 2 for s in spans), spans
+            assert any("client.0" in s for s in spans), spans
+            # revive: the report ships from the surviving store and
+            # commits
+            await c.revive_osd(1)
+            await c.wait_osd_up(1)
+            await wait_for(
+                lambda: (c.leader() is not None
+                         and cid in c.leader().crash_mon.reports),
+                30, what="crash report committed")
+            # quiesce the workload and reconverge BEFORE the election
+            # churn: every acked write must read back byte-identical
+            await wl.stop()
+            await c.wait_health(pid, timeout=120.0)
+            await wl.verify()
+            # fresh leader: partition the current one — the NEW
+            # leader must already hold the crash table and raise
+            # RECENT_CRASH (no beacon/report replay needed)
+            old = c.leader()
+            c.partition_mon(old.rank)
+            await wait_for(
+                lambda: _survivor_leader(c, old) is not None,
+                30, what="fresh leader elected")
+            fresh = _survivor_leader(c, old)
+            out = fresh.crash_mon.command("crash ls", {})
+            assert cid in [r["crash_id"] for r in out["crashes"]]
+            assert "RECENT_CRASH" in fresh.health_mon.checks()
+            c.heal_mon(old.rank)
+            await c.wait_quorum()
+            # archive clears the warning cluster-wide
+            await c.client.mon_command("crash archive", id=cid,
+                                       timeout=30.0)
+            await wait_for(
+                lambda: (c.leader() is not None
+                         and "RECENT_CRASH"
+                         not in c.leader().health_mon.checks()),
+                20, what="RECENT_CRASH cleared")
+            # identical committed event sequence on every mon (the
+            # healed ex-leader caught up through paxos)
+            def converged():
+                tails = [_log_tail(m) for m in c.mons]
+                return tails[0] == tails[1] == tails[2]
+
+            await wait_for(converged, 30,
+                           what="log converged on all mons")
+            crash_entries = [e for e in
+                             c.mons[0].log_mon.entries
+                             if "daemon osd.1 crashed"
+                             in e.get("message", "")]
+            assert len(crash_entries) == 1, crash_entries
+        finally:
+            await c.stop()
+
+    run(main())

@@ -493,3 +493,53 @@ def test_op_timeline_normalizes_skewed_clocks():
             await c.stop()
 
     run(main())
+
+
+def test_op_timeline_tracks_drifting_clock():
+    """PR-4 gap, closed: the old estimator took a pure max over frame
+    stamps, so a daemon whose clock DRIFTS back down stayed pinned at
+    its stale high-water mark forever.  The EWMA decay must follow
+    the drift: after osd.0's skew falls from +6s to +1s, continued
+    traffic re-converges the estimate and the merged timeline
+    collapses back to real time."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("drift", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("drift")
+            c.set_clock_skew("osd.0", 6.0)
+            for i in range(10):
+                await io.write_full("a-%d" % i, b"z" * 256)
+            await asyncio.sleep(0.3)
+            off = c.clock_offsets().get("osd.0")
+            assert off is not None and abs(off - 6.0) < 0.5, off
+            # the clock drifts back down: a pure max would stay at 6
+            # forever; the EWMA follows as frames keep flowing
+            c.set_clock_skew("osd.0", 1.0)
+            converged = False
+            for i in range(800):
+                await io.write_full("b-%d" % (i % 16), b"z" * 256)
+                off = c.clock_offsets().get("osd.0", 99.0)
+                if abs(off - 1.0) < 0.2:
+                    converged = True
+                    break
+            assert converged, "offset stuck at %r after drift" % off
+            # a post-drift op's merged timeline is normalized with
+            # the CURRENT offset: the span collapses to real time
+            # (unnormalized — or pinned at the stale +6s max — the
+            # skew would spread it over multiple seconds)
+            for i in range(5):
+                await io.write_full("c-%d" % i, b"z" * 256)
+            await asyncio.sleep(0.3)
+            rec = [r for r in c.client.optracker.historic
+                   if r.trace][-1]
+            tl = c.op_timeline(rec.trace)
+            t0 = tl[0]["initiated"]
+            span = max(e["t"] for r in tl for e in r["events"]) - t0
+            assert span < 1.0, span
+        finally:
+            await c.stop()
+
+    run(main())
