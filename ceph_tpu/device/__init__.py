@@ -7,12 +7,13 @@ compile cache, pooled staging buffers, weighted admission
 backpressure, and device-loss fallback to the host paths.
 """
 
-from .runtime import (BufferPool, DeviceBusy, DeviceLost,
-                      DeviceRuntime, DispatchQueue, DispatchTicket,
-                      K_CLIENT_EC, K_MAPPING, K_RECOVERY_EC)
+from .runtime import (BufferPool, ChipRuntime, DeviceBusy,
+                      DeviceLost, DeviceRuntime, DispatchQueue,
+                      DispatchTicket, K_CLIENT_EC, K_MAPPING,
+                      K_RECOVERY_EC)
 
 __all__ = [
-    "BufferPool", "DeviceBusy", "DeviceLost", "DeviceRuntime",
-    "DispatchQueue", "DispatchTicket",
+    "BufferPool", "ChipRuntime", "DeviceBusy", "DeviceLost",
+    "DeviceRuntime", "DispatchQueue", "DispatchTicket",
     "K_CLIENT_EC", "K_MAPPING", "K_RECOVERY_EC",
 ]

@@ -1,5 +1,6 @@
 """Per-process TPU device runtime: the shared substrate under both
-accelerator hot paths (batched EC matmuls and bulk CRUSH mapping).
+accelerator hot paths (batched EC matmuls and bulk CRUSH mapping),
+now mesh-aware.
 
 Why a runtime at all (PAPERS: Ragged Paged Attention 2604.15464 for the
 shape-bucket recipe; "GPUs as Storage System Accelerators" 1202.3669
@@ -7,33 +8,50 @@ for admission control): until this layer existed each hot path talked
 to JAX ad hoc — every novel batch width recompiled, staging buffers
 were allocated per flush, and nothing bounded device queue depth, so a
 mapping storm could starve EC writes.  The runtime centralises four
-concerns:
+concerns, each now **per chip** (mesh discipline from "Large Scale
+Distributed Linear Algebra With TPUs", 2112.09017):
 
 * **shape-bucketed compile cache** — batches pad to power-of-two
   word-count buckets so steady state hits a handful of jitted
   programs; `note_program` is the compile counter the acceptance
   criteria assert against, and `warmup_ec` pre-compiles the common
-  buckets at OSD boot.
+  buckets at OSD boot.  Each chip accounts its own programs (a real
+  mesh compiles per chip).
 * **HBM staging pool** — bucket-sized arrays leased/released across
-  flushes instead of allocated per flush (`BufferPool`).
+  flushes instead of allocated per flush (`BufferPool`), one pool per
+  chip.
 * **dispatch queue with admission backpressure** — bounded in-flight
   dispatches, weighted-fair across service classes (client-EC /
   recovery-EC / mapping — the weights mirror the mClock op-scheduler
   profile, osd/scheduler.py DEVICE_DISPATCH_WEIGHTS); queue-full
   surfaces as `DeviceBusy` so callers degrade to deadline-flush or
-  the host path instead of piling device work.
-* **device-loss degradation** — a failed/poisoned dispatch flips the
-  runtime to fallback (`available` False: the EC batcher encodes on
-  the host codecs, PoolMapping takes the scalar mapper), OSD beacons
-  carry the flag so the mon raises DEVICE_FALLBACK, and a probe loop
-  retries under ExpBackoff until the device heals.
+  the host path instead of piling device work.  One queue per chip,
+  so one OSD's storm cannot starve a co-located OSD on another chip.
+* **device-loss degradation** — a failed/poisoned dispatch flips
+  *its chip* to fallback: only the OSDs whose affinity lands on that
+  chip degrade to host paths (and beacon it, so the mon's
+  DEVICE_FALLBACK detail names the chip), while the rest of the mesh
+  keeps serving on-device.  A per-chip probe loop retries under
+  ExpBackoff until the chip heals.
 
-Every dispatch carries a `DispatchTicket` (class, bucket, bytes,
+The mesh is enumerated once per runtime (ceph_tpu.device.mesh): real
+chips on a TPU host, ``CEPH_TPU_MESH_CHIPS`` logical chips on CPU CI
+(or a real forced count under
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``).  OSDs take
+``chip_for(osd_id)`` affinity; oversized flushes shard column-wise
+across every available chip (``shard_plan``) — the proven
+collective-free split — and reassemble bit-identically.
+
+Every dispatch carries a `DispatchTicket` (chip, class, bucket, bytes,
 enqueue/launch/done stamps) that feeds the exporter
 (`device_dispatch_seconds`, `device_queue_depth`,
-`device_bucket_hit_ratio`) and gives the OpTracker exact per-op flush
-attribution (the ticket IS the op's device-dispatch stage — no more
-sampling the batcher's last flush time).
+`device_bucket_hit_ratio`, all labeled by ``chip``) and gives the
+OpTracker exact per-op flush attribution.
+
+Back-compat: the single-chip API (``DeviceRuntime.poison/heal/
+inject_fault``, aggregate counters, ``pool``/``queue`` views) still
+works — on a 1-chip mesh (plain CPU CI) behavior is identical to the
+pre-mesh runtime.
 """
 
 from __future__ import annotations
@@ -43,6 +61,8 @@ import heapq
 import time
 
 import numpy as np
+
+from . import mesh
 
 # service classes (the device-side analog of the mClock op classes)
 K_CLIENT_EC = "client-ec"
@@ -58,7 +78,7 @@ class DeviceBusy(Exception):
 
 class DeviceLost(Exception):
     """A dispatch failed at the device layer (or a fault was
-    injected): the runtime flips to host fallback."""
+    injected): the chip flips to host fallback."""
 
 
 class DispatchTicket:
@@ -67,16 +87,20 @@ class DispatchTicket:
     Stamps: t_enqueue (admission requested) -> t_admit (queue granted)
     -> t_launch (dispatch handed to the device) -> t_done.  queue_wait
     and device_s are the two stages the exporter and the OpTracker
-    attribute separately."""
+    attribute separately.  `chip` names the mesh chip the dispatch ran
+    on (the exporter's chip label)."""
 
-    __slots__ = ("seq", "klass", "bucket", "nbytes", "t_enqueue",
-                 "t_admit", "t_launch", "t_done", "ok", "error")
+    __slots__ = ("seq", "klass", "bucket", "nbytes", "chip",
+                 "t_enqueue", "t_admit", "t_launch", "t_done", "ok",
+                 "error")
 
-    def __init__(self, seq: int, klass: str, bucket: int, nbytes: int):
+    def __init__(self, seq: int, klass: str, bucket: int, nbytes: int,
+                 chip: int = 0):
         self.seq = seq
         self.klass = klass
         self.bucket = bucket
         self.nbytes = nbytes
+        self.chip = chip
         self.t_enqueue = time.monotonic()
         self.t_admit = 0.0
         self.t_launch = 0.0
@@ -99,7 +123,7 @@ class DispatchTicket:
     def dump(self) -> dict:
         return {"seq": self.seq, "klass": self.klass,
                 "bucket": self.bucket, "bytes": self.nbytes,
-                "queue_wait": self.queue_wait,
+                "chip": self.chip, "queue_wait": self.queue_wait,
                 "device_s": self.device_s, "ok": self.ok,
                 "error": self.error}
 
@@ -229,28 +253,34 @@ _MIN_BUCKET = 512          # words: floor so tiny flushes share one program
 _TICKET_RING = 512
 _HIST_BUCKETS = 32         # power-of-two microsecond histogram
 
+# words at/above which a flush shards across the mesh's available
+# chips (the zero-collective stripe-axis split); conf
+# device_shard_min_words overrides via configure()
+_SHARD_MIN_WORDS = 1 << 19
 
-class DeviceRuntime:
-    """One per process (per event loop, with a loop-less fallback for
-    synchronous callers such as the bulk mapper warming outside
-    asyncio).  Both hot paths route dispatches through here."""
 
-    _global: "DeviceRuntime | None" = None
+class ChipRuntime:
+    """One mesh chip's isolation domain: its own DispatchQueue,
+    BufferPool, compile-cache accounting, ticket ring and
+    fallback/poison state.  OSDs bind to a chip via
+    ``DeviceRuntime.chip_for`` affinity; a poisoned chip degrades only
+    its own OSDs to the host paths while the rest of the mesh keeps
+    serving on-device."""
 
-    def __init__(self, weights: dict[str, float] | None = None,
-                 max_inflight: int = 2, max_queue: int = 64):
-        if weights is None:
-            from ..osd.scheduler import DEVICE_DISPATCH_WEIGHTS
-            weights = DEVICE_DISPATCH_WEIGHTS
+    def __init__(self, rt: "DeviceRuntime", index: int,
+                 weights: dict[str, float], max_inflight: int,
+                 max_queue: int):
+        self.rt = rt
+        self.index = int(index)
         self.queue = DispatchQueue(weights, max_inflight, max_queue)
         self.pool = BufferPool()
         # compile cache bookkeeping: program identity -> compiled once
+        # (per chip: a real mesh compiles each program per chip)
         self.programs: set[tuple] = set()
         self.compile_count = 0
         self.bucket_hits = 0
         self.bucket_misses = 0
         # dispatch telemetry
-        self._seq = 0
         self.tickets: list[DispatchTicket] = []     # bounded ring
         self.dispatch_buckets_us = [0] * _HIST_BUCKETS
         self.dispatches = 0
@@ -263,68 +293,43 @@ class DeviceRuntime:
         self.heal_count = 0
         self._fault_budget = 0         # injected failures outstanding
         self._probe_task = None
-        self._probe_base = 0.05
-        self._probe_cap = 1.0
         self._listeners: list = []     # on_state_change(fallback: bool)
+        self._jdev = None              # lazy jax device handle
+        self._jdev_resolved = False
 
-    # -- lifecycle ---------------------------------------------------------
+    # -- placement ---------------------------------------------------------
 
-    @classmethod
-    def get(cls) -> "DeviceRuntime":
-        """Loop-local instance (lifetime tracks the loop, same
-        reasoning as DeviceBatcher.get); synchronous callers with no
-        loop share a process-global instance."""
-        try:
-            loop = asyncio.get_event_loop()
-        except RuntimeError:
-            loop = None
-        if loop is None:
-            if cls._global is None:
-                cls._global = cls()
-            return cls._global
-        inst = getattr(loop, "_ceph_tpu_device_runtime", None)
-        if inst is None:
-            inst = cls()
-            loop._ceph_tpu_device_runtime = inst
-        return inst
+    @property
+    def jax_device(self):
+        """The jax device backing this chip (lazy; None when logical
+        chips share the process default device — placement is then a
+        no-op, which is the cheap path on single-device CI)."""
+        if not self._jdev_resolved:
+            self._jdev_resolved = True
+            devs = mesh.local_devices()
+            if len(devs) > 1:
+                self._jdev = devs[self.index % len(devs)]
+        return self._jdev
 
-    @classmethod
-    def reset(cls) -> "DeviceRuntime":
-        """Fresh instance bound to the current loop (tests)."""
-        inst = cls()
-        try:
-            loop = asyncio.get_event_loop()
-            loop._ceph_tpu_device_runtime = inst
-        except RuntimeError:
-            cls._global = inst
-        return inst
-
-    def configure(self, conf) -> None:
-        """Adopt daemon config (OSD boot): queue bounds + probe ramp."""
-        try:
-            self.queue.max_inflight = max(
-                1, int(conf["device_max_inflight"]))
-            self.queue.max_queue = int(conf["device_queue_len"])
-            self.probe_interval = float(conf["device_probe_interval"])
-            self._probe_base = self.probe_interval / 4.0
-            self._probe_cap = self.probe_interval
-        except (KeyError, TypeError):
-            pass
+    def place(self, arr):
+        """Commit an array to this chip's device (computation follows
+        data placement — the 2112.09017 dispatch discipline).  Returns
+        the input unchanged when the mesh shares one physical
+        device."""
+        dev = self.jax_device
+        if dev is None:
+            return arr
+        import jax
+        return jax.device_put(arr, dev)
 
     # -- shape buckets / compile cache ------------------------------------
 
-    @staticmethod
-    def bucket_for(n_words: int) -> int:
-        """Pad target: next power of two >= n, floored at _MIN_BUCKET
-        so micro-flushes share one program."""
-        n = max(int(n_words), _MIN_BUCKET)
-        return 1 << (n - 1).bit_length()
-
     def note_program(self, kind: str, key: tuple) -> bool:
         """Record a program dispatch; True when this (kind, key) had
-        never compiled before.  `compile_count` is the acceptance
-        criterion's counter: a steady-state mixed workload must stay
-        within a handful of distinct programs."""
+        never compiled on THIS chip before.  The summed
+        `compile_count` is the acceptance criterion's counter: a
+        steady-state mixed workload must stay within a handful of
+        distinct programs."""
         pk = (kind,) + tuple(key)
         if pk in self.programs:
             self.bucket_hits += 1
@@ -334,45 +339,12 @@ class DeviceRuntime:
         self.bucket_misses += 1
         return True
 
-    @property
-    def bucket_hit_ratio(self) -> float:
-        total = self.bucket_hits + self.bucket_misses
-        return self.bucket_hits / total if total else 1.0
-
-    async def warmup_ec(self, matrix, w: int,
-                        buckets: tuple = (1024, 4096, 16384)) -> None:
-        """Pre-compile the common EC buckets for one coding matrix at
-        boot so the first client flushes hit the cache instead of
-        paying a compile inside the write path."""
-        from ..ec.batcher import DeviceBatcher
-        matrix_key = tuple(tuple(r) for r in matrix)
-        k = len(matrix[0])
-        dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32}[int(w)]
-        for b in buckets:
-            if not self.available:
-                return
-            key = ("ec", matrix_key, int(w), int(b))
-            if key[0:1] + key[1:] in self.programs:
-                continue
-            try:
-                enc = DeviceBatcher._encoder(matrix_key, int(w))
-                buf = self.pool.lease((k, int(b)), dtype)
-                try:
-                    np.asarray(enc(buf))
-                finally:
-                    self.pool.release(buf)
-                self.note_program("ec", (matrix_key, int(w), int(b)))
-            except Exception as e:          # warmup must never wedge boot
-                self.poison(e)
-                return
-            await asyncio.sleep(0)          # yield between compiles
-
     # -- tickets -----------------------------------------------------------
 
     def open_ticket(self, klass: str, bucket: int,
                     nbytes: int) -> DispatchTicket:
-        self._seq += 1
-        return DispatchTicket(self._seq, klass, bucket, nbytes)
+        return DispatchTicket(self.rt.next_seq(), klass, bucket,
+                              nbytes, chip=self.index)
 
     async def admit(self, ticket: DispatchTicket,
                     cost: float | None = None) -> None:
@@ -392,11 +364,12 @@ class DeviceRuntime:
 
     def launch(self, ticket: DispatchTicket) -> None:
         """Stamp launch; consumes one injected fault if armed (the
-        deterministic device-loss hook the thrasher uses)."""
+        deterministic chip-loss hook the thrasher uses)."""
         ticket.t_launch = time.monotonic()
         if self._fault_budget > 0:
             self._fault_budget -= 1
-            raise DeviceLost("injected device fault")
+            raise DeviceLost("injected device fault (chip %d)"
+                             % self.index)
 
     def finish(self, ticket: DispatchTicket, ok: bool = True,
                error: Exception | None = None) -> None:
@@ -422,8 +395,9 @@ class DeviceRuntime:
         return not self.fallback
 
     def add_listener(self, fn) -> None:
-        """fn(fallback: bool) on every poison/heal transition (the OSD
-        uses it to beacon the state change immediately)."""
+        """fn(fallback: bool) on every poison/heal transition of THIS
+        chip (the OSD bound here uses it to beacon the state change
+        immediately)."""
         self._listeners.append(fn)
 
     def _notify(self) -> None:
@@ -434,8 +408,9 @@ class DeviceRuntime:
                 pass        # observability must never sink the runtime
 
     def poison(self, reason) -> None:
-        """Flip to host fallback; a probe loop retries the device
-        under ExpBackoff until it heals."""
+        """Flip this chip to host fallback; a probe loop retries the
+        device under ExpBackoff until it heals.  Other chips are
+        untouched — their OSDs keep serving on-device."""
         if self.fallback:
             return
         self.fallback = True
@@ -458,27 +433,31 @@ class DeviceRuntime:
         self._notify()
 
     def inject_fault(self, n: int = 1) -> None:
-        """Arm n deterministic dispatch failures (thrasher hook);
-        probes consume from the same budget, so the runtime stays in
-        fallback until the budget drains (or clear_faults())."""
+        """Arm n deterministic dispatch failures on this chip
+        (thrasher hook); probes consume from the same budget, so the
+        chip stays in fallback until the budget drains (or
+        clear_faults())."""
         self._fault_budget += int(n)
 
     def clear_faults(self) -> None:
         self._fault_budget = 0
 
     def _run_probe(self) -> None:
-        """One probe dispatch: trivially small device work; raises on
-        failure.  Injected faults make probes fail too, so the
-        fallback window is controllable in tests."""
+        """One probe dispatch: trivially small device work on this
+        chip; raises on failure.  Injected faults make probes fail
+        too, so the fallback window is controllable in tests."""
         if self._fault_budget > 0:
             self._fault_budget -= 1
-            raise DeviceLost("injected device fault (probe)")
+            raise DeviceLost("injected device fault (probe, chip %d)"
+                             % self.index)
         import jax.numpy as jnp
-        np.asarray(jnp.zeros((8,), jnp.uint8) + jnp.uint8(1))
+        np.asarray(self.place(jnp.zeros((8,), jnp.uint8))
+                   + jnp.uint8(1))
 
     async def _probe_loop(self) -> None:
         from ..utils.backoff import ExpBackoff
-        bo = ExpBackoff(base=self._probe_base, cap=self._probe_cap)
+        bo = ExpBackoff(base=self.rt._probe_base,
+                        cap=self.rt._probe_cap)
         try:
             while self.fallback:
                 await bo.sleep()
@@ -492,18 +471,10 @@ class DeviceRuntime:
 
     # -- telemetry ---------------------------------------------------------
 
-    def dispatch_pctls(self) -> dict:
-        """p50/p99 (ms) over the ticket ring's device times."""
-        samples = sorted(t.device_s for t in self.tickets if t.ok)
-        if not samples:
-            return {"n": 0}
-        n = len(samples)
-
-        def at(p):
-            return round(samples[min(n - 1, int(p / 100.0 * n))] * 1e3,
-                         4)
-
-        return {"n": n, "p50": at(50), "p99": at(99)}
+    @property
+    def bucket_hit_ratio(self) -> float:
+        total = self.bucket_hits + self.bucket_misses
+        return self.bucket_hits / total if total else 1.0
 
     def metrics(self) -> dict:
         return {
@@ -521,14 +492,388 @@ class DeviceRuntime:
             "device_queue_rejected": self.queue.rejected,
         }
 
+
+class DeviceRuntime:
+    """One per process (per event loop, with a loop-less fallback for
+    synchronous callers such as the bulk mapper warming outside
+    asyncio).  Both hot paths route dispatches through here — each
+    onto a mesh chip (``ChipRuntime``): OSDs via ``chip_for``
+    affinity, chip-less callers via ``route(None)`` (first available
+    chip)."""
+
+    _global: "DeviceRuntime | None" = None
+
+    def __init__(self, weights: dict[str, float] | None = None,
+                 max_inflight: int = 2, max_queue: int = 64,
+                 chips: int | None = None):
+        if weights is None:
+            from ..osd.scheduler import DEVICE_DISPATCH_WEIGHTS
+            weights = DEVICE_DISPATCH_WEIGHTS
+        n = int(chips) if chips else mesh.chip_count()
+        self._seq = 0
+        self._probe_base = 0.05
+        self._probe_cap = 1.0
+        self.shard_min_words = _SHARD_MIN_WORDS
+        self.chips: list[ChipRuntime] = [
+            ChipRuntime(self, i, weights, max_inflight, max_queue)
+            for i in range(max(1, n))]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def get(cls) -> "DeviceRuntime":
+        """Loop-local instance (lifetime tracks the loop, same
+        reasoning as DeviceBatcher.get); synchronous callers with no
+        loop share a process-global instance."""
+        try:
+            loop = asyncio.get_event_loop()
+        except RuntimeError:
+            loop = None
+        if loop is None:
+            if cls._global is None:
+                cls._global = cls()
+            return cls._global
+        inst = getattr(loop, "_ceph_tpu_device_runtime", None)
+        if inst is None:
+            inst = cls()
+            loop._ceph_tpu_device_runtime = inst
+        return inst
+
+    @classmethod
+    def reset(cls, chips: int | None = None) -> "DeviceRuntime":
+        """Fresh instance bound to the current loop (tests); `chips`
+        forces the logical mesh size regardless of environment."""
+        inst = cls(chips=chips)
+        try:
+            loop = asyncio.get_event_loop()
+            loop._ceph_tpu_device_runtime = inst
+        except RuntimeError:
+            cls._global = inst
+        return inst
+
+    def configure(self, conf) -> None:
+        """Adopt daemon config (OSD boot): per-chip queue bounds +
+        probe ramp + mesh shard threshold."""
+        try:
+            max_inflight = max(1, int(conf["device_max_inflight"]))
+            max_queue = int(conf["device_queue_len"])
+            for c in self.chips:
+                c.queue.max_inflight = max_inflight
+                c.queue.max_queue = max_queue
+            self.probe_interval = float(conf["device_probe_interval"])
+            self._probe_base = self.probe_interval / 4.0
+            self._probe_cap = self.probe_interval
+        except (KeyError, TypeError):
+            pass
+        try:
+            self.shard_min_words = max(
+                _MIN_BUCKET, int(conf["device_shard_min_words"]))
+        except (KeyError, TypeError, ValueError):
+            pass
+
+    # -- mesh placement ----------------------------------------------------
+
+    @property
+    def n_chips(self) -> int:
+        return len(self.chips)
+
+    def chip(self, index: int | None = None) -> ChipRuntime:
+        """Chip by index (modulo the mesh), or the default chip."""
+        if index is None:
+            index = 0
+        return self.chips[int(index) % len(self.chips)]
+
+    def chip_for(self, osd_id: int) -> ChipRuntime:
+        """The chip OSD `osd_id` binds to: deterministic modulo
+        affinity, so co-located daemons land on distinct chips and a
+        chip loss degrades a knowable OSD subset."""
+        return self.chips[mesh.affinity(osd_id, len(self.chips))]
+
+    def route(self, chip: int | None) -> ChipRuntime | None:
+        """Resolve a dispatch target.  An explicit chip index is
+        honored even while poisoned (the caller's affinity chip IS
+        its isolation domain — it must degrade to host, not borrow a
+        neighbor and erode the isolation story).  None picks the
+        first available chip (chip-less callers: client-side codecs,
+        warmup, bulk mapping outside a daemon) and returns None only
+        when the whole mesh is down."""
+        if chip is not None:
+            return self.chips[int(chip) % len(self.chips)]
+        for c in self.chips:
+            if c.available:
+                return c
+        return None
+
+    def chip_available(self, chip: int | None = None) -> bool:
+        """Availability gate: explicit chip -> that chip's state;
+        None -> any chip available."""
+        if chip is not None:
+            return self.chips[int(chip) % len(self.chips)].available
+        return any(c.available for c in self.chips)
+
+    def available_chips(self) -> list[ChipRuntime]:
+        return [c for c in self.chips if c.available]
+
+    def shard_plan(self, chip: ChipRuntime,
+                   n_words: int) -> list[tuple[ChipRuntime, int, int]]:
+        """Column ranges for one flush: [(chip, lo, hi)].  A flush at
+        or above `shard_min_words` splits contiguously across the
+        owning chip plus every other available chip — the stripe-axis
+        split MULTICHIP_SCALING.json proves collective-free — and
+        reassembles bit-identically (GF parity is column-independent).
+        Below the threshold (or on a 1-chip mesh) the plan is the
+        single owning chip."""
+        n_words = int(n_words)
+        targets = [chip] + [c for c in self.chips
+                            if c.available and c is not chip]
+        if n_words < self.shard_min_words or len(targets) == 1:
+            return [(chip, 0, n_words)]
+        per = -(-n_words // len(targets))       # ceil
+        plan = []
+        lo = 0
+        for c in targets:
+            hi = min(n_words, lo + per)
+            if hi <= lo:
+                break
+            plan.append((c, lo, hi))
+            lo = hi
+        return plan
+
+    def next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def note_program(self, kind: str, key: tuple) -> bool:
+        """Chip-less compile accounting (the crush device mapper's
+        deep hook has no chip context): attributed to the first
+        available chip."""
+        target = self.route(None) or self.chips[0]
+        return target.note_program(kind, key)
+
+    # -- shape buckets / warmup -------------------------------------------
+
+    @staticmethod
+    def bucket_for(n_words: int) -> int:
+        """Pad target: next power of two >= n, floored at _MIN_BUCKET
+        so micro-flushes share one program."""
+        n = max(int(n_words), _MIN_BUCKET)
+        return 1 << (n - 1).bit_length()
+
+    async def warmup_ec(self, matrix, w: int,
+                        buckets: tuple = (1024, 4096, 16384),
+                        chip: int | None = None) -> None:
+        """Pre-compile the common EC buckets for one coding matrix at
+        boot — on the caller's affinity chip (OSD boot passes its
+        own) — so the first client flushes hit the cache instead of
+        paying a compile inside the write path."""
+        from ..ec.batcher import DeviceBatcher
+        target = self.route(chip)
+        if target is None:
+            return
+        matrix_key = tuple(tuple(r) for r in matrix)
+        k = len(matrix[0])
+        dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32}[int(w)]
+        for b in buckets:
+            if not target.available:
+                return
+            key = ("ec", matrix_key, int(w), int(b))
+            if key in target.programs:
+                continue
+            try:
+                enc = DeviceBatcher._encoder(matrix_key, int(w))
+                buf = target.pool.lease((k, int(b)), dtype)
+                try:
+                    np.asarray(enc(target.place(buf)))
+                finally:
+                    target.pool.release(buf)
+                target.note_program("ec",
+                                    (matrix_key, int(w), int(b)))
+            except Exception as e:      # warmup must never wedge boot
+                target.poison(e)
+                return
+            await asyncio.sleep(0)      # yield between compiles
+
+    # -- aggregate views (single-chip back-compat + telemetry) ------------
+
+    def _sum(self, attr: str) -> int:
+        return sum(getattr(c, attr) for c in self.chips)
+
+    @property
+    def compile_count(self) -> int:
+        return self._sum("compile_count")
+
+    @property
+    def bucket_hits(self) -> int:
+        return self._sum("bucket_hits")
+
+    @property
+    def bucket_misses(self) -> int:
+        return self._sum("bucket_misses")
+
+    @property
+    def dispatches(self) -> int:
+        return self._sum("dispatches")
+
+    @property
+    def dispatch_seconds(self) -> float:
+        return sum(c.dispatch_seconds for c in self.chips)
+
+    @property
+    def host_fallbacks(self) -> int:
+        return self._sum("host_fallbacks")
+
+    @host_fallbacks.setter
+    def host_fallbacks(self, v: int) -> None:
+        # legacy `rt.host_fallbacks += 1` path: the default chip
+        # absorbs the delta (mesh-aware callers count on their chip)
+        others = sum(c.host_fallbacks for c in self.chips[1:])
+        self.chips[0].host_fallbacks = max(0, int(v) - others)
+
+    @property
+    def fallback_count(self) -> int:
+        return self._sum("fallback_count")
+
+    @property
+    def heal_count(self) -> int:
+        return self._sum("heal_count")
+
+    @property
+    def programs(self) -> set:
+        out: set = set()
+        for c in self.chips:
+            out |= c.programs
+        return out
+
+    @property
+    def tickets(self) -> list[DispatchTicket]:
+        out: list[DispatchTicket] = []
+        for c in self.chips:
+            out.extend(c.tickets)
+        out.sort(key=lambda t: t.seq)
+        return out
+
+    @property
+    def pool(self) -> BufferPool:
+        """Default chip's staging pool (single-chip back-compat)."""
+        return self.chips[0].pool
+
+    @property
+    def queue(self) -> DispatchQueue:
+        """Default chip's dispatch queue (single-chip back-compat)."""
+        return self.chips[0].queue
+
+    @property
+    def bucket_hit_ratio(self) -> float:
+        total = self.bucket_hits + self.bucket_misses
+        return self.bucket_hits / total if total else 1.0
+
+    @property
+    def fallback(self) -> bool:
+        """Whole-mesh loss: every chip poisoned.  Per-chip state is
+        `chips[i].fallback` (what OSD beacons carry)."""
+        return all(c.fallback for c in self.chips)
+
+    @property
+    def fallback_reason(self) -> str | None:
+        for c in self.chips:
+            if c.fallback_reason:
+                return c.fallback_reason
+        return None
+
+    @property
+    def available(self) -> bool:
+        return any(c.available for c in self.chips)
+
+    def add_listener(self, fn) -> None:
+        """Mesh-wide listener (back-compat): fires on every chip's
+        transition.  Per-OSD daemons register on their affinity chip
+        instead."""
+        for c in self.chips:
+            c.add_listener(fn)
+
+    def poison(self, reason) -> None:
+        """Whole-mesh poison (back-compat / catastrophic loss): every
+        chip flips to host fallback."""
+        for c in self.chips:
+            c.poison(reason)
+
+    def heal(self) -> None:
+        for c in self.chips:
+            c.heal()
+
+    def inject_fault(self, n: int = 1) -> None:
+        """Arm n failures on EVERY chip (whole-device loss shape);
+        chip-scoped injection is `chips[i].inject_fault`."""
+        for c in self.chips:
+            c.inject_fault(n)
+
+    def clear_faults(self) -> None:
+        for c in self.chips:
+            c.clear_faults()
+
+    # -- telemetry ---------------------------------------------------------
+
+    def dispatch_pctls(self) -> dict:
+        """p50/p99 (ms) over every chip's ticket ring."""
+        samples = sorted(t.device_s for c in self.chips
+                         for t in c.tickets if t.ok)
+        if not samples:
+            return {"n": 0}
+        n = len(samples)
+
+        def at(p):
+            return round(samples[min(n - 1, int(p / 100.0 * n))] * 1e3,
+                         4)
+
+        return {"n": n, "p50": at(50), "p99": at(99)}
+
+    def metrics(self) -> dict:
+        """Mesh-aggregate metric map (the pre-mesh names; per-chip
+        series come from prom_lines' chip label)."""
+        return {
+            "device_chips": len(self.chips),
+            "device_queue_depth": sum(c.queue.depth
+                                      for c in self.chips),
+            "device_inflight": sum(c.queue.inflight
+                                   for c in self.chips),
+            "device_bucket_hit_ratio": round(self.bucket_hit_ratio, 4),
+            "device_compile_count": self.compile_count,
+            "device_dispatches": self.dispatches,
+            "device_host_fallbacks": self.host_fallbacks,
+            "device_pool_hits": self._sum_pool("hits"),
+            "device_pool_misses": self._sum_pool("misses"),
+            "device_fallback": int(self.fallback),
+            "device_fallback_count": self.fallback_count,
+            "device_heal_count": self.heal_count,
+            "device_queue_rejected": sum(c.queue.rejected
+                                         for c in self.chips),
+            "device_fallback_chips": sum(1 for c in self.chips
+                                         if c.fallback),
+        }
+
+    def _sum_pool(self, attr: str) -> int:
+        return sum(getattr(c.pool, attr) for c in self.chips)
+
     def prom_lines(self, prefix: str = "ceph_tpu") -> list[str]:
-        """Prometheus exposition lines (utils.exporter renderer)."""
+        """Prometheus exposition lines: every device series carries a
+        ``chip`` label (one series per mesh chip), plus the unlabeled
+        mesh-size gauge.  TYPE is emitted once per family across
+        chips (the exposition rule utils.exporter lints)."""
         from ..utils.exporter import hist_lines
-        lines = []
-        for name, val in sorted(self.metrics().items()):
-            base = "%s_%s" % (prefix, name)
-            lines.append("# TYPE %s gauge" % base)
-            lines.append("%s %g" % (base, float(val)))
-        lines.extend(hist_lines("%s_device_dispatch_seconds" % prefix,
-                                self.dispatch_buckets_us))
+        lines = ["# TYPE %s_device_chips gauge" % prefix,
+                 "%s_device_chips %d" % (prefix, len(self.chips))]
+        typed: set[str] = set()
+        hist_typed: set[str] = set()
+        for c in self.chips:
+            label = 'chip="%d"' % c.index
+            for name, val in sorted(c.metrics().items()):
+                base = "%s_%s" % (prefix, name)
+                if base not in typed:
+                    typed.add(base)
+                    lines.append("# TYPE %s gauge" % base)
+                lines.append("%s{%s} %g" % (base, label, float(val)))
+            lines.extend(hist_lines(
+                "%s_device_dispatch_seconds" % prefix,
+                c.dispatch_buckets_us, labels=label,
+                typed=hist_typed))
         return lines

@@ -142,21 +142,24 @@ class ErasureCode(ErasureCodeInterface):
 
     async def encode_async(self, want_to_encode: set[int],
                            data: bytes, klass: str | None = None,
-                           on_ticket=None) -> dict[int, bytes]:
+                           on_ticket=None,
+                           chip: int | None = None) -> dict[int, bytes]:
         """encode() with the GF matmul batched onto the device across
         concurrent callers (ECBackend's hot call,
         src/osd/ECTransaction.cc:56 -> encode_chunks).  Falls back to
         the sync host path when offload is disabled, the codec has no
-        plain matrix form, or the device runtime is in fallback.
+        plain matrix form, or the caller's mesh chip is in fallback.
 
         klass selects the device dispatch class (client-EC vs
-        recovery-EC admission weights); on_ticket receives the flush's
+        recovery-EC admission weights); chip is the caller's mesh
+        affinity (OSDs pass their bound chip — a poisoned chip
+        degrades only its own OSDs); on_ticket receives the flush's
         DispatchTicket for exact per-op attribution."""
         from ..device.runtime import DeviceRuntime, K_CLIENT_EC
         from .batcher import DeviceBatcher, device_offload_enabled
         dm = self._device_matrix()
         if dm is None or len(data) == 0 or not device_offload_enabled() \
-                or not DeviceRuntime.get().available:
+                or not DeviceRuntime.get().chip_available(chip):
             return self.encode(want_to_encode, data)
         import numpy as np
         matrix, w = dm
@@ -167,7 +170,7 @@ class ErasureCode(ErasureCodeInterface):
             for i in range(self.get_data_chunk_count())])
         parity = await DeviceBatcher.get().encode(
             matrix, w, arr, klass=klass or K_CLIENT_EC,
-            on_ticket=on_ticket)
+            on_ticket=on_ticket, chip=chip)
         out = dict(prepared)
         for i in range(len(matrix)):
             out[self.chunk_index(
@@ -177,17 +180,19 @@ class ErasureCode(ErasureCodeInterface):
     async def decode_async(self, want_to_read: set[int],
                            chunks: Mapping[int, bytes],
                            klass: str | None = None,
-                           on_ticket=None) -> dict[int, bytes]:
+                           on_ticket=None,
+                           chip: int | None = None) -> dict[int, bytes]:
         """decode() with the reconstruction matmul batched onto the
         device (the ECBackend degraded-read/recovery call,
         src/osd/ECUtil.cc:12-121).  Reconstruction is an encode with
-        the inverted-survivor matrix, so it shares the encode queue."""
+        the inverted-survivor matrix, so it shares the encode queue
+        (and the caller's chip affinity)."""
         from ..device.runtime import DeviceRuntime, K_CLIENT_EC
         from .batcher import (DeviceBatcher, device_offload_enabled,
                               reconstruct_matrix)
         dm = self._device_matrix()
         if (dm is None or not device_offload_enabled()
-                or not DeviceRuntime.get().available
+                or not DeviceRuntime.get().chip_available(chip)
                 or self.chunk_mapping
                 or want_to_read <= set(chunks)
                 or any(len(c) == 0 for c in chunks.values())):
@@ -212,7 +217,7 @@ class ErasureCode(ErasureCodeInterface):
             for c in chosen])
         words = await DeviceBatcher.get().encode(
             rows, w, arr, klass=klass or K_CLIENT_EC,
-            on_ticket=on_ticket)
+            on_ticket=on_ticket, chip=chip)
         out = {}
         for j, e in enumerate(erased):
             out[e] = words[j].tobytes()
@@ -223,11 +228,13 @@ class ErasureCode(ErasureCodeInterface):
 
     async def decode_concat_async(self, chunks: Mapping[int, bytes],
                                   klass: str | None = None,
-                                  on_ticket=None) -> bytes:
+                                  on_ticket=None,
+                                  chip: int | None = None) -> bytes:
         k = self.get_data_chunk_count()
         want = {self.chunk_index(i) for i in range(k)}
         decoded = await self.decode_async(want, chunks, klass=klass,
-                                          on_ticket=on_ticket)
+                                          on_ticket=on_ticket,
+                                          chip=chip)
         return b"".join(decoded[self.chunk_index(i)]
                         for i in range(k))
 

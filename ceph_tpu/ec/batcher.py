@@ -13,7 +13,9 @@ matmul batch — either when the pending payload reaches
 batching with per-op deadlines elsewhere).
 
 Every flush routes through the shared device runtime
-(ceph_tpu.device.runtime):
+(ceph_tpu.device.runtime) onto a mesh **chip** — the caller's
+affinity chip (OSDs pass `chip=`; chip-less callers take the first
+available chip):
 
 * the batch pads to a power-of-two word-count **bucket** staged in a
   pooled buffer, so steady state re-dispatches a handful of compiled
@@ -22,11 +24,18 @@ Every flush routes through the shared device runtime
   sliced off, so bucket parity is bit-identical to the unpadded host
   encode, pinned by tests/test_device_runtime.py);
 * admission is weighted-fair across classes (client-EC, recovery-EC,
-  mapping) with bounded in-flight dispatches; queue-full degrades
-  THIS flush to the host codepath rather than stacking device work;
-* a failed dispatch poisons the runtime (host fallback + DEVICE_
-  FALLBACK health via the OSD beacon) and the flush is re-encoded on
-  the host, so awaiting OSD ops never observe the loss;
+  mapping) with bounded in-flight dispatches per chip; queue-full
+  degrades THIS flush to the host codepath rather than stacking
+  device work;
+* an **oversized flush shards column-wise across every available
+  chip** (the stripe-axis split MULTICHIP_SCALING.json proves
+  collective-free: GF parity is column-independent) and reassembles
+  bit-identically; a shard failure poisons only its chip and that
+  shard is re-encoded on the host;
+* a failed dispatch poisons ITS chip (host fallback for the OSDs
+  bound there + per-chip DEVICE_FALLBACK health via the OSD beacon)
+  and the flush is re-encoded on the host, so awaiting OSD ops never
+  observe the loss — the rest of the mesh keeps serving on-device;
 * each device flush carries a DispatchTicket delivered to per-item
   `on_ticket` callbacks — the exact per-op device-dispatch
   attribution the OpTracker stage histograms consume.
@@ -102,6 +111,7 @@ class DeviceBatcher:
         self.batches_flushed = 0
         self.items_encoded = 0
         self.host_flushes = 0        # flushes served by the host path
+        self.sharded_flushes = 0     # flushes split across the mesh
         # device-dispatch telemetry: per-flush wall time of the device
         # call.  Kept for bench --trace and back-compat; per-OP
         # attribution now rides the dispatch ticket instead of
@@ -151,15 +161,23 @@ class DeviceBatcher:
 
     async def encode(self, matrix: list[list[int]], w: int,
                      data: np.ndarray, klass: str = K_CLIENT_EC,
-                     on_ticket=None) -> np.ndarray:
+                     on_ticket=None,
+                     chip: int | None = None) -> np.ndarray:
         """data [k, n] words -> [m, n] parity words, batched with any
-        concurrent callers using the same (matrix, w, klass).
+        concurrent callers using the same (matrix, w, klass, chip).
+
+        `chip` is the caller's mesh affinity (OSDs pass their bound
+        chip; None routes to the first available chip) — batches are
+        keyed per chip so each chip runs its own stream and a
+        poisoned chip degrades only its own callers.
 
         `on_ticket` (if given) receives the flush's DispatchTicket
-        after the device call — exact per-op dispatch attribution.
-        Host-fallback flushes deliver no ticket (there was no device
-        dispatch to attribute)."""
-        key = (tuple(tuple(r) for r in matrix), int(w), klass)
+        after the device call — exact per-op dispatch attribution
+        (the primary shard's ticket when the flush sharded across the
+        mesh).  Host-fallback flushes deliver no ticket (there was no
+        device dispatch to attribute)."""
+        key = (tuple(tuple(r) for r in matrix), int(w), klass,
+               None if chip is None else int(chip))
         loop = asyncio.get_event_loop()
         pb = self._pending.get(key)
         if pb is None:
@@ -191,63 +209,38 @@ class DeviceBatcher:
         asyncio.get_event_loop().create_task(self._flush_async(key, pb))
 
     async def _flush_async(self, key, pb: _PendingBatch) -> None:
-        matrix_key, w, klass = key
+        matrix_key, w, klass, chip_idx = key
         rt = DeviceRuntime.get()
         import time
-        k = pb.arrays[0].shape[0]
         n = pb.n_words
-        dtype = _WORD_DTYPE[int(w)]
-        nbytes = n * k * dtype().itemsize
         out = None
         ticket = None
-        use_device = rt.available
-        if use_device:
-            bucket = rt.bucket_for(n)
-            ticket = rt.open_ticket(klass, bucket, nbytes)
-            try:
-                await rt.admit(ticket)
-            except DeviceBusy:
-                # admission pushback: degrade THIS flush to the host
-                # path instead of stacking device work
-                use_device = False
-                ticket = None
-        if use_device:
-            buf = rt.pool.lease((k, bucket), dtype)
-            try:
-                off = 0
-                for arr in pb.arrays:
-                    ni = arr.shape[1]
-                    buf[:, off:off + ni] = arr
-                    off += ni
-                rt.note_program("ec", (matrix_key, int(w), bucket))
-                t0 = time.perf_counter()
-                rt.launch(ticket)       # injected-fault hook
-                enc = self._encoder(matrix_key, int(w))
-                out = np.asarray(enc(buf))[:, :n]
-                rt.finish(ticket, ok=True)
+        target = rt.route(chip_idx)
+        if target is not None and target.available:
+            t0 = time.perf_counter()
+            plan = rt.shard_plan(target, n)
+            if len(plan) == 1:
+                out, ticket = await self._encode_shard(
+                    target, matrix_key, int(w), klass, pb.arrays, n,
+                    solo=True)
+            else:
+                out, ticket = await self._encode_sharded(
+                    rt, plan, matrix_key, int(w), klass, pb.arrays)
+            if out is not None:
                 dt = time.perf_counter() - t0
                 self.last_flush_s = dt
                 self.flush_seconds += dt
                 self.flush_history.append(dt)
                 if len(self.flush_history) > 512:
                     del self.flush_history[:256]
-            except Exception as e:
-                # device loss: poison the runtime (host fallback +
-                # DEVICE_FALLBACK health) and serve this flush on the
-                # host so awaiting OSD ops never see the failure
-                rt.finish(ticket, ok=False, error=e)
-                rt.poison(e)
-                ticket = None
-                out = None
-            finally:
-                rt.pool.release(buf)
         if out is None:
             try:
                 flat = (pb.arrays[0] if len(pb.arrays) == 1
                         else np.concatenate(pb.arrays, axis=1))
                 out = host_encode([list(r) for r in matrix_key], w,
                                   flat)
-                rt.host_fallbacks += 1
+                (target if target is not None
+                 else rt.chip(chip_idx)).host_fallbacks += 1
                 self.host_flushes += 1
             except Exception as e:
                 # a host-path failure is a real codec error: it must
@@ -261,6 +254,10 @@ class DeviceBatcher:
                 return
         self.batches_flushed += 1
         self.items_encoded += len(pb.arrays)
+        self._deliver(pb, out, ticket)
+
+    @staticmethod
+    def _deliver(pb: _PendingBatch, out: np.ndarray, ticket) -> None:
         off = 0
         for arr, fut, cb in zip(pb.arrays, pb.futures, pb.tickets):
             ni = arr.shape[1]
@@ -272,6 +269,82 @@ class DeviceBatcher:
                 except Exception:
                     pass    # attribution must never sink the flush
             off += ni
+
+    async def _encode_shard(self, chip, matrix_key, w: int,
+                            klass: str, parts: list[np.ndarray],
+                            n: int, solo: bool):
+        """One chip's slice of a flush: admit on the chip's queue,
+        stage into its pooled bucket buffer, dispatch on its device.
+        Returns (parity [m, n], ticket).
+
+        `solo=True` is the whole-flush single-chip path: DeviceBusy
+        and device loss return (None, None) so the caller degrades
+        the WHOLE flush to the host codec (the pre-mesh behavior).
+        Shards of a mesh-split flush (`solo=False`) instead degrade
+        THEMSELVES to the host inline — a lost chip costs its shard,
+        not the flush — so reassembly is unconditional."""
+        dtype = _WORD_DTYPE[int(w)]
+        k = parts[0].shape[0]
+        bucket = chip.rt.bucket_for(n)
+        ticket = chip.open_ticket(klass, bucket,
+                                  n * k * dtype().itemsize)
+        try:
+            await chip.admit(ticket)
+        except DeviceBusy:
+            if solo:
+                return None, None
+            return self._host_shard(chip, matrix_key, w, parts), None
+        buf = chip.pool.lease((k, bucket), dtype)
+        try:
+            off = 0
+            for arr in parts:
+                ni = arr.shape[1]
+                buf[:, off:off + ni] = arr
+                off += ni
+            chip.note_program("ec", (matrix_key, int(w), bucket))
+            chip.launch(ticket)         # injected-fault hook
+            enc = self._encoder(matrix_key, int(w))
+            out = np.asarray(enc(chip.place(buf)))[:, :n]
+            chip.finish(ticket, ok=True)
+            return out, ticket
+        except Exception as e:
+            # device loss: poison THIS chip (host fallback + per-chip
+            # DEVICE_FALLBACK health for the OSDs bound to it); the
+            # rest of the mesh keeps serving
+            chip.finish(ticket, ok=False, error=e)
+            chip.poison(e)
+            if solo:
+                return None, None
+            return self._host_shard(chip, matrix_key, w, parts), None
+        finally:
+            chip.pool.release(buf)
+
+    def _host_shard(self, chip, matrix_key, w: int,
+                    parts: list[np.ndarray]) -> np.ndarray:
+        """Host-encode one shard of a mesh-split flush (its chip was
+        lost or pushed back): correctness never depends on the mesh."""
+        flat = (parts[0] if len(parts) == 1
+                else np.concatenate(parts, axis=1))
+        chip.host_fallbacks += 1
+        self.host_flushes += 1
+        return host_encode([list(r) for r in matrix_key], w, flat)
+
+    async def _encode_sharded(self, rt, plan, matrix_key, w: int,
+                              klass: str, arrays: list[np.ndarray]):
+        """Mesh-shard one oversized flush across the plan's chips:
+        contiguous column slices encode concurrently (proven
+        collective-free over the stripe axis) and reassemble
+        bit-identically.  Returns (parity, primary ticket)."""
+        flat = (arrays[0] if len(arrays) == 1
+                else np.concatenate(arrays, axis=1))
+        self.sharded_flushes += 1
+        parts = await asyncio.gather(*[
+            self._encode_shard(chip, matrix_key, w, klass,
+                               [flat[:, lo:hi]], hi - lo, solo=False)
+            for chip, lo, hi in plan])
+        out = np.concatenate([p for p, _t in parts], axis=1)
+        ticket = next((t for _p, t in parts if t is not None), None)
+        return out, ticket
 
 
 def reconstruct_matrix(k: int, w: int, matrix: list[list[int]],

@@ -523,7 +523,12 @@ class Monitor:
             # reports the warnings immediately on election
             now = time.monotonic()
             slow = int(msg.slow_ops or 0)
+            # device-fallback state is chip-encoded: 0 = on-device,
+            # 1+chip = that mesh chip lost (the health detail names
+            # it; an old beacon without the field reads as chip 0)
             flb = int(msg.device_fallback or 0)
+            if flb:
+                flb = 1 + int(getattr(msg, "device_chip", 0) or 0)
             self.osd_slow_ops[msg.osd] = (slow, now)
             self.osd_device_fallback[msg.osd] = (flb, now)
             if self.is_leader() and \
@@ -683,6 +688,9 @@ class Monitor:
                 "WRN", "daemon %s crashed: %s: %s (crash id %s)"
                 % (r.get("entity"), r.get("exc_type"),
                    r.get("exc_msg"), r.get("crash_id")))
+        if fresh:
+            # commit-time retention sweep rides the same proposal
+            self.crash_mon.maybe_prune()
 
     def _ack_crash_commit(self, ops: list) -> None:
         from ..msg.messages import MCrashReportAck
@@ -871,6 +879,11 @@ class Monitor:
         # re-flush unacked clog entries: a leader election or dropped
         # frame between emit and commit loses nothing
         self.clog.flush()
+        # crash-table retention: the leader queues committed rm ops
+        # for archived reports past mon_crash_retention
+        if self.is_leader() and (not self.multi
+                                 or self.mpaxos.active):
+            self.crash_mon.maybe_prune()
         now = time.monotonic()
         interval = self.ctx.conf["mon_osd_down_out_interval"]
         changed = False

@@ -214,9 +214,12 @@ class HealthMonitor:
     def __init__(self, mon):
         self.mon = mon
         # committed (paxos) snapshot: {"slow": {osd: n},
-        #                              "devflb": {osd: 0|1},
+        #                              "devflb": {osd: 0 | 1+chip},
         #                              "pgdeg": n degraded objects,
         #                              "pgavail": n inactive pgs}
+        # devflb values are chip-encoded (0 = on-device, 1+chip =
+        # that mesh chip lost) so the health detail can name the
+        # degraded chip even on a freshly elected leader
         self.persisted: dict = {"slow": {}, "devflb": {},
                                 "pgdeg": 0, "pgavail": 0}
 
@@ -297,7 +300,8 @@ class HealthMonitor:
             if int(devflb):
                 self.mon.log_mon.append(
                     "WRN", "Health check failed: osd.%d on host "
-                    "fallback (DEVICE_FALLBACK)" % osd)
+                    "fallback, device chip %d lost "
+                    "(DEVICE_FALLBACK)" % (osd, int(devflb) - 1))
             else:
                 self.mon.log_mon.append(
                     "INF", "Health check cleared: DEVICE_FALLBACK "
@@ -411,25 +415,32 @@ class HealthMonitor:
                 "detail": ["osd.%d has %d ops past the complaint "
                            "threshold" % (o, slow[o])
                            for o in slow_daemons[:10]]}
-        # DEVICE_FALLBACK: a daemon's device runtime lost the
-        # accelerator and is serving EC/mapping from the host paths —
-        # degraded throughput, not degraded durability.  Raised while
-        # any live daemon reports it (beacon or committed snapshot);
-        # clears when the runtime heals and beacons say so.
+        # DEVICE_FALLBACK: a daemon's mesh chip lost the accelerator
+        # and is serving EC/mapping from the host paths — degraded
+        # throughput, not degraded durability, and scoped to the
+        # OSDs bound to the lost chip (the rest of the mesh keeps
+        # serving on-device).  Raised while any live daemon reports
+        # it (beacon or committed snapshot); the detail names the
+        # degraded chip; clears when the chip heals and beacons say
+        # so.  Values are chip-encoded: 1+chip.
         flb = self._merged(
             getattr(self.mon, "osd_device_fallback", {}),
             self.persisted["devflb"])
         flb_daemons = sorted(o for o, v in flb.items() if v)
         if flb_daemons:
+            chips = sorted({int(flb[o]) - 1 for o in flb_daemons})
             out["DEVICE_FALLBACK"] = {
                 "severity": "HEALTH_WARN",
                 "summary": "%d daemons on host fallback (device "
-                           "lost): %s"
-                           % (len(flb_daemons),
+                           "chips %s lost): %s"
+                           % (len(flb_daemons), chips,
                               ["osd.%d" % o
                                for o in flb_daemons[:10]]),
+                "chips": chips,
                 "detail": ["osd.%d serving EC/mapping on the host "
-                           "paths" % o for o in flb_daemons[:10]]}
+                           "paths (chip %d)"
+                           % (o, int(flb[o]) - 1)
+                           for o in flb_daemons[:10]]}
         # PG_DEGRADED / PG_AVAILABILITY (the reference's PGMap-fed
         # health checks): a fresh mgr digest wins; the paxos-committed
         # snapshot a previous leader left fills in until digests reach
@@ -599,12 +610,40 @@ class CrashMonitor:
     def __init__(self, mon):
         self.mon = mon
         self.reports: dict[str, dict] = {}   # crash_id -> report
+        # clock hook: tests pin retention pruning to a virtual now
+        self.clock = time.time
 
     def load(self) -> None:
         raw = self.mon.store.get(CRASH_KEY)
         if raw is not None:
             self.reports = {k: dict(v)
                             for k, v in denc.decode(raw).items()}
+
+    def maybe_prune(self) -> None:
+        """Leader-side auto-prune: ARCHIVED reports older than
+        `mon_crash_retention` are removed through committed rm ops
+        (every mon's table shrinks identically at apply) — the table
+        stops growing without bound while un-archived reports stay
+        forever (an operator never loses an unacknowledged
+        post-mortem).  Runs from the mon tick and whenever fresh
+        reports commit; retention <= 0 disables."""
+        try:
+            keep = float(self.mon.ctx.conf["mon_crash_retention"])
+        except (KeyError, TypeError, ValueError):
+            return
+        if keep <= 0:
+            return
+        now = self.clock()
+        pend = {op[1] for op in self.mon.pending_svc.get("crash", [])
+                if op[0] == "rm"}
+        for cid, r in sorted(self.reports.items()):
+            if not r.get("archived") or cid in pend:
+                continue
+            if now - float(r.get("timestamp") or 0) > keep:
+                self.mon.queue_svc_op("crash", ("rm", cid))
+                self.mon.log_mon.append(
+                    "INF", "crash %s pruned (archived, older than "
+                    "retention)" % cid)
 
     def apply(self, ops: list, tx) -> None:
         for op in ops:

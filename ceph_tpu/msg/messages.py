@@ -101,11 +101,14 @@ class MOSDBeacon(Message):
     while healthy; slow_ops carries the count of in-flight ops older
     than osd_op_complaint_time so the monitor can raise (and clear)
     the SLOW_OPS health warning; device_fallback reports whether the
-    daemon's device runtime is serving from the host paths (the mon
-    raises DEVICE_FALLBACK while any live daemon reports it)."""
+    daemon's mesh chip is serving from the host paths and device_chip
+    names that chip (the mon raises DEVICE_FALLBACK while any live
+    daemon reports it, with the chip in the health detail — only the
+    OSDs bound to a lost chip degrade)."""
 
     TYPE = "osd_beacon"
-    FIELDS = ("osd", "epoch", "slow_ops", "device_fallback")
+    FIELDS = ("osd", "epoch", "slow_ops", "device_fallback",
+              "device_chip")
 
 
 @register

@@ -82,6 +82,10 @@ class OSD:
         from .watch import WatchRegistry
 
         self.cls_handler = default_handler()
+        # bound at start(): this OSD's mesh chip (ChipRuntime) —
+        # deterministic OSD->chip affinity, the per-chip isolation
+        # domain its EC flushes and bulk mapping dispatch on
+        self.device_chip = None
         self.ec = ECPGBackend(self)
         self.scrubber = Scrubber(self)
         self.watches = WatchRegistry(self)
@@ -154,6 +158,13 @@ class OSD:
         # to the mons; the paxos-committed ack clears them here)
         from ..utils import crash as crashmod
         self._crash_pending = crashmod.pending_crashes(self.store)
+        # clog seq floor: resume ABOVE the previous incarnation's
+        # last-used seq (persisted per emit) so the LogMonitor's
+        # (who, seq) dedup never swallows reborn entries and
+        # pre-restart unacked entries cannot supersede them
+        self.clog.resume_above(crashmod.load_clog_seq(self.store))
+        self.clog.on_seq = \
+            lambda s: crashmod.save_clog_seq(self.store, s)
         if self._crash_pending:
             self.ctx.log.info(
                 "osd", "osd.%d found %d pending crash report(s)"
@@ -161,14 +172,18 @@ class OSD:
         addr = await self.msgr.bind(host, port)
         self.sched.start(self.msgr.spawn)
         self._load_pgs()
-        # device runtime: adopt this daemon's queue bounds and beacon
-        # fallback transitions immediately (a mapping storm or device
-        # loss must reach the mon's health checks within one beacon,
-        # not one reporting interval)
+        # device runtime: adopt this daemon's queue bounds, bind this
+        # OSD to its mesh chip (deterministic affinity — co-located
+        # daemons land on distinct chips, so one chip's loss degrades
+        # only its own OSDs), and beacon fallback transitions
+        # immediately (a mapping storm or chip loss must reach the
+        # mon's health checks within one beacon, not one reporting
+        # interval)
         from ..device.runtime import DeviceRuntime
         rt = DeviceRuntime.get()
         rt.configure(self.ctx.conf)
-        rt.add_listener(self._on_device_state)
+        self.device_chip = rt.chip_for(self.whoami)
+        self.device_chip.add_listener(self._on_device_state)
         mon = self.msgr.connect_to(self.mon_addr, entity_hint="mon.0")
         mon.send(MMonSubscribe(start=1))
         self._tasks.append(self.msgr.spawn(self._mon_watchdog()))
@@ -176,21 +191,24 @@ class OSD:
         return addr
 
     def _on_device_state(self, fallback: bool) -> None:
-        """Device runtime poisoned/healed: beacon the new state now,
-        and tell the cluster log (the daemon-origin side of the
-        DEVICE_FALLBACK story; the mon clogs the health edge)."""
+        """This OSD's mesh chip poisoned/healed: beacon the new state
+        now, and tell the cluster log (the daemon-origin side of the
+        per-chip DEVICE_FALLBACK story; the mon clogs the health
+        edge, naming the chip)."""
         if self.stopping or not self.booted:
             return
+        chip = (self.device_chip.index
+                if self.device_chip is not None else 0)
         self.ctx.log.info(
-            "osd", "osd.%d device runtime %s"
-            % (self.whoami, "LOST -> host fallback" if fallback
-               else "healed"))
+            "osd", "osd.%d device chip %d %s"
+            % (self.whoami, chip,
+               "LOST -> host fallback" if fallback else "healed"))
         if fallback:
-            self.clog.warn("osd.%d device runtime lost, serving from "
-                           "host paths" % self.whoami)
+            self.clog.warn("osd.%d device chip %d lost, serving from "
+                           "host paths" % (self.whoami, chip))
         else:
-            self.clog.info("osd.%d device runtime healed"
-                           % self.whoami)
+            self.clog.info("osd.%d device chip %d healed"
+                           % (self.whoami, chip))
         self._beacon_stamp = 0.0        # bypass the report interval
         self._maybe_send_beacon()
 
@@ -651,7 +669,10 @@ class OSD:
             try:
                 from ..parallel.mapping import OSDMapMapping
 
-                mapping = OSDMapMapping(m)
+                mapping = OSDMapMapping(
+                    m, chip=(self.device_chip.index
+                             if self.device_chip is not None
+                             else None))
             except Exception:
                 mapping = None
         for pool_id, pool in m.pools.items():
@@ -2265,9 +2286,12 @@ class OSD:
 
     def _maybe_send_beacon(self) -> None:
         """MOSDBeacon to the mons: liveness plus the slow-op count
-        (in-flight ops past osd_op_complaint_time).  The monitor's
-        HealthMonitor turns a nonzero cluster total into SLOW_OPS and
-        clears it when a later beacon reports zero."""
+        (in-flight ops past osd_op_complaint_time) and this OSD's
+        chip state.  The monitor's HealthMonitor turns a nonzero
+        cluster total into SLOW_OPS and clears it when a later beacon
+        reports zero; device_fallback + device_chip feed the per-chip
+        DEVICE_FALLBACK detail (only the OSDs bound to a lost chip
+        report it — the rest of the mesh keeps serving on-device)."""
         from ..device.runtime import DeviceRuntime
         from ..msg.messages import MOSDBeacon
         slow = self.optracker.slow_in_flight()
@@ -2283,10 +2307,14 @@ class OSD:
                 "osd", "osd.%d has %d slow ops (oldest %.1fs): %s"
                 % (self.whoami, len(slow), oldest,
                    slow[0].desc))
+        chip = (self.device_chip
+                if self.device_chip is not None
+                else DeviceRuntime.get().chip_for(self.whoami))
         self._send_mons(MOSDBeacon(
             osd=self.whoami, epoch=self.osdmap.epoch,
             slow_ops=len(slow),
-            device_fallback=int(DeviceRuntime.get().fallback)))
+            device_fallback=int(chip.fallback),
+            device_chip=chip.index))
 
     def _obj_logical_size(self, pg: PG, ho, is_ec: bool) -> int:
         """Logical object bytes: an EC shard records the full logical

@@ -155,19 +155,22 @@ class ECPGBackend:
         if dm is None or not device_offload_enabled():
             return
         rt = DeviceRuntime.get()
-        if rt.available:
+        if rt.chip_available(self._chip()):
             matrix, w = dm
             # workload-aware buckets from the daemon's op-size
             # histogram when history exists; the static default list
-            # otherwise (first boot, cold daemon)
+            # otherwise (first boot, cold daemon) — compiled on this
+            # OSD's own chip (the one its flushes will dispatch on)
             derived = derive_warmup_buckets(
                 getattr(self.osd, "op_size_hist", None),
                 k=len(matrix[0]), w=w)
             if derived:
                 self.osd.msgr.spawn(
-                    rt.warmup_ec(matrix, w, buckets=derived))
+                    rt.warmup_ec(matrix, w, buckets=derived,
+                                 chip=self._chip()))
             else:
-                self.osd.msgr.spawn(rt.warmup_ec(matrix, w))
+                self.osd.msgr.spawn(
+                    rt.warmup_ec(matrix, w, chip=self._chip()))
 
     class _Locked:
         def __init__(self, backend, key):
@@ -449,6 +452,13 @@ class ECPGBackend:
 
     # -- write path --------------------------------------------------------
 
+    def _chip(self) -> int | None:
+        """This daemon's mesh-chip index (OSD->chip affinity): every
+        EC dispatch from this backend lands on the OSD's own chip, so
+        a chip loss degrades exactly this daemon to the host paths."""
+        chip = getattr(self.osd, "device_chip", None)
+        return chip.index if chip is not None else None
+
     def _on_dispatch_ticket(self, top):
         """Per-op device-dispatch attribution callback: the batcher
         delivers the DispatchTicket of the EXACT flush that carried
@@ -483,7 +493,8 @@ class ECPGBackend:
         t0 = _time.monotonic()
         shards = await codec.encode_async(
             set(range(n)), data, klass=klass,
-            on_ticket=self._on_dispatch_ticket(top))
+            on_ticket=self._on_dispatch_ticket(top),
+            chip=self._chip())
         self.osd.perf.hist_sample("op_ec_batch_wait",
                                   _time.monotonic() - t0)
         if top is not None:
@@ -1012,7 +1023,8 @@ class ECPGBackend:
                           by_ver[best].items()}
                 size = next(iter(by_ver[best].values()))[1]
                 try:
-                    data = await codec.decode_concat_async(chunks)
+                    data = await codec.decode_concat_async(
+                        chunks, chip=self._chip())
                 except (IOError, OSError):
                     continue  # widen to the remaining members
                 return (data[:size], best,
@@ -1190,7 +1202,8 @@ class ECPGBackend:
                 n = codec.get_chunk_count()
                 from ..device.runtime import K_RECOVERY_EC
                 shards = await codec.encode_async(
-                    set(range(n)), data, klass=K_RECOVERY_EC)
+                    set(range(n)), data, klass=K_RECOVERY_EC,
+                    chip=self._chip())
                 # user xattrs: local shard first, else the attrs the
                 # surviving shards returned with the read replies (the
                 # primary's own shard may be missing too)
@@ -1217,7 +1230,8 @@ class ECPGBackend:
                         if cd is None:
                             continue
                         cshards = await codec.encode_async(
-                            set(range(n)), cd, klass=K_RECOVERY_EC)
+                            set(range(n)), cd, klass=K_RECOVERY_EC,
+                    chip=self._chip())
                         ca = dict(cattrs or {})
                         ca[SIZE_XATTR] = b"%d" % len(cd)
                         ca[SHARD_XATTR] = b"%d" % j
@@ -1265,7 +1279,8 @@ class ECPGBackend:
                     n = codec.get_chunk_count()
                     from ..device.runtime import K_RECOVERY_EC
                     shards = await codec.encode_async(
-                        set(range(n)), data, klass=K_RECOVERY_EC)
+                        set(range(n)), data, klass=K_RECOVERY_EC,
+                    chip=self._chip())
                     t = self._shard_txn(pg, ho, shards[j], j,
                                         len(data), ver, None,
                                         hinfo_bytes(shards))
@@ -1289,7 +1304,8 @@ class ECPGBackend:
                     n = codec.get_chunk_count()
                     from ..device.runtime import K_RECOVERY_EC
                     cshards = await codec.encode_async(
-                        set(range(n)), cd, klass=K_RECOVERY_EC)
+                        set(range(n)), cd, klass=K_RECOVERY_EC,
+                    chip=self._chip())
                     ct = self._shard_txn(pg, cho, cshards[j], j,
                                          len(cd), cver, None,
                                          hinfo_bytes(cshards))

@@ -14,13 +14,15 @@ Falls back to the scalar pipeline per-PG when the crush map is outside
 the device scope (non-straw2 buckets, multi-choose rules).
 
 Device dispatches route through the shared device runtime
-(ceph_tpu.device.runtime): each pool pass is admitted under the
-"mapping" class (weight below client/recovery EC, so a full-cluster
-remap cannot starve EC writes of the accelerator), carries a
-DispatchTicket for the exporter, and degrades to the scalar host
-pipeline when admission pushes back (DeviceBusy) or the runtime is in
-device-loss fallback.  A dispatch failure poisons the runtime and
-this build finishes on the host path.
+(ceph_tpu.device.runtime) onto one mesh chip — the caller's affinity
+chip when given (an OSD passes its bound chip so per-chip isolation
+holds for mapping too), else the first available chip: each pool pass
+is admitted under the "mapping" class (weight below client/recovery
+EC, so a full-cluster remap cannot starve EC writes of the
+accelerator), carries a DispatchTicket for the exporter, and degrades
+to the scalar host pipeline when admission pushes back (DeviceBusy)
+or the chip is in device-loss fallback.  A dispatch failure poisons
+only the chip it ran on and this build finishes on the host path.
 """
 
 from __future__ import annotations
@@ -64,14 +66,15 @@ class OSDMapMapping:
     as dense arrays."""
 
     def __init__(self, osdmap: OSDMap, device_mapper=None,
-                 runtime=None):
+                 runtime=None, chip: int | None = None):
         self.epoch = osdmap.epoch
         self.pools: dict[int, PoolMapping] = {}
         self.device_pools = 0      # pools mapped on device this build
         self.scalar_pools = 0      # pools that fell back to host
-        self._build(osdmap, device_mapper, runtime)
+        self._build(osdmap, device_mapper, runtime, chip)
 
-    def _build(self, osdmap: OSDMap, device_mapper, runtime) -> None:
+    def _build(self, osdmap: OSDMap, device_mapper, runtime,
+               chip: int | None) -> None:
         state = np.asarray(osdmap.osd_state, dtype=np.int32)
         exists = (state & OSD_EXISTS) != 0
         isup = (state & OSD_UP) != 0
@@ -81,12 +84,13 @@ class OSDMapMapping:
         rt = runtime or DeviceRuntime.get()
         for pool in osdmap.pools.values():
             try:
-                if not rt.available:
-                    raise ValueError("device runtime in fallback")
+                target = rt.route(chip)
+                if target is None or not target.available:
+                    raise ValueError("mapping chip in fallback")
                 if dm is None:
                     dm = osdmap.device_mapper()
                 up, prim = self._map_pool_ticketed(
-                    osdmap, pool, dm, rt, exists, isup, aff)
+                    osdmap, pool, dm, target, exists, isup, aff)
             except (ValueError, DeviceBusy):
                 # outside device scope, admission pushback, or
                 # device-loss fallback: the scalar pipeline is the
@@ -99,31 +103,31 @@ class OSDMapMapping:
             self._apply_exceptions(osdmap, pool, pm)
             self.pools[pool.id] = pm
 
-    def _map_pool_ticketed(self, osdmap, pool, dm, rt,
+    def _map_pool_ticketed(self, osdmap, pool, dm, chip,
                            exists, isup, aff):
-        """One pool pass under a mapping-class dispatch ticket.  Sync
-        context (map advance runs outside any op coroutine), so
-        admission is the non-blocking form — a full dispatch queue
-        degrades this pass to the scalar path rather than queueing
-        device work behind EC flushes."""
-        ticket = rt.open_ticket(K_MAPPING,
-                                rt.bucket_for(pool.pg_num),
-                                pool.pg_num * pool.size * 4)
-        rt.try_admit(ticket)
+        """One pool pass under a mapping-class dispatch ticket on the
+        routed chip.  Sync context (map advance runs outside any op
+        coroutine), so admission is the non-blocking form — a full
+        dispatch queue degrades this pass to the scalar path rather
+        than queueing device work behind EC flushes."""
+        ticket = chip.open_ticket(K_MAPPING,
+                                  chip.rt.bucket_for(pool.pg_num),
+                                  pool.pg_num * pool.size * 4)
+        chip.try_admit(ticket)
         try:
-            rt.launch(ticket)       # injected-fault hook
+            chip.launch(ticket)     # injected-fault hook
             up, prim = self._map_pool_device(osdmap, pool, dm,
                                              exists, isup, aff)
         except ValueError:
             # map outside device scope: a scalar-fallback condition,
             # not a device loss
-            rt.finish(ticket, ok=False)
+            chip.finish(ticket, ok=False)
             raise
         except Exception as e:      # DeviceLost + real device faults
-            rt.finish(ticket, ok=False, error=e)
-            rt.poison(e)
+            chip.finish(ticket, ok=False, error=e)
+            chip.poison(e)
             raise ValueError("device mapping dispatch failed") from e
-        rt.finish(ticket, ok=True)
+        chip.finish(ticket, ok=True)
         return up, prim
 
     # -- vectorized pool mapping ------------------------------------------

@@ -215,13 +215,22 @@ class ExtentStore(ObjectStore):
         self._overlay = {}
 
     def statfs(self) -> dict:
-        """Real device capacity vs the allocator's free-space view
-        (omap/onode KV bytes ride the DB, not the device — the same
-        split BlueStore's statfs reports)."""
+        """Real device capacity vs the allocator's free-space view,
+        PLUS the onode/omap KV footprint: metadata rides the DB, not
+        the device, but it is real occupancy — `used` that omits it
+        undercounts every omap-heavy workload (BlueStore folds its
+        RocksDB usage into statfs the same way).  `kv_bytes` is
+        broken out so `df` consumers can see the split; `available`
+        stays the allocator's view of the block device (KV growth
+        does not shrink extent space)."""
         total = int(self.dev.size)
         free = int(self.alloc.free_bytes)
-        used = max(0, total - free)
-        return {"total": total, "used": used, "available": free}
+        kv = 0
+        for k, v in self.db.iterate():
+            kv += len(k) + len(v)
+        used = max(0, total - free) + kv
+        return {"total": total, "used": used, "available": free,
+                "kv_bytes": kv}
 
     def _replay_wal(self) -> None:
         """Apply committed-but-unapplied deferred writes.  Runs before

@@ -74,13 +74,18 @@ class LocalCluster:
 
     def __init__(self, n_osds: int = 3, n_mons: int = 1,
                  conf: dict | None = None, seed: int | None = None,
-                 with_mgr: bool = False):
+                 with_mgr: bool = False,
+                 device_chips: int | None = None):
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.conf = dict(FAST_CONF)
         self.conf.update(conf or {})
         self.seed = seed
         self.with_mgr = with_mgr
+        # force the device-mesh size before daemons bind their chips
+        # (None keeps the environment's mesh: CEPH_TPU_MESH_CHIPS /
+        # jax device count — the tier-1 conftest forces 8)
+        self.device_chips = device_chips
         self.mons: list[Monitor] = []
         self.monmap: list[tuple[str, str]] = []
         self.osds: list[OSD | None] = []
@@ -100,6 +105,9 @@ class LocalCluster:
         return inj
 
     async def start(self) -> "LocalCluster":
+        if self.device_chips is not None:
+            from ..device.runtime import DeviceRuntime
+            DeviceRuntime.reset(chips=self.device_chips)
         if self.n_mons > 1:
             self.monmap = [("mon.%d" % i, "127.0.0.1:%d" % po)
                            for i, po in

@@ -131,11 +131,20 @@ class ClusterThrasher:
                          committed profile with identical coding
                          parameters (rename/rollout path: codec cache
                          invalidation on every OSD, zero data risk);
-      device_fallback  — poison the device runtime mid-round: the
+      device_fallback  — poison the WHOLE device mesh mid-round: the
                          workload must complete on the host codec /
                          scalar-mapper paths with zero lost acked
                          writes, DEVICE_FALLBACK must raise, and the
-                         probe loop must heal it (warning clears);
+                         probe loops must heal it (warning clears);
+      chip_loss        — poison ONE mesh chip mid-round: the OSDs
+                         bound to it degrade to the host paths (the
+                         per-chip DEVICE_FALLBACK detail names the
+                         chip) while every surviving chip stays on
+                         the device path (its fallback flag never
+                         flips and it serves zero host fallbacks),
+                         writes keep completing with zero lost acked
+                         writes, and the probe loop heals only the
+                         poisoned chip (warning clears);
       osd_crash        — crash an OSD on an injected exception: the
                          report must survive in its store, surface in
                          the committed `crash ls` after revive, raise
@@ -155,7 +164,7 @@ class ClusterThrasher:
     ALL_ACTIONS = ("kill_revive", "kill_wipe_revive", "out_in",
                    "mon_partition", "map_churn", "pg_num_grow",
                    "pgp_num_grow", "ec_profile_swap",
-                   "device_fallback", "osd_crash")
+                   "device_fallback", "chip_loss", "osd_crash")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -204,7 +213,8 @@ class ClusterThrasher:
             # never plan an isolated majority: one rank only
             return (action, self.rng.randrange(self.cluster.n_mons))
         if action in ("map_churn", "pg_num_grow", "pgp_num_grow",
-                      "ec_profile_swap", "device_fallback"):
+                      "ec_profile_swap", "device_fallback",
+                      "chip_loss"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -370,6 +380,44 @@ class ClusterThrasher:
             rt.clear_faults()            # next probe heals
             await self._wait_health_check(c, "DEVICE_FALLBACK", False)
             assert not rt.fallback, "runtime did not heal"
+        elif action == "chip_loss":
+            from ..device.runtime import DeviceRuntime
+            rt = DeviceRuntime.get()
+            victim = arg % rt.n_chips
+            chip = rt.chips[victim]
+            survivors = [sc for sc in rt.chips if sc is not chip]
+            # survivors must never leave the device path: snapshot
+            # their host-fallback counters before the loss
+            pre_host = {sc.index: sc.host_fallbacks
+                        for sc in survivors}
+            chip.inject_fault(1 << 30)   # probes keep failing too
+            chip.poison("thrash: chip_loss round (chip %d)" % victim)
+            self.log.append("chip_loss: poisoned chip %d" % victim)
+            # writes keep completing: PGs whose primary sits on the
+            # lost chip encode on the host, the rest stay on-device
+            for _ in range(5):
+                assert (await workload.write_one()) is not None, \
+                    "write could not complete through the chip loss"
+            if any(o.device_chip is chip for o in c.live_osds):
+                # an OSD is bound to the lost chip: the health check
+                # must raise AND its detail must name exactly this
+                # chip (per-chip DEVICE_FALLBACK)
+                await self._wait_health_check(c, "DEVICE_FALLBACK",
+                                              True)
+                leader = c.leader()
+                check = leader.health_mon.checks()["DEVICE_FALLBACK"]
+                assert check.get("chips") == [victim], check
+            for sc in survivors:
+                assert not sc.fallback, \
+                    "surviving chip %d left the device path" \
+                    % sc.index
+                assert sc.host_fallbacks == pre_host[sc.index], \
+                    "surviving chip %d served host fallbacks " \
+                    "during the chip loss" % sc.index
+            chip.clear_faults()          # next probe heals
+            await self._wait_health_check(c, "DEVICE_FALLBACK", False)
+            assert not chip.fallback, "chip %d did not heal" % victim
+            assert all(not sc.fallback for sc in survivors)
         else:
             raise ValueError(action)
 

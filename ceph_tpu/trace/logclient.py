@@ -51,6 +51,17 @@ class LogClient:
         # level -> total entries ever queued (the
         # ceph_tpu_log_messages_total{daemon,level} exporter source)
         self.counts: dict[str, int] = {lv: 0 for lv in LEVELS}
+        # on_seq(seq) after every emit: daemons persist the last-used
+        # seq into their own store so a restart resumes ABOVE it —
+        # the LogMonitor dedups by (who, seq), so a seq reset would
+        # swallow the reborn daemon's entries as resends and let
+        # pre-restart unacked entries supersede them
+        self.on_seq = None
+
+    def resume_above(self, seq: int) -> None:
+        """Adopt a persisted floor: the next entry's seq is at least
+        `seq`+1 (restart path; no-op when the floor is behind us)."""
+        self._seq = max(self._seq, int(seq))
 
     # -- emit (the clog->error()/warn()/info() surface) -----------------
 
@@ -65,6 +76,11 @@ class LogClient:
             raise ValueError("unregistered clog severity %r (have %s)"
                              % (level, LEVELS))
         self._seq += 1
+        if self.on_seq is not None:
+            try:
+                self.on_seq(self._seq)
+            except Exception:
+                pass        # persistence must never sink the emit
         entry = {"seq": self._seq, "stamp": time.time(),
                  "who": self.daemon, "channel": channel,
                  "level": level, "message": str(message)}

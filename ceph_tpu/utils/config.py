@@ -302,6 +302,11 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("device_warmup", OPT_INT, 1,
            "pre-compile common EC shape buckets when a profile's codec"
            " is first built (0 disables)"),
+    Option("device_shard_min_words", OPT_INT, 1 << 19,
+           "EC flushes at or above this many words per chunk shard"
+           " column-wise across every available mesh chip (the"
+           " collective-free stripe-axis split); flushes below it"
+           " stay on the caller's affinity chip"),
     Option("osd_pg_log_dups_tracked", OPT_INT, 128,
            "reqid (client,tid) dup-detection journal entries kept per"
            " PG (PrimaryLogPG osd_reqid_t dedup analog)"),
@@ -318,6 +323,10 @@ DEFAULT_SCHEMA: list[Option] = [
            "un-archived crash reports newer than this raise the"
            " RECENT_CRASH health warning (mgr/crash warn_recent_"
            "interval role)"),
+    Option("mon_crash_retention", OPT_FLOAT, 30 * 24 * 3600.0,
+           "ARCHIVED crash reports older than this are auto-pruned"
+           " from the committed crash table at commit/tick time"
+           " (mgr/crash retain_interval role); <= 0 disables"),
     Option("memstore_device_bytes", OPT_INT, 1 << 30,
            "nominal device size RAM stores report in statfs (the"
            " df raw-capacity denominator)"),

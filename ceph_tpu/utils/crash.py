@@ -110,3 +110,35 @@ def remove_crash(store, crash_id: str) -> None:
         t = Transaction()
         t.remove(META_COLL, ho)
         store.apply_transaction(t)
+
+
+# -- daemon meta values (the same meta collection crash reports use) --------
+
+CLOG_SEQ_OBJ = hobject_t("clog_seq")
+
+
+def load_clog_seq(store) -> int:
+    """The last clog sequence number this daemon's previous
+    incarnation used (0 when none was ever persisted)."""
+    try:
+        if not store.collection_exists(META_COLL):
+            return 0
+        return int(denc.decode(store.read(META_COLL, CLOG_SEQ_OBJ)))
+    except Exception:       # missing / torn: start from zero
+        return 0
+
+
+def save_clog_seq(store, seq: int) -> None:
+    """Persist the daemon's last-used clog seq into its own store so
+    a restart resumes ABOVE it: the LogMonitor dedups by (who, seq),
+    so a rebooted daemon that restarted from 1 would have its fresh
+    entries silently swallowed as resends of already-committed seqs
+    (and could never supersede its pre-restart unacked ones)."""
+    t = Transaction()
+    if not store.collection_exists(META_COLL):
+        t.create_collection(META_COLL)
+    blob = denc.encode(int(seq))
+    t.touch(META_COLL, CLOG_SEQ_OBJ)
+    t.truncate(META_COLL, CLOG_SEQ_OBJ, 0)
+    t.write(META_COLL, CLOG_SEQ_OBJ, 0, len(blob), blob)
+    store.apply_transaction(t)

@@ -210,7 +210,9 @@ def device_runtime_lines(prefix: str = "ceph_tpu") -> list[str]:
     bucket hit ratio, compile count, fallback state, and the
     device_dispatch_seconds histogram — every dispatch ticket feeds
     these, so the accelerator's behavior is scrapeable beside the
-    daemon counters."""
+    daemon counters.  Every series carries a ``chip`` label (one per
+    mesh chip, so a single lost chip is visible as ITS series
+    flipping) plus the unlabeled mesh-size gauge."""
     from ..device.runtime import DeviceRuntime
     return DeviceRuntime.get().prom_lines(prefix)
 

@@ -274,6 +274,96 @@ def test_crash_roundtrip_recent_crash_and_archive():
     run(main())
 
 
+def test_clog_seq_resumes_above_restart():
+    """A restarted daemon resumes its clog seq ABOVE the floor
+    persisted in its own store: the LogMonitor dedups by (who, seq),
+    so a seq reset would swallow the reborn daemon's entries as
+    resends of already-committed ones (and pre-restart unacked
+    entries could supersede them) — the carry-forward gap."""
+    from ceph_tpu.utils.crash import load_clog_seq
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("seqp", pg_num=4)
+            await c.wait_health(pid)
+            osd0 = c.osds[0]
+            osd0.clog.info("pre-restart marker entry")
+            pre_seq = osd0.clog._seq
+            assert pre_seq > 0
+            # the floor is persisted in the daemon's own store
+            assert load_clog_seq(osd0.store) == pre_seq
+            mon = c.mons[0]
+            await wait_for(
+                lambda: any(e.get("message")
+                            == "pre-restart marker entry"
+                            for e in mon.log_mon.entries),
+                20, what="pre-restart entry committed")
+            await c.kill_osd(0)
+            await c.wait_osd_down(0)
+            await c.revive_osd(0)
+            await c.wait_osd_up(0)
+            osd0b = c.osds[0]
+            assert osd0b is not osd0
+            assert osd0b.clog._seq >= pre_seq   # resumed above
+            entry = osd0b.clog.queue("INF", "post-restart marker")
+            osd0b.clog.flush()
+            assert entry["seq"] > pre_seq
+            # the post-restart entry COMMITS (a seq reset would have
+            # been deduped away as a resend)
+            await wait_for(
+                lambda: any(e.get("message") == "post-restart marker"
+                            for e in mon.log_mon.entries),
+                20, what="post-restart entry committed")
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_crash_table_auto_prune_retention():
+    """ARCHIVED reports older than mon_crash_retention are removed
+    from the COMMITTED table at tick time (the clock hook pins
+    "now"), while un-archived reports are never pruned — an operator
+    cannot silently lose a post-mortem they have not acknowledged."""
+
+    async def main():
+        c = await LocalCluster(
+            n_osds=3, conf={"mon_crash_retention": 3600.0}).start()
+        try:
+            pid = await c.create_pool("prune", pg_num=4)
+            await c.wait_health(pid)
+            cid = await c.crash_osd(0, "prunable crash")
+            await c.wait_osd_down(0)
+            await c.revive_osd(0)
+            await c.wait_osd_up(0)
+            mon = c.mons[0]
+            await wait_for(lambda: cid in mon.crash_mon.reports, 20,
+                           what="crash committed")
+            # jump the prune clock far past retention: the
+            # UN-archived report must survive every tick
+            import time as _t
+            mon.crash_mon.clock = lambda: _t.time() + 10 * 3600.0
+            await asyncio.sleep(2.5)        # > one mon tick
+            assert cid in mon.crash_mon.reports, \
+                "un-archived report was pruned"
+            # once archived, the next tick prunes it via a committed
+            # rm (the table itself shrinks, not just the summary)
+            await c.client.mon_command("crash archive", id=cid)
+            await wait_for(
+                lambda: cid not in mon.crash_mon.reports, 20,
+                what="archived report pruned from the table")
+            out = await c.client.mon_command("crash ls")
+            assert out["crashes"] == []
+            log = await c.client.mon_command("log last", n=50)
+            assert any("pruned" in e["message"]
+                       for e in log["lines"])
+        finally:
+            await c.stop()
+
+    run(main())
+
+
 # -- statfs / df raw-capacity axis ------------------------------------------
 
 

@@ -61,7 +61,55 @@ def test_device_fallback_thrash_round():
             await wl.verify()           # every acked write intact
             assert wl.acked, "workload never acked a write"
             assert not rt.fallback
-            assert rt.fallback_count == 1 and rt.heal_count == 1
+            # whole-device loss: every mesh chip poisoned and healed
+            # exactly once
+            assert rt.fallback_count == rt.n_chips
+            assert rt.heal_count == rt.n_chips
+        finally:
+            await c.stop()
+
+    run(coro=main(), timeout=300)
+
+
+def test_chip_loss_thrash_round():
+    """The ISSUE's acceptance round: poison ONE mesh chip mid-round —
+    zero lost acked writes, per-chip DEVICE_FALLBACK raise->heal on
+    the poisoned chip only (the health detail names it), and every
+    surviving chip stays on the device path throughout (asserted
+    inside the thrasher action: fallback flag never flips, zero host
+    fallbacks served)."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, seed=4242).start()
+        try:
+            rt = DeviceRuntime.get()
+            assert rt.n_chips >= 3      # conftest's 8-chip mesh
+            rt._probe_base = 0.02
+            rt._probe_cap = 0.1
+            # 3 OSDs on distinct chips (modulo affinity)
+            assert len({o.device_chip.index for o in c.live_osds}) \
+                == 3
+            pid = await c.create_pool("ecmesh", pg_num=4,
+                                      pool_type="erasure")
+            await c.wait_health(pid)
+            wl = Workload(c.client.io_ctx("ecmesh"), seed=7,
+                          prefix="chiploss").start()
+            # victim pinned to chip 1 = osd.1's affinity chip, so the
+            # round exercises a chip that IS bound to a live OSD
+            th = ClusterThrasher(c, seed=11,
+                                 actions=[("chip_loss", 1)])
+            await th.run(pid, wl)
+            await wl.stop()
+            await wl.verify()           # every acked write intact
+            assert wl.acked, "workload never acked a write"
+            victim = rt.chips[1]
+            assert not victim.fallback
+            assert victim.fallback_count == 1
+            assert victim.heal_count == 1
+            # the rest of the mesh never degraded
+            for chip in rt.chips:
+                if chip is not victim:
+                    assert chip.fallback_count == 0, chip.index
         finally:
             await c.stop()
 

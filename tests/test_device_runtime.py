@@ -167,11 +167,12 @@ def test_queue_full_raises_device_busy():
 
 
 def test_fallback_and_heal_roundtrip():
-    """An injected dispatch fault poisons the runtime: the in-flight
-    flush is re-encoded on the host (callers never see the loss),
-    subsequent encodes take the host path, and once the fault clears
-    the probe loop heals the runtime and dispatches go back to the
-    device."""
+    """An injected dispatch fault poisons ONLY the chip it ran on:
+    the in-flight flush is re-encoded on the host (callers never see
+    the loss), encodes bound to that chip take the host path while
+    the rest of the mesh keeps serving on-device, and once the fault
+    clears the probe loop heals the chip and its dispatches go back
+    to the device."""
     codec = _codec("isa", technique="reed_sol_van", k=4, m=2)
     n = codec.get_chunk_count()
     rng = np.random.default_rng(5)
@@ -182,26 +183,76 @@ def test_fallback_and_heal_roundtrip():
         rt = DeviceRuntime.reset()
         rt._probe_base = 0.01
         rt._probe_cap = 0.05
-        rt.inject_fault(1 << 30)
-        out = await codec.encode_async(set(range(n)), data)
+        chip = rt.chips[0]
+        chip.inject_fault(1 << 30)
+        out = await codec.encode_async(set(range(n)), data, chip=0)
         for i in host:
             assert out[i] == host[i], i     # host fallback, exact
-        assert rt.fallback
-        assert rt.host_fallbacks >= 1
-        # while poisoned, encodes bypass the batcher entirely
-        out2 = await codec.encode_async(set(range(n)), data)
+        assert chip.fallback
+        assert not rt.fallback      # one chip lost != the mesh lost
+        assert chip.host_fallbacks >= 1
+        # while ITS chip is poisoned, chip-bound encodes bypass the
+        # batcher entirely (the daemon-side gate)
+        out2 = await codec.encode_async(set(range(n)), data, chip=0)
         assert out2[n - 1] == host[n - 1]
-        rt.clear_faults()                   # next probe heals
+        assert chip.dispatches == 0
+        # ...but another chip's callers keep dispatching on-device
+        if rt.n_chips > 1:
+            other = rt.chips[1]
+            before_other = other.dispatches
+            out3 = await codec.encode_async(set(range(n)), data,
+                                            chip=1)
+            assert out3[0] == host[0]
+            assert other.dispatches == before_other + 1
+            assert not other.fallback
+        chip.clear_faults()                 # next probe heals
         for _ in range(200):
-            if not rt.fallback:
+            if not chip.fallback:
                 break
             await asyncio.sleep(0.02)
-        assert not rt.fallback, "probe loop did not heal the runtime"
-        assert rt.heal_count == 1
+        assert not chip.fallback, "probe loop did not heal the chip"
+        assert chip.heal_count == 1
+        before = chip.dispatches
+        out4 = await codec.encode_async(set(range(n)), data, chip=0)
+        assert out4[0] == host[0]
+        assert chip.dispatches == before + 1    # back on the device
+
+    run(main())
+
+
+def test_whole_mesh_loss_and_heal():
+    """Mesh-wide poison (catastrophic device loss, the pre-mesh
+    shape): every chip flips, the aggregate `fallback` raises,
+    chip-less encodes take the host path, and clearing the fault
+    budget lets the per-chip probe loops heal the whole mesh."""
+    codec = _codec("isa", technique="reed_sol_van", k=4, m=2)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()
+    host = codec.encode(set(range(n)), data)
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        rt._probe_base = 0.01
+        rt._probe_cap = 0.05
+        rt.inject_fault(1 << 30)
+        rt.poison("test: whole-mesh loss")
+        assert rt.fallback
+        assert not rt.available
+        assert rt.fallback_count == rt.n_chips
+        out = await codec.encode_async(set(range(n)), data)
+        assert out[0] == host[0]            # host path, exact
+        rt.clear_faults()
+        for _ in range(400):
+            if not rt.fallback and rt.heal_count == rt.n_chips:
+                break
+            await asyncio.sleep(0.02)
+        assert not rt.fallback, "probes did not heal the mesh"
+        assert rt.heal_count == rt.n_chips
         before = rt.dispatches
-        out3 = await codec.encode_async(set(range(n)), data)
-        assert out3[0] == host[0]
-        assert rt.dispatches == before + 1  # back on the device
+        out2 = await codec.encode_async(set(range(n)), data)
+        assert out2[0] == host[0]
+        assert rt.dispatches == before + 1
 
     run(main())
 
@@ -345,6 +396,203 @@ def test_exporter_device_series():
                  "ceph_tpu_device_compile_count",
                  "ceph_tpu_device_fallback"):
         assert name in text, name
+
+
+# -- mesh: enumeration, affinity, stripe-axis sharding ---------------------
+
+
+def test_mesh_enumeration_under_forced_device_count():
+    """tier-1 CI runs under the conftest's forced 8-device virtual
+    CPU platform (XLA_FLAGS=--xla_force_host_platform_device_count=8
+    via utils.jaxenv): the mesh must see all 8 as real jax devices
+    and the runtime must build one ChipRuntime per chip, each with
+    its own queue/pool/fallback state."""
+    import jax
+
+    from ceph_tpu.device import mesh
+
+    assert len(jax.local_devices()) == 8
+    assert mesh.chip_count() == 8
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        assert rt.n_chips == 8
+        assert len({id(c.queue) for c in rt.chips}) == 8
+        assert len({id(c.pool) for c in rt.chips}) == 8
+        # each chip is backed by a distinct physical device
+        assert len({c.jax_device.id for c in rt.chips}) == 8
+
+    run(main())
+
+
+def test_simulated_mesh_env_subprocess():
+    """mesh.simulated_mesh_env is the from-scratch CI recipe (vstart /
+    bench --device use it): a fresh process launched with it sees the
+    forced device count and builds a matching mesh — no TPU needed."""
+    import os
+    import subprocess
+    import sys
+
+    from ceph_tpu.device import mesh
+
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from ceph_tpu.device.runtime import DeviceRuntime\n"
+        "assert len(jax.local_devices()) == 4, jax.local_devices()\n"
+        "rt = DeviceRuntime()\n"
+        "assert rt.n_chips == 4, rt.n_chips\n"
+        "print('MESH_OK')\n")
+    env = mesh.simulated_mesh_env(4)
+    env.pop(mesh.MESH_ENV, None)
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "MESH_OK" in out.stdout
+
+
+def test_osd_chip_affinity_spreads():
+    """Co-located OSDs land on distinct chips until the mesh is full
+    (deterministic modulo affinity — a chip loss maps to a knowable
+    OSD subset)."""
+
+    async def main():
+        rt = DeviceRuntime.reset(chips=4)
+        assert [rt.chip_for(o).index for o in range(6)] \
+            == [0, 1, 2, 3, 0, 1]
+        # an explicit chip route is honored even while poisoned (the
+        # affinity chip IS the isolation domain)
+        rt.chips[2].poison("t")
+        assert rt.route(2) is rt.chips[2]
+        # chip-less routing skips poisoned chips
+        rt.chips[0].poison("t")
+        assert rt.route(None) is rt.chips[1]
+
+    run(main())
+
+
+def test_mesh_sharded_encode_bit_parity():
+    """Stripe-axis mesh sharding: an oversized flush splits its word
+    columns across every available chip and reassembles
+    BIT-IDENTICALLY to the single-chip and host codec paths — across
+    dp=1,2,4,8 and mixed (non-bucket) sizes.  This is the
+    collective-free split MULTICHIP_SCALING.json proves; parity is
+    the acceptance oracle."""
+    codec = _codec("isa", technique="reed_sol_van", k=5, m=3)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(21)
+    blobs = [rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+             for size in (40_000, 100_001, 260_000, 37_123)]
+    host = [codec.encode(set(range(n)), d) for d in blobs]
+
+    for dp in (1, 2, 4, 8):
+        async def main(dp=dp):
+            rt = DeviceRuntime.reset(chips=dp)
+            rt.shard_min_words = 1024       # force the mesh split
+            from ceph_tpu.ec.batcher import DeviceBatcher
+            bat = DeviceBatcher.get()
+            before = bat.sharded_flushes
+            for d, h in zip(blobs, host):
+                out = await codec.encode_async(set(range(n)), d)
+                for i in h:
+                    assert out[i] == h[i], (dp, len(d), i)
+            if dp > 1:
+                assert bat.sharded_flushes > before
+                # the split genuinely used multiple chips
+                assert sum(1 for c in rt.chips
+                           if c.dispatches > 0) > 1
+            else:
+                assert bat.sharded_flushes == before
+
+        run(main())
+
+
+def test_mesh_sharded_decode_bit_parity():
+    """Reconstruction (decode-as-encode) rides the same mesh split:
+    a sharded degraded read rebuilds erased chunks bit-identically
+    to the host decode."""
+    codec = _codec("isa", technique="reed_sol_van", k=4, m=2)
+    n = codec.get_chunk_count()
+    k = codec.get_data_chunk_count()
+    rng = np.random.default_rng(23)
+    data = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    full = codec.encode(set(range(n)), data)
+
+    async def main():
+        rt = DeviceRuntime.reset(chips=4)
+        rt.shard_min_words = 1024
+        survivors = {i: full[i] for i in range(n) if i not in (1, 4)}
+        host = codec.decode({1}, dict(survivors))
+        dev = await codec.decode_async({1}, dict(survivors))
+        assert dev[1] == host[1]
+        decoded = await codec.decode_async(set(range(k)),
+                                           dict(survivors))
+        got = b"".join(decoded[i] for i in range(k))
+        assert got.startswith(data)     # padded tail beyond payload
+
+    run(main())
+
+
+def test_mesh_shard_loss_mid_flush():
+    """A chip dying mid-sharded-flush poisons ONLY itself: its shard
+    re-encodes on the host inline, the flush still reassembles
+    bit-identically, and the other chips stay clean on the device
+    path."""
+    codec = _codec("isa", technique="reed_sol_van", k=4, m=2)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(29)
+    data = rng.integers(0, 256, 120_000, dtype=np.uint8).tobytes()
+    host = codec.encode(set(range(n)), data)
+
+    async def main():
+        rt = DeviceRuntime.reset(chips=4)
+        rt.shard_min_words = 1024
+        rt.chips[2].inject_fault(1)     # third shard's chip dies
+        out = await codec.encode_async(set(range(n)), data)
+        for i in host:
+            assert out[i] == host[i], i
+        assert rt.chips[2].fallback
+        assert rt.chips[2].host_fallbacks == 1
+        for c in rt.chips:
+            if c.index != 2:
+                assert not c.fallback, c.index
+                assert c.host_fallbacks == 0
+                assert c.dispatches >= 1
+        # the next oversized flush excludes the poisoned chip from
+        # its shard plan and still reassembles exactly
+        out2 = await codec.encode_async(set(range(n)), data)
+        for i in host:
+            assert out2[i] == host[i], i
+        assert rt.chips[2].host_fallbacks == 1  # not routed again
+
+    run(main())
+
+
+def test_exporter_chip_labels():
+    """Every device series carries a chip label per mesh chip, the
+    mesh-size gauge is present, and the document passes the
+    exposition lint."""
+    codec = _codec("jerasure", technique="reed_sol_van", k=2, m=1)
+    n = codec.get_chunk_count()
+
+    async def main():
+        DeviceRuntime.reset(chips=3)
+        await codec.encode_async(set(range(n)), b"z" * 4096)
+        from ceph_tpu.utils.exporter import device_runtime_lines
+        return "\n".join(device_runtime_lines())
+
+    text = run(main())
+    from ceph_tpu.utils.exporter import validate_exposition
+    assert validate_exposition(text) == []
+    assert "ceph_tpu_device_chips 3" in text
+    for chip in range(3):
+        assert 'ceph_tpu_device_fallback{chip="%d"}' % chip in text
+    # exactly one dispatch, attributed to the routed chip
+    assert 'ceph_tpu_device_dispatches{chip="0"} 1' in text
+    assert 'ceph_tpu_device_dispatches{chip="1"} 0' in text
 
 
 def test_warmup_precompiles_buckets():

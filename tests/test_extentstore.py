@@ -410,3 +410,39 @@ def test_failed_op_rolls_back_ram_state(tmp_path):
     assert not s2.exists(CID, hobject_t("phantom", pool=1))
     assert s2.exists(CID, oid)
     s2.umount()
+
+
+# -- statfs: KV (onode/omap) bytes count as used ---------------------------
+
+
+def test_statfs_counts_omap_kv_bytes(tmp_path):
+    """`used` includes the onode/omap KV footprint, not just device
+    blocks: omap-only writes (zero extent allocation) must still grow
+    `used` — the carry-forward undercount where an omap-heavy
+    workload reported a near-empty store."""
+    s = mkstore(tmp_path)
+    oid = hobject_t("omapped", pool=1)
+    t = Transaction()
+    t.touch(CID, oid)
+    s.apply_transaction(t)
+    sf0 = s.statfs()
+    assert sf0["kv_bytes"] > 0          # superblock + onodes
+    free0 = s.alloc.free_bytes
+    t = Transaction()
+    t.omap_setkeys(CID, oid, {b"k%d" % i: b"v" * 512
+                              for i in range(64)})
+    s.apply_transaction(t)
+    sf1 = s.statfs()
+    # no device blocks moved, but used (and kv_bytes) grew by at
+    # least the omap payload
+    assert s.alloc.free_bytes == free0
+    assert sf1["kv_bytes"] >= sf0["kv_bytes"] + 64 * 512
+    assert sf1["used"] >= sf0["used"] + 64 * 512, (sf0, sf1)
+    assert sf1["total"] >= sf1["used"]
+    # and removal shrinks it back
+    t = Transaction()
+    t.omap_clear(CID, oid)
+    s.apply_transaction(t)
+    sf2 = s.statfs()
+    assert sf2["kv_bytes"] < sf1["kv_bytes"]
+    s.umount()
