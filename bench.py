@@ -747,9 +747,249 @@ def _publish_baseline(rec: dict) -> None:
         rec.setdefault("extra", {})["publish_error"] = repr(e)[:200]
 
 
+def _bench_pgmap_fold(n_rows: int = 100_000) -> dict:
+    """Columnar-vs-dict PGMap fold micro-benchmark: ingest the same
+    synthetic 100k-row report set into both implementations, time the
+    digest fold (the per-tick cost at scale), publish the speedup."""
+    import numpy as np
+
+    from ceph_tpu.mgr.pgmap import DictPGMap, PGMap
+
+    rng = np.random.default_rng(23)
+    pools = rng.integers(1, 13, n_rows)
+    daemons = rng.integers(0, 64, n_rows)
+    objs = rng.integers(0, 100, n_rows)
+    wops = rng.integers(0, 10000, n_rows)
+    by_daemon: dict = {}
+    for i in range(n_rows):
+        by_daemon.setdefault("osd.%d" % daemons[i], []).append({
+            "pgid": "%d.%x" % (pools[i], i), "pool": int(pools[i]),
+            "state": "active", "num_objects": int(objs[i]),
+            "num_bytes": int(objs[i]) << 20, "degraded": 0,
+            "misplaced": int(objs[i]) % 3, "unfound": 0,
+            "log_size": 10, "read_ops": int(wops[i]),
+            "read_bytes": 0, "write_ops": int(wops[i]),
+            "write_bytes": int(wops[i]) << 12,
+            "recovery_ops": 0, "recovery_bytes": 0})
+    out: dict = {"rows": n_rows}
+    for label, cls in (("dict", DictPGMap), ("columnar", PGMap)):
+        pm = cls(stale_after=1e9)
+        for d, rows in by_daemon.items():
+            pm.apply_report(d, rows, None, stamp=100.0)
+        for d, rows in by_daemon.items():
+            bumped = [dict(r, write_ops=r["write_ops"] + 32)
+                      for r in rows]
+            pm.apply_report(d, bumped, None, stamp=104.0)
+        samples = []
+        dig = None
+        for _ in range(5):
+            t0 = time.perf_counter()
+            dig = pm.digest(now=104.0)
+            samples.append(time.perf_counter() - t0)
+        out["%s_fold_s" % label] = round(sorted(samples)[2], 4)
+        out["%s_num_pgs" % label] = dig["num_pgs"]
+    out["speedup_x"] = round(out["dict_fold_s"]
+                             / max(out["columnar_fold_s"], 1e-9), 1)
+    return out
+
+
+def bench_scale(sizes: tuple = (1000,)) -> dict:
+    """--scale mode: boot shell clusters through the real mon path
+    (ceph_tpu.scale), churn topology, and publish the control-plane
+    figures — boot-storm epoch folding, map-epoch convergence after
+    churn, misplaced-fraction drain through the stats plane, batched
+    balancer stddev before/after — plus the columnar PGMap fold
+    micro-benchmark, into SCALE.json + BASELINE.json with a
+    regression gate."""
+    import asyncio
+
+    from ceph_tpu.scale import ScaleCluster
+
+    async def leg(n: int) -> dict:
+        row: dict = {"shells": n}
+        c = await ScaleCluster(n, conf={"log_level": 0}).start()
+        try:
+            mon = c.mons[0]
+            row["boot_seconds"] = round(c.boot_seconds, 2)
+            row["boot_epochs"] = mon.osdmap.epoch
+            pg_num = min(4096, 4 * n)
+            t0 = time.perf_counter()
+            await c.create_pool("scale", pg_num=pg_num)
+            await c.wait_epoch_converged(c.leader().osdmap.epoch,
+                                         timeout=120.0)
+            deadline = time.perf_counter() + 180.0
+            while (c.digest() or {}).get("num_pgs") != pg_num:
+                if time.perf_counter() > deadline:
+                    raise TimeoutError("digest never filled")
+                await asyncio.sleep(0.3)
+            row["pg_num"] = pg_num
+            row["digest_fill_seconds"] = round(
+                time.perf_counter() - t0, 2)
+            # churn: mark out 1%, measure command->converged
+            t0 = time.perf_counter()
+            victims = await c.mark_out_fraction(0.01)
+            conv = await c.wait_epoch_converged(
+                c.leader().osdmap.epoch, timeout=180.0)
+            row["churned_osds"] = len(victims)
+            row["epoch_convergence_seconds"] = round(
+                time.perf_counter() - t0, 2)
+            drain = await c.wait_misplaced_drained(timeout=300.0)
+            row["max_misplaced"] = drain["max_misplaced"]
+            row["misplaced_drain_seconds"] = round(
+                drain["drain_seconds"], 2)
+            row["max_recovery_rate"] = round(
+                drain["max_recovery_rate"], 1)
+            # balancer tick (batched scorer through the mgr)
+            info = await c.mgr.balancer_tick()
+            row["balancer"] = {
+                "candidates_scored": info.get("candidates_scored", 0),
+                "device_rounds": info.get("device_rounds", 0),
+                "changes": info.get("changes", 0),
+                "stddev_before": round(
+                    info.get("stddev_before", 0.0), 3),
+                "stddev_after": round(
+                    info.get("stddev_after", 0.0), 3),
+            }
+            row["full_maps_sent"] = mon.full_maps_sent
+            row["inc_epochs_sent"] = mon.inc_epochs_sent
+            _ = conv
+        finally:
+            await c.stop()
+        return row
+
+    legs = [asyncio.run(asyncio.wait_for(leg(n), 900)) for n in sizes]
+    rec = {
+        "metric": "scale_plane",
+        "legs": legs,
+        "pgmap_fold": _bench_pgmap_fold(),
+    }
+    rec["gate"] = _gate_scale(rec)
+    _publish_scale(rec)
+    return rec
+
+
+def _gate_scale(rec: dict) -> dict:
+    """Scale-plane regression gate: structural invariants always
+    (booted, churn observed through the stats plane, balancer
+    improved, >= 1000 candidates in one dispatch, columnar fold not
+    slower than dict), timing vs the published SCALE.json with a 3x
+    allowance (shared-CI jitter)."""
+    import os
+    failures = []
+    published = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SCALE.json")
+    try:
+        with open(path) as f:
+            for r in (json.load(f).get("measured") or {}) \
+                    .get("legs", []):
+                published[int(r["shells"])] = r
+    except Exception:
+        pass
+    for r in rec["legs"]:
+        n = r["shells"]
+        if r.get("max_misplaced", 0) <= 0:
+            failures.append("%d: churn never surfaced misplaced" % n)
+        bal = r.get("balancer") or {}
+        if bal.get("candidates_scored", 0) < 1000:
+            failures.append("%d: balancer scored %d < 1000 candidates"
+                            % (n, bal.get("candidates_scored", 0)))
+        if bal.get("stddev_after", 0) > bal.get("stddev_before", 0):
+            failures.append("%d: balancer worsened stddev" % n)
+        if r.get("full_maps_sent", 0) > 10:
+            failures.append("%d: %d full maps (publication must stay"
+                            " incremental)" % (n, r["full_maps_sent"]))
+        prev = published.get(n)
+        if prev:
+            for key in ("epoch_convergence_seconds",
+                        "misplaced_drain_seconds"):
+                if prev.get(key) and r.get(key, 0) > 3 * prev[key]:
+                    failures.append(
+                        "%d: %s %.2fs regressed past 3x the"
+                        " published %.2fs"
+                        % (n, key, r[key], prev[key]))
+    fold = rec.get("pgmap_fold") or {}
+    if fold.get("speedup_x", 0) < 1.0:
+        failures.append("columnar fold slower than dict (%.2fx)"
+                        % fold.get("speedup_x", 0))
+    if fold.get("dict_num_pgs") != fold.get("columnar_num_pgs"):
+        failures.append("fold outputs disagree")
+    return {"ok": not failures, "failures": failures}
+
+
+def _publish_scale(rec: dict) -> None:
+    """Fold the measured legs into SCALE.json + BASELINE.json's
+    published map.  A failed gate publishes nothing (the committed
+    artifact stays the last good run)."""
+    import os
+    if not rec.get("gate", {}).get("ok"):
+        return
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        path = os.path.join(root, "SCALE.json")
+        doc = {}
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            pass
+        doc["measured"] = {
+            "source": "bench.py --scale",
+            "legs": rec["legs"],
+            "pgmap_fold": rec["pgmap_fold"],
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+        return
+    try:
+        path = os.path.join(root, "BASELINE.json")
+        with open(path) as f:
+            doc = json.load(f)
+        biggest = rec["legs"][-1]
+        doc.setdefault("published", {})["scale_plane"] = {
+            "shells": biggest["shells"],
+            "boot_seconds": biggest["boot_seconds"],
+            "epoch_convergence_seconds":
+                biggest["epoch_convergence_seconds"],
+            "misplaced_drain_seconds":
+                biggest["misplaced_drain_seconds"],
+            "balancer_candidates_scored":
+                biggest["balancer"]["candidates_scored"],
+            "balancer_stddev_before":
+                biggest["balancer"]["stddev_before"],
+            "balancer_stddev_after":
+                biggest["balancer"]["stddev_after"],
+            "pgmap_fold_speedup_x": rec["pgmap_fold"]["speedup_x"],
+            "source": "bench.py --scale",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+
+
 def main() -> None:
     if "--trace" in sys.argv:
         print(json.dumps(bench_trace()))
+        return
+    if "--scale" in sys.argv:
+        _maybe_simulate_mesh()
+        sizes = (1000,)
+        i = sys.argv.index("--scale")
+        if i + 1 < len(sys.argv) and \
+                sys.argv[i + 1].replace(",", "").isdigit():
+            sizes = tuple(int(s) for s in
+                          sys.argv[i + 1].split(",") if s)
+        rec = bench_scale(sizes)
+        print(json.dumps(rec))
+        if not rec["gate"]["ok"]:
+            # the scale figures are guarded artifacts like the dp
+            # curve: a regression is a CI failure, not a quieter JSON
+            sys.exit(1)
         return
     if "--device" in sys.argv:
         # force the virtual mesh BEFORE anything imports jax (no-op

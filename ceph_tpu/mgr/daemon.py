@@ -105,7 +105,8 @@ class Manager:
             return True
         from ..msg.messages import MLogAck
         if isinstance(msg, MLogAck):
-            self.clog.handle_ack(msg.who, int(msg.last or 0))
+            self.clog.handle_ack(msg.who, int(msg.last or 0),
+                                 inc=getattr(msg, "inc", None))
             return True
         if isinstance(msg, MOSDMapMsg):
             self.osdmap, _ = consume_map_payload(
@@ -331,48 +332,90 @@ class Manager:
         """pybind/mgr/balancer Module.serve: periodically run the
         upmap optimizer against the current map and commit its
         pg_upmap_items through the monitor."""
-        from ..osd.balancer import calc_pg_upmaps
-
         while True:
             await asyncio.sleep(self.balance_interval)
             if not self.balancer_enabled or not self.osdmap.pools:
                 continue
-            inc = self.osdmap.new_incremental()
             try:
-                n = calc_pg_upmaps(self.osdmap, inc,
-                                   max_deviation=1.0,
-                                   max_iterations=32)
+                await self.balancer_tick()
             except Exception as e:
                 self.ctx.log.info("mgr", "balancer failed: %r" % e)
-                continue
-            self.balancer_rounds += 1
-            removals = [pgid for pgid in inc.old_pg_upmap_items
-                        if pgid not in inc.new_pg_upmap_items]
-            if not n and not removals:
-                continue
-            for pgid, items in inc.new_pg_upmap_items.items():
-                try:
-                    if items:
-                        await self.mon_command(
-                            "osd pg-upmap-items", pool=pgid.pool,
-                            ps=pgid.ps,
-                            mappings=[list(t) for t in items])
-                    else:
-                        await self.mon_command(
-                            "osd rm-pg-upmap-items", pool=pgid.pool,
-                            ps=pgid.ps)
-                    self.balancer_changes += 1
-                except Exception as e:
-                    self.ctx.log.info(
-                        "mgr", "upmap commit failed: %r" % e)
-            for pgid in removals:
-                # stale entries the optimizer retired (e.g. the source
-                # osd left the raw set) — committed as removals too
-                try:
+
+    async def balancer_tick(self) -> dict:
+        """One optimizer round + commit (shared by the autonomous
+        loop and `bench.py --scale`).  Mode rides
+        `mgr_balancer_mode`: 'batched' generates every candidate move
+        and scores them in bulk device dispatches
+        (scale.balancer.batched_calc_pg_upmaps — the TPU-scored
+        balancer); 'sequential' keeps the reference's greedy
+        calc_pg_upmaps walk.  Both emit items through the identical
+        validity rules, so the committed upmaps agree in effect."""
+        from ..osd.balancer import calc_pg_upmaps
+
+        mode = str(self.ctx.conf.get("mgr_balancer_mode", "batched"))
+        inc = self.osdmap.new_incremental()
+        info: dict = {"mode": mode}
+        if mode == "batched":
+            from ..scale.balancer import batched_calc_pg_upmaps
+
+            def opt():
+                return batched_calc_pg_upmaps(
+                    self.osdmap, inc, max_deviation=1.0,
+                    max_changes=int(self.ctx.conf.get(
+                        "mgr_balancer_max_changes", 48)))
+
+            if self.osdmap.max_osd >= 200:
+                # big maps: the raw-row build + scoring is seconds of
+                # synchronous work on a CPU backend — run it off-loop
+                # so beacons/digests keep flowing (vstart-size maps
+                # stay inline: cheap, and clear of any thread overlap
+                # with live EC dispatch)
+                res = await asyncio.get_event_loop() \
+                    .run_in_executor(None, opt)
+            else:
+                res = opt()
+            n = res.changes
+            info.update(
+                candidates_scored=res.candidates_scored,
+                device_rounds=res.device_rounds,
+                host_rounds=res.host_rounds,
+                stddev_before=res.stddev_before,
+                stddev_after=res.stddev_after)
+        else:
+            n = calc_pg_upmaps(self.osdmap, inc, max_deviation=1.0,
+                               max_iterations=32)
+        info["changes"] = n
+        self.balancer_rounds += 1
+        removals = [pgid for pgid in inc.old_pg_upmap_items
+                    if pgid not in inc.new_pg_upmap_items]
+        if n or removals:
+            await self._commit_upmaps(inc, removals)
+        return info
+
+    async def _commit_upmaps(self, inc, removals) -> None:
+        for pgid, items in inc.new_pg_upmap_items.items():
+            try:
+                if items:
+                    await self.mon_command(
+                        "osd pg-upmap-items", pool=pgid.pool,
+                        ps=pgid.ps,
+                        mappings=[list(t) for t in items])
+                else:
                     await self.mon_command(
                         "osd rm-pg-upmap-items", pool=pgid.pool,
                         ps=pgid.ps)
-                    self.balancer_changes += 1
-                except Exception as e:
-                    self.ctx.log.info(
-                        "mgr", "upmap removal failed: %r" % e)
+                self.balancer_changes += 1
+            except Exception as e:
+                self.ctx.log.info(
+                    "mgr", "upmap commit failed: %r" % e)
+        for pgid in removals:
+            # stale entries the optimizer retired (e.g. the source
+            # osd left the raw set) — committed as removals too
+            try:
+                await self.mon_command(
+                    "osd rm-pg-upmap-items", pool=pgid.pool,
+                    ps=pgid.ps)
+                self.balancer_changes += 1
+            except Exception as e:
+                self.ctx.log.info(
+                    "mgr", "upmap removal failed: %r" % e)

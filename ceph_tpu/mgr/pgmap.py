@@ -20,9 +20,21 @@ delta machinery), and renders:
 Counter resets (primary restart or failover) surface as negative
 deltas and clamp to zero — exactly one digest period of undercounted
 rate, never a negative or wildly inflated one.
+
+**Columnar storage** (the scale-plane shape): at 100k-1M PG rows the
+per-tick fold (pool totals + state counts + digest) dominates the
+mgr, so rows live in flat numpy columns — one int64/float64 array per
+stat — and every fold is a vectorized masked pass (staleness window,
+pool filter, per-pool segment sums) instead of a python dict walk.
+Ingest stays row-wise (one primary's report is small); the fold is
+where the rows multiply.  `DictPGMap` below preserves the original
+dict-of-rows implementation as the golden reference the columnar fold
+is pinned against (and the fold micro-benchmark's baseline).
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 RATE_COUNTERS = ("read_ops", "read_bytes", "write_ops", "write_bytes",
                  "recovery_ops", "recovery_bytes")
@@ -30,16 +42,123 @@ RATE_COUNTERS = ("read_ops", "read_bytes", "write_ops", "write_bytes",
 # digest keys carrying the per-second forms of RATE_COUNTERS
 RATE_KEYS = tuple(c + "_s" for c in RATE_COUNTERS)
 
+# columnar int stats: (column name, wire/row key, output key)
+_INT_COLS = (("pool", "pool", None),
+             ("num_objects", "num_objects", "objects"),
+             ("num_bytes", "num_bytes", "bytes"),
+             ("degraded", "degraded", "degraded"),
+             ("misplaced", "misplaced", "misplaced"),
+             ("unfound", "unfound", "unfound"),
+             ("log_size", "log_size", "log_size"))
+
+
+class _RatesView:
+    """Read-only dict-shaped view over the rate columns (the
+    ``pm.rates[pgid]`` surface the stats tests and exporter keep)."""
+
+    def __init__(self, pm: "PGMap"):
+        self._pm = pm
+
+    def _row(self, pgid) -> int | None:
+        row = self._pm._idx.get(pgid)
+        if row is None or not self._pm._has_rate[row]:
+            return None
+        return row
+
+    def __contains__(self, pgid) -> bool:
+        return self._row(pgid) is not None
+
+    def __getitem__(self, pgid) -> dict:
+        row = self._row(pgid)
+        if row is None:
+            raise KeyError(pgid)
+        return {k: float(self._pm._rate[i][row])
+                for i, k in enumerate(RATE_KEYS)}
+
+    def get(self, pgid, default=None):
+        return self[pgid] if pgid in self else default
+
 
 class PGMap:
     def __init__(self, stale_after: float = 15.0):
         self.stale_after = float(stale_after)
-        # pgid -> latest stat row (+ "_from" daemon, "_stamp")
-        self.pg_stats: dict[str, dict] = {}
-        # pgid -> {counter_s: rate} derived from the last two reports
-        self.rates: dict[str, dict] = {}
+        # pgid -> row index into the columns
+        self._idx: dict[str, int] = {}
+        self._n = 0
+        self._cap = 0
+        self._int: dict[str, np.ndarray] = {}       # int64 stats
+        self._ctr: list[np.ndarray] = []            # RATE_COUNTERS
+        self._rate: list[np.ndarray] = []           # RATE_KEYS
+        self._stamp = np.empty(0, np.float64)
+        self._from = np.empty(0, np.int32)          # interned daemon
+        self._state = np.empty(0, np.int16)         # interned state
+        self._has_rate = np.empty(0, bool)
+        self._daemon_codes: dict[str, int] = {}
+        self._state_codes: dict[str, int] = {}
+        self._state_names: list[str] = []
+        self.rates = _RatesView(self)
         # daemon -> {"op_size_hist_bytes_pow2": [...], "_stamp": t}
+        # (bounded: one row per reporting daemon, never per-PG)
         self.osd_stats: dict[str, dict] = {}
+
+    # -- column plumbing ---------------------------------------------------
+
+    def _grow(self) -> None:
+        new_cap = max(256, self._cap * 2)
+        pad = new_cap - self._cap
+
+        def ext(arr, fill=0):
+            return np.concatenate(
+                [arr, np.full(pad, fill, arr.dtype)])
+
+        for k in list(self._int):
+            self._int[k] = ext(self._int[k])
+        self._ctr = [ext(a) for a in self._ctr]
+        self._rate = [ext(a) for a in self._rate]
+        self._stamp = ext(self._stamp)
+        self._from = ext(self._from, -1)
+        self._state = ext(self._state)
+        self._has_rate = ext(self._has_rate, False)
+        self._cap = new_cap
+
+    def _alloc_row(self, pgid: str) -> int:
+        if not self._cap:
+            self._int = {c: np.zeros(256, np.int64)
+                         for c, _w, _o in _INT_COLS}
+            self._ctr = [np.zeros(256, np.float64)
+                         for _ in RATE_COUNTERS]
+            self._rate = [np.zeros(256, np.float64)
+                          for _ in RATE_KEYS]
+            self._stamp = np.zeros(256, np.float64)
+            self._from = np.full(256, -1, np.int32)
+            self._state = np.zeros(256, np.int16)
+            self._has_rate = np.zeros(256, bool)
+            self._cap = 256
+        elif self._n >= self._cap:
+            self._grow()
+        row = self._n
+        self._n += 1
+        self._idx[pgid] = row
+        return row
+
+    def _daemon_code(self, daemon: str) -> int:
+        code = self._daemon_codes.get(daemon)
+        if code is None:
+            code = len(self._daemon_codes)
+            self._daemon_codes[daemon] = code
+        return code
+
+    def _state_code(self, state: str) -> int:
+        code = self._state_codes.get(state)
+        if code is None:
+            code = len(self._state_names)
+            self._state_codes[state] = code
+            self._state_names.append(state)
+        return code
+
+    @property
+    def num_rows(self) -> int:
+        return self._n
 
     # -- ingest ------------------------------------------------------------
 
@@ -51,67 +170,99 @@ class PGMap:
             row = dict(osd_stats)
             row["_stamp"] = stamp
             self.osd_stats[daemon] = row
-        for st in pg_stats or []:
+        if not pg_stats:
+            return
+        did = self._daemon_code(daemon)
+        for st in pg_stats:
             pgid = st.get("pgid")
             if not pgid:
                 continue
-            prev = self.pg_stats.get(pgid)
-            cur = dict(st)
-            cur["_from"] = daemon
-            cur["_stamp"] = stamp
-            if prev is not None and prev["_from"] == daemon:
-                dt = stamp - prev["_stamp"]
+            row = self._idx.get(pgid)
+            fresh = row is None
+            if fresh:
+                row = self._alloc_row(pgid)
+            same_primary = (not fresh and self._from[row] == did)
+            if same_primary:
+                dt = stamp - self._stamp[row]
                 if dt > 0:
-                    self.rates[pgid] = {
-                        c + "_s": max(0.0, (cur.get(c, 0)
-                                            - prev.get(c, 0)) / dt)
-                        for c in RATE_COUNTERS}
+                    for i, c in enumerate(RATE_COUNTERS):
+                        cur = float(st.get(c, 0))
+                        self._rate[i][row] = max(
+                            0.0, (cur - self._ctr[i][row]) / dt)
+                    self._has_rate[row] = True
             else:
                 # new PG or a primary change: no comparable base —
                 # rates restart from the next delta
-                self.rates.pop(pgid, None)
-            self.pg_stats[pgid] = cur
+                self._has_rate[row] = False
+                for i in range(len(RATE_KEYS)):
+                    self._rate[i][row] = 0.0
+            for c, w, _o in _INT_COLS:
+                self._int[c][row] = int(st.get(w, 0))
+            for i, c in enumerate(RATE_COUNTERS):
+                self._ctr[i][row] = float(st.get(c, 0))
+            self._state[row] = self._state_code(
+                st.get("state", "unknown"))
+            self._from[row] = did
+            self._stamp[row] = stamp
 
-    # -- views -------------------------------------------------------------
+    # -- vectorized fold ---------------------------------------------------
 
-    def _live_rows(self, now: float, pools: set | None):
-        for pgid, st in self.pg_stats.items():
-            if now - st["_stamp"] > self.stale_after:
-                continue            # dead primary's last report
-            if pools is not None and st.get("pool") not in pools:
-                continue            # pool deleted since the report
-            yield pgid, st
+    def _live_mask(self, now: float, pools: set | None) -> np.ndarray:
+        n = self._n
+        live = (now - self._stamp[:n]) <= self.stale_after
+        if pools is not None:
+            live &= np.isin(self._int["pool"][:n],
+                            np.fromiter((int(p) for p in pools),
+                                        np.int64,
+                                        count=len(pools)))
+        return live
 
     def pool_totals(self, now: float,
                     pools: set | None = None) -> dict[int, dict]:
-        """Per-pool sums of the live stat rows + their rates."""
-        out: dict[int, dict] = {}
-        for pgid, st in self._live_rows(now, pools):
-            row = out.setdefault(st["pool"], {
-                "num_pgs": 0, "objects": 0, "bytes": 0,
-                "degraded": 0, "misplaced": 0, "unfound": 0,
-                "log_size": 0,
-                **{k: 0.0 for k in RATE_KEYS}})
-            row["num_pgs"] += 1
-            row["objects"] += st.get("num_objects", 0)
-            row["bytes"] += st.get("num_bytes", 0)
-            row["degraded"] += st.get("degraded", 0)
-            row["misplaced"] += st.get("misplaced", 0)
-            row["unfound"] += st.get("unfound", 0)
-            row["log_size"] += st.get("log_size", 0)
-            rt = self.rates.get(pgid)
-            if rt:
-                for k in RATE_KEYS:
-                    row[k] += rt.get(k, 0.0)
+        """Per-pool sums of the live stat rows + their rates — one
+        masked segment-sum pass over the columns."""
+        if not self._n:
+            return {}
+        idx = np.nonzero(self._live_mask(now, pools))[0]
+        if not idx.size:
+            return {}
+        uniq, inv = np.unique(self._int["pool"][idx],
+                              return_inverse=True)
+        k = uniq.size
+        out = {int(p): {"num_pgs": 0, "objects": 0, "bytes": 0,
+                        "degraded": 0, "misplaced": 0, "unfound": 0,
+                        "log_size": 0, **{rk: 0.0 for rk in RATE_KEYS}}
+               for p in uniq}
+        counts = np.bincount(inv, minlength=k)
+        for p, c in zip(uniq, counts):
+            out[int(p)]["num_pgs"] = int(c)
+        for c, _w, o in _INT_COLS:
+            if o is None:
+                continue
+            acc = np.zeros(k, np.int64)
+            np.add.at(acc, inv, self._int[c][idx])
+            for p, v in zip(uniq, acc):
+                out[int(p)][o] = int(v)
+        for i, rk in enumerate(RATE_KEYS):
+            acc = np.bincount(inv, weights=self._rate[i][idx],
+                              minlength=k)
+            for p, v in zip(uniq, acc):
+                out[int(p)][rk] = float(v)
         return out
 
     def pg_state_counts(self, now: float,
                         pools: set | None = None) -> dict[str, int]:
-        states: dict[str, int] = {}
-        for _pgid, st in self._live_rows(now, pools):
-            s = st.get("state", "unknown")
-            states[s] = states.get(s, 0) + 1
-        return states
+        if not self._n:
+            return {}
+        idx = np.nonzero(self._live_mask(now, pools))[0]
+        if not idx.size:
+            return {}
+        counts = np.bincount(self._state[idx],
+                             minlength=len(self._state_names))
+        return {self._state_names[i]: int(n)
+                for i, n in enumerate(counts) if n}
+
+    # -- daemon-extra views (bounded dicts, unchanged shape) ---------------
 
     def live_osd_stats(self, now: float) -> dict[str, dict]:
         """Per-daemon extras (statfs, clog counters) from reports
@@ -167,3 +318,90 @@ class PGMap:
             "op_size_hist_bytes_pow2": self.op_size_hist(now),
             "osd_stats": osd_rows,
         }
+
+
+class DictPGMap:
+    """The original dict-of-rows PGMap: the golden reference the
+    columnar fold is pinned against (tests/test_scale.py) and the
+    baseline for the `bench.py --scale` fold micro-benchmark.  Keep
+    its fold semantics bit-for-bit when touching either class."""
+
+    def __init__(self, stale_after: float = 15.0):
+        self.stale_after = float(stale_after)
+        # pgid -> latest stat row (+ "_from" daemon, "_stamp")
+        self.pg_stats: dict[str, dict] = {}
+        # pgid -> {counter_s: rate} derived from the last two reports
+        self.rates: dict[str, dict] = {}
+        # daemon -> {"op_size_hist_bytes_pow2": [...], "_stamp": t}
+        self.osd_stats: dict[str, dict] = {}
+
+    # -- ingest ------------------------------------------------------------
+
+    def apply_report(self, daemon: str, pg_stats: list | None,
+                     osd_stats: dict | None, stamp: float) -> None:
+        if osd_stats:
+            row = dict(osd_stats)
+            row["_stamp"] = stamp
+            self.osd_stats[daemon] = row
+        for st in pg_stats or []:
+            pgid = st.get("pgid")
+            if not pgid:
+                continue
+            prev = self.pg_stats.get(pgid)
+            cur = dict(st)
+            cur["_from"] = daemon
+            cur["_stamp"] = stamp
+            if prev is not None and prev["_from"] == daemon:
+                dt = stamp - prev["_stamp"]
+                if dt > 0:
+                    self.rates[pgid] = {
+                        c + "_s": max(0.0, (cur.get(c, 0)
+                                            - prev.get(c, 0)) / dt)
+                        for c in RATE_COUNTERS}
+            else:
+                self.rates.pop(pgid, None)
+            self.pg_stats[pgid] = cur
+
+    # -- views -------------------------------------------------------------
+
+    def _live_rows(self, now: float, pools: set | None):
+        for pgid, st in self.pg_stats.items():
+            if now - st["_stamp"] > self.stale_after:
+                continue            # dead primary's last report
+            if pools is not None and st.get("pool") not in pools:
+                continue            # pool deleted since the report
+            yield pgid, st
+
+    def pool_totals(self, now: float,
+                    pools: set | None = None) -> dict[int, dict]:
+        out: dict[int, dict] = {}
+        for pgid, st in self._live_rows(now, pools):
+            row = out.setdefault(st["pool"], {
+                "num_pgs": 0, "objects": 0, "bytes": 0,
+                "degraded": 0, "misplaced": 0, "unfound": 0,
+                "log_size": 0,
+                **{k: 0.0 for k in RATE_KEYS}})
+            row["num_pgs"] += 1
+            row["objects"] += st.get("num_objects", 0)
+            row["bytes"] += st.get("num_bytes", 0)
+            row["degraded"] += st.get("degraded", 0)
+            row["misplaced"] += st.get("misplaced", 0)
+            row["unfound"] += st.get("unfound", 0)
+            row["log_size"] += st.get("log_size", 0)
+            rt = self.rates.get(pgid)
+            if rt:
+                for k in RATE_KEYS:
+                    row[k] += rt.get(k, 0.0)
+        return out
+
+    def pg_state_counts(self, now: float,
+                        pools: set | None = None) -> dict[str, int]:
+        states: dict[str, int] = {}
+        for _pgid, st in self._live_rows(now, pools):
+            s = st.get("state", "unknown")
+            states[s] = states.get(s, 0) + 1
+        return states
+
+    live_osd_stats = PGMap.live_osd_stats
+    op_size_hist = PGMap.op_size_hist
+    digest = PGMap.digest

@@ -110,6 +110,19 @@ class Monitor:
         self.pending_inc: Incremental | None = None
         # conn -> epoch already sent (subscription state)
         self.subscribers: dict = {}
+        # proposal batch window state (scale plane): boot storms and
+        # clog appends fold into one proposal per window instead of
+        # one commit (+ full-map encode) per message
+        self._batch_flush_scheduled = False
+        # crush membership caches: committed root items (invalidated
+        # when the crush object changes) + the pending map's additions
+        self._crush_set: set[int] = set()
+        self._crush_set_src = None
+        self._pending_crush_set: set[int] = set()
+        # map-publication traffic counters (the late-joiner test and
+        # `bench --scale` publication-cost figure)
+        self.full_maps_sent = 0
+        self.inc_epochs_sent = 0
         # target osd -> reporter osd -> FailureReport
         self.failure_info: dict[int, dict[int, FailureReport]] = {}
         self.down_pending_out: dict[int, float] = {}
@@ -359,9 +372,38 @@ class Monitor:
 
     def queue_svc_op(self, svc: str, op: tuple) -> None:
         """Stage a service mutation (config/auth/log) for the next
-        paxos round (PaxosService pending analog)."""
+        paxos round (PaxosService pending analog).  Rides the batch
+        window: a boot storm's clog appends fold into the same few
+        commits as the boots themselves."""
         self.pending_svc.setdefault(svc, []).append(list(op))
-        self._propose_pending()
+        self._propose_soon()
+
+    def _propose_soon(self) -> None:
+        """Commit the pending state — now, or after the configured
+        batch window (mon_propose_batch_window) so storm-prone
+        fire-and-forget mutations (MOSDBoot floods at shell-cluster
+        scale) fold into a handful of epochs instead of paying one
+        paxos commit + full-map encode each.  Multi-mon mode already
+        serializes through the proposal loop (its in-flight round IS
+        the batch window); commands keep calling _propose_pending
+        directly, so their synchronous-ack contract is unchanged."""
+        window = float(self.ctx.conf.get("mon_propose_batch_window",
+                                         0.0) or 0.0)
+        if window <= 0 or self.multi:
+            self._propose_pending()
+            return
+        if self._batch_flush_scheduled:
+            return
+        self._batch_flush_scheduled = True
+
+        async def flush() -> None:
+            try:
+                await asyncio.sleep(window)
+            finally:
+                self._batch_flush_scheduled = False
+            self._propose_pending()
+
+        self.msgr.spawn(flush())
 
     def _take_svc(self) -> dict:
         svc, self.pending_svc = self.pending_svc, {}
@@ -440,16 +482,24 @@ class Monitor:
             self._publish()
 
     def _publish(self) -> None:
-        """Push incrementals to every subscriber past its known epoch."""
+        """Push incrementals to every subscriber past its known epoch.
+        The store reads are memoized per distinct `have` — at shell-
+        cluster scale most of the fleet sits at the same epoch, so one
+        commit's fan-out does O(distinct epochs) store walks, not
+        O(subscribers)."""
+        memo: dict[int, list[bytes]] = {}
         for conn, have in list(self.subscribers.items()):
             if not conn.is_open:
                 del self.subscribers[conn]
                 continue
             if have >= self.osdmap.epoch:
                 continue
-            incs = self._collect_incs(have)
+            incs = memo.get(have)
+            if incs is None:
+                incs = memo[have] = self._collect_incs(have)
             conn.send(MOSDMapMsg(fsid=self.fsid, full=None,
                                  incrementals=incs))
+            self.inc_epochs_sent += len(incs)
             self.subscribers[conn] = self.osdmap.epoch
 
     def _collect_incs(self, have: int) -> list[bytes]:
@@ -463,13 +513,22 @@ class Monitor:
 
     def _send_map(self, conn, have: int = -1) -> None:
         if 0 <= have < self.osdmap.epoch:
-            incs = self._collect_incs(have)
-            if incs:
-                conn.send(MOSDMapMsg(fsid=self.fsid, full=None,
-                                     incrementals=incs))
-                return
+            # bounded incremental catch-up: a subscriber a few epochs
+            # behind gets the contiguous delta, but one N epochs back
+            # (a late joiner against a long history) gets ONE full
+            # map — shipping the whole incremental history would cost
+            # O(history) wire per fresh subscriber at scale
+            cap = int(self.ctx.conf.get("mon_map_catchup_max", 64))
+            if self.osdmap.epoch - have <= cap:
+                incs = self._collect_incs(have)
+                if incs:
+                    conn.send(MOSDMapMsg(fsid=self.fsid, full=None,
+                                         incrementals=incs))
+                    self.inc_epochs_sent += len(incs)
+                    return
         conn.send(MOSDMapMsg(fsid=self.fsid, full=self.osdmap.encode(),
                              incrementals=[]))
+        self.full_maps_sent += 1
 
     # -- dispatch ----------------------------------------------------------
 
@@ -499,7 +558,8 @@ class Monitor:
             return True
         if isinstance(msg, MLogAck):
             # ack for entries this (peon) mon forwarded to the leader
-            self.clog.handle_ack(msg.who, int(msg.last or 0))
+            self.clog.handle_ack(msg.who, int(msg.last or 0),
+                                 inc=getattr(msg, "inc", None))
             return True
         if isinstance(msg, MCrashReport):
             self._handle_crash_report(conn, msg.reports or [])
@@ -608,6 +668,11 @@ class Monitor:
         (dedup against both the committed last_seq and the not-yet-
         proposed pending queue, so a re-flush racing its own proposal
         stacks nothing)."""
+        def key(e) -> tuple[int, int]:
+            # dedup key: (boot incarnation, seq) — a wiped-and-reborn
+            # daemon's fresh incarnation re-keys its restarted seqs
+            return (int(e.get("inc") or 0), int(e.get("seq") or 0))
+
         by_who: dict[str, list] = {}
         for e in entries:
             who = e.get("who")
@@ -618,43 +683,47 @@ class Monitor:
         for who, batch in by_who.items():
             if conn is not None:
                 self._log_ack_routes[who] = conn
-            committed = self.log_mon.last_seq.get(who, 0)
-            top = max(int(e.get("seq") or 0) for e in batch)
+            committed = self.log_mon.committed_floor(who)
+            top = max(key(e) for e in batch)
             if committed >= top:
                 # resend raced (or outlived) its ack: re-ack now
-                self._send_log_ack(who, committed)
+                self._send_log_ack(who, committed[1],
+                                   inc=committed[0])
                 continue
             if not leading:
                 continue
-            pend = max((int(op[1].get("seq") or 0)
+            pend = max((key(op[1])
                         for op in self.pending_svc.get("log", [])
                         if op[0] == "append"
-                        and op[1].get("who") == who), default=0)
+                        and op[1].get("who") == who),
+                       default=(0, 0))
             base = max(committed, pend)
-            for e in sorted(batch,
-                            key=lambda e: int(e.get("seq") or 0)):
-                if int(e.get("seq") or 0) > base:
+            for e in sorted(batch, key=key):
+                if key(e) > base:
                     self.queue_svc_op("log", ("append", dict(e)))
 
     def _ack_log_commit(self, ops: list) -> None:
-        tops: dict[str, int] = {}
+        tops: dict[str, tuple[int, int]] = {}
         for op in ops:
             if op[0] == "append":
                 who = op[1].get("who")
                 seq = int(op[1].get("seq") or 0)
+                inc = int(op[1].get("inc") or 0)
                 if who and seq:
-                    tops[who] = max(tops.get(who, 0), seq)
-        for who, seq in tops.items():
-            self._send_log_ack(who, seq)
+                    tops[who] = max(tops.get(who, (0, 0)),
+                                    (inc, seq))
+        for who, (inc, seq) in tops.items():
+            self._send_log_ack(who, seq, inc=inc)
 
-    def _send_log_ack(self, who: str, last: int) -> None:
+    def _send_log_ack(self, who: str, last: int,
+                      inc: int = 0) -> None:
         from ..msg.messages import MLogAck
         if who == self.name:
-            self.clog.handle_ack(who, last)
+            self.clog.handle_ack(who, last, inc=inc)
             return
         conn = self._log_ack_routes.get(who)
         if conn is not None and conn.is_open:
-            conn.send(MLogAck(who=who, last=last))
+            conn.send(MLogAck(who=who, last=last, inc=inc))
 
     def _handle_crash_report(self, conn, reports: list) -> None:
         """Pending crash reports from a rebooted daemon: ack ids the
@@ -741,11 +810,12 @@ class Monitor:
         if not (cur_state & OSD_EXISTS) or not known \
                 or self.osdmap.is_out(osd):
             inc.new_weight[osd] = 0x10000
-        if not self._in_crush(osd):
-            inc.new_crush = self._crush_with(osd)
+        self._ensure_in_crush(osd)
         self.failure_info.pop(osd, None)
         self.down_pending_out.pop(osd, None)
-        self._propose_pending()
+        # batched (mon_propose_batch_window): a boot STORM folds into
+        # a handful of epochs instead of one commit each
+        self._propose_soon()
         self.ctx.log.info("mon", "osd.%d booted at %s (epoch %d)"
                           % (osd, addr, self.osdmap.epoch))
         self.log_mon.append("INF", "osd.%d boot (epoch %d)"
@@ -797,24 +867,109 @@ class Monitor:
             inc.new_up_thru[osd] = want
             self._propose_pending()
 
-    def _in_crush(self, osd: int) -> bool:
-        root = self.osdmap.crush.buckets.get(-1)
-        return root is not None and osd in root.items
+    def _crush_osds(self) -> set[int]:
+        """Committed crush root membership as a set (cached per crush
+        object — the per-boot `osd in root.items` list walk is O(n)
+        and a 10k-osd boot storm would pay it n times)."""
+        crush = self.osdmap.crush
+        if self._crush_set_src is not crush:
+            self._crush_set = set(self._crush_members(crush))
+            self._crush_set_src = crush
+        return self._crush_set
+
+    def _ensure_in_crush(self, osd: int) -> None:
+        """Make sure `osd` is in the (pending or committed) crush
+        map.  The first addition of a proposal window builds the
+        pending map once; later boots in the SAME window append to it
+        in place — never O(n) rebuilds per boot."""
+        inc = self._pending()
+        if inc.new_crush is not None:
+            if osd in self._pending_crush_set:
+                return
+            self._crush_append_osd(inc.new_crush, osd)
+            self._pending_crush_set.add(osd)
+            return
+        if osd in self._crush_osds():
+            return
+        inc.new_crush = self._crush_with(osd)
+        self._pending_crush_set = set(self._crush_members(
+            inc.new_crush))
+
+    @staticmethod
+    def _crush_members(crush: CrushMap) -> list[int]:
+        return [o for b in crush.buckets.values()
+                for o in b.items if o >= 0]
+
+    def _osds_per_host(self) -> int:
+        return int(self.ctx.conf.get("mon_crush_osds_per_host", 0)
+                   or 0)
+
+    def _crush_append_osd(self, crush: CrushMap, osd: int) -> None:
+        """In-place append to the PENDING crush map (O(1)-ish per
+        boot): flat maps grow the root, host-grouped maps grow (or
+        create) the osd's host bucket and roll its weight up to the
+        root."""
+        per_host = self._osds_per_host()
+        root = crush.buckets.get(-1)
+        if per_host <= 0:
+            root.items.append(osd)
+            root.item_weights.append(0x10000)
+            root.weight += 0x10000
+            return
+        hid = -(2 + osd // per_host)
+        hb = crush.buckets.get(hid)
+        if hb is None:
+            hb = crush.add_bucket(STRAW2, 1, [osd], [0x10000],
+                                  id=hid,
+                                  name="host-%d" % (osd // per_host))
+            root.items.append(hid)
+            root.item_weights.append(hb.weight)
+        else:
+            hb.items.append(osd)
+            hb.item_weights.append(0x10000)
+            hb.weight += 0x10000
+            root.item_weights[root.items.index(hid)] += 0x10000
+        root.weight += 0x10000
 
     def _crush_with(self, osd: int) -> CrushMap:
-        """Flat default map: one straw2 root holding every known osd,
-        one replicated rule (chooseleaf type 0 — the vstart dev-cluster
-        shape) and one EC indep rule."""
+        """Default map rebuild.  Flat shape (the vstart dev-cluster
+        default): one straw2 root holding every known osd, choose
+        over devices.  With `mon_crush_osds_per_host` > 0 (the scale
+        plane's shape): osds grouped into straw2 host buckets under
+        the root, chooseleaf over hosts — real failure domains, and
+        each placement draw hashes O(hosts + per_host) items instead
+        of O(osds)."""
         known = set()
-        root = self.osdmap.crush.buckets.get(-1)
-        if root is not None:
-            known.update(root.items)
+        known.update(self._crush_osds())
         pending = self.pending_inc
         if pending is not None:
             known.update(pending.new_up_client)
         known.add(osd)
         items = sorted(known)
+        per_host = self._osds_per_host()
         crush = CrushMap()
+        if per_host > 0:
+            from ..models.crushmap import (CHOOSELEAF_FIRSTN,
+                                           CHOOSELEAF_INDEP)
+            hosts: dict[int, list[int]] = {}
+            for o in items:
+                hosts.setdefault(o // per_host, []).append(o)
+            host_ids = []
+            for h, its in sorted(hosts.items()):
+                b = crush.add_bucket(STRAW2, 1, its,
+                                     [0x10000] * len(its),
+                                     id=-(2 + h), name="host-%d" % h)
+                host_ids.append(b.id)
+            crush.add_bucket(STRAW2, 2, host_ids,
+                             [crush.buckets[h].weight
+                              for h in host_ids], id=-1)
+            crush.add_rule([(TAKE, -1, 0),
+                            (CHOOSELEAF_FIRSTN, 0, 1), (EMIT, 0, 0)],
+                           id=0, name="replicated_rule")
+            crush.add_rule([(TAKE, -1, 0),
+                            (CHOOSELEAF_INDEP, 0, 1), (EMIT, 0, 0)],
+                           id=1, name="erasure_rule")
+            return crush
         crush.add_bucket(STRAW2, 1, items, [0x10000] * len(items),
                          id=-1)
         crush.add_rule([(TAKE, -1, 0), (CHOOSE_FIRSTN, 0, 0),

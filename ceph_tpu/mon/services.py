@@ -530,6 +530,15 @@ class LogMonitor:
         self.mon = mon
         self.entries: list[dict] = []       # capped ring
         self.last_seq: dict[str, int] = {}  # who -> committed seq
+        # who -> boot incarnation of the committed seq: the dedup key
+        # is the lexicographic (inc, seq) pair, so a daemon reborn on
+        # a wiped store (fresh, larger incarnation; seqs restart at 1)
+        # is never swallowed as a resend of its previous life
+        self.last_inc: dict[str, int] = {}
+
+    def committed_floor(self, who: str) -> tuple[int, int]:
+        """(incarnation, seq) of the last committed entry for `who`."""
+        return (self.last_inc.get(who, 0), self.last_seq.get(who, 0))
 
     def load(self) -> None:
         raw = self.mon.store.get(LOG_KEY)
@@ -540,6 +549,8 @@ class LogMonitor:
             self.entries = [dict(e) for e in (d.get("entries") or [])]
             self.last_seq = {w: int(s)
                              for w, s in (d.get("seq") or {}).items()}
+            self.last_inc = {w: int(s)
+                             for w, s in (d.get("inc") or {}).items()}
         else:                               # pre-clog bare list
             self.entries = [dict(e) for e in d]
 
@@ -550,17 +561,22 @@ class LogMonitor:
             e = dict(op[1])
             who = e.get("who") or "?"
             seq = int(e.get("seq") or 0)
+            inc = int(e.get("inc") or 0)
             if seq:
                 # resend dedup: a LogClient re-flush racing its own
-                # ack must not commit the entry twice
-                if seq <= self.last_seq.get(who, 0):
+                # ack must not commit the entry twice.  Pair order —
+                # a newer incarnation always passes (and resets the
+                # seq floor), same incarnation requires a higher seq
+                if (inc, seq) <= self.committed_floor(who):
                     continue
                 self.last_seq[who] = seq
+                self.last_inc[who] = inc
             self.entries.append(e)
         if len(self.entries) > LOG_CAP:
             self.entries = self.entries[-LOG_CAP:]
         tx.set(LOG_KEY, denc.encode({"entries": self.entries,
-                                     "seq": self.last_seq}))
+                                     "seq": self.last_seq,
+                                     "inc": self.last_inc}))
 
     def append(self, level: str, message: str, who: str | None = None,
                channel: str = "cluster") -> None:

@@ -177,11 +177,12 @@ class MLog(Message):
 
 @register
 class MLogAck(Message):
-    """mon -> daemon: entries of `who` up to seq `last` are
+    """mon -> daemon: entries of `who` up to seq `last` (of boot
+    incarnation `inc`; absent = the daemon's only life) are
     paxos-committed (MLogAck.h)."""
 
     TYPE = "log_ack"
-    FIELDS = ("who", "last")
+    FIELDS = ("who", "last", "inc")
 
 
 @register

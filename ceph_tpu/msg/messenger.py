@@ -903,15 +903,28 @@ class Messenger:
     # -- dispatch ----------------------------------------------------------
 
     async def _dispatch(self, conn: Connection, msg: Message) -> None:
-        for d in self.dispatchers:
-            handler = getattr(d, "ms_dispatch", None)
-            if handler is None:
-                continue
-            res = handler(conn, msg)
-            if asyncio.iscoroutine(res):
-                res = await res
-            if res:
-                return
+        try:
+            for d in self.dispatchers:
+                handler = getattr(d, "ms_dispatch", None)
+                if handler is None:
+                    continue
+                res = handler(conn, msg)
+                if asyncio.iscoroutine(res):
+                    res = await res
+                if res:
+                    return
+        except Exception as exc:
+            # the SYNCHRONOUS dispatch path: an unhandled handler
+            # exception here never reaches spawn()'s done callback, so
+            # without this hook call it would drop the transport with
+            # no post-mortem artifact (spawned-task exceptions already
+            # route through the same hook)
+            if self.crash_hook is not None:
+                try:
+                    self.crash_hook(exc)
+                except Exception:
+                    pass
+            raise
 
     async def _reset(self, conn: Connection) -> None:
         for d in self.dispatchers:

@@ -124,139 +124,181 @@ def _pool_raw(osdmap: OSDMap, pool) -> list[list[int]]:
         return rows
 
 
+class BalancerState:
+    """The shared prologue of both optimizers (sequential
+    calc_pg_upmaps and the batched scale-plane scorer): raw and
+    effective-up rows per PG, pg_upmap-pinned placements, per-pool
+    failure domains, the cleaned existing-items table, and the
+    weight-proportional target/deviation accounting."""
+
+    __slots__ = ("osdmap", "pool_ids", "pg_raw", "pg_up", "pinned",
+                 "pg_domains", "existing", "new_items", "weights",
+                 "target", "counts")
+
+    def __init__(self, osdmap: OSDMap, pools: list[int] | None):
+        self.osdmap = osdmap
+        pool_ids = sorted(pools if pools is not None
+                          else osdmap.pools)
+        self.pool_ids = [p for p in pool_ids if p in osdmap.pools]
+        self.pg_raw: dict[pg_t, list[int]] = {}
+        self.pg_up: dict[pg_t, list[int]] = {}
+        self.pinned: dict[pg_t, list[int]] = {}
+        self.pg_domains: dict[int, dict[int, int] | None] = {}
+        for pid in self.pool_ids:
+            pool = osdmap.pools[pid]
+            raw_rows = _pool_raw(osdmap, pool)
+            self.pg_domains[pid] = _failure_domains(osdmap,
+                                                    pool.crush_rule)
+            for ps in range(pool.pg_num):
+                pg = pg_t(pid, ps)
+                if pg in osdmap.pg_upmap:
+                    # explicit pg_upmap pins override items entirely
+                    # (OSDMap._apply_upmap); count their real
+                    # placement but never try to move them
+                    up, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
+                    self.pinned[pg] = up
+                    continue
+                self.pg_raw[pg] = raw_rows[ps]
+                self.pg_up[pg] = _effective_up(
+                    osdmap, raw_rows[ps],
+                    osdmap.pg_upmap_items.get(pg, []))
+
+        # weight-proportional target over up+in osds
+        self.weights = {o: osdmap.osd_weight[o] / 0x10000
+                        for o in range(osdmap.max_osd)
+                        if osdmap.is_up(o) and osdmap.is_in(o)}
+        total_w = sum(self.weights.values())
+        total_placements = (
+            sum(len(up) for up in self.pg_up.values())
+            + sum(len(up) for up in self.pinned.values()))
+        self.target = ({o: total_placements * w / total_w
+                        for o, w in self.weights.items()}
+                       if total_w > 0 else {})
+        self.counts = {o: 0 for o in self.weights}
+        for ups in (self.pg_up, self.pinned):
+            for up in ups.values():
+                for o in up:
+                    if o in self.counts:
+                        self.counts[o] += 1
+
+        self.existing = {pg: items
+                         for pg, items in osdmap.pg_upmap_items.items()
+                         if pg.pool in set(self.pool_ids)}
+        # retire no-op entries up front (source left the raw set or
+        # the item no longer applies) — the reference's
+        # clean_pg_upmaps pass
+        self.new_items: dict[pg_t, list[tuple[int, int]]] = {}
+        for pg, items in self.existing.items():
+            if pg in self.pinned:
+                self.new_items[pg] = list(items)  # pg_upmap mask: keep
+                continue
+            raw = self.pg_raw.get(pg, [])
+            row = list(raw)
+            kept = []
+            for f, t in items:
+                if f in row and t not in row:
+                    row = [t if o == f else o for o in row]
+                    kept.append((f, t))
+            self.new_items[pg] = kept
+
+    def row_valid(self, pg: pg_t, row: list[int]) -> bool:
+        if len(set(row)) != len(row):
+            return False
+        domains = self.pg_domains.get(pg.pool)
+        if domains is None:
+            return True
+        doms = [domains.get(o) for o in row]
+        return None not in doms and len(set(doms)) == len(doms)
+
+    def try_move(self, pg: pg_t, over: int,
+                 under: int) -> list[int] | None:
+        """Attempt the move `over` -> `under` for one PG through the
+        EXACT reference validity rules (raw-vs-up item rewrite,
+        _apply_upmap replay, failure-domain validation).  On success
+        the state (items, up row, counts) is updated and the new
+        effective up row returned; None = invalid, state untouched.
+        Both optimizers commit moves ONLY through here, so their
+        emitted items are identical in effect by construction."""
+        up = self.pg_up.get(pg)
+        if up is None or over not in up or under in up:
+            return None
+        raw = self.pg_raw[pg]
+        # rewrite against the RAW mapping: if `over` is a raw member,
+        # add (over, under); else an existing item (X -> over) must
+        # exist — rewrite it to (X -> under), never stack
+        # (over -> under) no-ops
+        items = [t for t in self.new_items.get(pg, [])
+                 if t[1] != over]
+        if over in raw:
+            items = [t for t in items if t[0] != over]
+            items.append((over, under))
+        else:
+            src = next((f for f, t in self.new_items.get(pg, [])
+                        if t == over), None)
+            if src is None or src not in raw:
+                return None
+            items = [t for t in items if t[0] != src]
+            items.append((src, under))
+        # the REAL effect of the new item list (replayed via
+        # _apply_upmap semantics over the raw row) is what must be
+        # validated and accounted — dropping an item can silently
+        # restore its source, so the old up row is not a reliable base
+        new_row = _effective_up(self.osdmap, raw, items)
+        if over in new_row or not self.row_valid(pg, new_row):
+            return None
+        if sum(1 for o in new_row if o == under) != 1:
+            return None
+        self.new_items[pg] = items
+        for o in up:
+            if o in self.counts:
+                self.counts[o] -= 1
+        for o in new_row:
+            if o in self.counts:
+                self.counts[o] += 1
+        self.pg_up[pg] = new_row
+        return new_row
+
+    def fill_incremental(self, inc: Incremental) -> None:
+        for pg, items in self.new_items.items():
+            if items != self.existing.get(pg, []):
+                if items:
+                    inc.new_pg_upmap_items[pg] = items
+                elif pg in self.existing:
+                    inc.old_pg_upmap_items.append(pg)
+        for pg in self.existing:
+            if pg not in self.new_items:
+                inc.old_pg_upmap_items.append(pg)
+
+
 def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
                    max_deviation: float = 1.0,
                    max_iterations: int = 100,
                    pools: list[int] | None = None) -> int:
     """Fill inc.new_pg_upmap_items / old_pg_upmap_items; returns the
     number of changes (OSDMap.cc:5159 contract)."""
-    pool_ids = sorted(pools if pools is not None else osdmap.pools)
-    pool_ids = [p for p in pool_ids if p in osdmap.pools]
-    if not pool_ids:
+    st = BalancerState(osdmap, pools)
+    if not st.pool_ids or not st.target:
         return 0
-
-    pg_raw: dict[pg_t, list[int]] = {}
-    pg_up: dict[pg_t, list[int]] = {}
-    pinned: dict[pg_t, list[int]] = {}
-    pg_domains: dict[int, dict[int, int] | None] = {}
-    for pid in pool_ids:
-        pool = osdmap.pools[pid]
-        raw_rows = _pool_raw(osdmap, pool)
-        pg_domains[pid] = _failure_domains(osdmap, pool.crush_rule)
-        for ps in range(pool.pg_num):
-            pg = pg_t(pid, ps)
-            if pg in osdmap.pg_upmap:
-                # explicit pg_upmap pins override items entirely
-                # (OSDMap._apply_upmap); count their real placement
-                # but never try to move them
-                up, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
-                pinned[pg] = up
-                continue
-            pg_raw[pg] = raw_rows[ps]
-            pg_up[pg] = _effective_up(
-                osdmap, raw_rows[ps],
-                osdmap.pg_upmap_items.get(pg, []))
-
-    # weight-proportional target over up+in osds
-    weights = {o: osdmap.osd_weight[o] / 0x10000
-               for o in range(osdmap.max_osd)
-               if osdmap.is_up(o) and osdmap.is_in(o)}
-    total_w = sum(weights.values())
-    if total_w <= 0:
-        return 0
-    total_placements = (sum(len(up) for up in pg_up.values())
-                        + sum(len(up) for up in pinned.values()))
-    target = {o: total_placements * w / total_w
-              for o, w in weights.items()}
-
-    counts = {o: 0 for o in weights}
-    for up in pg_up.values():
-        for o in up:
-            if o in counts:
-                counts[o] += 1
-    for up in pinned.values():
-        for o in up:
-            if o in counts:
-                counts[o] += 1
-
-    existing = {pg: items for pg, items in osdmap.pg_upmap_items.items()
-                if pg.pool in set(pool_ids)}
-    # retire no-op entries up front (source left the raw set or the
-    # item no longer applies) — the reference's clean_pg_upmaps pass
-    new_items: dict[pg_t, list[tuple[int, int]]] = {}
-    for pg, items in existing.items():
-        if pg in pinned:
-            new_items[pg] = list(items)   # masked by pg_upmap: keep
-            continue
-        raw = pg_raw.get(pg, [])
-        row = list(raw)
-        kept = []
-        for f, t in items:
-            if f in row and t not in row:
-                row = [t if o == f else o for o in row]
-                kept.append((f, t))
-        new_items[pg] = kept
-
-    def row_valid(pg: pg_t, row: list[int]) -> bool:
-        if len(set(row)) != len(row):
-            return False
-        domains = pg_domains.get(pg.pool)
-        if domains is None:
-            return True
-        doms = [domains.get(o) for o in row]
-        return None not in doms and len(set(doms)) == len(doms)
 
     changes = 0
     for _ in range(max_iterations):
-        deviations = {o: counts[o] - target[o] for o in counts}
+        deviations = {o: st.counts[o] - st.target[o]
+                      for o in st.counts}
         over = max(deviations, key=lambda o: deviations[o])
         if deviations[over] <= max_deviation:
             break
         under_sorted = sorted(deviations, key=lambda o: deviations[o])
         moved = False
-        for pg, up in pg_up.items():
+        for pg, up in st.pg_up.items():
             if over not in up:
                 continue
-            raw = pg_raw[pg]
             for under in under_sorted:
                 if deviations[under] >= -0.0001:
                     break  # nobody meaningfully underfull
                 if under in up:
                     continue
-                # rewrite against the RAW mapping: if `over` is a raw
-                # member, add (over, under); else an existing item
-                # (X -> over) must exist — rewrite it to (X -> under),
-                # never stack (over -> under) no-ops
-                items = [t for t in new_items.get(pg, [])
-                         if t[1] != over]
-                if over in raw:
-                    items = [t for t in items if t[0] != over]
-                    items.append((over, under))
-                else:
-                    src = next((f for f, t in new_items.get(pg, [])
-                                if t == over), None)
-                    if src is None or src not in raw:
-                        continue
-                    items = [t for t in items if t[0] != src]
-                    items.append((src, under))
-                # the REAL effect of the new item list (replayed via
-                # _apply_upmap semantics over the raw row) is what
-                # must be validated and accounted — dropping an item
-                # can silently restore its source, so the old up row
-                # is not a reliable base
-                new_row = _effective_up(osdmap, raw, items)
-                if over in new_row or not row_valid(pg, new_row):
+                if st.try_move(pg, over, under) is None:
                     continue
-                if sum(1 for o in new_row if o == under) != 1:
-                    continue
-                new_items[pg] = items
-                for o in up:
-                    if o in counts:
-                        counts[o] -= 1
-                for o in new_row:
-                    if o in counts:
-                        counts[o] += 1
-                pg_up[pg] = new_row
                 changes += 1
                 moved = True
                 break
@@ -265,13 +307,5 @@ def calc_pg_upmaps(osdmap: OSDMap, inc: Incremental,
         if not moved:
             break
 
-    for pg, items in new_items.items():
-        if items != existing.get(pg, []):
-            if items:
-                inc.new_pg_upmap_items[pg] = items
-            elif pg in existing:
-                inc.old_pg_upmap_items.append(pg)
-    for pg in existing:
-        if pg not in new_items:
-            inc.old_pg_upmap_items.append(pg)
+    st.fill_incremental(inc)
     return changes

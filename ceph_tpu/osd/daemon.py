@@ -160,9 +160,17 @@ class OSD:
         self._crash_pending = crashmod.pending_crashes(self.store)
         # clog seq floor: resume ABOVE the previous incarnation's
         # last-used seq (persisted per emit) so the LogMonitor's
-        # (who, seq) dedup never swallows reborn entries and
-        # pre-restart unacked entries cannot supersede them
-        self.clog.resume_above(crashmod.load_clog_seq(self.store))
+        # (who, inc, seq) dedup never swallows reborn entries and
+        # pre-restart unacked entries cannot supersede them.  A WIPED
+        # store lost the floor — mint a fresh (larger) boot
+        # incarnation instead, so seqs restarting from 1 re-key as
+        # new entries rather than replaying committed ones
+        clog_inc = crashmod.load_clog_incarnation(self.store)
+        if not clog_inc:
+            clog_inc = crashmod.new_clog_incarnation()
+            crashmod.save_clog_incarnation(self.store, clog_inc)
+        self.clog.resume_above(crashmod.load_clog_seq(self.store),
+                               incarnation=clog_inc)
         self.clog.on_seq = \
             lambda s: crashmod.save_clog_seq(self.store, s)
         if self._crash_pending:
@@ -405,7 +413,8 @@ class OSD:
             return True
         from ..msg.messages import MCrashReportAck, MLogAck
         if isinstance(msg, MLogAck):
-            self.clog.handle_ack(msg.who, int(msg.last or 0))
+            self.clog.handle_ack(msg.who, int(msg.last or 0),
+                                 inc=getattr(msg, "inc", None))
             return True
         if isinstance(msg, MCrashReportAck):
             self._handle_crash_ack(msg.crash_ids)

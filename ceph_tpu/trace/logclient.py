@@ -46,6 +46,12 @@ class LogClient:
         self.daemon = daemon
         self.send_fn = send_fn
         self._seq = 0
+        # boot incarnation: entries carry (inc, seq) and the
+        # LogMonitor dedups on the PAIR, ordered lexicographically —
+        # a daemon reborn on a WIPED store (its persisted seq floor
+        # gone) mints a fresh, larger incarnation, so its seqs
+        # restarting from 1 are new entries, not swallowed resends
+        self.incarnation = 0
         # unacked entries, oldest first (the LogClient log_queue)
         self.pending: list[dict] = []
         # level -> total entries ever queued (the
@@ -53,15 +59,19 @@ class LogClient:
         self.counts: dict[str, int] = {lv: 0 for lv in LEVELS}
         # on_seq(seq) after every emit: daemons persist the last-used
         # seq into their own store so a restart resumes ABOVE it —
-        # the LogMonitor dedups by (who, seq), so a seq reset would
-        # swallow the reborn daemon's entries as resends and let
-        # pre-restart unacked entries supersede them
+        # the LogMonitor dedups by (who, inc, seq), so a seq reset
+        # under an unchanged incarnation would swallow the reborn
+        # daemon's entries as resends and let pre-restart unacked
+        # entries supersede them
         self.on_seq = None
 
-    def resume_above(self, seq: int) -> None:
+    def resume_above(self, seq: int, incarnation: int = 0) -> None:
         """Adopt a persisted floor: the next entry's seq is at least
-        `seq`+1 (restart path; no-op when the floor is behind us)."""
+        `seq`+1 (restart path; no-op when the floor is behind us).
+        `incarnation` is the persisted boot incarnation — a fresh
+        (wiped) store passes a newly minted one instead."""
         self._seq = max(self._seq, int(seq))
+        self.incarnation = max(self.incarnation, int(incarnation))
 
     # -- emit (the clog->error()/warn()/info() surface) -----------------
 
@@ -81,7 +91,8 @@ class LogClient:
                 self.on_seq(self._seq)
             except Exception:
                 pass        # persistence must never sink the emit
-        entry = {"seq": self._seq, "stamp": time.time(),
+        entry = {"seq": self._seq, "inc": self.incarnation,
+                 "stamp": time.time(),
                  "who": self.daemon, "channel": channel,
                  "level": level, "message": str(message)}
         self.pending.append(entry)
@@ -119,9 +130,14 @@ class LogClient:
         from ..msg.messages import MLog
         self.send_fn(MLog(entries=[dict(e) for e in self.pending]))
 
-    def handle_ack(self, who: str, last: int) -> None:
-        """A mon observed the paxos commit through entry `last`."""
+    def handle_ack(self, who: str, last: int,
+                   inc: int | None = None) -> None:
+        """A mon observed the paxos commit through entry `last` (of
+        incarnation `inc`; an ack naming an OLDER incarnation is a
+        stale ack for a previous life and retires nothing here)."""
         if who != self.daemon:
+            return
+        if inc is not None and int(inc) != self.incarnation:
             return
         self.pending = [e for e in self.pending
                         if e["seq"] > int(last)]

@@ -333,4 +333,39 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_crash_ring_tail", OPT_INT, 100,
            "LogRing entries captured into a crash report (the"
            " post-mortem high-verbosity context)"),
+    # -- scale plane (ceph_tpu.scale) ------------------------------------
+    Option("mon_crush_osds_per_host", OPT_INT, 0,
+           "group booting osds into straw2 host buckets of this size"
+           " (chooseleaf-over-hosts rules, real failure domains, and"
+           " O(hosts + size) placement draws instead of O(osds));"
+           " 0 keeps the flat vstart root"),
+    Option("mon_map_catchup_max", OPT_INT, 64,
+           "a subscriber more than this many epochs behind is caught"
+           " up with ONE full map instead of the whole incremental"
+           " history (bounds late-joiner wire cost)"),
+    Option("mon_propose_batch_window", OPT_FLOAT, 0.0,
+           "seconds the mon folds storm-prone fire-and-forget"
+           " mutations (boots, clog appends) into one proposal before"
+           " committing; 0 = commit immediately (a 10k-shell boot"
+           " storm would otherwise burn one epoch + full-map encode"
+           " per boot)"),
+    Option("shell_report_interval", OPT_FLOAT, 1.0,
+           "period of a ShellOSD's beacon + synthetic-stats report"),
+    Option("shell_objects_per_pg", OPT_INT, 8,
+           "synthetic objects each shell PG reports (drives the"
+           " misplaced/degraded accounting at scale)"),
+    Option("shell_object_bytes", OPT_INT, 1 << 20,
+           "synthetic bytes per shell object"),
+    Option("shell_recovery_objects_per_s", OPT_FLOAT, 256.0,
+           "simulated backfill drain rate per shell (misplaced"
+           " objects recovered per second)"),
+    Option("mgr_balancer_mode", OPT_STR, "batched",
+           "upmap optimizer flavor: 'batched' scores thousands of"
+           " candidate moves per tick in one device dispatch"
+           " (scale.balancer); 'sequential' keeps the reference's"
+           " greedy calc_pg_upmaps walk",
+           enum_allowed=("batched", "sequential")),
+    Option("mgr_balancer_max_changes", OPT_INT, 48,
+           "upmap items committed per batched balancer tick (bounds"
+           " the per-tick mon command fan-out)"),
 ]

@@ -142,3 +142,37 @@ def save_clog_seq(store, seq: int) -> None:
     t.truncate(META_COLL, CLOG_SEQ_OBJ, 0)
     t.write(META_COLL, CLOG_SEQ_OBJ, 0, len(blob), blob)
     store.apply_transaction(t)
+
+
+CLOG_INC_OBJ = hobject_t("clog_incarnation")
+
+
+def new_clog_incarnation() -> int:
+    """A fresh boot incarnation, strictly greater than any minted by
+    an earlier boot of this daemon (wall-clock nanoseconds): a WIPED
+    store loses the persisted seq floor, so the reborn daemon re-keys
+    its clog entries under a new incarnation instead of replaying seqs
+    the LogMonitor's (who, inc, seq) dedup already committed."""
+    return time.time_ns()
+
+
+def load_clog_incarnation(store) -> int:
+    """The persisted boot incarnation (0 when none — a fresh or wiped
+    store, where the caller mints a new one)."""
+    try:
+        if not store.collection_exists(META_COLL):
+            return 0
+        return int(denc.decode(store.read(META_COLL, CLOG_INC_OBJ)))
+    except Exception:       # missing / torn: treat as fresh
+        return 0
+
+
+def save_clog_incarnation(store, inc: int) -> None:
+    t = Transaction()
+    if not store.collection_exists(META_COLL):
+        t.create_collection(META_COLL)
+    blob = denc.encode(int(inc))
+    t.touch(META_COLL, CLOG_INC_OBJ)
+    t.truncate(META_COLL, CLOG_INC_OBJ, 0)
+    t.write(META_COLL, CLOG_INC_OBJ, 0, len(blob), blob)
+    store.apply_transaction(t)

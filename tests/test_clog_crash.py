@@ -321,6 +321,120 @@ def test_clog_seq_resumes_above_restart():
     run(main())
 
 
+def test_clog_survives_store_wipe_incarnation_rekey():
+    """A daemon reborn on a WIPED store loses its persisted clog seq
+    floor — without re-keying, the LogMonitor's dedup would swallow
+    its early entries (seqs restart at 1, all <= the committed floor)
+    as resends.  The fresh store mints a new boot incarnation and the
+    dedup keys on (who, inc, seq), so the wiped-and-reborn daemon's
+    entries commit (the carry-forward gap this PR closes)."""
+    from ceph_tpu.utils.crash import load_clog_incarnation
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("wipeclog", pg_num=4)
+            await c.wait_health(pid)
+            osd0 = c.osds[0]
+            for i in range(3):
+                osd0.clog.info("pre-wipe entry %d" % i)
+            pre_seq = osd0.clog._seq
+            pre_inc = osd0.clog.incarnation
+            assert pre_seq >= 3 and pre_inc > 0
+            assert load_clog_incarnation(osd0.store) == pre_inc
+            mon = c.mons[0]
+            await wait_for(
+                lambda: any(e.get("message") == "pre-wipe entry 2"
+                            for e in mon.log_mon.entries),
+                20, what="pre-wipe entries committed")
+            assert mon.log_mon.committed_floor("osd.0") \
+                == (pre_inc, pre_seq)
+            await c.kill_osd(0)
+            await c.wait_osd_down(0)
+            await c.revive_osd(0, wipe=True)    # FRESH store
+            await c.wait_osd_up(0)
+            osd0b = c.osds[0]
+            # the reborn daemon restarts seqs under a NEWER incarnation
+            assert osd0b.clog.incarnation > pre_inc
+            entry = osd0b.clog.queue("INF", "post-wipe marker")
+            osd0b.clog.flush()
+            assert entry["seq"] <= pre_seq      # the gap's shape
+            # ...and the entry still COMMITS (the old dedup would have
+            # swallowed it as a resend of seq <= floor)
+            await wait_for(
+                lambda: any(e.get("message") == "post-wipe marker"
+                            for e in mon.log_mon.entries),
+                20, what="post-wipe entry committed")
+            assert mon.log_mon.committed_floor("osd.0") \
+                == (osd0b.clog.incarnation, entry["seq"])
+            # the client retired it on the (inc-matched) ack
+            await wait_for(lambda: osd0b.clog.num_pending == 0, 20,
+                           what="post-wipe entry acked")
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_dispatch_path_exception_produces_crash_report():
+    """An unhandled exception in ms_dispatch's SYNCHRONOUS path must
+    produce a crash report like spawned-task exceptions do (the
+    carry-forward gap): raise from a dispatch handler, revive, and
+    assert `crash ls` shows it."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("dispcrash", pg_num=4)
+            await c.wait_health(pid)
+            osd1 = c.osds[1]
+            real = osd1.ms_dispatch
+            state = {"armed": True}
+
+            def bomb(conn, msg):
+                from ceph_tpu.msg.messages import MOSDPing
+                if state["armed"] and isinstance(msg, MOSDPing):
+                    state["armed"] = False
+                    raise RuntimeError("injected dispatch bomb")
+                return real(conn, msg)
+
+            osd1.ms_dispatch = bomb
+            # a peer heartbeat trips the bomb inside the synchronous
+            # dispatch path; the crash hook records the report into
+            # osd.1's OWN store
+            await wait_for(lambda: osd1._crash_pending, 20,
+                           what="dispatch crash recorded")
+            rep = osd1._crash_pending[0]
+            assert rep["exc_type"] == "RuntimeError"
+            assert "injected dispatch bomb" in rep["exc_msg"]
+            # the daemon dies (hard-stop) and the REBOOT ships the
+            # report from the surviving store to the mon's table
+            await c.kill_osd(1)
+            await c.revive_osd(1)
+            await c.wait_osd_up(1)
+            out = {}
+
+            async def crash_listed():
+                nonlocal out
+                try:
+                    out = await c.client.mon_command("crash ls")
+                except Exception:
+                    return False        # command raced a busy mon
+                return any(r["entity"] == "osd.1"
+                           and "dispatch bomb" in (r["exc_msg"] or "")
+                           for r in out["crashes"])
+
+            deadline = asyncio.get_running_loop().time() + 60
+            while not await crash_listed():
+                assert asyncio.get_running_loop().time() < deadline, \
+                    out
+                await asyncio.sleep(0.25)
+        finally:
+            await c.stop()
+
+    run(main())
+
+
 def test_crash_table_auto_prune_retention():
     """ARCHIVED reports older than mon_crash_retention are removed
     from the COMMITTED table at tick time (the clock hook pins
