@@ -700,6 +700,7 @@ def bench_device(n_objs: int = 48, rounds: int = 8,
             "unit": "GiB/s",
             "extra": {
                 "bucket_hit_ratio": round(rt.bucket_hit_ratio, 4),
+                "bucket_waste_ratio": round(rt.bucket_waste_ratio, 4),
                 "dispatch_ms": rt.dispatch_pctls(),
                 "compile_count": rt.compile_count,
                 "pool_hits": rt.pool.hits,
@@ -713,6 +714,214 @@ def bench_device(n_objs: int = 48, rounds: int = 8,
     rec = asyncio.run(asyncio.wait_for(run(), 600))
     _publish_baseline(rec)
     return rec
+
+
+def bench_device_ragged(n_objs: int = 24, rounds: int = 4) -> dict:
+    """Mixed-size ragged sweep: drive the cluster's actual EC flush
+    path (batcher bucket-ladder staging + device runtime) with a
+    log-uniform size mix from sub-KiB to MiB-class objects — the
+    workload whose bucket-ceiling padding was most of the
+    `ec_backend_path_gibps` (382) vs raw-encode (487) gap.  Reports
+    the payload GiB/s of the mixed stream, the observed
+    `bucket_waste_ratio` beside the pow2 counterfactual, the compile
+    count, and a parity oracle vs the host codec; published into
+    BASELINE.json as `ec_backend_path_mixed` behind `_gate_device_ec`
+    (waste must stay a small fraction of the pow2 counterfactual,
+    parity bit-identical, compile budget <= 8)."""
+    import asyncio
+    import os
+
+    os.environ.setdefault("CEPH_TPU_EC_OFFLOAD", "1")
+
+    async def run() -> dict:
+        from ceph_tpu.device.runtime import DeviceRuntime
+        from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+
+        codec = ErasureCodePluginRegistry.instance().factory(
+            "isa", {"technique": "reed_sol_van", "k": "8", "m": "3"})
+        n = codec.get_chunk_count()
+        rt = DeviceRuntime.reset()
+        rng = np.random.default_rng(31)
+        sizes = [int(s) for s in np.exp(rng.uniform(
+            np.log(1 << 10), np.log(1 << 20), n_objs))]
+        objs = [rng.integers(0, 256, s, dtype=np.uint8).tobytes()
+                for s in sizes]
+        # parity oracle: adversarial picks (smallest, largest, one
+        # mid) checked bit-identical to the host codec
+        picks = [int(np.argmin(sizes)), int(np.argmax(sizes)),
+                 n_objs // 2]
+        host = {i: codec.encode(set(range(n)), objs[i])
+                for i in picks}
+        outs = await asyncio.gather(*[
+            codec.encode_async(set(range(n)), d) for d in objs])
+        parity_ok = all(outs[i][c] == host[i][c]
+                        for i in host for c in host[i])
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            await asyncio.gather(*[
+                codec.encode_async(set(range(n)), d) for d in objs])
+        wall = time.perf_counter() - t0
+        payload = sum(sizes) * rounds
+        import jax
+        return {
+            "metric": "ec_backend_path_mixed",
+            "value": round(payload / wall / (1 << 30), 2),
+            "unit": "GiB/s",
+            "backend": jax.default_backend(),
+            "bucket_waste_ratio": round(rt.bucket_waste_ratio, 4),
+            "pow2_waste_ratio": round(rt.pow2_waste_ratio, 4),
+            "compile_count": rt.compile_count,
+            "host_fallbacks": rt.host_fallbacks,
+            "dispatches": rt.dispatches,
+            "parity_ok": parity_ok,
+            "size_mix": {"min": min(sizes), "max": max(sizes),
+                         "n_objs": n_objs, "rounds": rounds},
+        }
+
+    return asyncio.run(asyncio.wait_for(run(), 600))
+
+
+def bench_device_delta(n_objs: int = 48, delta_bytes: int = 8192,
+                       rounds: int = 6) -> dict:
+    """Partial-write (parity-delta) throughput: concurrent
+    `codec.delta_async` calls — the exact program `_try_delta_write`
+    dispatches for small in-place overwrites — across `n_objs`
+    objects per round, each updating one touched data-chunk column
+    range.  The deltas ride the full coding matrix with zero rows, so
+    they batch with each other into shared device dispatches; the
+    bench reports delta payload GiB/s, ops per dispatch (the batching
+    factor), and a parity oracle vs the host numpy path.  Published
+    into BASELINE.json as `ec_delta_path` behind `_gate_device_ec`."""
+    import asyncio
+    import os
+
+    os.environ.setdefault("CEPH_TPU_EC_OFFLOAD", "1")
+
+    async def run() -> dict:
+        from ceph_tpu.device.runtime import DeviceRuntime
+        from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+
+        codec = ErasureCodePluginRegistry.instance().factory(
+            "isa", {"technique": "reed_sol_van", "k": "8", "m": "3"})
+        k = codec.get_data_chunk_count()
+        rt = DeviceRuntime.reset()
+        rng = np.random.default_rng(37)
+        deltas = [{int(rng.integers(0, k)):
+                   rng.integers(0, 256, delta_bytes,
+                                dtype=np.uint8).tobytes()}
+                  for _ in range(n_objs)]
+        host = [codec.parity_delta(d) for d in deltas[:3]]
+        outs = await asyncio.gather(*[
+            codec.delta_async(d) for d in deltas])   # warm + oracle
+        parity_ok = all(outs[i][r] == host[i][r]
+                        for i in range(3) for r in host[i])
+        before = rt.dispatches
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            await asyncio.gather(*[
+                codec.delta_async(d) for d in deltas])
+        wall = time.perf_counter() - t0
+        ops = n_objs * rounds
+        dispatches = max(1, rt.dispatches - before)
+        payload = delta_bytes * ops
+        import jax
+        return {
+            "metric": "ec_delta_path",
+            "value": round(payload / wall / (1 << 30), 3),
+            "unit": "GiB/s (delta payload)",
+            "backend": jax.default_backend(),
+            "deltas_per_s": round(ops / wall, 1),
+            "ops_per_dispatch": round(ops / dispatches, 1),
+            "host_fallbacks": rt.host_fallbacks,
+            "parity_ok": parity_ok,
+            "delta_bytes": delta_bytes,
+        }
+
+    return asyncio.run(asyncio.wait_for(run(), 600))
+
+
+def _gate_device_ec(ragged: dict, delta: dict) -> dict:
+    """Regression gate for the ragged + delta figures: parity must be
+    bit-identical to the host codecs, ragged staging must actually
+    close the padding gap (small absolute waste AND far below the
+    pow2 counterfactual), the compile budget must hold, deltas must
+    genuinely batch — and neither throughput figure may regress below
+    0.8x its published value on the same backend."""
+    import os
+    failures = []
+    if not ragged.get("parity_ok"):
+        failures.append("ragged parity mismatch vs host codec")
+    waste = ragged.get("bucket_waste_ratio", 1.0)
+    pow2 = ragged.get("pow2_waste_ratio", 0.0)
+    if waste > 0.05:
+        failures.append("ragged waste ratio %.3f above 0.05" % waste)
+    if pow2 > 0.0 and waste > 0.5 * pow2:
+        failures.append(
+            "ragged waste %.3f did not close the pow2 gap (%.3f)"
+            % (waste, pow2))
+    if ragged.get("compile_count", 99) > 8:
+        failures.append("mixed workload compiled %d > 8 programs"
+                        % ragged.get("compile_count"))
+    if ragged.get("host_fallbacks"):
+        failures.append("ragged sweep fell back to host")
+    if not delta.get("parity_ok"):
+        failures.append("delta parity mismatch vs host path")
+    if delta.get("ops_per_dispatch", 0) < 2:
+        failures.append(
+            "partial writes never batched (%.1f ops/dispatch)"
+            % delta.get("ops_per_dispatch", 0))
+    published = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            published = json.load(f).get("published") or {}
+    except Exception:
+        pass
+    for rec, key in ((ragged, "ec_backend_path_mixed"),
+                     (delta, "ec_delta_path")):
+        prev = published.get(key) or {}
+        if (prev.get("backend") == rec.get("backend")
+                and prev.get("value")
+                and rec["value"] < 0.8 * float(prev["value"])):
+            failures.append(
+                "%s %.2f regressed below 0.8x the published %.2f"
+                % (key, rec["value"], float(prev["value"])))
+    return {"ok": not failures, "failures": failures}
+
+
+def _publish_device_ec(ragged: dict, delta: dict,
+                       gate: dict) -> None:
+    """Fold the mixed-size and partial-write figures into
+    BASELINE.json's published map (backend recorded so the gate only
+    compares like with like).  A failed gate publishes nothing."""
+    import os
+    if not gate.get("ok"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})["ec_backend_path_mixed"] = {
+            "value": ragged["value"], "unit": ragged["unit"],
+            "backend": ragged["backend"],
+            "bucket_waste_ratio": ragged["bucket_waste_ratio"],
+            "pow2_waste_ratio": ragged["pow2_waste_ratio"],
+            "source": "bench.py --device",
+        }
+        doc["published"]["ec_delta_path"] = {
+            "value": delta["value"], "unit": delta["unit"],
+            "backend": delta["backend"],
+            "ops_per_dispatch": delta["ops_per_dispatch"],
+            "deltas_per_s": delta["deltas_per_s"],
+            "source": "bench.py --device",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        ragged["publish_error"] = repr(e)[:200]
 
 
 def _publish_baseline(rec: dict) -> None:
@@ -997,12 +1206,19 @@ def main() -> None:
         # sweep then run on the same mesh
         _maybe_simulate_mesh()
         rec = bench_device()
+        rec["ragged"] = bench_device_ragged()
+        rec["delta"] = bench_device_delta()
+        rec["ec_gate"] = _gate_device_ec(rec["ragged"], rec["delta"])
+        _publish_device_ec(rec["ragged"], rec["delta"],
+                           rec["ec_gate"])
         rec["mesh"] = bench_device_mesh()
         print(json.dumps(rec))
-        if not rec["mesh"]["gate"]["ok"]:
-            # the dp-scaling curve is a guarded artifact: a regression
-            # below 0.8x linear (or 0.8x the published curve) is a
-            # CI failure, not a quietly worse JSON
+        if not rec["mesh"]["gate"]["ok"] or not rec["ec_gate"]["ok"]:
+            # the dp-scaling curve and the ragged/delta figures are
+            # guarded artifacts: a regression below 0.8x linear /
+            # 0.8x the published figures, a parity mismatch, or a
+            # padding-waste blowup is a CI failure, not a quietly
+            # worse JSON
             sys.exit(1)
         return
     if "--stats" in sys.argv:

@@ -11,12 +11,15 @@ mapping storm could starve EC writes.  The runtime centralises four
 concerns, each now **per chip** (mesh discipline from "Large Scale
 Distributed Linear Algebra With TPUs", 2112.09017):
 
-* **shape-bucketed compile cache** — batches pad to power-of-two
-  word-count buckets so steady state hits a handful of jitted
-  programs; `note_program` is the compile counter the acceptance
-  criteria assert against, and `warmup_ec` pre-compiles the common
-  buckets at OSD boot.  Each chip accounts its own programs (a real
-  mesh compiles per chip).
+* **shape-bucketed compile cache** — flushes stage as a **bucket
+  ladder** (`ragged_plan`): power-of-two segments covering the exact
+  ragged flush total, so only the ladder's tail rounds up instead of
+  the whole flush padding to its pow2 ceiling, while steady state
+  still hits a handful of jitted programs; `note_program` is the
+  compile counter the acceptance criteria assert against, and
+  `warmup_ec` pre-compiles the common buckets at OSD boot.  Each chip
+  accounts its own programs (a real mesh compiles per chip) and its
+  staging waste (`bucket_waste_ratio`).
 * **HBM staging pool** — bucket-sized arrays leased/released across
   flushes instead of allocated per flush (`BufferPool`), one pool per
   chip.
@@ -253,6 +256,13 @@ _MIN_BUCKET = 512          # words: floor so tiny flushes share one program
 _TICKET_RING = 512
 _HIST_BUCKETS = 32         # power-of-two microsecond histogram
 
+# bucket-ladder cap: a ragged flush stages at most this many pow2
+# segments (each an already-compiled bucket program); the tail-only
+# rounding then bounds waste at ~n / 2^(cap-1) of the flush, while
+# more segments would trade the padding win back for per-dispatch
+# overhead
+_RAGGED_MAX_SEGMENTS = 6
+
 # words at/above which a flush shards across the mesh's available
 # chips (the zero-collective stripe-axis split); conf
 # device_shard_min_words overrides via configure()
@@ -280,6 +290,14 @@ class ChipRuntime:
         self.compile_count = 0
         self.bucket_hits = 0
         self.bucket_misses = 0
+        # ragged staging accounting: payload vs bucket-padded words
+        # per flush (the waste the bucket ladder exists to kill;
+        # exported as device_bucket_waste_ratio per chip), plus the
+        # counterfactual pad a whole-flush pow2 bucket would have
+        # burned — the before/after the bench publishes
+        self.staged_payload_words = 0
+        self.staged_pad_words = 0
+        self.staged_pow2_pad_words = 0
         # dispatch telemetry
         self.tickets: list[DispatchTicket] = []     # bounded ring
         self.dispatch_buckets_us = [0] * _HIST_BUCKETS
@@ -338,6 +356,21 @@ class ChipRuntime:
         self.compile_count += 1
         self.bucket_misses += 1
         return True
+
+    def note_staging(self, payload_words: int,
+                     padded_words: int) -> None:
+        """Account one flush's staging: `payload_words` real columns
+        staged into `padded_words` of bucket capacity.  The cumulative
+        pad/(pad+payload) ratio is the padding-waste figure the
+        exporter publishes and bench --device gates on; the pow2
+        counterfactual records what rounding the whole flush to its
+        pow2 ceiling (the pre-ragged behavior) would have padded."""
+        self.staged_payload_words += max(0, int(payload_words))
+        self.staged_pad_words += max(
+            0, int(padded_words) - int(payload_words))
+        self.staged_pow2_pad_words += max(
+            0, DeviceRuntime.bucket_for(payload_words)
+            - int(payload_words))
 
     # -- tickets -----------------------------------------------------------
 
@@ -476,11 +509,20 @@ class ChipRuntime:
         total = self.bucket_hits + self.bucket_misses
         return self.bucket_hits / total if total else 1.0
 
+    @property
+    def bucket_waste_ratio(self) -> float:
+        """Fraction of staged bucket capacity that was padding (0.0
+        with no flushes yet): the ragged batcher's observable win."""
+        total = self.staged_payload_words + self.staged_pad_words
+        return self.staged_pad_words / total if total else 0.0
+
     def metrics(self) -> dict:
         return {
             "device_queue_depth": self.queue.depth,
             "device_inflight": self.queue.inflight,
             "device_bucket_hit_ratio": round(self.bucket_hit_ratio, 4),
+            "device_bucket_waste_ratio": round(self.bucket_waste_ratio,
+                                               4),
             "device_compile_count": self.compile_count,
             "device_dispatches": self.dispatches,
             "device_host_fallbacks": self.host_fallbacks,
@@ -659,6 +701,41 @@ class DeviceRuntime:
         n = max(int(n_words), _MIN_BUCKET)
         return 1 << (n - 1).bit_length()
 
+    @classmethod
+    def ragged_plan(cls, n_words: int,
+                    max_segments: int | None = None
+                    ) -> list[tuple[int, int]]:
+        """Bucket ladder for one ragged flush: [(lo, segment_bucket)]
+        covering `n_words` columns with power-of-two segments (each an
+        already-compiled bucket program, so the compile cache stays
+        bounded).  Only the ladder's TAIL rounds up — greedy
+        largest-pow2-first, final remainder to its own bucket — so a
+        mixed-size flush wastes at most one small bucket instead of
+        padding the whole total to the next power of two (the Ragged
+        Paged Attention recipe, arXiv:2604.15464: one program family
+        serving variable-length batches from packed buffers).  When
+        the ladder would pad as much as the single pow2 bucket it
+        degenerates to that bucket (one dispatch beats several for
+        equal padding)."""
+        n = max(int(n_words), 1)
+        single = cls.bucket_for(n)
+        cap = max_segments or _RAGGED_MAX_SEGMENTS
+        plan: list[tuple[int, int]] = []
+        lo = 0
+        remaining = n
+        while len(plan) < cap - 1 and remaining > _MIN_BUCKET:
+            p = 1 << (remaining.bit_length() - 1)
+            plan.append((lo, p))
+            lo += p
+            remaining -= p
+        if remaining > 0:
+            b = cls.bucket_for(remaining)
+            plan.append((lo, b))
+            lo += b
+        if lo >= single:
+            return [(0, single)]
+        return plan
+
     async def warmup_ec(self, matrix, w: int,
                         buckets: tuple = (1024, 4096, 16384),
                         chip: int | None = None) -> None:
@@ -768,6 +845,23 @@ class DeviceRuntime:
         return self.bucket_hits / total if total else 1.0
 
     @property
+    def bucket_waste_ratio(self) -> float:
+        """Mesh-aggregate staging waste: padded words that carried no
+        payload over total staged capacity."""
+        pay = self._sum("staged_payload_words")
+        pad = self._sum("staged_pad_words")
+        return pad / (pay + pad) if (pay + pad) else 0.0
+
+    @property
+    def pow2_waste_ratio(self) -> float:
+        """What the same flushes would have wasted under whole-flush
+        pow2 bucketing (the counterfactual the ragged figure is
+        gated against)."""
+        pay = self._sum("staged_payload_words")
+        pad = self._sum("staged_pow2_pad_words")
+        return pad / (pay + pad) if (pay + pad) else 0.0
+
+    @property
     def fallback(self) -> bool:
         """Whole-mesh loss: every chip poisoned.  Per-chip state is
         `chips[i].fallback` (what OSD beacons carry)."""
@@ -837,6 +931,8 @@ class DeviceRuntime:
             "device_inflight": sum(c.queue.inflight
                                    for c in self.chips),
             "device_bucket_hit_ratio": round(self.bucket_hit_ratio, 4),
+            "device_bucket_waste_ratio": round(self.bucket_waste_ratio,
+                                               4),
             "device_compile_count": self.compile_count,
             "device_dispatches": self.dispatches,
             "device_host_fallbacks": self.host_fallbacks,

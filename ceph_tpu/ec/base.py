@@ -177,6 +177,101 @@ class ErasureCode(ErasureCodeInterface):
                 self.get_data_chunk_count() + i)] = parity[i].tobytes()
         return {i: out[i] for i in want_to_encode}
 
+    def parity_delta(self, deltas: Mapping[int, bytes]
+                     ) -> dict[int, bytes]:
+        """Host parity updates for a partial overwrite (the
+        XOR-delta formulation of arXiv:2108.02692): given
+        ``delta_j = new_j XOR old_j`` for each touched data chunk j
+        (logical/generator-row index; all values the same length),
+        returns {parity row i: XOR-delta to apply to parity chunk i}:
+
+            new_parity_i = old_parity_i XOR sum_j gfmul(M[i][j],
+                                                        delta_j)
+
+        Exact under GF linearity for any matrix codec.  This is the
+        scalar numpy path — `delta_async` routes the same math through
+        the device batcher and falls back here."""
+        dm = self._device_matrix()
+        if dm is None:
+            raise ValueError(
+                "codec has no plain matrix form for parity deltas")
+        import numpy as np
+
+        from . import gf
+        matrix, w = dm
+        m = len(matrix)
+        dtype = np.dtype(self._word_dtype(w))
+        arrs = {int(j): np.frombuffer(d, dtype=dtype)
+                for j, d in deltas.items()}
+        lengths = {a.shape[0] for a in arrs.values()}
+        if len(lengths) > 1:
+            raise ValueError(
+                "delta regions have differing lengths %s" % lengths)
+        n = lengths.pop() if lengths else 0
+        out: dict[int, bytes] = {}
+        for i in range(m):
+            acc = np.zeros(n, dtype=dtype)
+            for j, darr in arrs.items():
+                c = int(matrix[i][j])
+                if int(w) == 8:
+                    gf.region_mad_u8(acc, darr, c)
+                else:
+                    gf.region_mad_words(acc, darr, c, int(w))
+            out[i] = acc.tobytes()
+        return out
+
+    async def delta_async(self, deltas: Mapping[int, bytes],
+                          klass: str | None = None,
+                          on_ticket=None,
+                          chip: int | None = None) -> dict[int, bytes]:
+        """`parity_delta` with the GF products batched onto the device
+        (the OSD partial-write hot call, osd/ecbackend.py
+        `_try_delta_write`): concurrent small overwrites across
+        PGs/objects aggregate their (coefficient column, delta words)
+        products into one dispatch on the caller's affinity chip.
+
+        The delta rides the codec's FULL coding matrix with zero rows
+        for untouched data chunks — zero rows contribute nothing under
+        GF linearity, so delta flushes share the encode streams and
+        compiled bucket programs, and batch with ordinary full writes
+        into the same device dispatch.  Host fallback (offload off,
+        chip poisoned, word-misaligned region) is `parity_delta`'s
+        numpy path; DeviceBusy and mid-flush device loss degrade
+        inside the batcher the same way encode flushes do.  `on_ticket`
+        receives the flush's DispatchTicket (exact per-op
+        `op_ec_device_dispatch` attribution); host-served deltas
+        deliver none."""
+        from ..device.runtime import DeviceRuntime, K_CLIENT_EC
+        from .batcher import DeviceBatcher, device_offload_enabled
+        if not deltas:
+            return {}
+        dm = self._device_matrix()
+        if dm is None:
+            raise ValueError(
+                "codec has no plain matrix form for parity deltas")
+        import numpy as np
+        matrix, w = dm
+        word = np.dtype(self._word_dtype(w)).itemsize
+        lengths = {len(d) for d in deltas.values()}
+        if len(lengths) != 1:
+            raise ValueError(
+                "delta regions have differing lengths %s" % lengths)
+        nbytes = lengths.pop()
+        if (nbytes == 0 or nbytes % word
+                or not device_offload_enabled()
+                or not DeviceRuntime.get().chip_available(chip)):
+            return self.parity_delta(deltas)
+        k = self.get_data_chunk_count()
+        arr = np.zeros((k, nbytes // word),
+                       dtype=self._word_dtype(w))
+        for j, d in deltas.items():
+            arr[int(j)] = np.frombuffer(d,
+                                        dtype=self._word_dtype(w))
+        parity = await DeviceBatcher.get().encode(
+            matrix, w, arr, klass=klass or K_CLIENT_EC,
+            on_ticket=on_ticket, chip=chip)
+        return {i: parity[i].tobytes() for i in range(len(matrix))}
+
     async def decode_async(self, want_to_read: set[int],
                            chunks: Mapping[int, bytes],
                            klass: str | None = None,

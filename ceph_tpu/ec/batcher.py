@@ -17,12 +17,17 @@ Every flush routes through the shared device runtime
 affinity chip (OSDs pass `chip=`; chip-less callers take the first
 available chip):
 
-* the batch pads to a power-of-two word-count **bucket** staged in a
-  pooled buffer, so steady state re-dispatches a handful of compiled
-  programs instead of recompiling per width (zero padding is exact
-  under GF linearity — parity columns of the pad are zeros that are
-  sliced off, so bucket parity is bit-identical to the unpadded host
-  encode, pinned by tests/test_device_runtime.py);
+* the batch is **ragged**: items of heterogeneous width pack
+  contiguously along the column axis with per-item segment offsets,
+  and the flush TOTAL stages across a pow2 **bucket ladder**
+  (``DeviceRuntime.ragged_plan`` — the Ragged Paged Attention recipe,
+  arXiv:2604.15464), so only the ladder's tail rounds up: per-item
+  padding is zero, mixed-size workloads stop burning bucket-ceiling
+  bandwidth, and steady state still re-dispatches a handful of
+  compiled bucket programs (zero padding is exact under GF linearity
+  — parity columns of the pad are zeros that are sliced off, so
+  ladder parity is bit-identical to the unpadded host encode, pinned
+  by tests/test_device_runtime.py + tests/test_ec_ragged.py);
 * admission is weighted-fair across classes (client-EC, recovery-EC,
   mapping) with bounded in-flight dispatches per chip; queue-full
   degrades THIS flush to the host codepath rather than stacking
@@ -274,8 +279,19 @@ class DeviceBatcher:
                             klass: str, parts: list[np.ndarray],
                             n: int, solo: bool):
         """One chip's slice of a flush: admit on the chip's queue,
-        stage into its pooled bucket buffer, dispatch on its device.
-        Returns (parity [m, n], ticket).
+        stage the ragged total into its pooled bucket-ladder buffers,
+        dispatch on its device.  Returns (parity [m, n], ticket).
+
+        Ragged staging: the flush's heterogeneous-width items pack
+        contiguously along the column axis; the packed total covers a
+        **bucket ladder** (``DeviceRuntime.ragged_plan``) of pow2
+        segments, each staged in its own pooled buffer and encoded by
+        an already-compiled bucket program, so only the ladder's tail
+        rounds up — per-item widths never pad, and a mixed-size flush
+        stops burning bucket-ceiling bandwidth (GF parity is
+        column-independent, so the segment split is exact).  Items may
+        span segment boundaries; per-item offsets stay global column
+        offsets, so `_deliver`'s slicing is unchanged.
 
         `solo=True` is the whole-flush single-chip path: DeviceBusy
         and device loss return (None, None) so the caller degrades
@@ -285,8 +301,9 @@ class DeviceBatcher:
         not the flush — so reassembly is unconditional."""
         dtype = _WORD_DTYPE[int(w)]
         k = parts[0].shape[0]
-        bucket = chip.rt.bucket_for(n)
-        ticket = chip.open_ticket(klass, bucket,
+        plan = chip.rt.ragged_plan(n)
+        padded = sum(seg for _lo, seg in plan)
+        ticket = chip.open_ticket(klass, padded,
                                   n * k * dtype().itemsize)
         try:
             await chip.admit(ticket)
@@ -294,18 +311,39 @@ class DeviceBatcher:
             if solo:
                 return None, None
             return self._host_shard(chip, matrix_key, w, parts), None
-        buf = chip.pool.lease((k, bucket), dtype)
+        bufs: list[np.ndarray] = []
         try:
-            off = 0
+            for _lo, seg in plan:
+                bufs.append(chip.pool.lease((k, seg), dtype))
+            # pack items contiguously across the ladder (an item can
+            # straddle two segments); leased buffers come back zeroed
+            # so segment tails are exact GF zero columns
+            si, soff = 0, 0
             for arr in parts:
-                ni = arr.shape[1]
-                buf[:, off:off + ni] = arr
-                off += ni
-            chip.note_program("ec", (matrix_key, int(w), bucket))
+                ni, pos = arr.shape[1], 0
+                while pos < ni:
+                    take = min(plan[si][1] - soff, ni - pos)
+                    bufs[si][:, soff:soff + take] = \
+                        arr[:, pos:pos + take]
+                    soff += take
+                    pos += take
+                    if soff == plan[si][1]:
+                        si += 1
+                        soff = 0
             chip.launch(ticket)         # injected-fault hook
             enc = self._encoder(matrix_key, int(w))
-            out = np.asarray(enc(chip.place(buf)))[:, :n]
+            outs = []
+            used = n
+            for (_lo, seg), buf in zip(plan, bufs):
+                chip.note_program("ec", (matrix_key, int(w), seg))
+                u = min(seg, used)
+                outs.append(np.asarray(
+                    enc(chip.place(buf)))[:, :u])
+                used -= u
+            out = (outs[0] if len(outs) == 1
+                   else np.concatenate(outs, axis=1))
             chip.finish(ticket, ok=True)
+            chip.note_staging(n, padded)
             return out, ticket
         except Exception as e:
             # device loss: poison THIS chip (host fallback + per-chip
@@ -317,7 +355,8 @@ class DeviceBatcher:
                 return None, None
             return self._host_shard(chip, matrix_key, w, parts), None
         finally:
-            chip.pool.release(buf)
+            for buf in bufs:
+                chip.pool.release(buf)
 
     def _host_shard(self, chip, matrix_key, w: int,
                     parts: list[np.ndarray]) -> np.ndarray:

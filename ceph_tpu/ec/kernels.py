@@ -457,6 +457,16 @@ class FusedEncoder:
     bit-identical to the host codecs.  run32 is the device-resident
     entry point on (k, n//4) uint32 views (free reinterpretation of
     the same bytes, little-endian lanes).
+
+    Ragged-segment friendliness: the kernel pads its input to a tile
+    multiple, so a fixed big tile would hand a small bucket-ladder
+    segment (ec.batcher ragged staging) back all the padding the
+    ladder just removed.  The tile therefore ADAPTS: inputs smaller
+    than `tile_bytes` compile against the largest halving of the tile
+    that still covers them (floored at the 1024-lane VPU alignment),
+    one cached program per clamped tile — the tile ladder mirrors the
+    bucket ladder, so segment programs stay few and pad stays
+    sub-tile.
     """
 
     def __init__(self, matrix: list[list[int]], tile_bytes: int = 32768):
@@ -469,12 +479,29 @@ class FusedEncoder:
             matrices.matrix_to_bitmatrix(self.k, self.m, 8, matrix),
             dtype=np.int8)
         self._bitmatrix = bm
-        self._fn = _fused_xor_pallas(bm, tile_bytes // 4)
+        self._fns: dict[int, object] = {}   # tile_lanes -> compiled
         self._decoders: dict[tuple, "FusedEncoder"] = {}
+
+    def _tile_lanes_for(self, lanes: int) -> int:
+        """Clamped tile (uint32 lanes) for an input of `lanes`: halve
+        the configured tile while it still over-covers the input,
+        never below the 1024-lane alignment _fused_xor_pallas needs."""
+        tile = self.tile_bytes // 4
+        while tile > 1024 and tile >= 2 * max(1, lanes):
+            tile //= 2
+        return max(tile, 1024)
+
+    def _fn_for(self, lanes: int):
+        tile = self._tile_lanes_for(lanes)
+        fn = self._fns.get(tile)
+        if fn is None:
+            fn = _fused_xor_pallas(self._bitmatrix, tile)
+            self._fns[tile] = fn
+        return fn
 
     def run32(self, data32: jax.Array) -> jax.Array:
         """(k, P) uint32 -> (m, P) uint32, device-resident."""
-        return self._fn(data32)
+        return self._fn_for(data32.shape[1])(data32)
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
         k, n = data.shape
@@ -484,7 +511,7 @@ class FusedEncoder:
         if pad:
             data = np.pad(data, ((0, 0), (0, pad)))
         d32 = np.ascontiguousarray(data).view(np.uint32)
-        out = np.asarray(self._fn(jnp.asarray(d32)))
+        out = np.asarray(self._fn_for(d32.shape[1])(jnp.asarray(d32)))
         out8 = out.view(np.uint8)
         return out8[:, :n] if pad else out8
 

@@ -75,12 +75,17 @@ def _parse_ver(raw: bytes) -> tuple[int, int]:
 
 def derive_warmup_buckets(op_size_hist: list[int] | None, k: int,
                           w: int, top: int = 3) -> tuple | None:
-    """Workload-aware device warmup: map the daemon's client
-    write-size histogram (pow2 byte buckets — op_size_hist[i] counts
-    writes of [2^i, 2^(i+1)) bytes) onto the runtime's word-count
-    buckets for a k-chunk, w-bit codec, keeping the `top` most
-    frequent.  Returns None when there is no history (caller falls
-    back to the static default list)."""
+    """Workload-aware device warmup for RAGGED streams: map the
+    daemon's client write-size histogram (pow2 byte buckets —
+    op_size_hist[i] counts writes of [2^i, 2^(i+1)) bytes) onto the
+    bucket-ladder segment programs a k-chunk, w-bit codec's flushes
+    will actually dispatch.  The batcher stages each flush TOTAL as a
+    pow2 segment ladder (``DeviceRuntime.ragged_plan``), so the
+    buckets worth warming are the ladder segments of each top item
+    width (solo flushes) plus the segments of their combined total
+    (the heterogeneous mixed flush a concurrent stream produces) —
+    not each item's own pow2 ceiling.  Returns None when there is no
+    history (caller falls back to the static default list)."""
     if not op_size_hist or not any(op_size_hist):
         return None
     from ..device.runtime import DeviceRuntime
@@ -88,11 +93,14 @@ def derive_warmup_buckets(op_size_hist: list[int] | None, k: int,
     ranked = sorted(
         (i for i, n in enumerate(op_size_hist) if n > 0),
         key=lambda i: (-op_size_hist[i], i))[:top]
-    buckets = set()
+    words = []
     for i in ranked:
         payload = 1 << (i + 1)          # bucket upper bound, bytes
-        chunk_words = -(-payload // (k * word_bytes))   # ceil div
-        buckets.add(DeviceRuntime.bucket_for(chunk_words))
+        words.append(-(-payload // (k * word_bytes)))   # ceil div
+    buckets = set()
+    for n in words + ([sum(words)] if len(words) > 1 else []):
+        for _lo, seg in DeviceRuntime.ragged_plan(n):
+            buckets.add(seg)
     return tuple(sorted(buckets))
 
 
@@ -353,8 +361,8 @@ class ECPGBackend:
             res = await self._try_delta_write(pg, msg)
             if res is not None:
                 outs2, ok2 = res
-                self._journal_reply(pg, msg, 0 if ok2 else -11,
-                                    outs2, pg.info.last_update[1])
+                # no _journal_reply here: the delta path journals the
+                # reqid inside the replicated shard txns themselves
                 conn.send(MOSDOpReply(
                     tid=msg.tid, result=0 if ok2 else -11,
                     outs=outs2, epoch=epoch,
@@ -710,9 +718,14 @@ class ECPGBackend:
 
         so the network traffic is (1+m) ranged reads + (1+m) ranged
         writes proportional to the touched bytes — NOT the object
-        size.  Untouched data shards get an attr-only version bump so
-        readers never mix generations.  Shard crcs (hinfo) update
-        incrementally via crc32 linearity:
+        size.  The GF products route through ``codec.delta_async`` —
+        device-batched on this OSD's affinity chip, so concurrent
+        partial writes across PGs/objects share one dispatch (numpy
+        host path under DeviceBusy/poison, bit-identical) — and the
+        reqid dup journal rides every shard txn so promoted replicas
+        answer resends.  Untouched data shards get an attr-only
+        version bump so readers never mix generations.  Shard crcs
+        (hinfo) update incrementally via crc32 linearity:
         crc(new) = crc(old) ^ crc(delta0pad) ^ crc(zeros) — computed
         by the primary with no extra I/O.  Returns op outs, or None
         when ineligible (growth, degraded members, non-matrix codec,
@@ -720,9 +733,6 @@ class ECPGBackend:
         The per-object oid_lock plays the ExtentCache role of
         serializing overlapping RMW cycles."""
         import zlib
-
-        from ..ec import gf as gfmod
-        import numpy as np
         pool = self.osd.osdmap.pools[pg.pool_id]
         codec = self.codec(pool)
         matrix = getattr(codec, "matrix", None)
@@ -845,24 +855,42 @@ class ECPGBackend:
                 dpad[c0:c0 + len(delta)] = delta
             new_crcs[j] = (old_crcs[j] ^ zlib.crc32(bytes(dpad))
                            ^ zeros_cs_crc) & 0xFFFFFFFF
+        # parity deltas: one device-batched GF product per interval
+        # (codec.delta_async — concurrent partial writes across
+        # PGs/objects batch their coefficient-column products into one
+        # dispatch on this OSD's chip, host numpy under
+        # DeviceBusy/poison), intervals issued concurrently so they
+        # share a flush; the op's ticket feeds op_ec_device_dispatch
+        top = getattr(msg, "_top", None)
+
+        def _iv_deltas(a: int, b: int) -> dict[int, bytes]:
+            out: dict[int, bytes] = {}
+            for j, parts in per_chunk.items():
+                row = bytearray(b - a)
+                touched = False
+                for c0, d in parts:
+                    if c0 >= b or c0 + len(d) <= a:
+                        continue
+                    dp = delta_part[(j, c0)]
+                    row[c0 - a:c0 - a + len(dp)] = dp
+                    touched = True
+                if touched:
+                    out[j] = bytes(row)
+            return out
+
+        pdeltas = await asyncio.gather(*[
+            codec.delta_async(_iv_deltas(a, b),
+                              on_ticket=self._on_dispatch_ticket(top),
+                              chip=self._chip())
+            for a, b in ivs])
         new_par: dict[tuple, bytes] = {}
         for i in range(m):
             dpad = bytearray(cs)
-            for a, b in ivs:
-                acc = _np.zeros((b - a,), dtype=_np.uint8)
-                for j, parts in per_chunk.items():
-                    coef = _np.array([[matrix[i][j]]],
-                                     dtype=_np.uint8)
-                    for c0, d in parts:
-                        if c0 >= b or c0 + len(d) <= a:
-                            continue
-                        darr = _np.frombuffer(
-                            delta_part[(j, c0)], _np.uint8)[None, :]
-                        contrib = gfmod.matmul_u8(coef, darr)[0]
-                        acc[c0 - a:c0 - a + len(d)] ^= contrib
+            for (a, b), pd in zip(ivs, pdeltas):
+                acc = _np.frombuffer(pd[i], _np.uint8)
                 ob = _np.frombuffer(old_par[(k + i, a)], _np.uint8)
                 new_par[(k + i, a)] = (ob[:b - a] ^ acc).tobytes()
-                dpad[a:b] = acc.tobytes()
+                dpad[a:b] = pd[i]
             new_crcs[k + i] = (old_crcs[k + i]
                                ^ zlib.crc32(bytes(dpad))
                                ^ zeros_cs_crc) & 0xFFFFFFFF
@@ -902,14 +930,25 @@ class ECPGBackend:
                 t.omap_setkeys(pg.cid, PGMETA_OID,
                                {_snapmod.sna_key(s, msg.oid): b"1"})
             txns[j] = t
+        outs = [{} for _ in msg.ops]
+        # the reqid dup journal rides EVERY shard txn (replicated, not
+        # primary-local like the full-write path's own-txn journal):
+        # after a primary loss the promoted replica answers a client
+        # resend from its own store
+        pg.record_reqid(list(txns.values()), msg.src, msg.tid, 0,
+                        outs, version[1])
         self.osd._op_event(msg, "ec_delta_rmw")
         ok = await self._commit_shard_txns(pg, msg.oid, entry, txns,
-                                           top=getattr(msg, "_top",
-                                                       None))
+                                           top=top)
+        if not ok:
+            # < k shards acked: the resend must re-execute (an
+            # in-place overwrite re-executes idempotently), not be
+            # answered 0 from the pre-journaled row
+            pg.forget_reqid(msg.src, msg.tid)
         # the log entry is appended either way: do NOT fall back to the
         # whole-object path after a commit attempt (same durability
         # contract as submit_write: ok = >= k shards persisted)
-        return ([{} for _ in msg.ops], ok)
+        return (outs, ok)
 
     def handle_sub_write(self, conn, msg: MOSDECSubOpWrite) -> None:
         """Shard side (ECBackend::handle_sub_write)."""

@@ -244,33 +244,78 @@ class PG:
     def _reqid_row(src: str, tid: int) -> bytes:
         return b"dup.%s.%d" % (src.encode(), int(tid))
 
-    def record_reqid(self, t: Transaction, src: str, tid,
+    def record_reqid(self, t, src: str, tid,
                      result: int, outs: list, version: int) -> None:
         """Journal one completed client write's reply, riding the same
         transaction as the write itself (atomic: a replayed store
-        never has the mutation without its dup row or vice versa)."""
+        never has the mutation without its dup row or vice versa).
+
+        `t` is one Transaction or a collection of them: the EC delta
+        path passes EVERY per-position shard transaction, so the dup
+        row replicates to each member and a promoted replica answers
+        a post-primary-loss resend from its own store."""
         if not src or tid is None:
             return
+        ts = (list(t) if isinstance(t, (list, tuple)) else [t])
         key = (src, int(tid))
         entry = {"result": int(result), "outs": list(outs or []),
                  "version": int(version)}
         if key not in self.reqid_journal:
             self.reqid_order.append(key)
         self.reqid_journal[key] = entry
-        t.omap_setkeys(self.cid, PGMETA_OID,
-                       {self._reqid_row(*key): denc.encode(entry)})
+        for txn in ts:
+            txn.omap_setkeys(self.cid, PGMETA_OID,
+                             {self._reqid_row(*key):
+                              denc.encode(entry)})
         cap = int(self.osd.ctx.conf.get("osd_pg_log_dups_tracked",
                                         128))
         while len(self.reqid_order) > cap:
             old = self.reqid_order.pop(0)
             self.reqid_journal.pop(old, None)
-            t.omap_rmkeys(self.cid, PGMETA_OID,
-                          [self._reqid_row(*old)])
+            for txn in ts:
+                txn.omap_rmkeys(self.cid, PGMETA_OID,
+                                [self._reqid_row(*old)])
+
+    def forget_reqid(self, src: str, tid) -> None:
+        """Drop a pre-journaled reply after a FAILED commit (< k
+        shards acked): the resend must re-execute, not be answered 0.
+        Local store row included; replicated copies on members that
+        did apply are harmless — re-execution of the same (src,tid)
+        write converges to the same bytes."""
+        if not src or tid is None:
+            return
+        key = (src, int(tid))
+        if self.reqid_journal.pop(key, None) is None:
+            return
+        try:
+            self.reqid_order.remove(key)
+        except ValueError:
+            pass
+        t = Transaction()
+        t.omap_rmkeys(self.cid, PGMETA_OID, [self._reqid_row(*key)])
+        self.osd.store.apply_transaction(t)
 
     def lookup_reqid(self, src: str, tid) -> dict | None:
         if not src or tid is None:
             return None
-        return self.reqid_journal.get((src, int(tid)))
+        key = (src, int(tid))
+        entry = self.reqid_journal.get(key)
+        if entry is None:
+            # replicated dup rows (the EC delta path journals inside
+            # the shard transactions): a replica promoted to primary
+            # serves the dup from its own store WITHOUT a reload
+            try:
+                raw = self.osd.store.omap_get_values(
+                    self.cid, PGMETA_OID,
+                    [self._reqid_row(*key)]).get(
+                        self._reqid_row(*key))
+            except Exception:
+                raw = None
+            if raw:
+                entry = dict(denc.decode(raw))
+                self.reqid_journal[key] = entry
+                self.reqid_order.append(key)
+        return entry
 
     def maybe_trim_log(self, t: Transaction) -> None:
         """Bound the log after appending a WRITE entry (never call

@@ -148,7 +148,18 @@ class ClusterThrasher:
       osd_crash        — crash an OSD on an injected exception: the
                          report must survive in its store, surface in
                          the committed `crash ls` after revive, raise
-                         RECENT_CRASH, and clear via `crash archive`.
+                         RECENT_CRASH, and clear via `crash archive`;
+      mixed_rmw        — the ragged/parity-delta oracle (ROADMAP
+                         direction 2): seeded rounds of interleaved
+                         full-object rewrites and partial overwrites
+                         (boundary-crossing offsets included) on the
+                         same EC objects, issued concurrently so they
+                         batch; afterwards every acked write reads
+                         back exactly AND every stored shard —
+                         delta-updated parity and incrementally
+                         re-crc'd hinfo included — must be
+                         BIT-IDENTICAL to the host codec's encode of
+                         the final object contents.
 
     Slow-op oracle: after every round's health check, no live OSD may
     still hold an op in flight past osd_op_complaint_time — a healthy
@@ -164,7 +175,8 @@ class ClusterThrasher:
     ALL_ACTIONS = ("kill_revive", "kill_wipe_revive", "out_in",
                    "mon_partition", "map_churn", "pg_num_grow",
                    "pgp_num_grow", "ec_profile_swap",
-                   "device_fallback", "chip_loss", "osd_crash")
+                   "device_fallback", "chip_loss", "osd_crash",
+                   "mixed_rmw")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -214,7 +226,7 @@ class ClusterThrasher:
             return (action, self.rng.randrange(self.cluster.n_mons))
         if action in ("map_churn", "pg_num_grow", "pgp_num_grow",
                       "ec_profile_swap", "device_fallback",
-                      "chip_loss"):
+                      "chip_loss", "mixed_rmw"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -418,8 +430,124 @@ class ClusterThrasher:
             await self._wait_health_check(c, "DEVICE_FALLBACK", False)
             assert not chip.fallback, "chip %d did not heal" % victim
             assert all(not sc.fallback for sc in survivors)
+        elif action == "mixed_rmw":
+            pid = next(
+                (p for p in self._pool_ids
+                 if (c.client.osdmap.pools.get(p) is not None
+                     and c.client.osdmap.pools[p]
+                     .erasure_code_profile)), None)
+            if pid is None:
+                return              # no EC pool under thrash
+            await self._mixed_rmw_round(c, pid, arg)
         else:
             raise ValueError(action)
+
+    async def _mixed_rmw_round(self, c, pid: int, seed: int) -> None:
+        """Interleaved full rewrites + partial overwrites on the same
+        EC objects (seeded, one write per object per concurrent
+        batch so the expected content is unambiguous), then the
+        direction-2 oracle: every acked write reads back exactly and
+        every stored shard is bit-identical to the host codec's
+        encode of the final contents."""
+        pool = c.client.osdmap.pools[pid]
+        io = c.client.io_ctx(pool.name)
+        rng = random.Random("mixed_rmw-%r-%d" % (self.seed, seed))
+        model: dict[str, bytearray] = {}
+        for i in range(4):
+            oid = "mixedrmw-%d-%d" % (seed, i)
+            size = rng.randrange(8, 33) * 1024
+            data = rng.randbytes(size)
+            await asyncio.wait_for(io.write_full(oid, data), 30.0)
+            model[oid] = bytearray(data)
+        oids = sorted(model)
+        chunk = max(1, len(model[oids[0]]) // 2)
+        for _step in range(5):
+            batch = []
+            for oid in oids:
+                size = len(model[oid])
+                roll = rng.random()
+                if roll < 0.25:
+                    batch.append((oid, rng.randbytes(size), None))
+                elif roll < 0.5:
+                    # chunk-boundary-crossing overwrite (the delta
+                    # path must split it per column range)
+                    ln = rng.randrange(256, 2048)
+                    off = max(0, min(size - ln,
+                                     chunk - ln // 2))
+                    batch.append((oid, rng.randbytes(ln), off))
+                else:
+                    ln = rng.randrange(16, 4096)
+                    off = rng.randrange(0, max(1, size - ln))
+                    batch.append((oid, rng.randbytes(ln), off))
+            # concurrent: partial overwrites across objects batch
+            # into shared device dispatches
+            await asyncio.wait_for(asyncio.gather(*[
+                (io.write_full(oid, d) if off is None
+                 else io.write(oid, d, off))
+                for oid, d, off in batch]), 60.0)
+            for oid, d, off in batch:   # all acked (gather raised
+                if off is None:         # on any failure)
+                    model[oid] = bytearray(d)
+                else:
+                    model[oid][off:off + len(d)] = d
+        self.log.append("mixed_rmw: %d objects, 5 rounds" % len(oids))
+        await c.wait_health(pid, timeout=120.0)
+        for oid, want in sorted(model.items()):
+            got = await asyncio.wait_for(io.read(oid), 30.0)
+            assert got == bytes(want), \
+                "acked mixed_rmw write lost/corrupt on %s" % oid
+        await self._verify_ec_host_parity(c, pid, model)
+
+    @staticmethod
+    async def _verify_ec_host_parity(c, pid: int,
+                                     objects: dict) -> None:
+        """Every live acting member's stored shard of `objects` must
+        be BIT-IDENTICAL to the host codec's encode of the expected
+        payload — delta-updated parity shards and the incrementally
+        maintained hinfo crcs included.  Run only on a healthy pool
+        (recovery drained), so every member holds current bytes."""
+        from ..ec.plugin import ErasureCodePluginRegistry
+        from ..osd.ecbackend import HINFO_XATTR, hinfo_bytes
+        from ..osd.osdmap import pg_t
+        from ..store.objectstore import hobject_t
+        m = c.client.osdmap
+        pool = m.pools[pid]
+        profile = dict(m.erasure_code_profiles.get(
+            pool.erasure_code_profile) or {})
+        codec = ErasureCodePluginRegistry.instance().factory(
+            profile.get("plugin", "jerasure"), dict(profile))
+        n = codec.get_chunk_count()
+        osd_by_id = {o.whoami: o for o in c.live_osds}
+        for oid, want in sorted(objects.items()):
+            expected = codec.encode(set(range(n)), bytes(want))
+            hinfo = hinfo_bytes(expected)
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg(oid, pid))
+            _up, _upp, acting, _prim = m.pg_to_up_acting_osds(pgid)
+            checked = 0
+            for j, osd_id in enumerate(acting):
+                osd = osd_by_id.get(osd_id)
+                if osd is None:
+                    continue
+                pg = osd.pgs.get(pg_t(pid, pgid.ps))
+                if pg is None:
+                    continue
+                local = osd.ec._local_shard(pg, hobject_t(oid))
+                assert local is not None, \
+                    "%s: osd.%d holds no shard" % (oid, osd_id)
+                lj, buf, size, _ver, attrs = local
+                assert lj == j, (oid, osd_id, lj, j)
+                assert size == len(want), (oid, size, len(want))
+                assert bytes(buf) == expected[j], (
+                    "mixed_rmw: shard %d of %s on osd.%d diverged "
+                    "from the host codec (%d bytes)"
+                    % (j, oid, osd_id, len(buf)))
+                assert attrs.get(HINFO_XATTR) == hinfo, (
+                    "mixed_rmw: hinfo crc of %s shard %d diverged "
+                    "from a host recompute" % (oid, j))
+                checked += 1
+            assert checked >= codec.get_data_chunk_count(), \
+                "%s: only %d shards checkable" % (oid, checked)
 
     @staticmethod
     async def _wait_crash_listed(c, crash_id: str,

@@ -207,7 +207,10 @@ _VALID_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 def device_runtime_lines(prefix: str = "ceph_tpu") -> list[str]:
     """Device-runtime metric family (ceph_tpu.device): queue depth,
-    bucket hit ratio, compile count, fallback state, and the
+    bucket hit ratio, the ragged staging waste ratio
+    (``device_bucket_waste_ratio`` — padded-but-empty over total
+    staged words, the figure the bucket ladder exists to keep near
+    zero), compile count, fallback state, and the
     device_dispatch_seconds histogram — every dispatch ticket feeds
     these, so the accelerator's behavior is scrapeable beside the
     daemon counters.  Every series carries a ``chip`` label (one per
