@@ -459,6 +459,156 @@ def bench_stats(seconds: float = 4.0) -> dict:
     return asyncio.run(asyncio.wait_for(run(), 300))
 
 
+def bench_scrub(n_bufs: int = 256, buf_bytes: int = 4096,
+                rounds: int = 6, n_objs: int = 96) -> dict:
+    """--scrub mode: the integrity plane's two figures.  (1) digest
+    throughput: the batched device crc32 lanes
+    (ceph_tpu.device.digest — one gather+XOR-reduce dispatch per
+    chunk, background admission class) vs the host zlib loop, parity
+    asserted bit-identical.  (2) scrub round duration: a LocalCluster
+    pool of `n_objs` objects deep-scrubbed end to end (map gathers,
+    device digests, hinfo compare).  Published into BASELINE.json's
+    `scrub_plane` behind a regression gate (parity, digests actually
+    dispatched on-device, round duration vs the published figure)."""
+    import asyncio
+    import os
+
+    os.environ.setdefault("CEPH_TPU_SCRUB_OFFLOAD", "1")
+
+    async def digest_leg() -> dict:
+        from ceph_tpu.device import digest as dg
+        from ceph_tpu.device.runtime import DeviceRuntime
+
+        rt = DeviceRuntime.reset()
+        rng = np.random.default_rng(41)
+        bufs = [rng.integers(0, 256, buf_bytes,
+                             dtype=np.uint8).tobytes()
+                for _ in range(n_bufs)]
+        # warm (compiles + table upload) and parity oracle
+        dev, path = await dg.crc32_batch(bufs)
+        host = dg.crc32_host(bufs)
+        parity_ok = (path == "device" and dev == host)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            await dg.crc32_batch(bufs)
+        dev_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            dg.crc32_host(bufs)
+        host_wall = time.perf_counter() - t0
+        payload = n_bufs * buf_bytes * rounds
+        import jax
+        return {
+            "digest_device_gibps": round(
+                payload / dev_wall / (1 << 30), 3),
+            "digest_host_gibps": round(
+                payload / host_wall / (1 << 30), 3),
+            "digest_parity_ok": parity_ok,
+            "digest_dispatches": rt.dispatches,
+            "backend": jax.default_backend(),
+            "buf_bytes": buf_bytes, "n_bufs": n_bufs,
+        }
+
+    async def round_leg() -> dict:
+        from ceph_tpu.testing import LocalCluster
+
+        c = await LocalCluster(
+            n_osds=3,
+            conf={"osd_scrub_interval": -1.0,
+                  "osd_deep_scrub_interval": -1.0}).start()
+        try:
+            pid = await c.create_pool("scrubbench", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("scrubbench")
+            for i in range(n_objs):
+                await io.write_full("sb-%d" % i, b"\xa7" * buf_bytes)
+            # warm round (compiles), then the timed round
+            await c.scrub_pool(pid, deep=True, recheck=False)
+            t0 = time.perf_counter()
+            res = await c.scrub_pool(pid, deep=True, recheck=False)
+            wall = time.perf_counter() - t0
+            assert res["errors"] == 0, res
+            dev = sum(o.perf.dump()["scrub_digest_device"]
+                      for o in c.live_osds)
+            host = sum(o.perf.dump()["scrub_digest_host"]
+                       for o in c.live_osds)
+            return {
+                "scrub_round_seconds": round(wall, 3),
+                "scrub_round_objects": n_objs,
+                "round_digest_device": dev,
+                "round_digest_host": host,
+            }
+        finally:
+            await c.stop()
+
+    rec = {"metric": "scrub_plane"}
+    rec.update(asyncio.run(asyncio.wait_for(digest_leg(), 300)))
+    rec.update(asyncio.run(asyncio.wait_for(round_leg(), 600)))
+    rec["gate"] = _gate_scrub(rec)
+    _publish_scrub(rec)
+    return rec
+
+
+def _gate_scrub(rec: dict) -> dict:
+    """Scrub-plane regression gate: digests must be bit-identical to
+    the host loop AND genuinely dispatched on-device (both in the
+    digest sweep and inside the cluster round), and the round
+    duration must stay within 3x the published same-backend figure
+    (shared-CI jitter allowance, like the scale gate)."""
+    import os
+    failures = []
+    if not rec.get("digest_parity_ok"):
+        failures.append("device digest parity mismatch vs zlib")
+    if not rec.get("digest_dispatches"):
+        failures.append("digest sweep never dispatched on-device")
+    if not rec.get("round_digest_device"):
+        failures.append("cluster scrub round digested nothing"
+                        " on-device")
+    published = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            published = (json.load(f).get("published") or {}) \
+                .get("scrub_plane") or {}
+    except Exception:
+        pass
+    prev = published.get("scrub_round_seconds")
+    if (prev and published.get("backend") == rec.get("backend")
+            and rec.get("scrub_round_seconds", 0) > 3 * prev):
+        failures.append(
+            "scrub round %.2fs regressed past 3x the published %.2fs"
+            % (rec["scrub_round_seconds"], prev))
+    return {"ok": not failures, "failures": failures}
+
+
+def _publish_scrub(rec: dict) -> None:
+    """Fold the scrub-plane figures into BASELINE.json's published
+    map (backend recorded so the gate compares like with like); a
+    failed gate publishes nothing."""
+    import os
+    if not rec.get("gate", {}).get("ok"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})["scrub_plane"] = {
+            "digest_device_gibps": rec["digest_device_gibps"],
+            "digest_host_gibps": rec["digest_host_gibps"],
+            "scrub_round_seconds": rec["scrub_round_seconds"],
+            "scrub_round_objects": rec["scrub_round_objects"],
+            "backend": rec["backend"],
+            "source": "bench.py --scrub",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+
+
 def _maybe_simulate_mesh(n: int = 8) -> None:
     """CPU runs (JAX_PLATFORMS=cpu, jax not yet imported) get an
     n-device virtual mesh so the dp sweep exercises real per-chip
@@ -1223,6 +1373,16 @@ def main() -> None:
         return
     if "--stats" in sys.argv:
         print(json.dumps(bench_stats()))
+        return
+    if "--scrub" in sys.argv:
+        _maybe_simulate_mesh()
+        rec = bench_scrub()
+        print(json.dumps(rec))
+        if not rec["gate"]["ok"]:
+            # the integrity-plane figures are guarded artifacts: a
+            # digest parity mismatch, a silently host-only round, or
+            # a 3x duration blowup is a CI failure
+            sys.exit(1)
         return
 
     import jax

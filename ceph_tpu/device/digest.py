@@ -1,0 +1,195 @@
+"""Batched CRC32 digest lanes: the scrub plane's device kernel.
+
+"GPUs as Storage System Accelerators" (arXiv:1202.3669, PAPERS.md) is
+about exactly this offload — integrity checksumming is embarrassingly
+parallel ACROSS objects but the host path computes one `zlib.crc32`
+at a time on the event loop.  This module turns a scrub chunk's
+digests (object bytes + attr blobs) into ONE device dispatch:
+
+* **linearity decomposition** — CRC32 is affine over GF(2): with the
+  standard byte-step ``s' = (s >> 8) ^ TAB[(s ^ b) & 0xff]``, byte
+  ``b`` contributes ``L^t(TAB[b])`` where ``t`` is its trailing byte
+  count and ``L(v) = (v >> 8) ^ TAB[v & 0xff]`` is the zero-byte
+  advance, so ``crc32(m) = XOR_i T[len-1-i][m[i]] ^ Z[len]`` with
+  ``T[t] = L^t(TAB)`` and ``Z[n] = crc32(0^n)``.  The position table
+  is host-precomputed once per bucket width (cached, pow2 sizes) and
+  the whole digest becomes one gather + XOR-reduce over
+  ``[lanes, width]`` — zero sequential byte scan on device, and zero
+  padding sensitivity (``T[t][0] == 0``, so the staged tail of a
+  short lane contributes nothing whatever index it gathers).
+* **chip-affine, pooled, admission-controlled** — lanes stage into a
+  pooled buffer on the caller's affinity chip (the same discipline as
+  EC flushes), admission rides the new ``background`` class (weight
+  below recovery — a scrub storm cannot starve client EC dispatches),
+  and compile accounting buckets (lanes, width) pow2 so steady state
+  re-dispatches a handful of programs.
+* **host fallback rides the poison/heal machinery** — DeviceBusy, a
+  poisoned chip, an injected fault, or an oversized buffer (the
+  position table is O(width), bounded at ``DEVICE_MAX_BYTES``)
+  degrade to the `zlib.crc32` loop; a failed dispatch poisons ITS
+  chip (per-chip DEVICE_FALLBACK health) and the probe loop heals it.
+
+Bit-parity with ``zlib.crc32`` is exact by construction and pinned by
+tests/test_scrub.py — the device digest and the host fallback are the
+same function, so a scrub round may switch paths mid-flight (poison
+injection) and still compare shards soundly.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import zlib
+
+import numpy as np
+
+from .runtime import DeviceBusy, DeviceRuntime, K_BACKGROUND
+
+_POLY = np.uint32(0xEDB88320)
+_FINAL = np.uint32(0xFFFFFFFF)
+
+# position-table memory is O(width x 256 x 4B): bound the device path
+# at 16 KiB lanes (a 16 MiB table); longer buffers take the host loop
+DEVICE_MAX_BYTES = 1 << 14
+
+_MIN_WIDTH = 256     # pow2 floor so tiny chunks share one program
+_MIN_LANES = 8
+
+
+def device_digest_enabled() -> bool:
+    """Device digesting defaults to on where device EC offload is on
+    (a real accelerator backend, or the CEPH_TPU_EC_OFFLOAD test
+    override); CEPH_TPU_SCRUB_OFFLOAD=1/0 forces it independently."""
+    v = os.environ.get("CEPH_TPU_SCRUB_OFFLOAD")
+    if v is not None:
+        return v not in ("0", "false", "no")
+    from ..ec.batcher import device_offload_enabled
+    return device_offload_enabled()
+
+
+@functools.lru_cache(maxsize=1)
+def _byte_table() -> np.ndarray:
+    """The standard CRC32 byte table (TAB[b] = contribution of byte b
+    processed last); linear in b over GF(2)."""
+    tab = np.arange(256, dtype=np.uint32)
+    for _ in range(8):
+        tab = np.where(tab & 1, (tab >> np.uint32(1)) ^ _POLY,
+                       tab >> np.uint32(1)).astype(np.uint32)
+    return tab
+
+
+@functools.lru_cache(maxsize=4)
+def _tables(width: int) -> tuple[np.ndarray, np.ndarray]:
+    """(T, Z) for one pow2 bucket width: T[t][b] = L^t(TAB[b]) (the
+    per-position contribution table the device gathers) and
+    Z[n] = crc32 of n zero bytes (the affine constant folded back in
+    on the host).  Built once per width and cached."""
+    tab = _byte_table()
+    T = np.empty((width, 256), np.uint32)
+    T[0] = tab
+    for t in range(1, width):
+        p = T[t - 1]
+        T[t] = (p >> np.uint32(8)) ^ tab[p & np.uint32(0xFF)]
+    Z = np.empty(width + 1, np.uint32)
+    Z[0] = 0
+    s = _FINAL
+    for n in range(1, width + 1):
+        s = (s >> np.uint32(8)) ^ tab[s & np.uint32(0xFF)]
+        Z[n] = s ^ _FINAL
+    return T, Z
+
+
+@functools.lru_cache(maxsize=16)
+def _device_table(width: int, chip_index: int):
+    """The position table committed to one chip's device (uploaded
+    once per (width, chip), like the EC coding matrices)."""
+    import jax.numpy as jnp
+    rt = DeviceRuntime.get()
+    return rt.chip(chip_index).place(jnp.asarray(_tables(width)[0]))
+
+
+@functools.lru_cache(maxsize=16)
+def _kernel(lanes: int, width: int):
+    """One jitted digest program per (lanes, width) bucket: gather
+    each byte's positional contribution and XOR-reduce the lane."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(data, lens, table):
+        pos = (lens[:, None]
+               - jnp.int32(1)
+               - jnp.arange(width, dtype=jnp.int32)[None, :])
+        contrib = table[jnp.clip(pos, 0, width - 1),
+                        data.astype(jnp.int32)]
+        contrib = jnp.where(pos >= 0, contrib, jnp.uint32(0))
+        return jax.lax.reduce(contrib, jnp.uint32(0),
+                              jax.lax.bitwise_xor, (1,))
+
+    return jax.jit(run)
+
+
+def crc32_host(bufs) -> list[int]:
+    """The host fallback (and the parity oracle): one zlib.crc32 per
+    buffer — identical values to the device lanes by construction."""
+    return [zlib.crc32(bytes(b)) & 0xFFFFFFFF for b in bufs]
+
+
+def _pow2(n: int, floor: int) -> int:
+    return 1 << max(int(n) - 1, floor - 1).bit_length()
+
+
+async def crc32_batch(bufs, chip: int | None = None,
+                      klass: str = K_BACKGROUND
+                      ) -> tuple[list[int], str]:
+    """Digest every buffer in one device dispatch on the caller's
+    affinity chip; returns (digests, path) where path is "device" or
+    "host".  Any degradation (offload disabled, chip lost, queue
+    full, oversized buffer, mid-dispatch failure) lands on the host
+    loop — the caller never sees the difference except in telemetry.
+    """
+    bufs = list(bufs)
+    if not bufs:
+        return [], "host"
+    rt = DeviceRuntime.get()
+    target = rt.route(chip)
+    maxlen = max(len(b) for b in bufs)
+    if (target is None or not target.available or maxlen == 0
+            or maxlen > DEVICE_MAX_BYTES
+            or not device_digest_enabled()):
+        return crc32_host(bufs), "host"
+    width = _pow2(maxlen, _MIN_WIDTH)
+    lanes = _pow2(len(bufs), _MIN_LANES)
+    total = sum(len(b) for b in bufs)
+    ticket = target.open_ticket(klass, width, total)
+    try:
+        await target.admit(ticket)
+    except DeviceBusy:
+        return crc32_host(bufs), "host"
+    stage = target.pool.lease((lanes, width), np.uint8)
+    try:
+        import jax.numpy as jnp
+        lens = np.zeros(lanes, np.int32)
+        for i, b in enumerate(bufs):
+            a = np.frombuffer(bytes(b), np.uint8)
+            stage[i, :a.size] = a
+            lens[i] = a.size
+        target.launch(ticket)           # injected-fault hook
+        _t, z = _tables(width)
+        lin = np.asarray(_kernel(lanes, width)(
+            target.place(jnp.asarray(stage)),
+            target.place(jnp.asarray(lens)),
+            _device_table(width, target.index)))
+        target.note_program("crc32", (lanes, width))
+        target.finish(ticket, ok=True)
+        # staging accounting in words, like the EC ladder
+        target.note_staging(total // 4, (lanes * width) // 4)
+        return [int(lin[i]) ^ int(z[lens[i]])
+                for i in range(len(bufs))], "device"
+    except Exception as e:
+        # device loss mid-digest: poison THIS chip (per-chip
+        # DEVICE_FALLBACK + probe heal) and finish the scrub on host
+        target.finish(ticket, ok=False, error=e)
+        target.poison(e)
+        return crc32_host(bufs), "host"
+    finally:
+        target.pool.release(stage)

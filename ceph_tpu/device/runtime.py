@@ -71,6 +71,10 @@ from . import mesh
 K_CLIENT_EC = "client-ec"
 K_RECOVERY_EC = "recovery-ec"
 K_MAPPING = "mapping"
+# background integrity/maintenance work (scrub digests, pool
+# compression pacing): weighted below recovery so an always-on scrub
+# or a compressed-pool burst can never starve the data-path classes
+K_BACKGROUND = "background"
 
 
 class DeviceBusy(Exception):

@@ -245,7 +245,7 @@ class Manager:
         per_pool = self.pgmap.pool_totals(now, pools)
         lines: list[str] = []
         gauges = ("objects", "bytes", "degraded", "misplaced",
-                  "unfound") + RATE_KEYS
+                  "unfound", "scrub_errors") + RATE_KEYS
         for g in gauges:
             fam = "ceph_tpu_pool_%s" % g
             lines.append("# TYPE %s gauge" % fam)
@@ -260,6 +260,15 @@ class Manager:
             fam = "ceph_tpu_cluster_%s" % g
             lines.append("# TYPE %s gauge" % fam)
             lines.append("%s %g" % (fam, totals[g]))
+        # integrity-plane summary series (the scrub_* families the
+        # exporter lint pins): damaged-PG count beside the summed
+        # error total the pool/cluster gauges above already carry
+        lines.append("# TYPE ceph_tpu_scrub_inconsistent_pgs gauge")
+        lines.append("ceph_tpu_scrub_inconsistent_pgs %d"
+                     % self.pgmap.inconsistent_pgs(now, pools))
+        lines.append("# TYPE ceph_tpu_scrub_errors_total gauge")
+        lines.append("ceph_tpu_scrub_errors_total %d"
+                     % totals.get("scrub_errors", 0))
         hist = self.pgmap.op_size_hist(now)
         if hist:
             fam = "ceph_tpu_cluster_op_size_bytes"

@@ -49,7 +49,8 @@ _INT_COLS = (("pool", "pool", None),
              ("degraded", "degraded", "degraded"),
              ("misplaced", "misplaced", "misplaced"),
              ("unfound", "unfound", "unfound"),
-             ("log_size", "log_size", "log_size"))
+             ("log_size", "log_size", "log_size"),
+             ("scrub_errors", "scrub_errors", "scrub_errors"))
 
 
 class _RatesView:
@@ -262,6 +263,16 @@ class PGMap:
         return {self._state_names[i]: int(n)
                 for i, n in enumerate(counts) if n}
 
+    def inconsistent_pgs(self, now: float,
+                         pools: set | None = None) -> int:
+        """Live PGs whose last scrub left a nonzero residual error
+        count — the PG_DAMAGED input (one vectorized mask pass)."""
+        if not self._n:
+            return 0
+        mask = self._live_mask(now, pools)
+        return int(np.count_nonzero(
+            self._int["scrub_errors"][:self._n][mask]))
+
     # -- daemon-extra views (bounded dicts, unchanged shape) ---------------
 
     def live_osd_stats(self, now: float) -> dict[str, dict]:
@@ -293,7 +304,7 @@ class PGMap:
         states = self.pg_state_counts(now, pools)
         totals = {
             "objects": 0, "bytes": 0, "degraded": 0,
-            "misplaced": 0, "unfound": 0,
+            "misplaced": 0, "unfound": 0, "scrub_errors": 0,
             **{k: 0.0 for k in RATE_KEYS}}
         for row in per_pool.values():
             for k in totals:
@@ -315,6 +326,10 @@ class PGMap:
                       for pid, row in per_pool.items()},
             "totals": totals,
             "inactive_pgs": inactive,
+            # scrub surface: PGs with unrepaired inconsistencies
+            # (PG_DAMAGED) beside the summed error count the totals
+            # carry (OSD_SCRUB_ERRORS)
+            "inconsistent_pgs": self.inconsistent_pgs(now, pools),
             "op_size_hist_bytes_pow2": self.op_size_hist(now),
             "osd_stats": osd_rows,
         }
@@ -379,7 +394,7 @@ class DictPGMap:
             row = out.setdefault(st["pool"], {
                 "num_pgs": 0, "objects": 0, "bytes": 0,
                 "degraded": 0, "misplaced": 0, "unfound": 0,
-                "log_size": 0,
+                "log_size": 0, "scrub_errors": 0,
                 **{k: 0.0 for k in RATE_KEYS}})
             row["num_pgs"] += 1
             row["objects"] += st.get("num_objects", 0)
@@ -388,6 +403,7 @@ class DictPGMap:
             row["misplaced"] += st.get("misplaced", 0)
             row["unfound"] += st.get("unfound", 0)
             row["log_size"] += st.get("log_size", 0)
+            row["scrub_errors"] += st.get("scrub_errors", 0)
             rt = self.rates.get(pgid)
             if rt:
                 for k in RATE_KEYS:
@@ -401,6 +417,11 @@ class DictPGMap:
             s = st.get("state", "unknown")
             states[s] = states.get(s, 0) + 1
         return states
+
+    def inconsistent_pgs(self, now: float,
+                         pools: set | None = None) -> int:
+        return sum(1 for _p, st in self._live_rows(now, pools)
+                   if st.get("scrub_errors", 0))
 
     live_osd_stats = PGMap.live_osd_stats
     op_size_hist = PGMap.op_size_hist

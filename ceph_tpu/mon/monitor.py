@@ -572,7 +572,12 @@ class Monitor:
                 totals = self.mgr_digest.get("totals") or {}
                 self.health_mon.maybe_commit_digest(
                     int(totals.get("degraded") or 0),
-                    int(self.mgr_digest.get("inactive_pgs") or 0))
+                    int(self.mgr_digest.get("inactive_pgs") or 0),
+                    scrub_errors=int(
+                        totals.get("scrub_errors") or 0),
+                    damaged_pgs=int(
+                        self.mgr_digest.get("inconsistent_pgs")
+                        or 0))
             return True
         if isinstance(msg, MOSDBeacon):
             # beacons are derived soft state: EVERY mon records them,

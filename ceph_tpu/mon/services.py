@@ -216,12 +216,15 @@ class HealthMonitor:
         # committed (paxos) snapshot: {"slow": {osd: n},
         #                              "devflb": {osd: 0 | 1+chip},
         #                              "pgdeg": n degraded objects,
-        #                              "pgavail": n inactive pgs}
+        #                              "pgavail": n inactive pgs,
+        #                              "scruberr": n scrub errors,
+        #                              "pgdmg": n inconsistent pgs}
         # devflb values are chip-encoded (0 = on-device, 1+chip =
         # that mesh chip lost) so the health detail can name the
         # degraded chip even on a freshly elected leader
         self.persisted: dict = {"slow": {}, "devflb": {},
-                                "pgdeg": 0, "pgavail": 0}
+                                "pgdeg": 0, "pgavail": 0,
+                                "scruberr": 0, "pgdmg": 0}
 
     # -- persistence / replay ------------------------------------------
 
@@ -236,7 +239,9 @@ class HealthMonitor:
                            for k, v in
                            (d.get("devflb") or {}).items()},
                 "pgdeg": int(d.get("pgdeg") or 0),
-                "pgavail": int(d.get("pgavail") or 0)}
+                "pgavail": int(d.get("pgavail") or 0),
+                "scruberr": int(d.get("scruberr") or 0),
+                "pgdmg": int(d.get("pgdmg") or 0)}
 
     def apply(self, ops: list, tx) -> None:
         """Deterministic commit apply (every mon runs this)."""
@@ -253,13 +258,15 @@ class HealthMonitor:
                     self.persisted["devflb"][int(osd)] = 1
                 else:
                     self.persisted["devflb"].pop(int(osd), None)
-            elif op[0] in ("pgdeg", "pgavail"):
+            elif op[0] in ("pgdeg", "pgavail", "scruberr", "pgdmg"):
                 self.persisted[op[0]] = int(op[1])
         tx.set(HEALTH_KEY, denc.encode(
             {"slow": dict(self.persisted["slow"]),
              "devflb": dict(self.persisted["devflb"]),
              "pgdeg": int(self.persisted["pgdeg"]),
-             "pgavail": int(self.persisted["pgavail"])}))
+             "pgavail": int(self.persisted["pgavail"]),
+             "scruberr": int(self.persisted["scruberr"]),
+             "pgdmg": int(self.persisted["pgdmg"])}))
 
     def maybe_commit(self, osd: int, slow: int, devflb: int) -> None:
         """Leader-side: stage a health svc op when a beacon changes
@@ -307,14 +314,16 @@ class HealthMonitor:
                     "INF", "Health check cleared: DEVICE_FALLBACK "
                     "(osd.%d)" % osd)
 
-    def maybe_commit_digest(self, degraded: int,
-                            inactive: int) -> None:
+    def maybe_commit_digest(self, degraded: int, inactive: int,
+                            scrub_errors: int = 0,
+                            damaged_pgs: int = 0) -> None:
         """Leader-side: persist PGMap-digest transitions (degraded
-        objects / inactive PGs raise-and-clear) through paxos, like
-        the beacon-fed checks — a freshly elected leader that never
-        saw a digest reports PG_DEGRADED / PG_AVAILABILITY
-        immediately.  Only the raised/cleared EDGE commits (a jittery
-        nonzero count does not burn a paxos round per digest)."""
+        objects / inactive PGs / scrub errors raise-and-clear)
+        through paxos, like the beacon-fed checks — a freshly elected
+        leader that never saw a digest reports PG_DEGRADED /
+        PG_AVAILABILITY / OSD_SCRUB_ERRORS / PG_DAMAGED immediately.
+        Only the raised/cleared EDGE commits (a jittery nonzero count
+        does not burn a paxos round per digest)."""
         pend = self.mon.pending_svc.get("health", [])
 
         def pending_val(kind):
@@ -323,8 +332,15 @@ class HealthMonitor:
                     return int(op[1])
             return None
 
-        for kind, val in (("pgdeg", int(degraded)),
-                          ("pgavail", int(inactive))):
+        for kind, val, check, what in (
+                ("pgdeg", int(degraded), "PG_DEGRADED",
+                 "%d objects degraded"),
+                ("pgavail", int(inactive), "PG_AVAILABILITY",
+                 "%d pgs inactive"),
+                ("scruberr", int(scrub_errors), "OSD_SCRUB_ERRORS",
+                 "%d scrub errors"),
+                ("pgdmg", int(damaged_pgs), "PG_DAMAGED",
+                 "Possible data damage: %d pgs inconsistent")):
             cur = pending_val(kind)
             if cur is None:
                 cur = int(self.persisted[kind])
@@ -333,15 +349,10 @@ class HealthMonitor:
             # commits when it crosses zero
             if (val > 0) != (cur > 0):
                 self.mon.queue_svc_op("health", (kind, val))
-                check = ("PG_DEGRADED" if kind == "pgdeg"
-                         else "PG_AVAILABILITY")
                 if val:
-                    what = ("%d objects degraded" % val
-                            if kind == "pgdeg"
-                            else "%d pgs inactive" % val)
                     self.mon.log_mon.append(
                         "WRN", "Health check failed: %s (%s)"
-                        % (what, check))
+                        % (what % val, check))
                 else:
                     self.mon.log_mon.append(
                         "INF", "Health check cleared: %s" % check)
@@ -455,10 +466,14 @@ class HealthMonitor:
             degraded = int(totals.get("degraded") or 0)
             unfound = int(totals.get("unfound") or 0)
             inactive = int(dig.get("inactive_pgs") or 0)
+            scrub_errors = int(totals.get("scrub_errors") or 0)
+            damaged = int(dig.get("inconsistent_pgs") or 0)
         else:
             degraded = int(self.persisted["pgdeg"])
             unfound = 0
             inactive = int(self.persisted["pgavail"])
+            scrub_errors = int(self.persisted["scruberr"])
+            damaged = int(self.persisted["pgdmg"])
         if degraded or unfound:
             detail = ["%d object copies degraded" % degraded]
             if unfound:
@@ -478,6 +493,26 @@ class HealthMonitor:
                 "summary": "Reduced data availability: %d pgs "
                            "inactive" % inactive,
                 "detail": []}
+        # OSD_SCRUB_ERRORS / PG_DAMAGED (the reference's scrub-fed
+        # health checks): raised while any PG's last scrub left a
+        # nonzero residual inconsistency count — via a fresh digest
+        # or the paxos-committed snapshot — and cleared ONLY when a
+        # repair scrub drains the residual to zero (the reference's
+        # "repair then re-scrub" contract)
+        if scrub_errors:
+            out["OSD_SCRUB_ERRORS"] = {
+                "severity": "HEALTH_ERR",
+                "summary": "%d scrub errors" % scrub_errors,
+                "detail": []}
+        if damaged:
+            out["PG_DAMAGED"] = {
+                "severity": "HEALTH_ERR",
+                "summary": "Possible data damage: %d pgs "
+                           "inconsistent" % damaged,
+                "detail": ["%d scrub errors across %d pgs; run "
+                           "`pg repair <pgid>` to rebuild from the "
+                           "authoritative copies"
+                           % (scrub_errors, damaged)]}
         # RECENT_CRASH (the crash module's health check): any
         # un-archived crash report newer than mon_crash_warn_age.
         # The crash table is itself paxos-committed, so a freshly

@@ -125,7 +125,28 @@ class OSD:
                            " (us, pow2)")
         self.perf.add_hist("op_ec_device_dispatch",
                            "device EC batch flush time (us, pow2)")
+        # integrity plane: scrub rounds, what they found/fixed, and
+        # how the digests were computed (device lanes vs host loop)
+        self.perf.add_u64("scrubs", "shallow scrub rounds completed")
+        self.perf.add_u64("deep_scrubs", "deep scrub rounds completed")
+        self.perf.add_u64("scrub_errors_found",
+                          "inconsistencies flagged by scrubs")
+        self.perf.add_u64("scrub_repaired",
+                          "divergent copies rewritten by repair"
+                          " scrubs")
+        self.perf.add_u64("scrub_digest_device",
+                          "scrub digests computed in device crc32"
+                          " lanes")
+        self.perf.add_u64("scrub_digest_host",
+                          "scrub digests computed by the host"
+                          " fallback loop")
+        self.perf.add_u64("comp_paced_ops",
+                          "compression-pool ops paced through the"
+                          " background device class")
         self._beacon_stamp = 0.0
+        # one periodic scrub at a time per daemon (the reference's
+        # scrubs_local bound collapsed to 1)
+        self._scrub_running = False
         # client write-size histogram (pow2 byte buckets, cumulative):
         # reported to the mgr for the cluster op-size profile and used
         # to derive workload-aware device warmup buckets (bucket i
@@ -406,7 +427,10 @@ class OSD:
             if self.sched.running:
                 self.sched.enqueue(key, klass, fn)
             else:           # not started (unit-test direct dispatch)
-                fn()
+                r = fn()
+                if asyncio.iscoroutine(r):
+                    # async handlers (scrub map builds) still run
+                    asyncio.ensure_future(r)
 
         if isinstance(msg, MConfig):
             self.ctx.conf.apply_mon_values(msg.values or {})
@@ -1631,20 +1655,63 @@ class OSD:
             self._send_backoff(pg, conn, oid=oid)
             self._kick_recovery(pg)
             return
+        if pool.compression_mode == "force" \
+                and not pool.is_erasure():
+            # compression pools: the compress/decompress CPU work is
+            # paced through the device runtime's background class so
+            # a compressed burst cannot starve client EC dispatches
+            self.msgr.spawn(
+                self._compression_paced(pg, conn, msg, writes))
+            return
         if writes:
             self._execute_write(pg, conn, msg)
         else:
-            outs, result = self._do_read_ops(
-                pg, msg.oid, msg.ops, getattr(msg, "snapid", None),
-                entity=msg.src)
-            conn.send(MOSDOpReply(tid=msg.tid, result=result,
-                                  outs=outs, epoch=self.osdmap.epoch,
-                                  version=0))
-            self.perf.inc("ops")
-            pg.stats.note_read(sum(
-                len(o.get("data") or b"") for o in outs
-                if isinstance(o, dict)))
-            self._op_finish(msg, "read_done")
+            self._serve_read(pg, conn, msg)
+
+    def _serve_read(self, pg: PG, conn, msg) -> None:
+        outs, result = self._do_read_ops(
+            pg, msg.oid, msg.ops, getattr(msg, "snapid", None),
+            entity=msg.src)
+        conn.send(MOSDOpReply(tid=msg.tid, result=result,
+                              outs=outs, epoch=self.osdmap.epoch,
+                              version=0))
+        self.perf.inc("ops")
+        pg.stats.note_read(sum(
+            len(o.get("data") or b"") for o in outs
+            if isinstance(o, dict)))
+        self._op_finish(msg, "read_done")
+
+    async def _compression_paced(self, pg: PG, conn, msg,
+                                 writes: bool) -> None:
+        """Pool-level compress/decompress rides the device runtime's
+        BACKGROUND admission class (weight below recovery): a
+        compressed-pool burst queues behind the data-path dispatch
+        grants instead of interleaving freely with them, so client EC
+        flushes keep their share of the chip.  A full admission queue
+        degrades to unpaced execution — pacing must never fail or
+        park the op itself."""
+        from ..device.runtime import (DeviceBusy, DeviceRuntime,
+                                      K_BACKGROUND)
+        chip = (self.device_chip if self.device_chip is not None
+                else DeviceRuntime.get().chip_for(self.whoami))
+        cost = max(1.0, sum(len(op.get("data") or b"")
+                            for op in msg.ops
+                            if isinstance(op, dict)) / 65536.0)
+        granted = False
+        try:
+            await chip.queue.admit(K_BACKGROUND, cost)
+            granted = True
+            self.perf.inc("comp_paced_ops")
+        except DeviceBusy:
+            pass        # overloaded: run unpaced, never fail the op
+        try:
+            if writes:
+                self._execute_write(pg, conn, msg)
+            else:
+                self._serve_read(pg, conn, msg)
+        finally:
+            if granted:
+                chip.queue.release()
 
     async def _handle_watch_ops(self, pg: PG, conn, msg) -> None:
         """watch/unwatch/notify ops (PrimaryLogPG do_osd_ops
@@ -2260,6 +2327,7 @@ class OSD:
                                                               pool):
                         self._requeue_waiters(pg)
                 self._maybe_clear_pg_temp(pg)
+            self._maybe_schedule_scrub()
             self._maybe_send_mgr_report()
             self._maybe_send_beacon()
             # event plane: re-flush unacked clog entries and pending
@@ -2292,6 +2360,78 @@ class OSD:
                     self._send_mons(MOSDFailure(
                         target=osd, failed_for=now - last,
                         epoch=self.osdmap.epoch))
+
+    # -- periodic scrub (the always-on integrity plane) --------------------
+
+    def _maybe_schedule_scrub(self) -> None:
+        """Drive scrubs on this primary's own schedule
+        (PG::sched_scrub condensed): the PG most overdue against
+        `osd_scrub_interval` / `osd_deep_scrub_interval` scrubs next,
+        one at a time per daemon, paced through the mClock K_SCRUB
+        class and the device runtime's background digest lanes.  Only
+        clean, min_size-satisfied primary PGs are eligible — scrub
+        compares copies, and a PG mid-recovery would read absent
+        copies as rot."""
+        if self._scrub_running or self.stopping or not self.booted:
+            return
+        conf = self.ctx.conf
+        shallow = float(conf.get("osd_scrub_interval", 0) or 0)
+        deep_iv = float(conf.get("osd_deep_scrub_interval", 0) or 0)
+        if shallow <= 0 and deep_iv <= 0:
+            return
+        now = time.time()
+        best = None             # (overdue-seconds, pg, deep)
+        for pg in self.pgs.values():
+            if not pg.is_primary() or pg.state != STATE_ACTIVE:
+                continue
+            if pg.missing or any(pg.peer_missing.get(o)
+                                 for o in pg.peer_missing):
+                continue
+            if getattr(pg, "_scrub_cmd_running", False):
+                continue
+            pool = self.osdmap.pools.get(pg.pool_id)
+            if pool is None or not self._min_size_ok(pg, pool):
+                continue
+            if deep_iv > 0 \
+                    and now - pg.last_deep_scrub_stamp >= deep_iv:
+                cand = (now - pg.last_deep_scrub_stamp - deep_iv,
+                        pg, True)
+            elif shallow > 0 \
+                    and now - pg.last_scrub_stamp >= shallow:
+                cand = (now - pg.last_scrub_stamp - shallow,
+                        pg, False)
+            else:
+                continue
+            if best is None or cand[0] > best[0]:
+                best = cand
+        if best is None:
+            return
+        self._scrub_running = True
+        self.msgr.spawn(self._periodic_scrub(best[1], best[2]))
+
+    async def _periodic_scrub(self, pg, deep: bool) -> None:
+        """One scheduled scrub round.  recheck=True: an inconsistency
+        only records if it persists across passes, so a client write
+        racing the per-member map builds settles instead of raising
+        PG_DAMAGED spuriously.  Failures are logged, never crash
+        reports — an interval change or pool delete mid-scrub is
+        routine, not a post-mortem."""
+        try:
+            res = await self.scrubber.scrub_pg(pg, deep=deep,
+                                               recheck=True)
+            if res["errors"]:
+                self.ctx.log.info(
+                    "osd", "osd.%d periodic %sscrub pg %s: %d "
+                    "inconsistencies %s"
+                    % (self.whoami, "deep-" if deep else "",
+                       pg.pgid, res["errors"],
+                       res["inconsistent"][:5]))
+        except Exception as e:
+            self.ctx.log.info(
+                "osd", "osd.%d periodic scrub pg %s aborted: %r"
+                % (self.whoami, pg.pgid, e))
+        finally:
+            self._scrub_running = False
 
     def _maybe_send_beacon(self) -> None:
         """MOSDBeacon to the mons: liveness plus the slow-op count
@@ -2407,6 +2547,14 @@ class OSD:
             "degraded": degraded, "misplaced": misplaced,
             "unfound": unfound,
             "log_size": len(pg.log.entries),
+            # integrity plane: the residual inconsistency count and
+            # the scrub stamps (pg_stat_t last_scrub_stamp) — the
+            # mgr digest folds scrub_errors into OSD_SCRUB_ERRORS /
+            # PG_DAMAGED health
+            "scrub_errors": getattr(pg, "scrub_errors", 0),
+            "last_scrub_stamp": getattr(pg, "last_scrub_stamp", 0.0),
+            "last_deep_scrub_stamp": getattr(
+                pg, "last_deep_scrub_stamp", 0.0),
             **pg.stats.to_wire(),
         }
 

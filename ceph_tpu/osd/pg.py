@@ -26,6 +26,8 @@ state.
 
 from __future__ import annotations
 
+import time
+
 from ..store.objectstore import Transaction, coll_t, hobject_t
 from ..utils import denc
 
@@ -215,6 +217,17 @@ class PG:
         # cumulative client-I/O + recovery counters this primary
         # accumulated (PGStats above); reported to the mgr
         self.stats = PGStats()
+        # integrity plane (pg_stat_t last_scrub_stamp/
+        # last_deep_scrub_stamp + the inconsistent-object residual):
+        # stamps seed to creation time so a fresh cluster does not
+        # storm itself with due-immediately scrubs; the periodic
+        # scheduler (osd_scrub_interval / osd_deep_scrub_interval)
+        # advances them, scrub_errors is the residual count the stat
+        # row ships into OSD_SCRUB_ERRORS / PG_DAMAGED health —
+        # cleared only by a repair scrub draining it to zero
+        self.last_scrub_stamp = time.time()
+        self.last_deep_scrub_stamp = self.last_scrub_stamp
+        self.scrub_errors = 0
 
     # -- identity ----------------------------------------------------------
 
@@ -236,6 +249,16 @@ class PG:
     def persist_log_entry(self, t: Transaction, e: LogEntry) -> None:
         t.omap_setkeys(self.cid, PGMETA_OID, {
             b"log." + ev_key(e.version): denc.encode(e.to_wire()),
+        })
+
+    def persist_scrub(self, t: Transaction) -> None:
+        """Scrub stamps + residual error count, durable so a restart
+        neither re-scrubs immediately nor forgets an unrepaired
+        inconsistency."""
+        t.omap_setkeys(self.cid, PGMETA_OID, {
+            b"scrub": denc.encode([self.last_scrub_stamp,
+                                   self.last_deep_scrub_stamp,
+                                   self.scrub_errors]),
         })
 
     # -- reqid dup journal -------------------------------------------------
@@ -366,6 +389,14 @@ class PG:
             self.past_intervals = [
                 dict(iv) for iv in
                 denc.decode(data[b"past_intervals"])]
+        if b"scrub" in data:
+            try:
+                ss, ds, errs = denc.decode(data[b"scrub"])
+                self.last_scrub_stamp = float(ss)
+                self.last_deep_scrub_stamp = float(ds)
+                self.scrub_errors = int(errs)
+            except (ValueError, TypeError):
+                pass
         entries = []
         for k, v in sorted(data.items()):
             if k.startswith(b"log."):

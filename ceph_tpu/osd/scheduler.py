@@ -59,11 +59,15 @@ DEFAULT_PROFILE = {
 # client/recovery proportions as the mClock profile above, plus the
 # bulk-mapping class — client EC flushes outrank recovery encodes,
 # which outrank whole-pool remap passes, so a mapping storm cannot
-# starve client writes of the accelerator.
+# starve client writes of the accelerator.  The background class
+# (scrub digest lanes, pool-compression pacing) sits below everything
+# else: always-on integrity work rides the excess, never the
+# reservation.
 DEVICE_DISPATCH_WEIGHTS = {
     "client-ec": DEFAULT_PROFILE[K_CLIENT][1],      # 4.0
     "recovery-ec": DEFAULT_PROFILE[K_RECOVERY][1],  # 2.0
     "mapping": 1.0,
+    "background": 0.5,
 }
 
 

@@ -49,6 +49,14 @@ FAST_CONF = {
     "osd_mgr_report_interval": 0.3,
     "mgr_stats_period": 0.25,
     "mgr_stats_stale_after": 5.0,
+    # integrity plane at dev pacing: scrub is ALWAYS ON — every PG
+    # shallow-scrubs every few seconds and deep-scrubs (digest vs
+    # hinfo vote) soon after, so silent rot surfaces within a thrash
+    # round; a straggling scrub replica is given ~1s + one retry
+    # before being recorded unavailable
+    "osd_scrub_interval": 3.0,
+    "osd_deep_scrub_interval": 6.0,
+    "osd_scrub_chunk_timeout": 1.0,
 }
 
 
@@ -483,6 +491,57 @@ class LocalCluster:
                                  pg.peer_missing.values()):
                 return False
         return True
+
+    # -- integrity plane (scrub oracles) -----------------------------------
+
+    def pg_primary(self, pool_id: int, ps: int):
+        """(primary OSD object, its PG object) for one PG on the
+        newest map a live daemon holds, or (None, None)."""
+        from ..osd.osdmap import pg_t
+        m = None
+        for osd in self.live_osds:
+            if osd.osdmap is not None:
+                if m is None or osd.osdmap.epoch > m.epoch:
+                    m = osd.osdmap
+        if m is None or pool_id not in m.pools:
+            return None, None
+        _up, _upp, _acting, actingp = m.pg_to_up_acting_osds(
+            pg_t(pool_id, ps))
+        alive = {o.whoami: o for o in self.live_osds}
+        osd = alive.get(actingp)
+        if osd is None:
+            return None, None
+        return osd, osd.pgs.get(pg_t(pool_id, ps))
+
+    async def scrub_pool(self, pool_id: int, deep: bool = True,
+                         repair: bool = False,
+                         recheck: bool = True) -> dict:
+        """Scrub every PG of the pool on its live primary and fold
+        the results — the thrasher's repair-to-clean oracle surface.
+        recheck=True confirms inconsistencies across passes, so a
+        still-running workload's in-flight writes never read as rot.
+        """
+        m = None
+        for osd in self.live_osds:
+            if osd.osdmap is not None:
+                if m is None or osd.osdmap.epoch > m.epoch:
+                    m = osd.osdmap
+        out = {"errors": 0, "inconsistent": [], "repaired": 0,
+               "unavailable": set()}
+        if m is None or pool_id not in m.pools:
+            return out
+        for ps in range(m.pools[pool_id].pg_num):
+            osd, pg = self.pg_primary(pool_id, ps)
+            if osd is None or pg is None:
+                continue
+            res = await osd.scrubber.scrub_pg(
+                pg, deep=deep, repair=repair, recheck=recheck)
+            out["errors"] += res["errors"]
+            out["inconsistent"].extend(res["inconsistent"])
+            out["repaired"] += res["repaired"]
+            out["unavailable"].update(res.get("unavailable") or ())
+        out["unavailable"] = sorted(out["unavailable"])
+        return out
 
     # -- cluster statistics plane (PGMap digest oracles) -------------------
 

@@ -159,7 +159,20 @@ class ClusterThrasher:
                          delta-updated parity and incrementally
                          re-crc'd hinfo included — must be
                          BIT-IDENTICAL to the host codec's encode of
-                         the final object contents.
+                         the final object contents;
+      corrupt_shard    — the integrity-plane oracle, EC flavor: plant
+                         seeded byte/attr/hinfo rot in stored EC
+                         shards via the store, then prove the scrub
+                         plane end to end — deep scrub finds EXACTLY
+                         the planted set (write races confirmed away
+                         by the recheck pass), PG_DAMAGED and
+                         OSD_SCRUB_ERRORS raise through the
+                         committed OSD->mgr->mon digest path, repair
+                         scrubs drain the residual to zero, health
+                         clears, and every planted object reads back
+                         its original bytes;
+      corrupt_replica  — the replicated-pool analog (byte rot or a
+                         divergent xattr on one replica).
 
     Slow-op oracle: after every round's health check, no live OSD may
     still hold an op in flight past osd_op_complaint_time — a healthy
@@ -170,13 +183,18 @@ class ClusterThrasher:
     entries in the committed cluster log (any ERR is an unexplained
     failure); kill/revive rounds must leave the victim's
     marked-down -> boot clog sequence committed in order.
+
+    Integrity-plane oracle (scrub_oracle, on by default): every
+    healthy round additionally deep-scrubs every thrashed pool and
+    demands ZERO inconsistencies — with scrub always on, every
+    thrash action is implicitly also a bit-rot regression test.
     """
 
     ALL_ACTIONS = ("kill_revive", "kill_wipe_revive", "out_in",
                    "mon_partition", "map_churn", "pg_num_grow",
                    "pgp_num_grow", "ec_profile_swap",
                    "device_fallback", "chip_loss", "osd_crash",
-                   "mixed_rmw")
+                   "mixed_rmw", "corrupt_shard", "corrupt_replica")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -207,6 +225,10 @@ class ClusterThrasher:
                     self._plan_one(self.rng.choice(pool)))
         self.log: list[str] = []
         self._pool_ids: list = []
+        # post-round deep-scrub-clean oracle (on by default: with
+        # scrub always on, every action doubles as a rot regression
+        # test); tests that deliberately leave rot behind turn it off
+        self.scrub_oracle = True
 
     def _default_actions(self) -> list[str]:
         acts = ["kill_revive", "kill_wipe_revive", "out_in",
@@ -226,7 +248,8 @@ class ClusterThrasher:
             return (action, self.rng.randrange(self.cluster.n_mons))
         if action in ("map_churn", "pg_num_grow", "pgp_num_grow",
                       "ec_profile_swap", "device_fallback",
-                      "chip_loss", "mixed_rmw"):
+                      "chip_loss", "mixed_rmw", "corrupt_shard",
+                      "corrupt_replica"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -439,8 +462,128 @@ class ClusterThrasher:
             if pid is None:
                 return              # no EC pool under thrash
             await self._mixed_rmw_round(c, pid, arg)
+        elif action in ("corrupt_shard", "corrupt_replica"):
+            want_ec = action == "corrupt_shard"
+            pid = next(
+                (p for p in self._pool_ids
+                 if (c.client.osdmap.pools.get(p) is not None
+                     and bool(c.client.osdmap.pools[p]
+                              .erasure_code_profile) == want_ec)),
+                None)
+            if pid is None:
+                return              # no pool of that flavor
+            await self._corrupt_round(c, pid, arg, ec=want_ec)
         else:
             raise ValueError(action)
+
+    async def _corrupt_round(self, c, pid: int, seed: int,
+                             ec: bool) -> None:
+        """Plant seeded corruption in stored copies via the store and
+        prove the scrub plane repairs to clean: deep scrub detects
+        EXACTLY the planted set, OSD_SCRUB_ERRORS + PG_DAMAGED raise
+        through the committed digest path, repair drains the residual
+        to zero, health clears, and the original bytes read back."""
+        from ..osd.osdmap import pg_t
+        from ..store.objectstore import NotFound, Transaction, \
+            hobject_t
+        pool = c.client.osdmap.pools[pid]
+        io = c.client.io_ctx(pool.name)
+        rng = random.Random("corrupt-%r-%d" % (self.seed, seed))
+        payloads = {}
+        for i in range(3):
+            oid = "rot-%d-%d" % (seed, i)
+            payloads[oid] = rng.randbytes(rng.randrange(2, 8) * 512)
+            await asyncio.wait_for(
+                io.write_full(oid, payloads[oid]), 30.0)
+        await c.wait_health(pid, timeout=120.0)
+        m = c.client.osdmap
+        alive = {o.whoami: o for o in c.live_osds}
+        planted: dict = {}          # ps -> set of planted oids
+        for oid in sorted(payloads)[:2]:
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg(oid, pid))
+            _up, _upp, acting, _prim = m.pg_to_up_acting_osds(pgid)
+            members = [o for o in acting if o >= 0 and o in alive]
+            victim = alive[members[rng.randrange(len(members))]]
+            pg = victim.pgs[pg_t(pid, pgid.ps)]
+            ho = hobject_t(oid)
+            mode = rng.choice(["data", "attrs", "hinfo"] if ec
+                              else ["data", "attrs"])
+            t = Transaction()
+            if mode == "data":
+                data = bytearray(victim.store.read(pg.cid, ho))
+                data[rng.randrange(len(data))] ^= 0xFF
+                t.write(pg.cid, ho, 0, len(data), bytes(data))
+            elif mode == "hinfo":
+                # rotted integrity METADATA: still a parseable crc
+                # vector, just the wrong one — the majority vote must
+                # out it and repair must recompute it
+                try:
+                    raw = victim.store.getattr(pg.cid, ho,
+                                               "ec_hinfo")
+                except NotFound:
+                    raw = b"0"
+                t.setattr(pg.cid, ho, "ec_hinfo", b"1" + raw)
+            elif ec:
+                # divergent shard metadata (ec_ver): the (ver, size)
+                # auth group loses this member even on shallow scrub
+                t.setattr(pg.cid, ho, "ec_ver", b"rot.rot")
+            else:
+                # replicated attr rot: a divergent EXTRA xattr —
+                # repair must remove it, not merge around it
+                t.setattr(pg.cid, ho, "_rot", b"planted")
+            victim.store.apply_transaction(t)
+            planted.setdefault(pgid.ps, set()).add(oid)
+            self.log.append("corrupt: %s %s on osd.%d (%s)"
+                            % (oid, mode, victim.whoami, pg.pgid))
+        all_planted = {o for s in planted.values() for o in s}
+        # 1. deep scrub finds EXACTLY the planted set (recheck
+        #    confirms away workload write races)
+        found = set()
+        for ps in sorted(planted):
+            osd, pg = c.pg_primary(pid, ps)
+            assert osd is not None and pg is not None, (pid, ps)
+            res = await osd.scrubber.scrub_pg(pg, deep=True,
+                                              recheck=True)
+            got = {k for k in res["inconsistent"]}
+            assert got == planted[ps], (
+                "deep scrub of %s found %r, planted %r"
+                % (pg.pgid, sorted(got), sorted(planted[ps])))
+            found |= got
+        assert found == all_planted, (found, all_planted)
+        # 2. the health surface raises through the committed
+        #    OSD -> mgr -> mon digest path
+        if c.mgr is not None:
+            await self._wait_health_check(c, "OSD_SCRUB_ERRORS", True)
+            await self._wait_health_check(c, "PG_DAMAGED", True)
+        # 3. repair drains the residual to zero (surgical: only the
+        #    known-bad objects, so an in-flight workload write can
+        #    never be "repaired" mid-replication)
+        for ps in sorted(planted):
+            osd, pg = c.pg_primary(pid, ps)
+            res = await osd.scrubber.scrub_pg(pg, deep=True,
+                                              repair=True,
+                                              only=planted[ps])
+            assert res["repaired"] >= 1, res
+            assert res["residual"] == 0, res
+        # ...and a re-scrub is CLEAN (repair idempotency: nothing
+        # left to find, nothing left to fix)
+        for ps in sorted(planted):
+            osd, pg = c.pg_primary(pid, ps)
+            res = await osd.scrubber.scrub_pg(pg, deep=True,
+                                              recheck=True)
+            assert not (set(res["inconsistent"]) & all_planted), res
+            assert res["errors"] == 0, res
+        # 4. health clears (only a successful repair scrub may clear)
+        if c.mgr is not None:
+            await self._wait_health_check(c, "OSD_SCRUB_ERRORS",
+                                          False)
+            await self._wait_health_check(c, "PG_DAMAGED", False)
+        # 5. the original bytes survive the whole ordeal
+        for oid, want in sorted(payloads.items()):
+            got = await asyncio.wait_for(io.read(oid), 30.0)
+            assert got == want, \
+                "corrupt round lost %s after repair" % oid
 
     async def _mixed_rmw_round(self, c, pid: int, seed: int) -> None:
         """Interleaved full rewrites + partial overwrites on the same
@@ -617,6 +760,17 @@ class ClusterThrasher:
             await c.wait_health(pool_id, timeout=120.0)
         for wl in workloads:
             await wl.verify(sample=300)
+        # integrity oracle: an un-tampered healthy round deep-scrubs
+        # CLEAN on every thrashed pool (recheck confirms away the
+        # still-running workload's in-flight writes) — any residual
+        # inconsistency is silent rot some action just manufactured
+        if self.scrub_oracle and hasattr(c, "scrub_pool"):
+            for pool_id in pool_ids:
+                res = await c.scrub_pool(pool_id, deep=True,
+                                         recheck=True)
+                assert res["errors"] == 0, (
+                    "deep scrub found inconsistencies after a "
+                    "healthy round: %r" % res)
         # slow-op oracle: the cluster is healthy and every acked write
         # read back — nothing may still sit in an OSD's in-flight
         # table past the complaint threshold (a parked op whose

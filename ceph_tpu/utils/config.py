@@ -333,6 +333,18 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_crash_ring_tail", OPT_INT, 100,
            "LogRing entries captured into a crash report (the"
            " post-mortem high-verbosity context)"),
+    # -- integrity plane (scrub scheduling + straggler handling) ---------
+    Option("osd_scrub_interval", OPT_FLOAT, 24 * 3600.0,
+           "seconds between automatic shallow scrubs of each PG"
+           " (osd_scrub_min_interval role); <= 0 disables periodic"
+           " scrubbing"),
+    Option("osd_deep_scrub_interval", OPT_FLOAT, 7 * 24 * 3600.0,
+           "seconds between automatic deep scrubs of each PG"
+           " (byte digests vs the hinfo crc vote); <= 0 disables"),
+    Option("osd_scrub_chunk_timeout", OPT_FLOAT, 5.0,
+           "deadline for a replica's scrub map per chunk; a member"
+           " that misses it (after one retry) is recorded"
+           " unavailable — never conflated with object absence"),
     # -- scale plane (ceph_tpu.scale) ------------------------------------
     Option("mon_crush_osds_per_host", OPT_INT, 0,
            "group booting osds into straw2 host buckets of this size"
