@@ -5,11 +5,14 @@ Analog of src/tools/rados (rados put/get/ls/rm/stat/df/bench):
     python -m ceph_tpu.cli.rados -m HOST:PORT[,HOST:PORT...] \\
         -p POOL put NAME FILE | get NAME FILE | ls | rm NAME \\
         | stat NAME | df | bench SECONDS write [--size N] \\
-        | mksnap SNAP | rmsnap SNAP | lssnap | report [OUT.json]
+        | mksnap SNAP | rmsnap SNAP | lssnap | report [OUT.json] \\
+        | trace export [OUT.json]
 
     Reads honor -s/--snap SNAPNAME (rados -s, snapshot reads).
     `report` writes the one-call diagnostics bundle (status, health,
     df, osd dump, recent cluster log, crash list) as JSON.
+    `trace export` drives a few probe ops and writes the client's
+    flight-recorder timeline as Chrome-trace / Perfetto JSON.
 """
 
 from __future__ import annotations
@@ -23,7 +26,14 @@ from ..client.rados import RadosClient
 
 
 async def _run(args) -> int:
-    client = RadosClient(args.mon.split(","))
+    ctx = None
+    if args.cmd == "trace":
+        # the trace verb's probe ops must all be retained whatever
+        # the production sampling default is
+        from ..utils.context import Context
+        ctx = Context("client.trace",
+                      conf_overrides={"flight_recorder_sample": 1})
+    client = RadosClient(args.mon.split(","), ctx=ctx)
     await client.connect()
     try:
         if args.cmd == "df":
@@ -87,6 +97,48 @@ async def _run(args) -> int:
                 with open(args.args[0], "w") as f:
                     f.write(blob + "\n")
                 print("wrote report to %s" % args.args[0])
+            else:
+                print(blob)
+            return 0
+        if args.cmd == "trace":
+            # `rados -p POOL trace export [OUT.json]`: drive a few
+            # probe writes+reads through the cluster and export this
+            # client's flight-recorder ring as Chrome-trace JSON (the
+            # client-visible slice of each op's span; daemon-side
+            # lanes come from the per-daemon admin sockets'
+            # dump_flight_recorder or the harness's export_trace)
+            import json
+
+            sub = args.args[0] if args.args else "export"
+            if sub != "export":
+                print("unknown trace subcommand %r" % sub,
+                      file=sys.stderr)
+                return 2
+            out_path = args.args[1] if len(args.args) > 1 else None
+            io = client.io_ctx(args.pool)
+            n_probe = 8
+            payload = b"\x42" * 4096
+            for i in range(n_probe):
+                await io.write_full("trace-probe-%d" % i, payload)
+                await io.read("trace-probe-%d" % i)
+            await asyncio.gather(
+                *[io.remove("trace-probe-%d" % i)
+                  for i in range(n_probe)],
+                return_exceptions=True)
+            await asyncio.sleep(0.1)    # last replies retire
+            from ..trace import recorder as flight
+            fr = client.ctx.flight_recorder
+            doc = flight.chrome_trace(
+                {client.msgr.entity:
+                 [dict(r) for r in fr.records]},
+                device=flight.device_records())
+            blob = json.dumps(doc)
+            if out_path:
+                with open(out_path, "w") as f:
+                    f.write(blob + "\n")
+                print("wrote %d trace events to %s (open in "
+                      "https://ui.perfetto.dev)"
+                      % (len(doc["traceEvents"]), out_path))
             else:
                 print(blob)
             return 0

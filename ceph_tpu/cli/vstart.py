@@ -93,8 +93,20 @@ async def run(args) -> int:
                               indent=2, default=str, sort_keys=True)
             except Exception as de:
                 path = "(diagnostics collection failed: %r)" % de
+            # the flight-recorder timeline rides beside the bundle:
+            # the Perfetto-openable artifact showing WHERE the failed
+            # round's time went (queue wait vs device vs sub-op RTT)
+            tfd, tpath = tempfile.mkstemp(
+                prefix="ceph_tpu_diag_", suffix="_trace.json")
+            os.close(tfd)
+            try:
+                cluster.export_trace(path=tpath)
+            except Exception as te:
+                tpath = "(trace export failed: %r)" % te
             print("thrash FAILED (replay with --seed %s): %s\n"
-                  "diagnostics bundle: %s" % (args.seed, e, path))
+                  "diagnostics bundle: %s\n"
+                  "flight-recorder trace (open in Perfetto): %s"
+                  % (args.seed, e, path, tpath))
             rc = 1
         finally:
             await wl.stop()

@@ -23,11 +23,19 @@ digests (object bytes + attr blobs) into ONE device dispatch:
   below recovery — a scrub storm cannot starve client EC dispatches),
   and compile accounting buckets (lanes, width) pow2 so steady state
   re-dispatches a handful of programs.
+* **segment folding lifts the lane cap** — the position table is
+  O(width), so lanes stay bounded at ``DEVICE_MAX_BYTES`` (16 KiB);
+  a longer buffer splits into <= 16 KiB segments that digest as
+  independent lanes of the same dispatch and recombine on host with
+  ``crc32_combine`` (CRC32 over GF(2): shift the prefix crc through
+  len(suffix) zero bytes by matrix square-and-multiply, xor the
+  suffix crc — zlib's combine), bit-parity pinned against
+  ``zlib.crc32``.
 * **host fallback rides the poison/heal machinery** — DeviceBusy, a
-  poisoned chip, an injected fault, or an oversized buffer (the
-  position table is O(width), bounded at ``DEVICE_MAX_BYTES``)
-  degrade to the `zlib.crc32` loop; a failed dispatch poisons ITS
-  chip (per-chip DEVICE_FALLBACK health) and the probe loop heals it.
+  poisoned chip, an injected fault, or a batch whose staging would
+  exceed ``DEVICE_MAX_STAGE_BYTES`` degrade to the `zlib.crc32`
+  loop; a failed dispatch poisons ITS chip (per-chip DEVICE_FALLBACK
+  health) and the probe loop heals it.
 
 Bit-parity with ``zlib.crc32`` is exact by construction and pinned by
 tests/test_scrub.py — the device digest and the host fallback are the
@@ -48,9 +56,20 @@ from .runtime import DeviceBusy, DeviceRuntime, K_BACKGROUND
 _POLY = np.uint32(0xEDB88320)
 _FINAL = np.uint32(0xFFFFFFFF)
 
-# position-table memory is O(width x 256 x 4B): bound the device path
-# at 16 KiB lanes (a 16 MiB table); longer buffers take the host loop
+# position-table memory is O(width x 256 x 4B): bound the device
+# LANE at 16 KiB (a 16 MiB table).  Longer buffers no longer fall to
+# the host — they split into <= 16 KiB segments that digest as
+# independent lanes in the same dispatch and recombine on the host
+# with `crc32_combine` (CRC32 of a concatenation is the GF(2)-matrix
+# shift of the prefix crc xor the suffix crc — zlib's combine trick),
+# so the lane cap bounds the TABLE, not the buffer.
 DEVICE_MAX_BYTES = 1 << 14
+
+# total staged bytes (lanes x width) a single digest dispatch may
+# occupy; a batch whose segment fan-out exceeds it takes the host loop
+# (staging a GiB-class buffer through the pool would evict every
+# EC staging buffer for one scrub chunk)
+DEVICE_MAX_STAGE_BYTES = 1 << 25
 
 _MIN_WIDTH = 256     # pow2 floor so tiny chunks share one program
 _MIN_LANES = 8
@@ -134,6 +153,62 @@ def crc32_host(bufs) -> list[int]:
     return [zlib.crc32(bytes(b)) & 0xFFFFFFFF for b in bufs]
 
 
+# -- crc32_combine: GF(2)-matrix concatenation fold ----------------------
+
+
+def _gf2_times(mat: list[int], vec: int) -> int:
+    s = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            s ^= mat[i]
+        vec >>= 1
+        i += 1
+    return s
+
+
+def _gf2_square(mat: list[int]) -> list[int]:
+    return [_gf2_times(mat, mat[n]) for n in range(32)]
+
+
+def crc32_combine(crc1: int, crc2: int, len2: int) -> int:
+    """crc32(A + B) from crc32(A), crc32(B) and len(B) — zlib's
+    crc32_combine ported exactly: advance crc1 through len2 zero
+    bytes with square-and-multiply over the 32x32 GF(2) operator
+    matrices, then xor crc2's contribution in.  Bit-parity with
+    ``zlib.crc32`` is pinned by tests/test_flight_recorder.py; this
+    is what lets the device digest lanes stay bounded at
+    ``DEVICE_MAX_BYTES`` while whole chunks of any length fold from
+    their segment digests on the host."""
+    if len2 <= 0:
+        return crc1 & 0xFFFFFFFF
+    # odd = the one-zero-BIT advance operator
+    odd = [0] * 32
+    odd[0] = 0xEDB88320
+    row = 1
+    for n in range(1, 32):
+        odd[n] = row
+        row <<= 1
+    even = _gf2_square(odd)         # 2 bits
+    odd = _gf2_square(even)         # 4 bits
+    crc1 &= 0xFFFFFFFF
+    n = int(len2)
+    while True:
+        even = _gf2_square(odd)     # 8, 32, 128... zero bits
+        if n & 1:
+            crc1 = _gf2_times(even, crc1)
+        n >>= 1
+        if not n:
+            break
+        odd = _gf2_square(even)
+        if n & 1:
+            crc1 = _gf2_times(odd, crc1)
+        n >>= 1
+        if not n:
+            break
+    return (crc1 ^ crc2) & 0xFFFFFFFF
+
+
 def _pow2(n: int, floor: int) -> int:
     return 1 << max(int(n) - 1, floor - 1).bit_length()
 
@@ -154,11 +229,25 @@ async def crc32_batch(bufs, chip: int | None = None,
     target = rt.route(chip)
     maxlen = max(len(b) for b in bufs)
     if (target is None or not target.available or maxlen == 0
-            or maxlen > DEVICE_MAX_BYTES
             or not device_digest_enabled()):
         return crc32_host(bufs), "host"
-    width = _pow2(maxlen, _MIN_WIDTH)
-    lanes = _pow2(len(bufs), _MIN_LANES)
+    # segment fold: buffers above the lane cap split into
+    # <= DEVICE_MAX_BYTES segments, each a lane of the SAME dispatch;
+    # whole-buffer digests recombine on host via crc32_combine, so
+    # the O(width) position table stays bounded while chunks of any
+    # length digest on-device
+    segs: list[bytes] = []
+    owner: list[tuple[int, int]] = []       # (buf index, seg len)
+    for i, b in enumerate(bufs):
+        bb = bytes(b)
+        for off in range(0, len(bb), DEVICE_MAX_BYTES):
+            s = bb[off:off + DEVICE_MAX_BYTES]
+            segs.append(s)
+            owner.append((i, len(s)))
+    width = _pow2(max(len(s) for s in segs), _MIN_WIDTH)
+    lanes = _pow2(len(segs), _MIN_LANES)
+    if lanes * width > DEVICE_MAX_STAGE_BYTES:
+        return crc32_host(bufs), "host"
     total = sum(len(b) for b in bufs)
     ticket = target.open_ticket(klass, width, total)
     try:
@@ -169,8 +258,8 @@ async def crc32_batch(bufs, chip: int | None = None,
     try:
         import jax.numpy as jnp
         lens = np.zeros(lanes, np.int32)
-        for i, b in enumerate(bufs):
-            a = np.frombuffer(bytes(b), np.uint8)
+        for i, s in enumerate(segs):
+            a = np.frombuffer(s, np.uint8)
             stage[i, :a.size] = a
             lens[i] = a.size
         target.launch(ticket)           # injected-fault hook
@@ -183,8 +272,16 @@ async def crc32_batch(bufs, chip: int | None = None,
         target.finish(ticket, ok=True)
         # staging accounting in words, like the EC ladder
         target.note_staging(total // 4, (lanes * width) // 4)
-        return [int(lin[i]) ^ int(z[lens[i]])
-                for i in range(len(bufs))], "device"
+        out: list[int] = [0] * len(bufs)
+        seen: set[int] = set()
+        for lane, (bi, seg_len) in enumerate(owner):
+            seg_crc = int(lin[lane]) ^ int(z[lens[lane]])
+            if bi not in seen:
+                seen.add(bi)
+                out[bi] = seg_crc
+            else:
+                out[bi] = crc32_combine(out[bi], seg_crc, seg_len)
+        return out, "device"
     except Exception as e:
         # device loss mid-digest: poison THIS chip (per-chip
         # DEVICE_FALLBACK + probe heal) and finish the scrub on host

@@ -70,6 +70,20 @@ def affinity(osd_id: int, n_chips: int) -> int:
     return int(osd_id) % max(1, int(n_chips))
 
 
+def describe() -> dict:
+    """Mesh identity for trace/export metadata: how many chips this
+    process sees, what backs them, and whether the count was forced
+    (so an exported timeline records what hardware its device lanes
+    actually ran on)."""
+    devs = local_devices()
+    out = {"chips": chip_count(),
+           "physical_devices": len(devs),
+           "forced": bool(os.environ.get(MESH_ENV))}
+    if devs:
+        out["platform"] = getattr(devs[0], "platform", "unknown")
+    return out
+
+
 def simulated_mesh_env(n: int, base: dict | None = None) -> dict:
     """Environment for a subprocess that should see `n` real host
     devices (the CI simulation recipe: XLA must be told before jax

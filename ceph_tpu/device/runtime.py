@@ -66,6 +66,7 @@ import time
 import numpy as np
 
 from . import mesh
+from ..trace import recorder as flight
 
 # service classes (the device-side analog of the mClock op classes)
 K_CLIENT_EC = "client-ec"
@@ -307,6 +308,7 @@ class ChipRuntime:
         self.dispatch_buckets_us = [0] * _HIST_BUCKETS
         self.dispatches = 0
         self.dispatch_seconds = 0.0
+        self.queue_wait_seconds = 0.0  # summed ticket queue waits
         self.host_fallbacks = 0        # flushes served by host codecs
         # device-loss state
         self.fallback = False
@@ -417,6 +419,7 @@ class ChipRuntime:
         self.tickets.append(ticket)
         if len(self.tickets) > _TICKET_RING:
             del self.tickets[:_TICKET_RING // 2]
+        self.queue_wait_seconds += ticket.queue_wait
         if ok:
             self.dispatches += 1
             dt = ticket.device_s
@@ -424,6 +427,9 @@ class ChipRuntime:
             us = max(1, int(dt * 1e6))
             i = min(_HIST_BUCKETS - 1, max(0, us.bit_length() - 1))
             self.dispatch_buckets_us[i] += 1
+        # flight recorder: every completed ticket is a device-lane
+        # span (the process ring the Perfetto export renders per chip)
+        flight.note_ticket(ticket)
 
     # -- device-loss degradation ------------------------------------------
 
@@ -520,7 +526,45 @@ class ChipRuntime:
         total = self.staged_payload_words + self.staged_pad_words
         return self.staged_pad_words / total if total else 0.0
 
+    def utilization(self, window: float | None = None,
+                    now: float | None = None) -> dict:
+        """Windowed utilization integrals over the ticket ring — the
+        per-chip busy/idle accounting arXiv:2112.09017 treats as the
+        primary scaling signal:
+
+        * ``busy_frac``  — chip-seconds of device time per wall
+          second in the window (can exceed 1.0 while multiple
+          dispatches are in flight);
+        * ``queue_wait_frac`` — admission-wait seconds per wall
+          second (the saturation leading indicator: latency is
+          queueing, not compute);
+        * ``idle_frac``  — max(0, 1 - busy_frac).
+
+        Only the ticket overlap with the window counts (a dispatch
+        straddling the window edge is clipped), so the figures are
+        honest rates, not lifetime averages."""
+        w = float(window if window is not None
+                  else self.rt.util_window)
+        t_now = time.monotonic() if now is None else now
+        lo = t_now - w
+        busy = qwait = 0.0
+        for t in self.tickets:
+            if not t.t_done or t.t_done <= lo:
+                continue
+            if t.ok:
+                busy += min(t.device_s, t.t_done - lo)
+            admit_end = t.t_admit or t.t_done
+            if admit_end > lo:
+                qwait += min(t.queue_wait, admit_end - lo)
+        busy_frac = busy / w if w > 0 else 0.0
+        qw_frac = qwait / w if w > 0 else 0.0
+        return {"window_s": round(w, 3),
+                "busy_frac": round(busy_frac, 4),
+                "queue_wait_frac": round(qw_frac, 4),
+                "idle_frac": round(max(0.0, 1.0 - busy_frac), 4)}
+
     def metrics(self) -> dict:
+        util = self.utilization()
         return {
             "device_queue_depth": self.queue.depth,
             "device_inflight": self.queue.inflight,
@@ -536,6 +580,11 @@ class ChipRuntime:
             "device_fallback_count": self.fallback_count,
             "device_heal_count": self.heal_count,
             "device_queue_rejected": self.queue.rejected,
+            # windowed utilization integrals (chip-labeled gauges:
+            # saturation visible per chip, cluster-wide via the mgr)
+            "device_util_busy": util["busy_frac"],
+            "device_util_queue_wait": util["queue_wait_frac"],
+            "device_util_idle": util["idle_frac"],
         }
 
 
@@ -560,6 +609,7 @@ class DeviceRuntime:
         self._probe_base = 0.05
         self._probe_cap = 1.0
         self.shard_min_words = _SHARD_MIN_WORDS
+        self.util_window = 10.0     # utilization-integral window (s)
         self.chips: list[ChipRuntime] = [
             ChipRuntime(self, i, weights, max_inflight, max_queue)
             for i in range(max(1, n))]
@@ -614,6 +664,11 @@ class DeviceRuntime:
         try:
             self.shard_min_words = max(
                 _MIN_BUCKET, int(conf["device_shard_min_words"]))
+        except (KeyError, TypeError, ValueError):
+            pass
+        try:
+            self.util_window = max(
+                0.1, float(conf["device_util_window"]))
         except (KeyError, TypeError, ValueError):
             pass
 

@@ -314,11 +314,25 @@ class PGMap:
         # per-OSD raw capacity (the statfs axis `df` renders): bounded
         # — one small row per reporting daemon, never per-PG data
         osd_rows = {}
+        # per-chip device utilization: each daemon reports ITS
+        # affinity chip's integrals; fold one row per chip, freshest
+        # report wins (co-located daemons share a chip and report
+        # identical figures off the same ChipRuntime ring)
+        device_util: dict[int, dict] = {}
+        dev_stamp: dict[int, float] = {}
         for d, row in self.live_osd_stats(now).items():
             sf = row.get("statfs")
             if sf:
                 osd_rows[d] = {"total": int(sf.get("total") or 0),
                                "used": int(sf.get("used") or 0)}
+            du = row.get("device_util")
+            if du and du.get("chip") is not None:
+                chip = int(du["chip"])
+                if row["_stamp"] >= dev_stamp.get(chip, -1.0):
+                    dev_stamp[chip] = row["_stamp"]
+                    device_util[chip] = {
+                        k: v for k, v in du.items() if k != "chip"}
+                    device_util[chip]["daemon"] = d
         return {
             "num_pgs": sum(r["num_pgs"] for r in per_pool.values()),
             "pg_states": states,
@@ -332,6 +346,9 @@ class PGMap:
             "inconsistent_pgs": self.inconsistent_pgs(now, pools),
             "op_size_hist_bytes_pow2": self.op_size_hist(now),
             "osd_stats": osd_rows,
+            # chip -> windowed busy/queue-wait/idle fractions (the
+            # `status` device-utilization line + QoS oracles)
+            "device_util": device_util,
         }
 
 

@@ -1257,9 +1257,19 @@ class Monitor:
         out["health"] = health["status"]
         out["checks"] = sorted(health["checks"])
         dig = self._digest_fresh()
-        if dig is not None:
+        if dig is None:
+            # a digest-less mon (mgr dead / never registered / digest
+            # past TTL) says so EXPLICITLY instead of silently
+            # omitting the section — absent data must never read as
+            # "zero activity"
+            out["pgmap"] = {
+                "available": False,
+                "status": "unavailable (no mgr digest)",
+            }
+        else:
             totals = dig.get("totals") or {}
             out["pgmap"] = {
+                "available": True,
                 "num_pgs": dig.get("num_pgs", 0),
                 "pg_states": dict(dig.get("pg_states") or {}),
                 "data": {
@@ -1284,6 +1294,16 @@ class Monitor:
                         totals.get("recovery_bytes_s") or 0.0),
                 },
             }
+            # device-utilization line: per-chip windowed busy /
+            # queue-wait / idle fractions from the digest, so chip
+            # saturation is visible in one `status` call cluster-wide
+            du = dig.get("device_util") or {}
+            if du:
+                out["device_util"] = {
+                    int(chip): dict(row)
+                    for chip, row in sorted(du.items(),
+                                            key=lambda kv:
+                                            int(kv[0]))}
         return out
 
     def _pool_digest_rows(self) -> list[dict]:

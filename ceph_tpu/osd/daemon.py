@@ -1318,6 +1318,13 @@ class OSD:
             return
         self.msgr.spawn(self._replicated_recover(pg))
 
+    def _span_recovery(self, pg: PG, t0: float, had: bool) -> None:
+        """Record one recovery flow on the flight recorder (only
+        flows that had work: the watchdog re-kicks idly)."""
+        fr = getattr(self.ctx, "flight_recorder", None)
+        if fr is not None and had:
+            fr.span("recovery", t0, meta={"pgid": str(pg.pgid)})
+
     async def _replicated_recover(self, pg: PG) -> None:
         """Paced replicated recovery: pull/push in chunks, each chunk
         admitted through the mClock 'recovery' class so client I/O
@@ -1327,6 +1334,9 @@ class OSD:
         if getattr(pg, "_recovery_flow", False):
             return
         pg._recovery_flow = True
+        had_work = bool(pg.missing
+                        or any(pg.peer_missing.values()))
+        t_rec0 = self.optracker.now()
         chunk = 16
         acting0 = list(pg.acting)
         try:
@@ -1387,6 +1397,7 @@ class OSD:
                         epoch=self.osdmap.epoch, pushes=pushes))
         finally:
             pg._recovery_flow = False
+            self._span_recovery(pg, t_rec0, had_work)
 
     async def _ec_recover(self, pg: PG) -> None:
         """EC recovery: reconstruct (never copy) shards
@@ -1396,6 +1407,9 @@ class OSD:
         if getattr(pg, "_recovery_flow", False):
             return
         pg._recovery_flow = True
+        had_work = bool(pg.missing
+                        or any(pg.peer_missing.values()))
+        t_rec0 = self.optracker.now()
         try:
             await self.ec.recover_primary_shards(pg)
             for osd_id, missing in list(pg.peer_missing.items()):
@@ -1404,6 +1418,7 @@ class OSD:
                                                       missing)
         finally:
             pg._recovery_flow = False
+            self._span_recovery(pg, t_rec0, had_work)
         if not pg.missing:
             self._requeue_waiters(pg)
 
@@ -1697,6 +1712,7 @@ class OSD:
         cost = max(1.0, sum(len(op.get("data") or b"")
                             for op in msg.ops
                             if isinstance(op, dict)) / 65536.0)
+        t0 = self.optracker.now()
         granted = False
         try:
             await chip.queue.admit(K_BACKGROUND, cost)
@@ -1712,6 +1728,11 @@ class OSD:
         finally:
             if granted:
                 chip.queue.release()
+            fr = getattr(self.ctx, "flight_recorder", None)
+            if fr is not None:
+                fr.span("compression_paced", t0,
+                        meta={"pgid": str(pg.pgid),
+                              "paced": granted})
 
     async def _handle_watch_ops(self, pg: PG, conn, msg) -> None:
         """watch/unwatch/notify ops (PrimaryLogPG do_osd_ops
@@ -2594,6 +2615,17 @@ class OSD:
             statfs = self.store.statfs()
         except Exception:
             statfs = None
+        # per-chip utilization integrals: this OSD reports ITS
+        # affinity chip's windowed busy/queue-wait/idle fractions —
+        # the mgr digest folds one row per chip and `status` renders
+        # the cluster's device-utilization line from them
+        device_util = None
+        if self.device_chip is not None:
+            try:
+                device_util = {"chip": self.device_chip.index,
+                               **self.device_chip.utilization()}
+            except Exception:
+                device_util = None
         self.msgr.send_to(addr, MMgrReport(
             daemon="osd.%d" % self.whoami, epoch=self.osdmap.epoch,
             perf=self.ctx.perf.dump(), pg_states=states,
@@ -2603,6 +2635,9 @@ class OSD:
                        list(self.op_size_hist),
                        # raw-capacity axis for `df` + the exporter
                        "statfs": statfs,
+                       # per-chip device utilization (flight-recorder
+                       # plane: saturation visible cluster-wide)
+                       "device_util": device_util,
                        # clog emission counters
                        # (ceph_tpu_log_messages_total)
                        "log_messages": self.clog.counts_wire()}),

@@ -265,6 +265,8 @@ class Scrubber:
         the set (heads and their clones ride together) — the
         surgical-repair path: rewrite exactly the known-bad objects
         without racing unrelated in-flight writes."""
+        fr = getattr(self.osd.ctx, "flight_recorder", None)
+        t_span0 = fr.now() if fr is not None else 0.0
         result = await self._scrub_once(pg, deep, repair, chunk,
                                         only=only)
         if recheck and result["errors"] and not repair:
@@ -286,6 +288,12 @@ class Scrubber:
         if result.get("ran"):
             self._note_scrub_done(pg, deep, result,
                                   partial=only is not None)
+        if fr is not None and result.get("ran"):
+            # background-work span beside the ops it competed with
+            fr.span("deep_scrub" if deep else "scrub", t_span0,
+                    meta={"pgid": str(pg.pgid),
+                          "errors": result.get("errors", 0),
+                          "repaired": result.get("repaired", 0)})
         return result
 
     def _note_scrub_done(self, pg: PG, deep: bool, result: dict,

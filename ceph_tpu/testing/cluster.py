@@ -57,6 +57,12 @@ FAST_CONF = {
     "osd_scrub_interval": 3.0,
     "osd_deep_scrub_interval": 6.0,
     "osd_scrub_chunk_timeout": 1.0,
+    # flight recorder at dev pacing: keep EVERY trace (production
+    # samples 1-in-N; harness oracles assert complete span trees for
+    # every acked write, so nothing may drop) and a short utilization
+    # window so saturation integrals react within a round
+    "flight_recorder_sample": 1,
+    "device_util_window": 5.0,
 }
 
 
@@ -377,6 +383,50 @@ class LocalCluster:
                     rec["clock_offset"] = off
                 out.append(rec)
         return sorted(out, key=lambda d: d["initiated"])
+
+    def export_trace(self, path: str | None = None,
+                     traces: list | None = None) -> dict:
+        """Merge every daemon's flight-recorder ring (dead daemons
+        included — their rings survive the stop) plus the process
+        device-ticket ring into ONE Chrome-trace / Perfetto JSON
+        document, normalized onto the client's clock via the
+        clock-offset solver.  ``traces`` filters op records to those
+        trace ids (background + device spans always ride).  ``path``
+        additionally writes the document to disk — the artifact you
+        drop into https://ui.perfetto.dev."""
+        from ..device import mesh
+        from ..trace import recorder as flight
+
+        rings: dict[str, list[dict]] = {}
+
+        def take(entity: str, ctx) -> None:
+            fr = getattr(ctx, "flight_recorder", None)
+            if fr is None:
+                return
+            recs = [dict(r) for r in fr.records]
+            if traces is not None:
+                want = set(traces)
+                recs = [r for r in recs
+                        if r.get("kind") != "op"
+                        or r.get("trace") in want]
+            rings[entity] = recs
+
+        if self.client is not None:
+            take(self.client.msgr.entity, self.client.ctx)
+        for osd in self.osds:
+            if osd is not None:
+                take("osd.%d" % osd.whoami, osd.ctx)
+        for m in self.mons:
+            take(m.msgr.entity, m.ctx)
+        doc = flight.chrome_trace(
+            rings, offsets=self.clock_offsets(),
+            device=flight.device_records(),
+            meta={"seed": self.seed, "mesh": mesh.describe()})
+        if path:
+            import json
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
 
     def stuck_ops(self) -> list[dict]:
         """In-flight ops past the complaint threshold on any live

@@ -11,5 +11,6 @@ EC batch -> commit) is reconstructable after the fact.
 
 from .logclient import LogClient
 from .optracker import OpTracker, TrackedOp
+from .recorder import FlightRecorder
 
-__all__ = ["LogClient", "OpTracker", "TrackedOp"]
+__all__ = ["FlightRecorder", "LogClient", "OpTracker", "TrackedOp"]

@@ -89,6 +89,22 @@ class TrackedOp:
         }
         if self.meta:
             out["meta"] = self.meta
+            tickets = self.meta.get("device_ticket")
+            if tickets:
+                # device-dispatched ops surface their attribution
+                # first-class (not buried in meta): which chip served
+                # the flush, and was the latency queue-wait or device
+                # time — the dump_historic_ops answer to "where did
+                # this op's milliseconds go"
+                t = tickets[-1]
+                out["device"] = {
+                    "chip": t.get("chip"),
+                    "klass": t.get("klass"),
+                    "bucket": t.get("bucket"),
+                    "queue_wait": t.get("queue_wait"),
+                    "device_s": t.get("device_s"),
+                    "dispatches": len(tickets),
+                }
         return out
 
 
@@ -110,6 +126,12 @@ class OpTracker:
         # dump commands find it without plumbing (CephContext keeps the
         # same backref for its admin hooks)
         ctx.optracker = self
+        # the daemon's flight-recorder ring rides the tracker: retired
+        # ops feed it (sampled; slow ops always), and it shares this
+        # tracker's skewable clock so recorder spans normalize with
+        # the same offsets as op stamps
+        from .recorder import FlightRecorder
+        self.recorder = FlightRecorder(ctx, daemon, clock=self.now)
 
     def now(self) -> float:
         return time.monotonic() + self.clock_skew
@@ -133,12 +155,14 @@ class OpTracker:
         cap = int(self.ctx.conf.get("osd_op_history_size", 20))
         if len(self.historic) > cap:
             del self.historic[:len(self.historic) - cap]
-        if op.age >= self.complaint_time:
+        slow = op.age >= self.complaint_time
+        if slow:
             self.historic_slow.append(op)
             scap = int(self.ctx.conf.get(
                 "osd_op_history_slow_op_size", 20))
             if len(self.historic_slow) > scap:
                 del self.historic_slow[:len(self.historic_slow) - scap]
+        self.recorder.note_op(op, slow=slow)
 
     # -- slow-op detection ---------------------------------------------
 
@@ -191,3 +215,4 @@ class OpTracker:
         admin.register("dump_historic_slow_ops",
                        lambda a: self.dump_historic_slow_ops(),
                        "show recently completed slow ops")
+        self.recorder.register_admin(admin)

@@ -1,0 +1,257 @@
+"""Stage/series name registries + the drift lint.
+
+The flight recorder, `bench.py --trace`, and the trace tests all
+reference OpTracker stage names and device exporter series by string
+literal.  A renamed stage at its emission site (`mark_event("...")`)
+would silently break every consumer — the timeline still renders, the
+bench still prints, but the renamed stage just stops matching.  This
+module makes that a tier-1 lint failure instead:
+
+* ``OP_STAGES`` / ``OP_STAGE_PREFIXES`` — the canonical registry of
+  every stage name the tracker can emit (prefixes cover the dynamic
+  forms like ``sent_osd.<n>``);
+* ``BACKGROUND_SPANS`` — the flight recorder's background span names;
+* ``DEVICE_SERIES`` — the per-chip device metric names the exporter
+  publishes (checked against a live ChipRuntime, so a metrics() key
+  added without registration also fails);
+* ``CONSUMER_STAGE_REFS`` — which stage names each consumer file
+  (bench.py, the trace tests) is known to reference.
+
+``lint_repo()`` closes the loop in both directions: every emitted
+literal must be registered, every registered name must still be
+emitted somewhere, and every consumer reference must be registered
+AND still literally present in the consumer's source — so a rename
+anywhere in the chain fails the lint until every link is updated.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+# every static stage literal the tracker emits (mark_event /
+# _op_event / finish / _op_finish call sites across ceph_tpu), plus
+# the two implicit stamps every op carries
+OP_STAGES = frozenset({
+    "initiated", "done",                      # implicit (ctor/default)
+    # client (client/rados.py)
+    "no_primary", "redirected", "redirected_inactive",
+    # mon (mon/monitor.py)
+    "proposal_queued", "proposal_timeout", "error",
+    # osd queue/dispatch (osd/daemon.py)
+    "queued", "reached_pg", "waiting_for_map", "waiting_for_active",
+    "waiting_for_min_size", "waiting_for_degraded_object",
+    "waiting_for_missing_object", "started_write", "started_apply",
+    "sub_op_sent", "applied", "read_done", "watch_done",
+    "done_no_replicas", "error_reply", "no_such_pool",
+    "dropped_not_primary", "dropped_wrong_pg_after_split",
+    "dropped_interval_change", "dropped_pool_deleted",
+    "dup_answered_from_journal",
+    "aborted_interval_change", "aborted_pool_deleted",
+    # EC backend (osd/ecbackend.py)
+    "ec_write_started", "ec_encode_start", "ec_encoded",
+    "device_dispatched", "ec_sub_write_sent", "ec_sub_write_acked",
+    "ec_sub_write_timeout", "ec_write_done", "ec_read_done",
+    "ec_shard_applied", "ec_delta_rmw", "ec_delta_done",
+    "ec_error_reply",
+})
+
+# dynamic stage families: the literal carries a %-format tail
+OP_STAGE_PREFIXES = ("sent_osd.", "commit_rec_osd.", "reply_r")
+
+# flight-recorder background span names (FlightRecorder.span callers)
+BACKGROUND_SPANS = frozenset({
+    "scrub", "deep_scrub", "recovery", "compression_paced",
+})
+
+# per-chip device series (ChipRuntime.metrics keys + the families
+# prom_lines adds beside them)
+DEVICE_SERIES = frozenset({
+    "device_queue_depth", "device_inflight",
+    "device_bucket_hit_ratio", "device_bucket_waste_ratio",
+    "device_compile_count", "device_dispatches",
+    "device_host_fallbacks", "device_pool_hits",
+    "device_pool_misses", "device_fallback",
+    "device_fallback_count", "device_heal_count",
+    "device_queue_rejected",
+    "device_util_busy", "device_util_queue_wait", "device_util_idle",
+    # families prom_lines emits beside the metrics() gauges
+    "device_chips", "device_dispatch_seconds",
+})
+
+# which stage names each consumer file references by literal; the
+# lint demands every entry be registered AND literally present in the
+# file, so a stage rename that misses a consumer fails here
+CONSUMER_STAGE_REFS = {
+    "bench.py": (
+        "queued", "reached_pg", "sub_op_sent", "ec_sub_write_sent",
+        "ec_sub_write_acked", "ec_encode_start", "ec_encoded",
+    ),
+    "tests/test_optracker.py": (
+        "queued", "reached_pg", "started_write", "sub_op_sent",
+        "started_apply", "applied", "ec_encode_start", "ec_encoded",
+    ),
+    "tests/test_flight_recorder.py": (
+        "queued", "ec_encode_start", "ec_encoded", "ec_write_done",
+        "device_dispatched",
+    ),
+}
+
+CONSUMER_SERIES_REFS = {
+    "tests/test_flight_recorder.py": (
+        "device_util_busy", "device_util_queue_wait",
+        "device_util_idle",
+    ),
+}
+
+_EMIT_RES = (
+    re.compile(r'\.mark_event\(\s*"([^"]+)"'),
+    re.compile(r'_op_event\([^,()]+,\s*"([^"]+)"'),
+    re.compile(r'\.finish\(\s*"([^"]+)"'),
+    re.compile(r'_op_finish\([^,()]+,\s*"([^"]+)"'),
+)
+
+_EMIT_COND_RE = re.compile(
+    r'\.mark_event\(\s*"([^"]+)"\s+if\s+.{0,120}?'
+    r'else\s+"([^"]+)"\)', re.S)
+
+_SPAN_RE = re.compile(r'\.span\(\s*\n?\s*"([^"]+)"')
+_SPAN_COND_RE = re.compile(
+    r'\.span\(\s*"([^"]+)"\s+if\s+.{0,120}?else\s+"([^"]+)"', re.S)
+
+
+def _repo_root(root: str | None) -> str:
+    return root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _iter_sources(pkg_dir: str):
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                with open(path) as f:
+                    yield path, f.read()
+
+
+def emitted_stages(root: str | None = None
+                   ) -> tuple[set[str], set[str], set[str]]:
+    """(exact stage names, dynamic prefixes, span names) scanned from
+    the ceph_tpu sources' emission call sites."""
+    exact: set[str] = set()
+    prefixes: set[str] = set()
+    spans: set[str] = set()
+    pkg = os.path.join(_repo_root(root), "ceph_tpu")
+    for _path, src in _iter_sources(pkg):
+        for rx in _EMIT_RES:
+            for name in rx.findall(src):
+                if "%" in name:
+                    prefixes.add(name.split("%")[0])
+                else:
+                    exact.add(name)
+        for a, b in _EMIT_COND_RE.findall(src):
+            exact.update((a, b))
+        for a, b in _SPAN_COND_RE.findall(src):
+            spans.update((a, b))
+        for name in _SPAN_RE.findall(src):
+            if " if " not in name:
+                spans.add(name)
+    return exact, prefixes, spans
+
+
+def stage_known(name: str) -> bool:
+    if name in OP_STAGES:
+        return True
+    return any(name.startswith(p) for p in OP_STAGE_PREFIXES)
+
+
+def lint_emissions(root: str | None = None) -> list[str]:
+    """Both directions between the registry and the emission sites."""
+    errors: list[str] = []
+    exact, prefixes, spans = emitted_stages(root)
+    for name in sorted(exact):
+        if not stage_known(name):
+            errors.append("emitted stage %r is not registered in"
+                          " trace.registry.OP_STAGES" % name)
+    for pref in sorted(prefixes):
+        if pref not in OP_STAGE_PREFIXES:
+            errors.append("emitted dynamic stage prefix %r is not in"
+                          " OP_STAGE_PREFIXES" % pref)
+    implicit = {"initiated", "done"}
+    for name in sorted(OP_STAGES - exact - implicit):
+        errors.append("registered stage %r is no longer emitted"
+                      " anywhere" % name)
+    for pref in sorted(set(OP_STAGE_PREFIXES) - prefixes):
+        errors.append("registered stage prefix %r is no longer"
+                      " emitted anywhere" % pref)
+    for name in sorted(spans - BACKGROUND_SPANS):
+        errors.append("background span %r is not registered in"
+                      " BACKGROUND_SPANS" % name)
+    for name in sorted(BACKGROUND_SPANS - spans):
+        errors.append("registered background span %r is no longer"
+                      " recorded anywhere" % name)
+    return errors
+
+
+def lint_device_series() -> list[str]:
+    """DEVICE_SERIES must match what a live chip actually exports (a
+    metrics() key added or renamed without registration fails)."""
+    from ..device.runtime import DeviceRuntime
+    live = set(DeviceRuntime(chips=1).chips[0].metrics())
+    live |= {"device_chips", "device_dispatch_seconds"}
+    errors = []
+    for name in sorted(live - DEVICE_SERIES):
+        errors.append("exported device series %r is not registered"
+                      " in trace.registry.DEVICE_SERIES" % name)
+    for name in sorted(DEVICE_SERIES - live):
+        errors.append("registered device series %r is no longer"
+                      " exported" % name)
+    return errors
+
+
+def lint_consumers(root: str | None = None) -> list[str]:
+    """Every consumer reference must be a registered name AND still
+    literally present in the consumer's source."""
+    errors: list[str] = []
+    base = _repo_root(root)
+    for relpath, names in sorted(CONSUMER_STAGE_REFS.items()):
+        path = os.path.join(base, relpath)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            errors.append("consumer %s is missing" % relpath)
+            continue
+        for name in names:
+            if not stage_known(name):
+                errors.append("%s references unregistered stage %r"
+                              % (relpath, name))
+            if '"%s"' % name not in src:
+                errors.append("%s no longer references stage %r"
+                              " (stale CONSUMER_STAGE_REFS entry?)"
+                              % (relpath, name))
+    for relpath, names in sorted(CONSUMER_SERIES_REFS.items()):
+        path = os.path.join(base, relpath)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            errors.append("consumer %s is missing" % relpath)
+            continue
+        for name in names:
+            if name not in DEVICE_SERIES:
+                errors.append("%s references unregistered series %r"
+                              % (relpath, name))
+            if name not in src:
+                errors.append("%s no longer references series %r"
+                              % (relpath, name))
+    return errors
+
+
+def lint_repo(root: str | None = None) -> list[str]:
+    """The tier-1 drift lint: emission sites vs registry vs consumer
+    references, plus the live device-series check."""
+    return (lint_emissions(root) + lint_device_series()
+            + lint_consumers(root))

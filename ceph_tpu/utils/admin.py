@@ -71,6 +71,19 @@ class AdminSocket:
                       lambda a: tracker().dump_historic_slow_ops(),
                       "show recently completed slow ops")
 
+        # flight-recorder ring (ceph_tpu.trace.recorder): the span
+        # records the Perfetto export merges — same lazy-backref
+        # pattern as the tracker dumps
+        def recorder():
+            fr = getattr(ctx, "flight_recorder", None)
+            if fr is None:
+                raise RuntimeError("this daemon records no spans")
+            return fr
+
+        self.register("dump_flight_recorder",
+                      lambda a: recorder().dump(),
+                      "dump the flight-recorder span ring")
+
     # -- server ----------------------------------------------------------
     def start(self) -> None:
         if not self.path:

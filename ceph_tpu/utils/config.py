@@ -333,6 +333,19 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_crash_ring_tail", OPT_INT, 100,
            "LogRing entries captured into a crash report (the"
            " post-mortem high-verbosity context)"),
+    # -- flight recorder (ceph_tpu.trace.recorder) -----------------------
+    Option("flight_recorder_ring", OPT_INT, 2048,
+           "span records kept in each daemon's flight-recorder ring"
+           " (op spans, background-work spans)"),
+    Option("flight_recorder_sample", OPT_INT, 4,
+           "1-in-N trace sampling for retained op records (keyed on"
+           " the trace id so a sampled write is complete on every"
+           " daemon; slow ops are always retained; 1 keeps every"
+           " trace)"),
+    Option("device_util_window", OPT_FLOAT, 10.0,
+           "window (s) of the per-chip utilization integrals"
+           " (busy / queue-wait / idle fractions fed to the exporter,"
+           " the mgr digest and `status`)"),
     # -- integrity plane (scrub scheduling + straggler handling) ---------
     Option("osd_scrub_interval", OPT_FLOAT, 24 * 3600.0,
            "seconds between automatic shallow scrubs of each PG"

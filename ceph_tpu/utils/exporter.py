@@ -210,12 +210,15 @@ def device_runtime_lines(prefix: str = "ceph_tpu") -> list[str]:
     bucket hit ratio, the ragged staging waste ratio
     (``device_bucket_waste_ratio`` — padded-but-empty over total
     staged words, the figure the bucket ladder exists to keep near
-    zero), compile count, fallback state, and the
-    device_dispatch_seconds histogram — every dispatch ticket feeds
-    these, so the accelerator's behavior is scrapeable beside the
-    daemon counters.  Every series carries a ``chip`` label (one per
-    mesh chip, so a single lost chip is visible as ITS series
-    flipping) plus the unlabeled mesh-size gauge."""
+    zero), compile count, fallback state, the windowed utilization
+    integrals (``device_util_busy`` / ``device_util_queue_wait`` /
+    ``device_util_idle`` — the per-chip saturation signal the flight
+    recorder's accounting derives), and the device_dispatch_seconds
+    histogram — every dispatch ticket feeds these, so the
+    accelerator's behavior is scrapeable beside the daemon counters.
+    Every series carries a ``chip`` label (one per mesh chip, so a
+    single lost chip is visible as ITS series flipping) plus the
+    unlabeled mesh-size gauge."""
     from ..device.runtime import DeviceRuntime
     return DeviceRuntime.get().prom_lines(prefix)
 

@@ -401,12 +401,29 @@ def test_dispatch_path_exception_produces_crash_report():
             osd1.ms_dispatch = bomb
             # a peer heartbeat trips the bomb inside the synchronous
             # dispatch path; the crash hook records the report into
-            # osd.1's OWN store
-            await wait_for(lambda: osd1._crash_pending, 20,
+            # osd.1's OWN store.  Snapshot the report from the SAME
+            # poll that observes it: the beacon-paced shipping + the
+            # mon's committed-table ack clears _crash_pending, so a
+            # predicate that merely returns the list races the ack
+            # window and flakes (this timed out when ship+ack landed
+            # between two backoff polls)
+            seen = {}
+
+            def crash_recorded():
+                if osd1._crash_pending:
+                    seen.update(osd1._crash_pending[0])
+                # the hook records synchronously right after the
+                # raise — once the bomb tripped, the report exists
+                # (pending here, or already shipped and acked away)
+                return bool(seen) or not state["armed"]
+
+            await wait_for(crash_recorded, 20,
                            what="dispatch crash recorded")
-            rep = osd1._crash_pending[0]
-            assert rep["exc_type"] == "RuntimeError"
-            assert "injected dispatch bomb" in rep["exc_msg"]
+            if seen:
+                assert seen["exc_type"] == "RuntimeError"
+                assert "injected dispatch bomb" in seen["exc_msg"]
+            # else: already committed on the mon — the `crash ls`
+            # check below asserts the report's content end to end
             # the daemon dies (hard-stop) and the REBOOT ships the
             # report from the surviving store to the mon's table
             await c.kill_osd(1)

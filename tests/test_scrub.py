@@ -368,11 +368,22 @@ def test_digest_device_host_bit_parity():
             out, path = await dg.crc32_batch(bufs, chip=1)
             assert path == "device"
             assert out == dg.crc32_host(bufs)
-            # oversized buffer: host loop, same values
-            big = [b"x" * (dg.DEVICE_MAX_BYTES + 1)]
+            # over-lane-cap buffer: segment folding keeps it ON
+            # DEVICE (lanes stay <= 16 KiB; whole-buffer crc folds
+            # from segment crcs via crc32_combine), same values
+            big = [b"x" * (dg.DEVICE_MAX_BYTES + 1),
+                   bytes(rng.integers(0, 256,
+                                      3 * dg.DEVICE_MAX_BYTES + 17,
+                                      dtype=np.uint8))]
             out2, path2 = await dg.crc32_batch(big)
-            assert path2 == "host"
+            assert path2 == "device"
             assert out2 == dg.crc32_host(big)
+            # a batch whose staging would blow the dispatch bound
+            # still degrades to host, same values
+            huge = [b"y" * (dg.DEVICE_MAX_STAGE_BYTES + 1)]
+            outh, pathh = await dg.crc32_batch(huge)
+            assert pathh == "host"
+            assert outh == dg.crc32_host(huge)
             # injected fault: host fallback rides the poison/heal
             # machinery — the chip flips, values stay identical
             chip = rt.chips[0]
