@@ -477,6 +477,184 @@ def bench_recorder_overhead(n_objs: int = 32, obj_bytes: int = 1 << 18,
     }
 
 
+def bench_traffic(duration: float = 4.0) -> dict:
+    """--traffic mode: the noisy-neighbor tenant-isolation bench
+    (ROADMAP direction 1).  Boots a LocalCluster with per-tenant
+    dmClock rows (the bully's limit tag set low, the victim holding a
+    real reservation), drives the victim fleet alone for a baseline,
+    then re-runs it with a bully tenant flooding the same EC pool
+    through the same shared messenger, and publishes per-tenant
+    p50/p99 + the isolation ratio into BASELINE.json behind
+    `_gate_traffic`.  The exported flight-recorder trace from the
+    contended phase is schema-validated and must carry tenant
+    attribution on op spans AND device tickets — the proof of WHERE
+    the victim's wait went."""
+    import asyncio
+    import os
+
+    os.environ.setdefault("CEPH_TPU_EC_OFFLOAD", "1")
+    from ceph_tpu.testing import LocalCluster, TrafficGenerator
+    from ceph_tpu.trace.recorder import validate_chrome_trace
+
+    CAPACITY = 1000.0
+    BULLY_LIM_FRAC = 0.10
+    VICTIM_SPEC = {"victim": {"streams": 4, "window": 2,
+                              "obj_bytes": 4096, "n_objects": 8}}
+    BULLY_SPEC = {"bully": {"streams": 8, "window": 8,
+                            "obj_bytes": 4096, "n_objects": 8}}
+
+    async def run() -> dict:
+        c = await LocalCluster(
+            n_osds=3, with_mgr=True,
+            conf={
+                "osd_mclock_capacity_iops": CAPACITY,
+                # bully throttled at its limit tag; victim holds a
+                # real reservation + weight
+                "osd_mclock_tenant_qos":
+                    "bully:0.02:0.5:%g,victim:0.30:4.0:1.0"
+                    % BULLY_LIM_FRAC,
+            }).start()
+        try:
+            pid = await c.create_pool("traffic_ec", pg_num=8,
+                                      pool_type="erasure")
+            await c.wait_health(pid)
+            # warmup (discarded): codec build + bucket compiles must
+            # not ride the published baseline's percentiles
+            await TrafficGenerator.build(
+                c.client, pid, VICTIM_SPEC, seed=3).run(1.0)
+            # phase A: victims alone (the published baseline)
+            alone = await TrafficGenerator.build(
+                c.client, pid, VICTIM_SPEC, seed=7).run(duration)
+            # phase B: victims + bully flood, same shared messenger
+            gen = TrafficGenerator.build(
+                c.client, pid, {**VICTIM_SPEC, **BULLY_SPEC},
+                seed=11)
+            contended = await gen.run(duration)
+            await gen.verify()      # throttled is never lossy
+            # flight-recorder proof: the exported trace carries
+            # tenant attribution on op spans and device tickets
+            doc = c.export_trace()
+            schema_errors = validate_chrome_trace(doc)
+            op_tenants = {e["args"].get("tenant")
+                          for e in doc["traceEvents"]
+                          if e.get("cat") == "op"
+                          and isinstance(e.get("args"), dict)}
+            dev_tenants = {e["args"].get("tenant")
+                           for e in doc["traceEvents"]
+                           if e.get("cat") == "device"
+                           and isinstance(e.get("args"), dict)}
+            slo = (c.digest() or {}).get("slo") or {}
+            import jax
+            v_alone = alone["victim"]
+            v_cont = contended["victim"]
+            b_cont = contended["bully"]
+            cap_ops = BULLY_LIM_FRAC * CAPACITY * c.n_osds
+            return {
+                "metric": "tenant_isolation",
+                "backend": jax.default_backend(),
+                "duration_s": duration,
+                "victim_alone": v_alone,
+                "victim_contended": v_cont,
+                "bully_contended": b_cont,
+                "isolation_p99_ratio": round(
+                    v_cont["p99_ms"]
+                    / max(1e-9, v_alone["p99_ms"]), 3),
+                "bully_ops_s": b_cont["ops_s"],
+                "bully_cap_ops_s": cap_ops,
+                "bully_cap_frac": round(
+                    b_cont["ops_s"] / max(1e-9, cap_ops), 3),
+                "slo_tenants": sorted(slo),
+                "trace_schema_errors": schema_errors[:5],
+                "trace_op_tenants": sorted(
+                    t for t in op_tenants if t),
+                "trace_device_tenants": sorted(
+                    t for t in dev_tenants if t),
+            }
+        finally:
+            await c.stop()
+
+    return asyncio.run(asyncio.wait_for(run(), 600))
+
+
+def _gate_traffic(rec: dict) -> dict:
+    """Tenant-isolation regression gate: the bully must be capped at
+    (about) its dmClock limit, the victim must complete real traffic
+    under the flood, the exported trace must schema-validate with
+    tenant attribution on op spans and device tickets, and the
+    victim's contended p99 must not regress past 2x the published
+    same-backend figure (p99 on a loaded CPU CI is jittery; the
+    repo's duration gates use 3x for the same reason)."""
+    failures = []
+    if rec.get("victim_contended", {}).get("n", 0) < 20:
+        failures.append("victim completed almost no ops under the"
+                        " bully flood")
+    if rec.get("victim_contended", {}).get("errors"):
+        failures.append("victim ops errored under the flood (%d)"
+                        % rec["victim_contended"]["errors"])
+    if rec.get("bully_cap_frac", 0.0) > 1.35:
+        failures.append(
+            "bully NOT limit-capped: %.0f ops/s vs cap %.0f"
+            % (rec.get("bully_ops_s", 0),
+               rec.get("bully_cap_ops_s", 0)))
+    if rec.get("trace_schema_errors"):
+        failures.append("exported trace failed schema validation:"
+                        " %r" % rec["trace_schema_errors"][:2])
+    if not set(rec.get("trace_op_tenants") or ()) \
+            & {"victim", "bully"}:
+        failures.append("exported op spans carry no tenant"
+                        " attribution")
+    if not rec.get("trace_device_tenants"):
+        failures.append("exported device tickets carry no tenant"
+                        " attribution")
+    import os
+    published = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            published = (json.load(f).get("published") or {}) \
+                .get("traffic_plane") or {}
+    except Exception:
+        published = {}
+    prev = (published.get("victim_contended") or {}).get("p99_ms")
+    if prev and published.get("backend") == rec.get("backend"):
+        cur = rec.get("victim_contended", {}).get("p99_ms", 0.0)
+        if cur > 2.0 * float(prev):
+            failures.append(
+                "victim contended p99 %.1fms regressed past 2x"
+                " the published %.1fms" % (cur, float(prev)))
+    return {"ok": not failures, "failures": failures}
+
+
+def _publish_traffic(rec: dict) -> None:
+    """Fold the tenant-isolation figures into BASELINE.json's
+    published map (backend recorded so the gate compares like with
+    like).  A failed gate publishes nothing."""
+    import os
+    if not rec.get("gate", {}).get("ok"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})["traffic_plane"] = {
+            "victim_alone": rec["victim_alone"],
+            "victim_contended": rec["victim_contended"],
+            "bully_contended": rec["bully_contended"],
+            "isolation_p99_ratio": rec["isolation_p99_ratio"],
+            "bully_ops_s": rec["bully_ops_s"],
+            "bully_cap_ops_s": rec["bully_cap_ops_s"],
+            "backend": rec["backend"],
+            "source": "bench.py --traffic",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+
+
 def _gate_trace(rec: dict) -> dict:
     """Flight-recorder regression gate: the recorder must cost <= 5%
     on the EC backend leg, must have actually recorded device spans
@@ -1464,6 +1642,19 @@ def _publish_scale(rec: dict) -> None:
 
 
 def main() -> None:
+    if "--traffic" in sys.argv:
+        _maybe_simulate_mesh()
+        rec = bench_traffic()
+        rec["gate"] = _gate_traffic(rec)
+        _publish_traffic(rec)
+        print(json.dumps(rec))
+        if not rec["gate"]["ok"]:
+            # the tenant-isolation figures are guarded artifacts: an
+            # uncapped bully, a victim p99 regression past the
+            # published figure, or a trace without tenant
+            # attribution is a CI failure, not a quieter JSON
+            sys.exit(1)
+        return
     if "--trace" in sys.argv:
         _maybe_simulate_mesh()
         rec = bench_trace()

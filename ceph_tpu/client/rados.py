@@ -45,10 +45,11 @@ class ObjectNotFound(RadosError):
 class _InFlight:
     __slots__ = ("tid", "pool", "oid", "ops", "future", "target",
                  "pgid", "acting", "snapc", "snapid", "backoff",
-                 "next_resend", "first_sent", "trace", "top")
+                 "next_resend", "first_sent", "trace", "top",
+                 "tenant")
 
     def __init__(self, tid, pool, oid, ops, future, snapc=None,
-                 snapid=None):
+                 snapid=None, tenant=None):
         self.tid = tid
         self.pool = pool
         self.oid = oid
@@ -64,6 +65,7 @@ class _InFlight:
         self.first_sent = 0.0
         self.trace = None       # cross-daemon span id (reqid_t role)
         self.top = None         # TrackedOp in the client's OpTracker
+        self.tenant = tenant    # tenant key stamped on every send
 
 
 class RadosClient:
@@ -151,11 +153,12 @@ class RadosClient:
         await self.msgr.shutdown()
         self._resend_task = None
 
-    def io_ctx(self, pool_name: str) -> "IoCtx":
+    def io_ctx(self, pool_name: str,
+               tenant: str | None = None) -> "IoCtx":
         for pid, pool in (self.osdmap.pools if self.osdmap else {}) \
                 .items():
             if pool.name == pool_name:
-                return IoCtx(self, pid)
+                return IoCtx(self, pid, tenant=tenant)
         raise ValueError("no pool %r" % pool_name)
 
     # -- dispatch ----------------------------------------------------------
@@ -315,17 +318,18 @@ class RadosClient:
         return actingp, pgid, acting
 
     def submit_op(self, pool_id: int, oid: str, ops: list[dict],
-                  snapc=None, snapid=None) -> asyncio.Future:
+                  snapc=None, snapid=None,
+                  tenant: str | None = None) -> asyncio.Future:
         self._tid += 1
         fut = asyncio.get_running_loop().create_future()
         op = _InFlight(self._tid, pool_id, oid, ops, fut,
-                       snapc=snapc, snapid=snapid)
+                       snapc=snapc, snapid=snapid, tenant=tenant)
         op.trace = "%s:%d" % (self.msgr.entity, self._tid)
         op.top = self.optracker.create(
             "client_op(tid=%d pool=%d %s [%s])"
             % (self._tid, pool_id, oid,
                ",".join(o.get("op", "?") for o in ops)),
-            trace=op.trace)
+            trace=op.trace, tenant=tenant)
         self._inflight[self._tid] = op
         self._send_op(op)
         return fut
@@ -388,6 +392,7 @@ class RadosClient:
             snapc=op.snapc, snapid=op.snapid, ops=op.ops,
             epoch=self.osdmap.epoch, flags=0)
         m.trace = op.trace
+        m.tenant = op.tenant    # rides the envelope into every layer
         if op.top is not None:
             op.top.mark_event("sent_osd.%d" % primary)
         self.msgr.send_to(addr, m, entity_hint="osd.%d" % primary)
@@ -513,9 +518,14 @@ class IoCtx:
     selfmanaged one set via set_selfmanaged_snapc; reads honor
     set_read_snap (IoCtx::snap_set_read)."""
 
-    def __init__(self, client: RadosClient, pool_id: int):
+    def __init__(self, client: RadosClient, pool_id: int,
+                 tenant: str | None = None):
         self.client = client
         self.pool_id = pool_id
+        # tenant key stamped on this handle's data-path ops: rides
+        # the MOSDOp envelope into the OSD's tag books, the device
+        # admission tickets, and the flight recorder's spans
+        self.tenant = tenant
         self.read_snap: int | None = None    # snapid reads resolve at
         self.selfmanaged_snapc: tuple[int, list[int]] | None = None
 
@@ -596,33 +606,35 @@ class IoCtx:
                     offset: int = 0) -> None:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "write", "offset": offset, "data": bytes(data)}],
-            snapc=self._snapc())
+            snapc=self._snapc(), tenant=self.tenant)
 
     async def write_full(self, oid: str, data: bytes) -> None:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "writefull", "data": bytes(data)}],
-            snapc=self._snapc())
+            snapc=self._snapc(), tenant=self.tenant)
 
     async def read(self, oid: str, length: int = 0,
                    offset: int = 0) -> bytes:
         outs = await self.client.submit_op(self.pool_id, oid, [
             {"op": "read", "offset": offset, "length": length}],
-            snapid=self.read_snap)
+            snapid=self.read_snap, tenant=self.tenant)
         return outs[0]["data"]
 
     async def stat(self, oid: str) -> int:
         outs = await self.client.submit_op(self.pool_id, oid, [
-            {"op": "stat"}], snapid=self.read_snap)
+            {"op": "stat"}], snapid=self.read_snap,
+            tenant=self.tenant)
         return outs[0]["size"]
 
     async def remove(self, oid: str) -> None:
         await self.client.submit_op(self.pool_id, oid, [
-            {"op": "delete"}], snapc=self._snapc())
+            {"op": "delete"}], snapc=self._snapc(),
+            tenant=self.tenant)
 
     async def truncate(self, oid: str, length: int) -> None:
         await self.client.submit_op(self.pool_id, oid, [
             {"op": "truncate", "length": int(length)}],
-            snapc=self._snapc())
+            snapc=self._snapc(), tenant=self.tenant)
 
     async def exec(self, oid: str, cls: str, method: str,
                    inp: dict | None = None) -> dict:

@@ -96,19 +96,24 @@ class DispatchTicket:
     -> t_launch (dispatch handed to the device) -> t_done.  queue_wait
     and device_s are the two stages the exporter and the OpTracker
     attribute separately.  `chip` names the mesh chip the dispatch ran
-    on (the exporter's chip label)."""
+    on (the exporter's chip label).  `tenant` attributes the dispatch
+    to the tenant whose ops it carried — the single tenant when every
+    batched item agreed, the literal "mixed" when a flush batched
+    several tenants' stripes, None for tenant-less work (recovery,
+    scrub, mapping)."""
 
     __slots__ = ("seq", "klass", "bucket", "nbytes", "chip",
                  "t_enqueue", "t_admit", "t_launch", "t_done", "ok",
-                 "error")
+                 "error", "tenant")
 
     def __init__(self, seq: int, klass: str, bucket: int, nbytes: int,
-                 chip: int = 0):
+                 chip: int = 0, tenant: str | None = None):
         self.seq = seq
         self.klass = klass
         self.bucket = bucket
         self.nbytes = nbytes
         self.chip = chip
+        self.tenant = tenant
         self.t_enqueue = time.monotonic()
         self.t_admit = 0.0
         self.t_launch = 0.0
@@ -131,7 +136,8 @@ class DispatchTicket:
     def dump(self) -> dict:
         return {"seq": self.seq, "klass": self.klass,
                 "bucket": self.bucket, "bytes": self.nbytes,
-                "chip": self.chip, "queue_wait": self.queue_wait,
+                "chip": self.chip, "tenant": self.tenant,
+                "queue_wait": self.queue_wait,
                 "device_s": self.device_s, "ok": self.ok,
                 "error": self.error}
 
@@ -380,10 +386,10 @@ class ChipRuntime:
 
     # -- tickets -----------------------------------------------------------
 
-    def open_ticket(self, klass: str, bucket: int,
-                    nbytes: int) -> DispatchTicket:
+    def open_ticket(self, klass: str, bucket: int, nbytes: int,
+                    tenant: str | None = None) -> DispatchTicket:
         return DispatchTicket(self.rt.next_seq(), klass, bucket,
-                              nbytes, chip=self.index)
+                              nbytes, chip=self.index, tenant=tenant)
 
     async def admit(self, ticket: DispatchTicket,
                     cost: float | None = None) -> None:

@@ -142,8 +142,9 @@ class ErasureCode(ErasureCodeInterface):
 
     async def encode_async(self, want_to_encode: set[int],
                            data: bytes, klass: str | None = None,
-                           on_ticket=None,
-                           chip: int | None = None) -> dict[int, bytes]:
+                           on_ticket=None, chip: int | None = None,
+                           tenant: str | None = None
+                           ) -> dict[int, bytes]:
         """encode() with the GF matmul batched onto the device across
         concurrent callers (ECBackend's hot call,
         src/osd/ECTransaction.cc:56 -> encode_chunks).  Falls back to
@@ -170,7 +171,7 @@ class ErasureCode(ErasureCodeInterface):
             for i in range(self.get_data_chunk_count())])
         parity = await DeviceBatcher.get().encode(
             matrix, w, arr, klass=klass or K_CLIENT_EC,
-            on_ticket=on_ticket, chip=chip)
+            on_ticket=on_ticket, chip=chip, tenant=tenant)
         out = dict(prepared)
         for i in range(len(matrix)):
             out[self.chunk_index(
@@ -222,8 +223,9 @@ class ErasureCode(ErasureCodeInterface):
 
     async def delta_async(self, deltas: Mapping[int, bytes],
                           klass: str | None = None,
-                          on_ticket=None,
-                          chip: int | None = None) -> dict[int, bytes]:
+                          on_ticket=None, chip: int | None = None,
+                          tenant: str | None = None
+                          ) -> dict[int, bytes]:
         """`parity_delta` with the GF products batched onto the device
         (the OSD partial-write hot call, osd/ecbackend.py
         `_try_delta_write`): concurrent small overwrites across
@@ -269,7 +271,7 @@ class ErasureCode(ErasureCodeInterface):
                                         dtype=self._word_dtype(w))
         parity = await DeviceBatcher.get().encode(
             matrix, w, arr, klass=klass or K_CLIENT_EC,
-            on_ticket=on_ticket, chip=chip)
+            on_ticket=on_ticket, chip=chip, tenant=tenant)
         return {i: parity[i].tobytes() for i in range(len(matrix))}
 
     async def decode_async(self, want_to_read: set[int],

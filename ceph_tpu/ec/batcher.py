@@ -90,14 +90,27 @@ def host_encode(matrix, w: int, data: np.ndarray) -> np.ndarray:
 
 
 class _PendingBatch:
-    __slots__ = ("arrays", "futures", "tickets", "n_words", "timer")
+    __slots__ = ("arrays", "futures", "tickets", "tenants", "n_words",
+                 "timer")
 
     def __init__(self):
         self.arrays: list[np.ndarray] = []   # each [k, n_i] words
         self.futures: list[asyncio.Future] = []
         self.tickets: list = []              # per-item on_ticket cbs
+        self.tenants: list = []              # per-item tenant keys
         self.n_words = 0
         self.timer = None
+
+    def tenant_label(self) -> str | None:
+        """The flush's tenant attribution: the one tenant every item
+        agreed on, "mixed" when several tenants' stripes batched into
+        this dispatch, None for tenant-less work."""
+        distinct = {t for t in self.tenants if t is not None}
+        if not distinct:
+            return None
+        if len(distinct) == 1:
+            return next(iter(distinct))
+        return "mixed"
 
 
 class DeviceBatcher:
@@ -166,8 +179,8 @@ class DeviceBatcher:
 
     async def encode(self, matrix: list[list[int]], w: int,
                      data: np.ndarray, klass: str = K_CLIENT_EC,
-                     on_ticket=None,
-                     chip: int | None = None) -> np.ndarray:
+                     on_ticket=None, chip: int | None = None,
+                     tenant: str | None = None) -> np.ndarray:
         """data [k, n] words -> [m, n] parity words, batched with any
         concurrent callers using the same (matrix, w, klass, chip).
 
@@ -192,6 +205,7 @@ class DeviceBatcher:
         pb.arrays.append(np.ascontiguousarray(data))
         pb.futures.append(fut)
         pb.tickets.append(on_ticket)
+        pb.tenants.append(tenant)
         pb.n_words += data.shape[1]
         word_bytes = _WORD_DTYPE[int(w)]().itemsize
         if (pb.n_words * data.shape[0] * word_bytes
@@ -224,13 +238,15 @@ class DeviceBatcher:
         if target is not None and target.available:
             t0 = time.perf_counter()
             plan = rt.shard_plan(target, n)
+            tenant = pb.tenant_label()
             if len(plan) == 1:
                 out, ticket = await self._encode_shard(
                     target, matrix_key, int(w), klass, pb.arrays, n,
-                    solo=True)
+                    solo=True, tenant=tenant)
             else:
                 out, ticket = await self._encode_sharded(
-                    rt, plan, matrix_key, int(w), klass, pb.arrays)
+                    rt, plan, matrix_key, int(w), klass, pb.arrays,
+                    tenant=tenant)
             if out is not None:
                 dt = time.perf_counter() - t0
                 self.last_flush_s = dt
@@ -277,7 +293,8 @@ class DeviceBatcher:
 
     async def _encode_shard(self, chip, matrix_key, w: int,
                             klass: str, parts: list[np.ndarray],
-                            n: int, solo: bool):
+                            n: int, solo: bool,
+                            tenant: str | None = None):
         """One chip's slice of a flush: admit on the chip's queue,
         stage the ragged total into its pooled bucket-ladder buffers,
         dispatch on its device.  Returns (parity [m, n], ticket).
@@ -304,7 +321,8 @@ class DeviceBatcher:
         plan = chip.rt.ragged_plan(n)
         padded = sum(seg for _lo, seg in plan)
         ticket = chip.open_ticket(klass, padded,
-                                  n * k * dtype().itemsize)
+                                  n * k * dtype().itemsize,
+                                  tenant=tenant)
         try:
             await chip.admit(ticket)
         except DeviceBusy:
@@ -369,7 +387,8 @@ class DeviceBatcher:
         return host_encode([list(r) for r in matrix_key], w, flat)
 
     async def _encode_sharded(self, rt, plan, matrix_key, w: int,
-                              klass: str, arrays: list[np.ndarray]):
+                              klass: str, arrays: list[np.ndarray],
+                              tenant: str | None = None):
         """Mesh-shard one oversized flush across the plan's chips:
         contiguous column slices encode concurrently (proven
         collective-free over the stripe axis) and reassemble
@@ -379,7 +398,8 @@ class DeviceBatcher:
         self.sharded_flushes += 1
         parts = await asyncio.gather(*[
             self._encode_shard(chip, matrix_key, w, klass,
-                               [flat[:, lo:hi]], hi - lo, solo=False)
+                               [flat[:, lo:hi]], hi - lo, solo=False,
+                               tenant=tenant)
             for chip, lo, hi in plan])
         out = np.concatenate([p for p, _t in parts], axis=1)
         ticket = next((t for _p, t in parts if t is not None), None)

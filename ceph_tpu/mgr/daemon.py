@@ -58,6 +58,11 @@ class Manager:
         self.stats_period = float(
             self.ctx.conf.get("mgr_stats_period", 1.0))
         self.digests_sent = 0
+        # tenant SLO plane: multi-window burn-rate engine over the
+        # per-tenant stage histograms the OSDs report; its verdicts
+        # ride the digest into the mon's SLO_LATENCY/SLO_BURN checks
+        from .slo import SLOEngine
+        self.slo = SLOEngine(self.ctx)
         self.exporter = PrometheusExporter(self.ctx)
         # cluster-log handle: mgr events ride the same
         # LogClient -> MLog -> LogMonitor pipeline as OSD events
@@ -176,6 +181,7 @@ class Manager:
         exp.add_renderer(self._render_reports)
         exp.add_renderer(self._render_pgmap)
         exp.add_renderer(self._render_event_plane)
+        exp.add_renderer(self._render_tenants)
 
     def _total_slow_ops(self) -> int:
         """Cluster-wide slow-op count aggregated from the per-daemon
@@ -312,6 +318,78 @@ class Manager:
                                     int(sf.get(key) or 0)))
         return lines
 
+    def _tenant_rows(self, now: float) -> dict[str, dict]:
+        """Cluster-aggregate per-tenant counters from the live daemon
+        reports, with label cardinality CAPPED at `tenant_label_max`:
+        the busiest tenants keep their own rows, the tail folds into
+        "other" — a tenant-id flood can never blow up the exporter's
+        (or the digest's) label space."""
+        agg: dict[str, dict] = {}
+        for row in self.pgmap.live_osd_stats(now).values():
+            for tenant, trow in (row.get("tenants") or {}).items():
+                a = agg.setdefault(tenant, {
+                    "ops": 0, "errors": 0, "total_hist": [0] * 32})
+                a["ops"] += int(trow.get("ops") or 0)
+                a["errors"] += int(trow.get("errors") or 0)
+                th = (trow.get("stages") or {}).get("total")
+                for i, v in enumerate((th or [])[:32]):
+                    a["total_hist"][i] += int(v)
+        cap = max(1, int(self.ctx.conf.get("tenant_label_max", 32)))
+        if len(agg) <= cap:
+            return agg
+        keep = sorted(agg, key=lambda t: (-agg[t]["ops"], t))[:cap - 1]
+        out = {t: agg[t] for t in keep}
+        other = out.setdefault("other", {
+            "ops": 0, "errors": 0, "total_hist": [0] * 32})
+        for t, a in agg.items():
+            if t in keep:
+                continue
+            other["ops"] += a["ops"]
+            other["errors"] += a["errors"]
+            for i, v in enumerate(a["total_hist"]):
+                other["total_hist"][i] += v
+        return out
+
+    def _render_tenants(self) -> list[str]:
+        """Tenant-labeled families (cardinality-capped): per-tenant
+        op/error totals, the end-to-end latency histogram, and the
+        SLO engine's burn figures — the scrape surface of the tenant
+        SLO plane."""
+        import asyncio as _aio
+
+        from ..utils.exporter import hist_lines
+        now = _aio.get_event_loop().time()
+        rows = self._tenant_rows(now)
+        if not rows:
+            return []
+        lines: list[str] = []
+        for fam, key in (("ceph_tpu_tenant_ops_total", "ops"),
+                         ("ceph_tpu_tenant_errors_total", "errors")):
+            lines.append("# TYPE %s counter" % fam)
+            for t in sorted(rows):
+                lines.append('%s{tenant="%s"} %d'
+                             % (fam, t, rows[t][key]))
+        typed: set[str] = set()
+        for t in sorted(rows):
+            lines.extend(hist_lines("ceph_tpu_tenant_op_seconds",
+                                    rows[t]["total_hist"],
+                                    labels='tenant="%s"' % t,
+                                    typed=typed))
+        slo = self.slo.evaluate(now)
+        for fam, key in (("ceph_tpu_tenant_slo_burn_fast",
+                          "burn_fast"),
+                         ("ceph_tpu_tenant_slo_burn_slow",
+                          "burn_slow"),
+                         ("ceph_tpu_tenant_p99_ms", "p99_ms")):
+            lines.append("# TYPE %s gauge" % fam)
+            for t in sorted(slo):
+                if t not in rows:
+                    continue    # capped out of the label space
+                v = slo[t].get(key)
+                if v is not None:
+                    lines.append('%s{tenant="%s"} %g' % (fam, t, v))
+        return lines
+
     # -- stats loop (PGMap digest -> monitors) -----------------------------
 
     async def _stats_loop(self) -> None:
@@ -326,6 +404,13 @@ class Manager:
             now = asyncio.get_event_loop().time()
             try:
                 digest = self.pgmap.digest(now, self.osdmap)
+                # tenant SLO plane: ingest this tick's cumulative
+                # tenant rows, evaluate the burn windows, and ship
+                # the verdicts in the digest (the mon commits the
+                # raise/clear edges through paxos)
+                self.slo.ingest(now,
+                                self.pgmap.live_osd_stats(now))
+                digest["slo"] = self.slo.evaluate(now)
             except Exception as e:
                 self.ctx.log.info("mgr", "digest failed: %r" % e)
                 continue

@@ -132,6 +132,10 @@ class Monitor:
         # a freshly elected leader reports SLOW_OPS / DEVICE_FALLBACK
         # immediately instead of waiting one beacon round (PR-2 gap)
         self.osd_slow_ops: dict[int, tuple[int, float]] = {}
+        # osd -> ({tenant: slow count}, monotonic stamp): the
+        # per-tenant slice of the slow counts (SLOW_OPS detail names
+        # the worst tenant from it)
+        self.osd_slow_tenants: dict[int, tuple[dict, float]] = {}
         # osd -> (device_fallback flag, monotonic stamp)
         self.osd_device_fallback: dict[int, tuple[int, float]] = {}
         # latest PGMap digest from the mgr (MMonMgrDigest): soft state
@@ -578,6 +582,14 @@ class Monitor:
                     damaged_pgs=int(
                         self.mgr_digest.get("inconsistent_pgs")
                         or 0))
+                # tenant SLO edges: commit the violating-tenant sets
+                # so SLO_LATENCY/SLO_BURN survive a leader change
+                slo = self.mgr_digest.get("slo") or {}
+                self.health_mon.maybe_commit_slo(
+                    [t for t, v in slo.items()
+                     if v.get("latency_violation")],
+                    [t for t, v in slo.items()
+                     if v.get("burn_alert")])
             return True
         if isinstance(msg, MOSDBeacon):
             # beacons are derived soft state: EVERY mon records them,
@@ -595,6 +607,10 @@ class Monitor:
             if flb:
                 flb = 1 + int(getattr(msg, "device_chip", 0) or 0)
             self.osd_slow_ops[msg.osd] = (slow, now)
+            # per-tenant slice (SLOW_OPS worst-tenant detail); soft
+            # state only — the committed count covers fresh leaders
+            self.osd_slow_tenants[msg.osd] = (
+                dict(getattr(msg, "slow_tenants", None) or {}), now)
             self.osd_device_fallback[msg.osd] = (flb, now)
             if self.is_leader() and \
                     (not self.multi or self.mpaxos.active):

@@ -221,10 +221,14 @@ class HealthMonitor:
         #                              "pgdmg": n inconsistent pgs}
         # devflb values are chip-encoded (0 = on-device, 1+chip =
         # that mesh chip lost) so the health detail can name the
-        # degraded chip even on a freshly elected leader
+        # degraded chip even on a freshly elected leader.  slolat /
+        # sloburn keep the VIOLATING TENANT NAMES committed (sorted
+        # lists), so a fresh leader's SLO_LATENCY / SLO_BURN detail
+        # still names them before any mgr digest reaches it.
         self.persisted: dict = {"slow": {}, "devflb": {},
                                 "pgdeg": 0, "pgavail": 0,
-                                "scruberr": 0, "pgdmg": 0}
+                                "scruberr": 0, "pgdmg": 0,
+                                "slolat": [], "sloburn": []}
 
     # -- persistence / replay ------------------------------------------
 
@@ -241,7 +245,11 @@ class HealthMonitor:
                 "pgdeg": int(d.get("pgdeg") or 0),
                 "pgavail": int(d.get("pgavail") or 0),
                 "scruberr": int(d.get("scruberr") or 0),
-                "pgdmg": int(d.get("pgdmg") or 0)}
+                "pgdmg": int(d.get("pgdmg") or 0),
+                "slolat": sorted(str(t)
+                                 for t in (d.get("slolat") or [])),
+                "sloburn": sorted(str(t)
+                                  for t in (d.get("sloburn") or []))}
 
     def apply(self, ops: list, tx) -> None:
         """Deterministic commit apply (every mon runs this)."""
@@ -260,13 +268,18 @@ class HealthMonitor:
                     self.persisted["devflb"].pop(int(osd), None)
             elif op[0] in ("pgdeg", "pgavail", "scruberr", "pgdmg"):
                 self.persisted[op[0]] = int(op[1])
+            elif op[0] in ("slolat", "sloburn"):
+                self.persisted[op[0]] = sorted(
+                    str(t) for t in (op[1] or []))
         tx.set(HEALTH_KEY, denc.encode(
             {"slow": dict(self.persisted["slow"]),
              "devflb": dict(self.persisted["devflb"]),
              "pgdeg": int(self.persisted["pgdeg"]),
              "pgavail": int(self.persisted["pgavail"]),
              "scruberr": int(self.persisted["scruberr"]),
-             "pgdmg": int(self.persisted["pgdmg"])}))
+             "pgdmg": int(self.persisted["pgdmg"]),
+             "slolat": list(self.persisted["slolat"]),
+             "sloburn": list(self.persisted["sloburn"])}))
 
     def maybe_commit(self, osd: int, slow: int, devflb: int) -> None:
         """Leader-side: stage a health svc op when a beacon changes
@@ -357,6 +370,45 @@ class HealthMonitor:
                     self.mon.log_mon.append(
                         "INF", "Health check cleared: %s" % check)
 
+    def maybe_commit_slo(self, lat_tenants: list,
+                         burn_tenants: list) -> None:
+        """Leader-side: persist the SLO-violating tenant SETS from
+        the mgr digest through paxos — edges only (a steady violation
+        burns no paxos rounds; the list commits when it CHANGES), so
+        a freshly elected leader raises SLO_LATENCY / SLO_BURN with
+        the offending tenants named before any digest reaches it."""
+        pend = self.mon.pending_svc.get("health", [])
+
+        def pending_val(kind):
+            for op in reversed(pend):
+                if op[0] == kind:
+                    return list(op[1])
+            return None
+
+        for kind, val, check in (
+                ("slolat", sorted(set(map(str, lat_tenants))),
+                 "SLO_LATENCY"),
+                ("sloburn", sorted(set(map(str, burn_tenants))),
+                 "SLO_BURN")):
+            cur = pending_val(kind)
+            if cur is None:
+                cur = list(self.persisted[kind])
+            if val == cur:
+                continue
+            self.mon.queue_svc_op("health", (kind, val))
+            if bool(val) != bool(cur):
+                if val:
+                    self.mon.log_mon.append(
+                        "WRN", "Health check failed: tenant(s) %s "
+                        "%s (%s)"
+                        % (",".join(val),
+                           "over latency objective"
+                           if kind == "slolat"
+                           else "burning SLO error budget", check))
+                else:
+                    self.mon.log_mon.append(
+                        "INF", "Health check cleared: %s" % check)
+
     # -- merged beacon views -------------------------------------------
 
     def _merged(self, soft: dict, committed: dict) -> dict:
@@ -426,6 +478,26 @@ class HealthMonitor:
                 "detail": ["osd.%d has %d ops past the complaint "
                            "threshold" % (o, slow[o])
                            for o in slow_daemons[:10]]}
+            # per-tenant attribution (beacon soft state): name the
+            # tenant owning the most slow ops so noisy-neighbor
+            # triage starts from the health line, not a dump crawl
+            import time as _tt
+            tnow = _tt.monotonic()
+            per_tenant: dict[str, int] = {}
+            for osd, (tmap, stamp) in getattr(
+                    self.mon, "osd_slow_tenants", {}).items():
+                if tnow - stamp >= self.SOFT_TTL or osd not in slow:
+                    continue
+                for t, n in (tmap or {}).items():
+                    if t:       # "" = tenant-less ops
+                        per_tenant[t] = per_tenant.get(t, 0) + int(n)
+            if per_tenant:
+                worst = max(sorted(per_tenant),
+                            key=lambda t: per_tenant[t])
+                out["SLOW_OPS"]["worst_tenant"] = worst
+                out["SLOW_OPS"]["detail"].append(
+                    "worst tenant: %s (%d slow ops)"
+                    % (worst, per_tenant[worst]))
         # DEVICE_FALLBACK: a daemon's mesh chip lost the accelerator
         # and is serving EC/mapping from the host paths — degraded
         # throughput, not degraded durability, and scoped to the
@@ -461,6 +533,7 @@ class HealthMonitor:
         dig_stamp = getattr(self.mon, "mgr_digest_stamp", 0.0)
         fresh = (dig is not None
                  and _t.monotonic() - dig_stamp < self.SOFT_TTL)
+        slo_detail: dict[str, dict] = {}
         if fresh:
             totals = dig.get("totals") or {}
             degraded = int(totals.get("degraded") or 0)
@@ -468,12 +541,21 @@ class HealthMonitor:
             inactive = int(dig.get("inactive_pgs") or 0)
             scrub_errors = int(totals.get("scrub_errors") or 0)
             damaged = int(dig.get("inconsistent_pgs") or 0)
+            slo_detail = dig.get("slo") or {}
+            slo_lat = sorted(t for t, v in slo_detail.items()
+                             if v.get("latency_violation"))
+            slo_burn = sorted(t for t, v in slo_detail.items()
+                              if v.get("burn_alert"))
         else:
             degraded = int(self.persisted["pgdeg"])
             unfound = 0
             inactive = int(self.persisted["pgavail"])
             scrub_errors = int(self.persisted["scruberr"])
             damaged = int(self.persisted["pgdmg"])
+            # fresh-leader shape: the committed tenant sets carry the
+            # warning until digests reach this mon
+            slo_lat = list(self.persisted["slolat"])
+            slo_burn = list(self.persisted["sloburn"])
         if degraded or unfound:
             detail = ["%d object copies degraded" % degraded]
             if unfound:
@@ -513,6 +595,40 @@ class HealthMonitor:
                            "`pg repair <pgid>` to rebuild from the "
                            "authoritative copies"
                            % (scrub_errors, damaged)]}
+        # SLO_LATENCY / SLO_BURN (the tenant SLO plane, mgr/slo.py):
+        # a tenant's windowed p99 over its latency objective raises
+        # SLO_LATENCY; a sustained multi-window burn of its error
+        # budget raises SLO_BURN.  A fresh digest carries the live
+        # verdicts; the paxos-committed tenant sets fill in for a
+        # freshly elected leader.
+        if slo_lat:
+            out["SLO_LATENCY"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "%d tenant(s) over latency objective: %s"
+                           % (len(slo_lat), slo_lat[:10]),
+                "tenants": slo_lat,
+                "detail": [
+                    "tenant %s p99 %.1fms over target %.1fms"
+                    % (t, (slo_detail.get(t) or {}).get("p99_ms", 0),
+                       (slo_detail.get(t) or {}).get("target_ms", 0))
+                    if t in slo_detail
+                    else "tenant %s over latency objective "
+                         "(committed edge)" % t
+                    for t in slo_lat[:10]]}
+        if slo_burn:
+            out["SLO_BURN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "%d tenant(s) burning SLO error budget:"
+                           " %s" % (len(slo_burn), slo_burn[:10]),
+                "tenants": slo_burn,
+                "detail": [
+                    "tenant %s burn rates fast=%s slow=%s"
+                    % (t, (slo_detail.get(t) or {}).get("burn_fast"),
+                       (slo_detail.get(t) or {}).get("burn_slow"))
+                    if t in slo_detail
+                    else "tenant %s burning error budget "
+                         "(committed edge)" % t
+                    for t in slo_burn[:10]]}
         # RECENT_CRASH (the crash module's health check): any
         # un-archived crash report newer than mon_crash_warn_age.
         # The crash table is itself paxos-committed, so a freshly

@@ -51,6 +51,12 @@ class Message:
         # this message into a cross-daemon op timeline; propagated in
         # the envelope, never interpreted by the transport
         self.trace = None
+        # optional tenant key: the client stamps it on ops (and the
+        # primary re-stamps sub-ops) so every layer — op tracking,
+        # the mClock tag books, device admission, the flight
+        # recorder — can attribute the work to a tenant.  Rides the
+        # envelope like `trace`; never interpreted by the transport
+        self.tenant = None
 
     def to_wire(self) -> dict:
         return {f: getattr(self, f) for f in self.FIELDS}
@@ -75,19 +81,23 @@ MSG_STRUCT_COMPAT = 1
 
 
 def encode_message(msg: Message, stamp: float | None = None) -> bytes:
-    # the trace id rides as a 5th envelope element and the sender's
-    # monotonic send stamp as a 6th: old decoders slice row[:4] and
-    # ignore both, so no compat bump is needed.  Untraced, unstamped
-    # messages keep the exact 4-element envelope (byte-stable for the
-    # pinned dencoder corpus); the messenger passes `stamp` on live
-    # frames so receivers can estimate per-peer clock offsets (the
-    # multi-host span-merge prerequisite).
+    # the trace id rides as a 5th envelope element, the sender's
+    # monotonic send stamp as a 6th, and the tenant key as a 7th: old
+    # decoders slice row[:4] and ignore the tail, so no compat bump
+    # is needed.  Untraced, unstamped, untenanted messages keep the
+    # exact 4-element envelope (byte-stable for the pinned dencoder
+    # corpus); the messenger passes `stamp` on live frames so
+    # receivers can estimate per-peer clock offsets (the multi-host
+    # span-merge prerequisite).
     row = [msg.TYPE, msg.seq, msg.src, msg.to_wire()]
     trace = getattr(msg, "trace", None)
-    if trace is not None or stamp is not None:
+    tenant = getattr(msg, "tenant", None)
+    if trace is not None or stamp is not None or tenant is not None:
         row.append(trace)
-    if stamp is not None:
+    if stamp is not None or tenant is not None:
         row.append(stamp)
+    if tenant is not None:
+        row.append(tenant)
     return denc.encode_versioned(row, MSG_STRUCT_V, MSG_STRUCT_COMPAT)
 
 
@@ -103,6 +113,7 @@ class UnknownMessage(Message):
 def decode_message(data: bytes | memoryview) -> Message:
     trace = None
     stamp = None
+    tenant = None
     if bytes(data[:1]) == b"V":
         _v, row = denc.decode_versioned(data, MSG_STRUCT_V)
         mtype, seq, src, fields = row[:4]
@@ -110,6 +121,8 @@ def decode_message(data: bytes | memoryview) -> Message:
             trace = row[4]
         if len(row) > 5:
             stamp = row[5]
+        if len(row) > 6:
+            tenant = row[6]
     else:                               # legacy unversioned frame
         mtype, seq, src, fields = denc.decode(data)
     cls = _REGISTRY.get(mtype)
@@ -121,4 +134,5 @@ def decode_message(data: bytes | memoryview) -> Message:
     msg.src = src
     msg.trace = trace
     msg.send_stamp = stamp
+    msg.tenant = tenant
     return msg

@@ -104,11 +104,15 @@ class MOSDBeacon(Message):
     daemon's mesh chip is serving from the host paths and device_chip
     names that chip (the mon raises DEVICE_FALLBACK while any live
     daemon reports it, with the chip in the health detail — only the
-    OSDs bound to a lost chip degrade)."""
+    OSDs bound to a lost chip degrade).  slow_tenants is the
+    per-tenant slice of slow_ops ({tenant: count}; tenant-less ops
+    fold under "") so the SLOW_OPS health detail can name the worst
+    tenant; legacy beacons without it read as no tenant attribution.
+    """
 
     TYPE = "osd_beacon"
-    FIELDS = ("osd", "epoch", "slow_ops", "device_fallback",
-              "device_chip")
+    FIELDS = ("osd", "epoch", "slow_ops", "slow_tenants",
+              "device_fallback", "device_chip")
 
 
 @register

@@ -152,7 +152,18 @@ class OSD:
         # to derive workload-aware device warmup buckets (bucket i
         # counts writes of [2^i, 2^(i+1)) payload bytes)
         self.op_size_hist: list[int] = [0] * 32
-        # sharded mClock op queue (ShardedOpWQ + mClockScheduler)
+        # tenant SLO plane: per-tenant stage histograms (pow2 µs
+        # buckets, cumulative — the same shape as the perf hists) and
+        # good/bad op counters, shipped in MMgrReport osd_stats so the
+        # mgr's SLO engine can evaluate per-tenant burn rates.
+        # Cardinality is conf-bounded (`tenant_tracking_max`):
+        # overflow tenants fold into the "other" bucket rather than
+        # growing the report without bound.
+        self.tenant_stages: dict[str, dict[str, list[int]]] = {}
+        self.tenant_ops: dict[str, dict[str, int]] = {}
+        self.optracker.on_retire = self._note_op_retired
+        # sharded mClock op queue (ShardedOpWQ + mClockScheduler);
+        # tenant-stamped client ops run under per-tenant RWL tag books
         self.sched = OpScheduler(self.ctx)
         self.sched.on_wait = self._note_queue_wait
         # epoch-0 empty map is the universal incremental base
@@ -320,10 +331,66 @@ class OSD:
 
     # -- observability helpers ---------------------------------------------
 
-    def _note_queue_wait(self, klass: str, seconds: float) -> None:
+    def _note_queue_wait(self, klass: str, seconds: float,
+                         tenant: str | None = None) -> None:
         from .scheduler import K_CLIENT
         if klass == K_CLIENT:
             self.perf.hist_sample("op_queue_wait", seconds)
+            if tenant is not None:
+                self.note_tenant_stage(tenant, "queue_wait", seconds)
+
+    # -- tenant SLO accounting ---------------------------------------------
+
+    def _tenant_key(self, tenant: str) -> str:
+        """Bound tenant-label cardinality: past `tenant_tracking_max`
+        distinct tenants, new ones fold into "other" (known tenants
+        keep their own rows)."""
+        if tenant in self.tenant_stages or tenant in self.tenant_ops:
+            return tenant
+        cap = int(self.ctx.conf.get("tenant_tracking_max", 64))
+        known = set(self.tenant_stages) | set(self.tenant_ops)
+        if len(known - {"other"}) >= cap:
+            return "other"
+        return tenant
+
+    def note_tenant_stage(self, tenant: str, stage: str,
+                          seconds: float) -> None:
+        """One stage-latency sample for one tenant (pow2 µs buckets,
+        cumulative — the per-tenant mirror of the op_* perf hists the
+        SLO engine derives window deltas from)."""
+        key = self._tenant_key(tenant)
+        hist = self.tenant_stages.setdefault(key, {}).setdefault(
+            stage, [0] * 32)
+        us = max(1, int(seconds * 1e6))
+        i = min(len(hist) - 1, max(0, us.bit_length() - 1))
+        hist[i] += 1
+
+    def note_tenant_op(self, tenant: str, ok: bool) -> None:
+        key = self._tenant_key(tenant)
+        row = self.tenant_ops.setdefault(key, {"ops": 0, "errors": 0})
+        row["ops"] += 1
+        if not ok:
+            row["errors"] += 1
+
+    # final events that count as availability failures for the
+    # tenant's error budget (an errored reply; parked/dropped ops are
+    # re-sent by the client and complete under a later record)
+    _BAD_FINISH = frozenset({"error_reply", "ec_error_reply",
+                             "no_such_pool"})
+
+    def _note_op_retired(self, op) -> None:
+        """OpTracker retire hook: end-to-end latency + availability
+        accounting for tenant-stamped PRIMARY client ops (sub-ops are
+        stages of the primary's sample, not ops of their own)."""
+        if op.tenant is None or not op.desc.startswith("osd_op("):
+            return
+        final = op.events[-1][1]
+        if final in ("dropped_not_primary", "dropped_pool_deleted",
+                     "dropped_interval_change",
+                     "dropped_wrong_pg_after_split"):
+            return      # the client re-targets; not a completed op
+        self.note_tenant_stage(op.tenant, "total", op.age)
+        self.note_tenant_op(op.tenant, final not in self._BAD_FINISH)
 
     def note_op_size(self, nbytes: int) -> None:
         """Record one client write's payload size in the pow2
@@ -341,7 +408,8 @@ class OSD:
         top = getattr(msg, "_top", None)
         if top is None:
             top = self.optracker.create(
-                desc, trace=getattr(msg, "trace", None))
+                desc, trace=getattr(msg, "trace", None),
+                tenant=getattr(msg, "tenant", None))
             msg._top = top
             top.mark_event("queued")
         return top
@@ -423,9 +491,9 @@ class OSD:
         OSD.cc:7360,9554)."""
         from .scheduler import K_CLIENT, K_RECOVERY, K_SCRUB
 
-        def q(key, klass, fn):
+        def q(key, klass, fn, tenant=None):
             if self.sched.running:
-                self.sched.enqueue(key, klass, fn)
+                self.sched.enqueue(key, klass, fn, tenant=tenant)
             else:           # not started (unit-test direct dispatch)
                 r = fn()
                 if asyncio.iscoroutine(r):
@@ -452,12 +520,14 @@ class OSD:
                         % (msg.src, msg.tid, msg.pool, msg.ps,
                            msg.oid, ops_s))
             q((msg.pool, msg.ps), K_CLIENT,
-              lambda: self._handle_op(conn, msg))
+              lambda: self._handle_op(conn, msg),
+              tenant=getattr(msg, "tenant", None))
         elif isinstance(msg, MOSDRepOp):
             self._track(msg, "rep_op(%s tid=%s %d.%x)"
                         % (msg.src, msg.tid, msg.pool, msg.ps))
             q((msg.pool, msg.ps), K_CLIENT,
-              lambda: self._handle_repop(conn, msg))
+              lambda: self._handle_repop(conn, msg),
+              tenant=getattr(msg, "tenant", None))
         elif isinstance(msg, MOSDRepOpReply):
             self._handle_repop_reply(msg)
         elif isinstance(msg, MOSDPGQuery):
@@ -512,7 +582,8 @@ class OSD:
                         % (msg.src, msg.tid, msg.pool, msg.ps,
                            msg.shard))
             q((msg.pool, msg.ps), K_CLIENT,
-              lambda: self.ec.handle_sub_write(conn, msg))
+              lambda: self.ec.handle_sub_write(conn, msg),
+              tenant=getattr(msg, "tenant", None))
         elif isinstance(msg, MOSDECSubOpWriteReply):
             self.ec.handle_sub_write_reply(msg)
         elif isinstance(msg, MOSDECSubOpRead):
@@ -2115,6 +2186,7 @@ class OSD:
         waiting = set()
         txn_wire = denc.encode(t.to_wire())
         trace = getattr(msg, "trace", None)
+        tenant = getattr(msg, "tenant", None)
         for osd in pg.acting:
             if osd < 0 or osd == self.whoami:
                 continue
@@ -2125,6 +2197,7 @@ class OSD:
                 min_epoch=pg.info.same_interval_since,
                 pg_trim_to=None)
             rep.trace = trace   # sub-op joins the client op's span
+            rep.tenant = tenant
             self._send_osd(osd, rep)
         self.store.apply_transaction(t)
         if not waiting:
@@ -2198,8 +2271,11 @@ class OSD:
             del pg.in_flight[msg.tid]
             t_sub = st.get("t_sub")
             if t_sub is not None:
-                self.perf.hist_sample("op_subop_rtt",
-                                      time.monotonic() - t_sub)
+                rtt = time.monotonic() - t_sub
+                self.perf.hist_sample("op_subop_rtt", rtt)
+                if top is not None and top.tenant is not None:
+                    self.note_tenant_stage(top.tenant, "subop_rtt",
+                                           rtt)
             if st["conn"] is not None:     # internal txns (snap trim)
                 st["conn"].send(MOSDOpReply(
                     tid=st["tid"], result=0, outs=st["outs"],
@@ -2483,6 +2559,10 @@ class OSD:
         self._send_mons(MOSDBeacon(
             osd=self.whoami, epoch=self.osdmap.epoch,
             slow_ops=len(slow),
+            # per-tenant slice of the slow count (tenant-less ops
+            # fold under "") so the SLOW_OPS health detail can name
+            # the worst tenant; legacy mons drop the unknown field
+            slow_tenants=self.optracker.slow_tenants(),
             device_fallback=int(chip.fallback),
             device_chip=chip.index))
 
@@ -2638,6 +2718,18 @@ class OSD:
                        # per-chip device utilization (flight-recorder
                        # plane: saturation visible cluster-wide)
                        "device_util": device_util,
+                       # tenant SLO plane: cumulative per-tenant
+                       # stage histograms + good/bad op counters —
+                       # the mgr SLO engine's burn-rate input
+                       "tenants": {
+                           t: {"stages": {s: list(h)
+                                          for s, h in
+                                          self.tenant_stages.get(
+                                              t, {}).items()},
+                               **self.tenant_ops.get(
+                                   t, {"ops": 0, "errors": 0})}
+                           for t in (set(self.tenant_stages)
+                                     | set(self.tenant_ops))},
                        # clog emission counters
                        # (ceph_tpu_log_messages_total)
                        "log_messages": self.clog.counts_wire()}),

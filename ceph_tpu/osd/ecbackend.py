@@ -205,17 +205,6 @@ class ECPGBackend:
 
     # -- client op entry ---------------------------------------------------
 
-    def _journal_reply(self, pg: PG, msg, result: int, outs: list,
-                       version: int) -> None:
-        """Persist the reply of a completed EC write into the reqid
-        journal (own txn: shard txns already applied).  Only result 0
-        is journaled — a failed write may legitimately re-execute."""
-        if result != 0:
-            return
-        t = Transaction()
-        pg.record_reqid(t, msg.src, msg.tid, result, outs, version)
-        self.osd.store.apply_transaction(t)
-
     async def handle_op(self, pg: PG, conn, msg) -> None:
         """Primary-side execution of one client op list."""
         async with self.oid_lock(pg, msg.oid):
@@ -361,8 +350,9 @@ class ECPGBackend:
             res = await self._try_delta_write(pg, msg)
             if res is not None:
                 outs2, ok2 = res
-                # no _journal_reply here: the delta path journals the
-                # reqid inside the replicated shard txns themselves
+                # the delta path journals the reqid inside the
+                # replicated shard txns themselves (submit_write's
+                # full-write path now does the same via `reqid`)
                 conn.send(MOSDOpReply(
                     tid=msg.tid, result=0 if ok2 else -11,
                     outs=outs2, epoch=epoch,
@@ -447,9 +437,9 @@ class ECPGBackend:
                                      snapset_b=snapset_b,
                                      sna_snaps=sna_snaps,
                                      whiteout=whiteout,
-                                     top=getattr(msg, "_top", None))
+                                     top=getattr(msg, "_top", None),
+                                     reqid=(msg.src, msg.tid, outs))
         ver = pg.info.last_update[1]
-        self._journal_reply(pg, msg, 0 if ok else -11, outs, ver)
         conn.send(MOSDOpReply(tid=msg.tid, result=0 if ok else -11,
                               outs=outs, epoch=self.osd.osdmap.epoch,
                               version=ver))
@@ -479,6 +469,9 @@ class ECPGBackend:
             if top is not None:
                 top.mark_event("device_dispatched")
                 top.note("device_ticket", t.dump())
+                if top.tenant is not None:
+                    self.osd.note_tenant_stage(
+                        top.tenant, "device_dispatch", t.device_s)
         return on_ticket
 
     async def _encode_shards(self, pg: PG, data: bytes,
@@ -496,15 +489,18 @@ class ECPGBackend:
         import time as _time
         codec = self.codec(self.osd.osdmap.pools[pg.pool_id])
         n = codec.get_chunk_count()
+        tenant = top.tenant if top is not None else None
         if top is not None:
             top.mark_event("ec_encode_start")
         t0 = _time.monotonic()
         shards = await codec.encode_async(
             set(range(n)), data, klass=klass,
             on_ticket=self._on_dispatch_ticket(top),
-            chip=self._chip())
-        self.osd.perf.hist_sample("op_ec_batch_wait",
-                                  _time.monotonic() - t0)
+            chip=self._chip(), tenant=tenant)
+        dt = _time.monotonic() - t0
+        self.osd.perf.hist_sample("op_ec_batch_wait", dt)
+        if tenant is not None:
+            self.osd.note_tenant_stage(tenant, "ec_batch_wait", dt)
         if top is not None:
             top.mark_event("ec_encoded")
         return shards
@@ -534,7 +530,8 @@ class ECPGBackend:
                            snapset_b: bytes | None = None,
                            sna_snaps: list | None = None,
                            whiteout: bool = False,
-                           top=None) -> bool:
+                           top=None, reqid: tuple | None = None
+                           ) -> bool:
         """Encode + distribute one object write; True when every live
         shard acked (ECBackend::try_reads_to_commit).
 
@@ -542,7 +539,15 @@ class ECPGBackend:
         hobject(oid, snap=clone_to) before the write applies;
         snapset_b is the updated SnapSet attr; sna_snaps index the new
         clone in the SnapMapper rows; whiteout turns a delete into a
-        zero-length tombstone that keeps the SnapSet (clones alive)."""
+        zero-length tombstone that keeps the SnapSet (clones alive).
+
+        `reqid` = (src, tid, outs) journals the client's reply dup
+        row inside EVERY shard transaction (the delta path's
+        replicated-journal contract extended to full writes): after a
+        primary loss, the promoted replica answers the client's
+        resend from its own store instead of re-executing.  A < k
+        commit forgets the pre-journaled row (the resend must
+        re-execute)."""
         from . import snaps as snapmod
         from .pg import PGMETA_OID
         epoch = self.osd.osdmap.epoch
@@ -587,8 +592,18 @@ class ECPGBackend:
                 t.omap_setkeys(pg.cid, PGMETA_OID,
                                {snapmod.sna_key(sn, oid): b"1"})
             txns[j] = t
-        return await self._commit_shard_txns(pg, oid, entry, txns,
-                                             top=top)
+        if reqid is not None:
+            src, tid, outs = reqid
+            pg.record_reqid(list(txns.values()), src, tid, 0,
+                            list(outs), version[1])
+        ok = await self._commit_shard_txns(pg, oid, entry, txns,
+                                           top=top)
+        if reqid is not None and not ok:
+            # < k shards acked: the resend must re-execute, not be
+            # answered 0 from the pre-journaled row (mirrors the
+            # delta path's forget-on-failed-commit contract)
+            pg.forget_reqid(reqid[0], reqid[1])
+        return ok
 
     async def _commit_shard_txns(self, pg: PG, oid: str, entry,
                                  txns: dict[int, "Transaction"],
@@ -632,7 +647,9 @@ class ECPGBackend:
                     txn=denc.encode(t.to_wire()),
                     log_entry=entry.to_wire(), epoch=epoch)
                 # the sub-op joins the client op's cross-daemon span
+                # (and its tenant rides along for shard-side books)
                 sub.trace = top.trace if top is not None else None
+                sub.tenant = top.tenant if top is not None else None
                 self.osd._send_osd(osd_id, sub)
         if waiting:
             if top is not None:
@@ -881,7 +898,9 @@ class ECPGBackend:
         pdeltas = await asyncio.gather(*[
             codec.delta_async(_iv_deltas(a, b),
                               on_ticket=self._on_dispatch_ticket(top),
-                              chip=self._chip())
+                              chip=self._chip(),
+                              tenant=(top.tenant if top is not None
+                                      else None))
             for a, b in ivs])
         new_par: dict[tuple, bytes] = {}
         for i in range(m):

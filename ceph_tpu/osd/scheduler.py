@@ -70,6 +70,36 @@ DEVICE_DISPATCH_WEIGHTS = {
     "background": 0.5,
 }
 
+# per-tenant dmClock row defaults (fractions of osd capacity like the
+# class profile): a tenant-stamped client op runs under its tenant's
+# OWN (reservation, weight, limit) tag book nested in the client
+# class, so a bully tenant is throttled at its limit tag while a
+# victim's reservation keeps flowing — the dmclock d-parameter model
+# extended to (class, tenant) keys.  Overridden per tenant via the
+# `osd_mclock_tenant_qos` conf rows ("name:res:weight:limit,...").
+TENANT_DEFAULT_PROFILE = (0.05, 1.0, 1.00)
+
+
+def parse_tenant_qos(spec: str) -> dict[str, tuple]:
+    """Parse the `osd_mclock_tenant_qos` conf string:
+    "bully:0.05:0.5:0.15,victim:0.30:4:1.0" ->
+    {tenant: (res_frac, weight, lim_frac)}.  Malformed rows are
+    skipped (a poison conf value must never sever the op path)."""
+    out: dict[str, tuple] = {}
+    for row in (spec or "").split(","):
+        row = row.strip()
+        if not row:
+            continue
+        parts = row.split(":")
+        if len(parts) != 4:
+            continue
+        try:
+            out[parts[0]] = (float(parts[1]), float(parts[2]),
+                             float(parts[3]))
+        except ValueError:
+            continue
+    return out
+
 
 class _ClassQ:
     __slots__ = ("res", "wgt", "lim", "r_tag", "p_tag", "l_tag",
@@ -87,14 +117,28 @@ class _ClassQ:
 
 
 class _Shard:
+    """Tag books are keyed by the base class name (str) or, for
+    tenant-stamped client ops, by a ("client", tenant) tuple — each
+    tenant gets its OWN dmClock RWL row nested inside the client
+    class, created lazily on first sight from the tenant QoS rows."""
+
     def __init__(self, profile: dict, capacity: float):
-        self.classes = {
+        self.capacity = capacity
+        self.classes: dict = {
             k: _ClassQ(res * capacity, wgt, lim * capacity)
             for k, (res, wgt, lim) in profile.items()}
         self.wake = asyncio.Event()
         self.size = 0
 
-    def push(self, klass: str, fn, cost: float) -> None:
+    def ensure(self, key, res_frac: float, wgt: float,
+               lim_frac: float) -> None:
+        """Create the (class, tenant) tag book on first sight."""
+        if key not in self.classes:
+            self.classes[key] = _ClassQ(res_frac * self.capacity,
+                                        wgt,
+                                        lim_frac * self.capacity)
+
+    def push(self, klass, fn, cost: float) -> None:
         q = self.classes[klass]
         now = time.monotonic()
         if not q.items:
@@ -113,20 +157,21 @@ class _Shard:
         busy = [(k, q) for k, q in self.classes.items() if q.items]
         if not busy:
             return None
-        # 1. reservation phase
+        # 1. reservation phase (key= keeps mixed str/tuple book keys
+        # out of the comparison when tags tie)
         ready = [(q.r_tag, k) for k, q in busy if q.r_tag <= now]
         if ready:
-            return ("R", min(ready)[1])
+            return ("R", min(ready, key=lambda t: t[0])[1])
         # 2. proportional phase under limit
         under = [(q.p_tag, k) for k, q in busy if q.l_tag <= now]
         if under:
-            return ("P", min(under)[1])
+            return ("P", min(under, key=lambda t: t[0])[1])
         # 3. everything limited: sleep till the nearest tag matures
         horizon = min(min(q.r_tag for _, q in busy),
                       min(q.l_tag for _, q in busy))
         return ("S", max(horizon - now, 0.0005))
 
-    def pop(self, klass: str, phase: str):
+    def pop(self, klass, phase: str):
         """Returns (fn, queue_wait_seconds)."""
         q = self.classes[klass]
         fn, cost, t_enq = q.items.pop(0)
@@ -159,18 +204,60 @@ class OpScheduler:
                              if conf else 10000.0)
         self.profile = dict(profile or DEFAULT_PROFILE)
         self.capacity = capacity_iops
+        self.ctx = ctx
         self.shards = [_Shard(self.profile, capacity_iops)
                        for _ in range(max(1, num_shards))]
         self._workers: list[asyncio.Task] = []
         self.running = False
-        # perf visibility
+        # perf visibility (base classes; tenant books fold into their
+        # base class here and get their own tenant_dispatched counts)
         self.dispatched = {k: 0 for k in self.profile}
+        self.tenant_dispatched: dict[str, int] = {}
         # per-class queue-wait books: klass -> [count, sum_seconds];
-        # on_wait(klass, seconds) additionally fires per dequeue so the
-        # OSD can feed its stage-latency histograms (the queue-wait
-        # stage of the op timeline)
+        # on_wait(klass, seconds, tenant) additionally fires per
+        # dequeue so the OSD can feed its stage-latency histograms
+        # (the queue-wait stage of the op timeline, per tenant)
         self.queue_wait = {k: [0, 0.0] for k in self.profile}
         self.on_wait = None
+        # tenant QoS rows parsed from conf, cached per spec string
+        self._tenant_qos_spec: str | None = None
+        self._tenant_qos: dict[str, tuple] = {}
+
+    # -- tenant QoS rows ---------------------------------------------------
+
+    def tenant_profile(self, tenant: str) -> tuple:
+        """(res_frac, weight, lim_frac) for one tenant: the
+        `osd_mclock_tenant_qos` conf row when present, else the
+        per-tenant defaults (`osd_mclock_tenant_*`).  Re-read per
+        spec-string change so `config set` acts live."""
+        conf = getattr(self.ctx, "conf", None)
+        if conf is None:
+            return TENANT_DEFAULT_PROFILE
+        spec = str(conf.get("osd_mclock_tenant_qos", "") or "")
+        if spec != self._tenant_qos_spec:
+            self._tenant_qos_spec = spec
+            self._tenant_qos = parse_tenant_qos(spec)
+        row = self._tenant_qos.get(tenant)
+        if row is not None:
+            return row
+        return (float(conf.get("osd_mclock_tenant_reservation",
+                               TENANT_DEFAULT_PROFILE[0])),
+                float(conf.get("osd_mclock_tenant_weight",
+                               TENANT_DEFAULT_PROFILE[1])),
+                float(conf.get("osd_mclock_tenant_limit",
+                               TENANT_DEFAULT_PROFILE[2])))
+
+    def _book_key(self, sh: _Shard, klass: str, tenant: str | None):
+        """Resolve the tag-book key for one item, lazily creating the
+        tenant's RWL row (tenant books nest only inside the client
+        class — background classes are already cluster-internal)."""
+        if tenant is None or klass != K_CLIENT:
+            return klass
+        key = (klass, tenant)
+        if key not in sh.classes:
+            res, wgt, lim = self.tenant_profile(tenant)
+            sh.ensure(key, res, wgt, lim)
+        return key
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -206,13 +293,19 @@ class OpScheduler:
                     pass
                 continue
             fn, waited = sh.pop(val, phase)
-            self.dispatched[val] += 1
-            book = self.queue_wait[val]
+            base, tenant = ((val[0], val[1])
+                            if isinstance(val, tuple)
+                            else (val, None))
+            self.dispatched[base] = self.dispatched.get(base, 0) + 1
+            if tenant is not None:
+                self.tenant_dispatched[tenant] = \
+                    self.tenant_dispatched.get(tenant, 0) + 1
+            book = self.queue_wait[base]
             book[0] += 1
             book[1] += waited
             if self.on_wait is not None:
                 try:
-                    self.on_wait(val, waited)
+                    self.on_wait(base, waited, tenant)
                 except Exception:
                     pass    # observability must never sink the worker
             try:
@@ -228,13 +321,16 @@ class OpScheduler:
     def shard_of(self, key) -> int:
         return hash(key) % len(self.shards)
 
-    def enqueue(self, key, klass: str, fn, cost: float = 1.0) -> None:
-        self.shards[self.shard_of(key)].push(klass, fn, cost)
+    def enqueue(self, key, klass: str, fn, cost: float = 1.0,
+                tenant: str | None = None) -> None:
+        sh = self.shards[self.shard_of(key)]
+        sh.push(self._book_key(sh, klass, tenant), fn, cost)
 
     async def admit(self, klass: str, cost: float = 1.0,
-                    key=0) -> None:
+                    key=0, tenant: str | None = None) -> None:
         """Admission ticket for background flows: resolves when the
-        arbiter grants `cost` units to `klass`."""
+        arbiter grants `cost` units to `klass` (or to the tenant's
+        own tag book when `tenant` is given)."""
         if not self.running:
             return
         loop = asyncio.get_event_loop()
@@ -244,5 +340,6 @@ class OpScheduler:
             if not fut.done():
                 fut.set_result(None)
 
-        self.shards[self.shard_of(key)].push(klass, grant, cost)
+        sh = self.shards[self.shard_of(key)]
+        sh.push(self._book_key(sh, klass, tenant), grant, cost)
         await fut

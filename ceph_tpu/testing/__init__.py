@@ -8,5 +8,7 @@ while a `Workload` keeps client traffic live and invariants checked.
 
 from .cluster import LocalCluster
 from .thrasher import ClusterThrasher, Workload
+from .traffic import TenantStream, TrafficGenerator
 
-__all__ = ["LocalCluster", "ClusterThrasher", "Workload"]
+__all__ = ["LocalCluster", "ClusterThrasher", "Workload",
+           "TenantStream", "TrafficGenerator"]

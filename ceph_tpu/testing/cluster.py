@@ -63,6 +63,13 @@ FAST_CONF = {
     # window so saturation integrals react within a round
     "flight_recorder_sample": 1,
     "device_util_window": 5.0,
+    # tenant SLO plane at dev pacing: burn windows of seconds (not
+    # SRE-scale minutes) so a bully round's burn both RAISES and
+    # DECAYS within a thrash round, and a small min-ops floor so
+    # short bursts still produce verdicts
+    "slo_fast_window": 2.0,
+    "slo_slow_window": 5.0,
+    "slo_min_ops": 10,
 }
 
 

@@ -172,7 +172,19 @@ class ClusterThrasher:
                          clears, and every planted object reads back
                          its original bytes;
       corrupt_replica  — the replicated-pool analog (byte rot or a
-                         divergent xattr on one replica).
+                         divergent xattr on one replica);
+      bully_tenant     — the tenant SLO-plane oracle: mid-round, a
+                         bully tenant floods the thrashed pool (many
+                         tenant-stamped streams, wide windows) while
+                         victim streams run a modest load through
+                         the same shared client; every acked write
+                         of BOTH tenants must read back
+                         byte-identical, and the post-round SLO
+                         oracle demands that once healthy no
+                         VICTIM tenant is left holding a burn or
+                         latency alert (the bully being throttled
+                         at its dmClock limit tag is by design, not
+                         a violation).
 
     Slow-op oracle: after every round's health check, no live OSD may
     still hold an op in flight past osd_op_complaint_time — a healthy
@@ -194,7 +206,8 @@ class ClusterThrasher:
                    "mon_partition", "map_churn", "pg_num_grow",
                    "pgp_num_grow", "ec_profile_swap",
                    "device_fallback", "chip_loss", "osd_crash",
-                   "mixed_rmw", "corrupt_shard", "corrupt_replica")
+                   "mixed_rmw", "corrupt_shard", "corrupt_replica",
+                   "bully_tenant")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -249,7 +262,7 @@ class ClusterThrasher:
         if action in ("map_churn", "pg_num_grow", "pgp_num_grow",
                       "ec_profile_swap", "device_fallback",
                       "chip_loss", "mixed_rmw", "corrupt_shard",
-                      "corrupt_replica"):
+                      "corrupt_replica", "bully_tenant"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -462,6 +475,11 @@ class ClusterThrasher:
             if pid is None:
                 return              # no EC pool under thrash
             await self._mixed_rmw_round(c, pid, arg)
+        elif action == "bully_tenant":
+            pid = self._pool_ids[arg % len(self._pool_ids)]
+            if c.client.osdmap.pools.get(pid) is None:
+                return
+            await self._bully_tenant_round(c, pid, arg)
         elif action in ("corrupt_shard", "corrupt_replica"):
             want_ec = action == "corrupt_shard"
             pid = next(
@@ -475,6 +493,71 @@ class ClusterThrasher:
             await self._corrupt_round(c, pid, arg, ec=want_ec)
         else:
             raise ValueError(action)
+
+    # tenants the bully rounds flood with: violations on these are by
+    # design (the limit tag throttling them IS the mechanism), so the
+    # post-round SLO oracle exempts them; every OTHER tenant must end
+    # the round alert-free
+    BULLY_TENANTS = frozenset({"bully", "other", "mixed"})
+
+    async def _bully_tenant_round(self, c, pid: int,
+                                  seed: int) -> None:
+        """Noisy-neighbor flood mid-round: a bully tenant's stream
+        fleet floods the thrashed pool while victim streams run a
+        modest load through the same shared client.  Both tenants'
+        acked writes must read back byte-identical (being throttled
+        is never being lossy); the post-round SLO oracle in
+        _check_invariants then demands no lingering victim alert."""
+        from .traffic import TrafficGenerator
+        pool = c.client.osdmap.pools[pid]
+        gen = TrafficGenerator.build(
+            c.client, pid,
+            {"victim": {"streams": 2, "window": 2,
+                        "obj_bytes": 2048, "n_objects": 8},
+             "bully": {"streams": 6, "window": 6,
+                       "obj_bytes": 4096, "n_objects": 8}},
+            seed=seed)
+        stats = await asyncio.wait_for(
+            gen.run(max(self.hold, 1.0)), 120.0)
+        self.log.append("bully_tenant on %s: %r"
+                        % (pool.name,
+                           {t: (s["n"], s["errors"])
+                            for t, s in stats.items()}))
+        for tenant, s in stats.items():
+            assert s["n"] > 0, \
+                "tenant %s completed zero ops under the flood" \
+                % tenant
+        # zero lost acked writes, bully included — throttling must
+        # never become loss
+        await asyncio.wait_for(gen.verify(), 120.0)
+
+    async def _slo_oracle(self, c, timeout: float = 45.0) -> None:
+        """Post-round tenant SLO oracle: once the cluster is healthy
+        and the burn windows have decayed, neither SLO_LATENCY nor
+        SLO_BURN may still name a non-bully tenant — a victim left
+        holding an alert after the fault cleared means the QoS plane
+        failed to protect it (or the engine failed to clear).  The
+        bully's own alerts are exempt: being throttled at its limit
+        tag is the mechanism working, not a violation."""
+        from ..utils.backoff import wait_for
+
+        def pred():
+            leader = c.leader()
+            if leader is None:
+                return False
+            checks = leader.health_mon.checks()
+            for name in ("SLO_LATENCY", "SLO_BURN"):
+                chk = checks.get(name)
+                if chk is None:
+                    continue
+                victims = [t for t in chk.get("tenants", ())
+                           if t not in self.BULLY_TENANTS]
+                if victims:
+                    return False
+            return True
+
+        await wait_for(pred, timeout,
+                       what="victim-tenant SLO alerts cleared")
 
     async def _corrupt_round(self, c, pid: int, seed: int,
                              ec: bool) -> None:
@@ -807,6 +890,13 @@ class ClusterThrasher:
         # once healthy, and a drain that was visibly degraded for
         # several samples must have shown a nonzero recovery rate
         # (data moved; the stats plane saw it move)
+        # tenant SLO oracle: every round that ran with tenants must
+        # end alert-free for the victims — a bully capped at its
+        # limit is not a violation, a victim still burning after the
+        # cluster healed is (the direction-1 QoS contract, asserted
+        # from the committed health surface, not internal state)
+        if getattr(c, "mgr", None) is not None:
+            await self._slo_oracle(c)
         if getattr(c, "mgr", None) is not None \
                 and hasattr(c, "wait_degraded_drained"):
             obs = await c.wait_degraded_drained(timeout=120.0)

@@ -30,13 +30,15 @@ class TrackedOp:
     """One tracked request on one daemon (TrackedOp/OpRequest)."""
 
     __slots__ = ("tracker", "seq", "trace", "desc", "daemon",
-                 "initiated", "wall", "events", "finished", "meta")
+                 "initiated", "wall", "events", "finished", "meta",
+                 "tenant")
 
     def __init__(self, tracker: "OpTracker", seq: int, desc: str,
-                 trace: str | None):
+                 trace: str | None, tenant: str | None = None):
         self.tracker = tracker
         self.seq = seq
         self.trace = trace
+        self.tenant = tenant
         self.desc = desc
         self.daemon = tracker.daemon
         self.initiated = tracker.now()
@@ -78,6 +80,7 @@ class TrackedOp:
     def dump(self) -> dict:
         out = {
             "trace": self.trace,
+            "tenant": self.tenant,
             "desc": self.desc,
             "daemon": self.daemon,
             "initiated": self.initiated,
@@ -132,6 +135,10 @@ class OpTracker:
         # the same offsets as op stamps
         from .recorder import FlightRecorder
         self.recorder = FlightRecorder(ctx, daemon, clock=self.now)
+        # retire hook: the owning daemon hangs its per-tenant SLO
+        # accounting here (stage histograms, good/bad op counts) —
+        # fired for every retired op, after the recorder's feed
+        self.on_retire = None
 
     def now(self) -> float:
         return time.monotonic() + self.clock_skew
@@ -144,8 +151,10 @@ class OpTracker:
 
     # -- lifecycle -----------------------------------------------------
 
-    def create(self, desc: str, trace: str | None = None) -> TrackedOp:
-        op = TrackedOp(self, next(self._seq), desc, trace)
+    def create(self, desc: str, trace: str | None = None,
+               tenant: str | None = None) -> TrackedOp:
+        op = TrackedOp(self, next(self._seq), desc, trace,
+                       tenant=tenant)
         self.ops[op.seq] = op
         return op
 
@@ -163,6 +172,11 @@ class OpTracker:
             if len(self.historic_slow) > scap:
                 del self.historic_slow[:len(self.historic_slow) - scap]
         self.recorder.note_op(op, slow=slow)
+        if self.on_retire is not None:
+            try:
+                self.on_retire(op)
+            except Exception:
+                pass    # observability must never sink the op path
 
     # -- slow-op detection ---------------------------------------------
 
@@ -188,31 +202,60 @@ class OpTracker:
                 out.append(op.dump())
         return out
 
-    def dump_ops_in_flight(self) -> dict:
-        ops = sorted(self.ops.values(), key=lambda o: o.initiated)
+    @staticmethod
+    def _tenant_match(op: TrackedOp, tenant: str | None) -> bool:
+        return tenant is None or op.tenant == tenant
+
+    def dump_ops_in_flight(self, tenant: str | None = None) -> dict:
+        """`tenant` narrows the dump to one tenant's ops (the
+        noisy-neighbor triage surface: whose in-flight ops are these)."""
+        ops = sorted((o for o in self.ops.values()
+                      if self._tenant_match(o, tenant)),
+                     key=lambda o: o.initiated)
         return {"num_ops": len(ops),
                 "complaint_time": self.complaint_time,
+                "tenant": tenant,
                 "ops": [op.dump() for op in ops]}
 
-    def dump_historic_ops(self) -> dict:
-        return {"num_ops": len(self.historic),
-                "ops": [op.dump() for op in self.historic]}
+    def dump_historic_ops(self, tenant: str | None = None) -> dict:
+        ops = [op for op in self.historic
+               if self._tenant_match(op, tenant)]
+        return {"num_ops": len(ops), "tenant": tenant,
+                "ops": [op.dump() for op in ops]}
 
-    def dump_historic_slow_ops(self) -> dict:
-        return {"num_ops": len(self.historic_slow),
+    def dump_historic_slow_ops(self,
+                               tenant: str | None = None) -> dict:
+        ops = [op for op in self.historic_slow
+               if self._tenant_match(op, tenant)]
+        return {"num_ops": len(ops),
                 "complaint_time": self.complaint_time,
-                "ops": [op.dump() for op in self.historic_slow]}
+                "tenant": tenant,
+                "ops": [op.dump() for op in ops]}
+
+    def slow_tenants(self) -> dict[str, int]:
+        """tenant -> slow in-flight op count (ops with no tenant fold
+        under "") — the per-tenant slice OSD beacons carry so the
+        SLOW_OPS health detail can name the worst tenant."""
+        out: dict[str, int] = {}
+        for op in self.slow_in_flight():
+            key = op.tenant or ""
+            out[key] = out.get(key, 0) + 1
+        return out
 
     # -- admin socket ---------------------------------------------------
 
     def register_admin(self, admin) -> None:
-        admin.register("dump_ops_in_flight",
-                       lambda a: self.dump_ops_in_flight(),
-                       "show in-flight tracked ops")
-        admin.register("dump_historic_ops",
-                       lambda a: self.dump_historic_ops(),
-                       "show recently completed ops")
-        admin.register("dump_historic_slow_ops",
-                       lambda a: self.dump_historic_slow_ops(),
-                       "show recently completed slow ops")
+        admin.register(
+            "dump_ops_in_flight",
+            lambda a: self.dump_ops_in_flight(a.get("tenant")),
+            "show in-flight tracked ops (optional tenant filter)")
+        admin.register(
+            "dump_historic_ops",
+            lambda a: self.dump_historic_ops(a.get("tenant")),
+            "show recently completed ops (optional tenant filter)")
+        admin.register(
+            "dump_historic_slow_ops",
+            lambda a: self.dump_historic_slow_ops(a.get("tenant")),
+            "show recently completed slow ops (optional tenant"
+            " filter)")
         self.recorder.register_admin(admin)

@@ -120,6 +120,7 @@ class FlightRecorder:
             "kind": "op",
             "daemon": op.daemon,
             "trace": op.trace,
+            "tenant": op.tenant,
             "desc": op.desc,
             "slow": bool(slow),
             "t0": op.initiated,
@@ -176,6 +177,7 @@ def note_ticket(ticket) -> None:
     _DEVICE_RING.append({
         "seq": ticket.seq, "klass": ticket.klass,
         "bucket": ticket.bucket, "bytes": ticket.nbytes,
+        "tenant": getattr(ticket, "tenant", None),
         "chip": ticket.chip, "t_enqueue": ticket.t_enqueue,
         "t_admit": ticket.t_admit, "t_launch": ticket.t_launch,
         "t_done": ticket.t_done, "ok": ticket.ok,
@@ -261,6 +263,7 @@ def chrome_trace(rings: dict[str, list[dict]],
             lanes[tid] = t1
             if rec["kind"] == "op":
                 args = {"trace": rec.get("trace"),
+                        "tenant": rec.get("tenant"),
                         "slow": rec.get("slow", False)}
                 for t in rec.get("tickets") or []:
                     args["device_ticket_seq"] = t.get("seq")
@@ -342,6 +345,7 @@ def chrome_trace(rings: dict[str, list[dict]],
                 "args": {"seq": t.get("seq"), "chip": chip,
                          "bucket": t.get("bucket"),
                          "bytes": t.get("bytes"),
+                         "tenant": t.get("tenant"),
                          "queue_wait": t.get("queue_wait"),
                          "ok": t.get("ok")}})
 

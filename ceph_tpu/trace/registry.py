@@ -79,6 +79,22 @@ DEVICE_SERIES = frozenset({
     "device_chips", "device_dispatch_seconds",
 })
 
+# tenant SLO plane: the per-tenant stage-histogram names OSDs emit
+# via note_tenant_stage (the mgr SLO engine's burn-rate input —
+# mgr/slo.py re-exports the same tuple) and the tenant-labeled
+# exporter families the mgr renders.  Both directions are linted:
+# every emitted literal registered, every registered name emitted.
+TENANT_STAGES = frozenset({
+    "queue_wait", "subop_rtt", "ec_batch_wait", "device_dispatch",
+    "total",
+})
+
+TENANT_SERIES = frozenset({
+    "ceph_tpu_tenant_ops_total", "ceph_tpu_tenant_errors_total",
+    "ceph_tpu_tenant_op_seconds", "ceph_tpu_tenant_slo_burn_fast",
+    "ceph_tpu_tenant_slo_burn_slow", "ceph_tpu_tenant_p99_ms",
+})
+
 # which stage names each consumer file references by literal; the
 # lint demands every entry be registered AND literally present in the
 # file, so a stage rename that misses a consumer fails here
@@ -211,6 +227,54 @@ def lint_device_series() -> list[str]:
     return errors
 
 
+_TENANT_STAGE_RE = re.compile(
+    r'note_tenant_stage\([^"]*?"([^"]+)"', re.S)
+
+
+def lint_tenant_plane(root: str | None = None) -> list[str]:
+    """Tenant SLO plane drift lint: every `note_tenant_stage` literal
+    emitted anywhere in ceph_tpu must be registered in TENANT_STAGES
+    (and vice versa — a renamed stage that still sits in the registry
+    fails), the SLO engine's own stage tuple must match, and every
+    registered tenant exporter family must literally appear in the
+    mgr's renderer (so a family rename cannot silently drop a
+    series)."""
+    errors: list[str] = []
+    base = _repo_root(root)
+    pkg = os.path.join(base, "ceph_tpu")
+    emitted: set[str] = set()
+    for _path, src in _iter_sources(pkg):
+        emitted.update(_TENANT_STAGE_RE.findall(src))
+    for name in sorted(emitted - TENANT_STAGES):
+        errors.append("emitted tenant stage %r is not registered in"
+                      " trace.registry.TENANT_STAGES" % name)
+    for name in sorted(TENANT_STAGES - emitted):
+        errors.append("registered tenant stage %r is no longer"
+                      " emitted anywhere" % name)
+    try:
+        from ..mgr.slo import TENANT_STAGES as ENGINE_STAGES
+        if set(ENGINE_STAGES) != TENANT_STAGES:
+            errors.append(
+                "mgr.slo.TENANT_STAGES %r diverged from"
+                " trace.registry.TENANT_STAGES %r"
+                % (sorted(ENGINE_STAGES), sorted(TENANT_STAGES)))
+    except Exception as e:
+        errors.append("mgr.slo unimportable: %r" % e)
+    mgr_path = os.path.join(pkg, "mgr", "daemon.py")
+    try:
+        with open(mgr_path) as f:
+            mgr_src = f.read()
+    except OSError:
+        errors.append("ceph_tpu/mgr/daemon.py is missing")
+        mgr_src = ""
+    for fam in sorted(TENANT_SERIES):
+        if fam not in mgr_src:
+            errors.append(
+                "registered tenant series %r is not rendered by"
+                " ceph_tpu/mgr/daemon.py" % fam)
+    return errors
+
+
 def lint_consumers(root: str | None = None) -> list[str]:
     """Every consumer reference must be a registered name AND still
     literally present in the consumer's source."""
@@ -252,6 +316,7 @@ def lint_consumers(root: str | None = None) -> list[str]:
 
 def lint_repo(root: str | None = None) -> list[str]:
     """The tier-1 drift lint: emission sites vs registry vs consumer
-    references, plus the live device-series check."""
+    references, plus the live device-series check and the tenant
+    SLO plane (stage histograms + exporter families)."""
     return (lint_emissions(root) + lint_device_series()
-            + lint_consumers(root))
+            + lint_consumers(root) + lint_tenant_plane(root))

@@ -61,15 +61,20 @@ class AdminSocket:
                 raise RuntimeError("this daemon tracks no ops")
             return tr
 
-        self.register("dump_ops_in_flight",
-                      lambda a: tracker().dump_ops_in_flight(),
-                      "show in-flight tracked ops")
-        self.register("dump_historic_ops",
-                      lambda a: tracker().dump_historic_ops(),
-                      "show recently completed ops")
-        self.register("dump_historic_slow_ops",
-                      lambda a: tracker().dump_historic_slow_ops(),
-                      "show recently completed slow ops")
+        self.register(
+            "dump_ops_in_flight",
+            lambda a: tracker().dump_ops_in_flight(a.get("tenant")),
+            "show in-flight tracked ops (optional tenant filter)")
+        self.register(
+            "dump_historic_ops",
+            lambda a: tracker().dump_historic_ops(a.get("tenant")),
+            "show recently completed ops (optional tenant filter)")
+        self.register(
+            "dump_historic_slow_ops",
+            lambda a: tracker().dump_historic_slow_ops(
+                a.get("tenant")),
+            "show recently completed slow ops (optional tenant"
+            " filter)")
 
         # flight-recorder ring (ceph_tpu.trace.recorder): the span
         # records the Perfetto export merges — same lazy-backref

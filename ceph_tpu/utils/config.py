@@ -393,4 +393,51 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("mgr_balancer_max_changes", OPT_INT, 48,
            "upmap items committed per batched balancer tick (bounds"
            " the per-tick mon command fan-out)"),
+    # -- tenant SLO plane (per-tenant QoS + mgr/slo.py burn engine) ------
+    Option("osd_mclock_tenant_reservation", OPT_FLOAT, 0.05,
+           "default per-tenant dmClock reservation (fraction of osd"
+           " capacity) for tenants without an osd_mclock_tenant_qos"
+           " row"),
+    Option("osd_mclock_tenant_weight", OPT_FLOAT, 1.0,
+           "default per-tenant dmClock weight"),
+    Option("osd_mclock_tenant_limit", OPT_FLOAT, 1.0,
+           "default per-tenant dmClock limit (fraction of osd"
+           " capacity; the hard ceiling a bully tenant is throttled"
+           " at)"),
+    Option("osd_mclock_tenant_qos", OPT_STR, "",
+           "per-tenant dmClock RWL rows:"
+           " 'tenant:res_frac:weight:lim_frac,...' — e.g."
+           " 'bully:0.05:0.5:0.15,victim:0.30:4:1.0'; tenants"
+           " without a row take the osd_mclock_tenant_* defaults"),
+    Option("tenant_tracking_max", OPT_INT, 64,
+           "distinct tenants tracked per OSD (stage histograms, op"
+           " counters, tag books); overflow tenants fold into the"
+           " 'other' bucket so a tenant-id flood cannot grow daemon"
+           " state without bound"),
+    Option("tenant_label_max", OPT_INT, 32,
+           "distinct tenant label values any exporter family may"
+           " carry; overflow tenants fold into tenant=\"other\""
+           " (Prometheus cardinality guard)"),
+    Option("slo_latency_target_ms", OPT_FLOAT, 100.0,
+           "per-tenant latency objective: the op duration a"
+           " 'good' op must finish under (pow2-µs bucket"
+           " resolution)"),
+    Option("slo_latency_objective", OPT_FLOAT, 0.99,
+           "fraction of a tenant's ops that must finish under the"
+           " latency target (1 - objective is the error budget the"
+           " burn rates divide by)"),
+    Option("slo_fast_window", OPT_FLOAT, 60.0,
+           "fast burn-rate window (s) of the multi-window SLO"
+           " alerts (the page-now window)"),
+    Option("slo_slow_window", OPT_FLOAT, 300.0,
+           "slow burn-rate window (s) — both windows must burn for"
+           " SLO_BURN to raise (one spike alone never pages)"),
+    Option("slo_burn_fast", OPT_FLOAT, 14.4,
+           "burn-rate threshold over the fast window (14.4 = the"
+           " SRE-workbook 2%%-budget-in-1h rate)"),
+    Option("slo_burn_slow", OPT_FLOAT, 6.0,
+           "burn-rate threshold over the slow window"),
+    Option("slo_min_ops", OPT_INT, 30,
+           "minimum ops observed in the fast window before a"
+           " tenant's SLO verdicts count (no alerts from noise)"),
 ]

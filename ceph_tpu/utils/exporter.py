@@ -154,7 +154,9 @@ _SERIES_RE = re.compile(
     r"(?P<labels>\{[^{}]*\})?\s+(?P<value>\S+)$")
 
 
-def validate_exposition(text: str) -> list[str]:
+def validate_exposition(text: str,
+                        max_label_card: int | None = 64
+                        ) -> list[str]:
     """Lint an exposition document (text format 0.0.4): every emitted
     series must carry a valid metric name and belong to a family that
     declared a `# TYPE` line before its first sample (histogram
@@ -162,9 +164,18 @@ def validate_exposition(text: str) -> list[str]:
     Returns a list of human-readable violations — empty means clean.
     Guards the growing series surface: a family added without a TYPE
     line breaks real Prometheus servers only at scrape time; this
-    makes it a unit-test failure instead."""
+    makes it a unit-test failure instead.
+
+    Cardinality guard: no (family, label) pair may carry more than
+    `max_label_card` distinct label VALUES (None disables).  An
+    unbounded label set — e.g. a tenant label fed raw tenant ids
+    instead of the capped fold-into-"other" rows — is the classic
+    Prometheus cardinality bomb; this makes it a lint failure before
+    it becomes a TSDB incident."""
     errors: list[str] = []
     typed: set[str] = set()
+    # (family, label name) -> set of observed label values
+    label_vals: dict[tuple[str, str], set] = {}
     for ln, line in enumerate(text.splitlines(), 1):
         line = line.strip()
         if not line:
@@ -194,12 +205,27 @@ def validate_exposition(text: str) -> list[str]:
         if family not in typed:
             errors.append("line %d: series %r has no # TYPE line"
                           % (ln, name))
+        if max_label_card is not None and m.group("labels"):
+            for lm in _LABEL_RE.finditer(m.group("labels")):
+                key = (family, lm.group(1))
+                vals = label_vals.setdefault(key, set())
+                vals.add(lm.group(2))
         try:
             float(m.group("value"))
         except ValueError:
             errors.append("line %d: non-numeric value %r"
                           % (ln, m.group("value")))
+    if max_label_card is not None:
+        for (family, label), vals in sorted(label_vals.items()):
+            if len(vals) > max_label_card:
+                errors.append(
+                    "family %r label %r carries %d distinct values "
+                    "(cap %d): unbounded label set"
+                    % (family, label, len(vals), max_label_card))
     return errors
+
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
 
 
 _VALID_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")

@@ -145,6 +145,71 @@ def test_limit_caps_best_effort_class(loop):
     assert done["n"] >= 40, "scrub made no progress at all"
 
 
+def test_tenant_limit_caps_bully(loop):
+    """Per-tenant RWL rows ((class, tenant) tag books): a bully
+    tenant with a low limit fraction is throttled at its limit tag
+    even with the client class otherwise idle."""
+    from ceph_tpu.utils.context import Context
+    ctx = Context("osd.0", conf_overrides={
+        "osd_mclock_tenant_qos": "bully:0.02:0.5:0.10",
+    })
+    sched = OpScheduler(ctx, num_shards=1, capacity_iops=1000.0)
+    _start(sched, loop)
+    done = {"n": 0}
+
+    async def go():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 0.4:
+            await sched.admit(K_CLIENT, tenant="bully")
+            done["n"] += 1
+        sched.stop()
+
+    loop.run_until_complete(go())
+    # limit = 0.10 * 1000/s -> ~40 grants in 0.4s; allow 3x slack
+    assert done["n"] <= 120, \
+        "bully exceeded its tenant limit: %d grants" % done["n"]
+    assert done["n"] >= 8, "bully made no progress at all"
+    assert sched.tenant_dispatched.get("bully", 0) == done["n"]
+
+
+def test_tenant_reservation_holds_under_bully_flood(loop):
+    """The victim's reservation keeps flowing while a bully tenant
+    floods the same client class — the noisy-neighbor contract at
+    the tag-book level."""
+    from ceph_tpu.utils.context import Context
+    ctx = Context("osd.0", conf_overrides={
+        "osd_mclock_tenant_qos":
+            "bully:0.02:0.5:0.50,victim:0.30:4.0:1.0",
+    })
+    sched = OpScheduler(ctx, num_shards=1, capacity_iops=4000.0)
+    _start(sched, loop)
+    stats = {"bully": 0, "stop": False}
+
+    async def bully_flood():
+        while not stats["stop"]:
+            await sched.admit(K_CLIENT, tenant="bully")
+            stats["bully"] += 1
+
+    async def go():
+        flood = asyncio.get_event_loop().create_task(bully_flood())
+        await asyncio.sleep(0.05)      # backlog builds
+        t0 = time.monotonic()
+        for _ in range(100):
+            await sched.admit(K_CLIENT, tenant="victim")
+        dt = time.monotonic() - t0
+        stats["stop"] = True
+        sched.stop()
+        flood.cancel()
+        return dt
+
+    dt = loop.run_until_complete(go())
+    # victim reserved at 0.30 * 4000/s -> 100 admits ~ 83ms nominal
+    assert dt < 1.5, \
+        "victim starved under bully flood: %.3fs" % dt
+    assert stats["bully"] > 10, \
+        "bully starved outright (limit should cap, not stop it)"
+
+
 def test_unstarted_scheduler_runs_inline():
     """admit() on a stopped scheduler must not hang (unit tests and
     shutdown paths dispatch directly)."""

@@ -1,0 +1,418 @@
+"""Tenant SLO plane: envelope back-compat, per-tenant dmClock tag
+books, the mgr burn-rate engine, paxos-committed SLO health edges,
+tenant-labeled exporter families behind the cardinality guard, the
+traffic generator, and the EC full-write replicated dup journal.
+
+The acceptance scenario rides here: a bully tenant floods a pool while
+victims hold their objectives; tenant identity is asserted end to end
+— envelope -> TrackedOp -> tag books -> device tickets -> flight
+recorder -> mgr SLO digest -> committed SLO_LATENCY/SLO_BURN edges
+that survive a leader change (fresh-Monitor-same-store, the
+test_stats.py pattern).
+"""
+
+import asyncio
+import os
+
+from ceph_tpu.testing import (ClusterThrasher, LocalCluster,
+                              TenantStream, TrafficGenerator,
+                              Workload)
+from ceph_tpu.utils.backoff import wait_for
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+# -- unit: envelope ----------------------------------------------------------
+
+
+def test_tenant_rides_the_message_envelope():
+    from ceph_tpu.msg.message import decode_message, encode_message
+    from ceph_tpu.msg.messages import MOSDOp
+    from ceph_tpu.utils import denc
+
+    m = MOSDOp(tid=3, pool=1, ps=0, oid="x", snapc=None, snapid=None,
+               ops=[{"op": "stat"}], epoch=5, flags=0)
+    m.trace = "client.0:3"
+    m.tenant = "acme"
+    out = decode_message(encode_message(m, stamp=12.5))
+    assert out.tenant == "acme"
+    assert out.trace == "client.0:3" and out.send_stamp == 12.5
+    # tenant without a stamp still round-trips (placeholder slots)
+    m2 = MOSDOp(tid=4, pool=1, ps=0, oid="y", snapc=None,
+                snapid=None, ops=[{"op": "stat"}], epoch=5, flags=0)
+    m2.tenant = "solo"
+    out2 = decode_message(encode_message(m2))
+    assert out2.tenant == "solo" and out2.trace is None
+
+    # legacy envelopes parse cleanly with tenant None: the 4-element
+    # (pre-trace), 5-element (trace only) and 6-element (trace +
+    # stamp) forms all predate the tenant element
+    for row in (["osd_op", 1, "client.0", m.to_wire()],
+                ["osd_op", 1, "client.0", m.to_wire(), "t1"],
+                ["osd_op", 1, "client.0", m.to_wire(), "t1", 3.5]):
+        old = decode_message(denc.encode_versioned(row, 1, 1))
+        assert old.tenant is None
+        assert old.oid == "x"
+
+    # untenanted, untraced messages keep the exact legacy envelope
+    # (byte-stable for the pinned dencoder corpus)
+    bare = MOSDOp(tid=5, pool=1, ps=0, oid="z", snapc=None,
+                  snapid=None, ops=[{"op": "stat"}], epoch=5, flags=0)
+    assert encode_message(bare) == denc.encode_versioned(
+        ["osd_op", 0, "", bare.to_wire()], 1, 1)
+
+
+def test_tenant_qos_row_parsing():
+    from ceph_tpu.osd.scheduler import parse_tenant_qos
+
+    rows = parse_tenant_qos(
+        "bully:0.05:0.5:0.15, victim:0.30:4:1.0,,bad:row")
+    assert rows == {"bully": (0.05, 0.5, 0.15),
+                    "victim": (0.30, 4.0, 1.0)}
+    assert parse_tenant_qos("") == {}
+
+
+# -- unit: SLO engine --------------------------------------------------------
+
+
+def _fake_row(ops, errors, hist):
+    return {"tenants": {"t1": {"ops": ops, "errors": errors,
+                               "stages": {"total": hist}}}}
+
+
+def test_slo_engine_burn_raise_and_decay():
+    from ceph_tpu.mgr.slo import SLOEngine, hist_over_ms, hist_p_ms
+    from ceph_tpu.utils.context import Context
+
+    ctx = Context("mgr", conf_overrides={
+        "slo_latency_target_ms": 10.0,      # bucket 2^13us=8ms good,
+        "slo_latency_objective": 0.99,      # 2^14=16ms bad
+        "slo_fast_window": 10.0,
+        "slo_slow_window": 30.0,
+        "slo_min_ops": 10,
+    })
+    eng = SLOEngine(ctx)
+    # pow2-µs histogram helpers
+    hist = [0] * 32
+    hist[10] = 99           # ~1-2ms: good
+    hist[14] = 1            # 16-32ms: over the 10ms target
+    assert hist_over_ms(hist, 10.0) == 1
+    assert hist_p_ms(hist, 0.5) == float(1 << 11) / 1e3
+    # cumulative snapshots: 100 ops, 1 bad -> 1% bad over a 1%
+    # budget = burn 1.0 (not alerting); then a burst of all-bad ops
+    # pushes both windows past the thresholds
+    eng.ingest(0.0, {"osd.0": _fake_row(0, 0, [0] * 32)})
+    eng.ingest(5.0, {"osd.0": _fake_row(100, 0, hist)})
+    v = eng.evaluate(5.0)["t1"]
+    assert v["window_ops"] == 100
+    assert abs(v["burn_fast"] - 1.0) < 1e-6
+    assert not v["burn_alert"] and not v["latency_violation"]
+    bad = list(hist)
+    bad[20] = 500           # ~1-2s: way over target
+    eng.ingest(6.0, {"osd.0": _fake_row(600, 0, bad)})
+    v = eng.evaluate(6.0)["t1"]
+    assert v["burn_fast"] > 14.4 and v["burn_slow"] > 6.0
+    assert v["burn_alert"] and v["latency_violation"]
+    assert v["p99_ms"] > 10.0
+    # quiet windows decay the alert: snapshots advance, no new ops
+    eng.ingest(20.0, {"osd.0": _fake_row(600, 0, bad)})
+    eng.ingest(29.0, {"osd.0": _fake_row(600, 0, bad)})
+    v = eng.evaluate(29.0)["t1"]
+    assert not v["burn_alert"] and not v["latency_violation"]
+    # counter reset (OSD restart) clamps, never a negative burn
+    eng.ingest(30.0, {"osd.0": _fake_row(5, 0, [0] * 32)})
+    v = eng.evaluate(30.0)["t1"]
+    assert not v["burn_alert"]
+
+
+# -- unit: committed SLO edges survive a leader change -----------------------
+
+
+def test_slo_health_survives_leader_change():
+    """The SLO_LATENCY/SLO_BURN raise edges commit through paxos: a
+    monitor that never saw a single digest (fresh instance over the
+    same store — the freshly-elected-leader shape) still names the
+    violating tenants; a clearing digest retires the committed
+    state (the test_stats.py fresh-Monitor-same-store pattern)."""
+    from ceph_tpu.mon import Monitor
+    from ceph_tpu.msg.messages import MMonMgrDigest
+    from ceph_tpu.utils.context import Context
+
+    def slo_digest(lat, burn):
+        return {"totals": {}, "slo": {
+            t: {"latency_violation": t in lat,
+                "burn_alert": t in burn,
+                "p99_ms": 50.0, "target_ms": 10.0,
+                "burn_fast": 20.0, "burn_slow": 8.0}
+            for t in set(lat) | set(burn)}}
+
+    async def main():
+        mon = Monitor(Context("mon"))
+        await mon.start()
+        try:
+            mon.ms_dispatch(None, MMonMgrDigest(
+                digest=slo_digest(["acme"], ["acme", "bully"]),
+                epoch=1))
+            assert mon.health_mon.persisted["slolat"] == ["acme"]
+            assert mon.health_mon.persisted["sloburn"] == \
+                ["acme", "bully"]
+            checks = mon.health_mon.checks()
+            assert checks["SLO_LATENCY"]["tenants"] == ["acme"]
+            assert checks["SLO_BURN"]["tenants"] == ["acme", "bully"]
+            # steady state (same sets) commits nothing new
+            before = mon.paxos.last_committed
+            mon.ms_dispatch(None, MMonMgrDigest(
+                digest=slo_digest(["acme"], ["acme", "bully"]),
+                epoch=1))
+            assert mon.paxos.last_committed == before
+
+            # the "fresh leader": same store, zero digests seen
+            mon2 = Monitor(Context("mon"), store=mon.store)
+            assert mon2.mgr_digest is None
+            checks2 = mon2.health_mon.checks()
+            assert checks2["SLO_LATENCY"]["tenants"] == ["acme"]
+            assert checks2["SLO_BURN"]["tenants"] == \
+                ["acme", "bully"]
+
+            # a clearing digest retires the committed edges
+            mon.ms_dispatch(None, MMonMgrDigest(
+                digest=slo_digest([], []), epoch=1))
+            assert mon.health_mon.persisted["slolat"] == []
+            assert mon.health_mon.persisted["sloburn"] == []
+            checks3 = mon.health_mon.checks()
+            assert "SLO_LATENCY" not in checks3
+            assert "SLO_BURN" not in checks3
+        finally:
+            await mon.shutdown()
+
+    run(main())
+
+
+# -- unit: exporter cardinality guard ----------------------------------------
+
+
+def test_exporter_cardinality_guard():
+    from ceph_tpu.utils.exporter import validate_exposition
+
+    bounded = "\n".join(
+        ["# TYPE t_ops counter"]
+        + ['t_ops{tenant="t%d"} 1' % i for i in range(10)])
+    assert validate_exposition(bounded) == []
+    flood = "\n".join(
+        ["# TYPE t_ops counter"]
+        + ['t_ops{tenant="t%d"} 1' % i for i in range(200)])
+    errs = validate_exposition(flood)
+    assert errs and "unbounded label set" in errs[0]
+    # cap is adjustable / disableable
+    assert validate_exposition(flood, max_label_card=None) == []
+    assert validate_exposition(bounded, max_label_card=4)
+
+
+# -- cluster: end-to-end tenant threading ------------------------------------
+
+
+def test_tenant_threading_end_to_end():
+    """A tenant-stamped write is attributed at EVERY layer: the
+    primary's TrackedOp (and its dump filter), the per-tenant stage
+    histograms, the device ticket of its EC flush, the flight
+    recorder's span, and the mgr's tenant rows."""
+    os.environ.setdefault("CEPH_TPU_EC_OFFLOAD", "1")
+
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            pid = await c.create_pool("tp", pg_num=4,
+                                      pool_type="erasure")
+            await c.wait_health(pid)
+            io = c.client.io_ctx("tp", tenant="acme")
+            for i in range(6):
+                await io.write_full("obj-%d" % i, b"x" * 4096)
+            assert (await io.read("obj-0")) == b"x" * 4096
+            # the primary's historic dump carries the tenant and the
+            # tenant filter narrows to it
+            found = None
+            for osd in c.live_osds:
+                d = osd.optracker.dump_historic_ops(tenant="acme")
+                if d["num_ops"]:
+                    found = d
+                    break
+            assert found is not None, "no OSD tracked acme ops"
+            assert all(o["tenant"] == "acme" for o in found["ops"])
+            assert osd.optracker.dump_historic_ops(
+                tenant="nobody")["num_ops"] == 0
+            # per-tenant stage histograms accumulated on the primary
+            stages = set()
+            for o in c.live_osds:
+                stages |= set(o.tenant_stages.get("acme", {}))
+            assert "total" in stages
+            assert "ec_batch_wait" in stages
+            # the EC flush's device ticket carries the tenant
+            from ceph_tpu.trace import recorder as flight
+            tickets = [r for r in flight.device_records()
+                       if r.get("tenant") in ("acme", "mixed")]
+            assert tickets, "no tenant-attributed device ticket"
+            # the flight-recorder export shows tenant on op spans
+            # AND device lanes (schema-validated)
+            doc = c.export_trace()
+            from ceph_tpu.trace.recorder import validate_chrome_trace
+            assert validate_chrome_trace(doc) == []
+            op_tenants = {e["args"].get("tenant")
+                          for e in doc["traceEvents"]
+                          if e.get("cat") == "op"}
+            assert "acme" in op_tenants
+            # the mgr aggregates the tenant rows and the digest
+            # carries SLO verdicts for them
+            await c.wait_stats(
+                lambda d: d is not None and "acme" in
+                (d.get("slo") or {}), timeout=30.0,
+                what="tenant slo row in digest")
+            # tenant-labeled exporter families render lint-clean
+            from ceph_tpu.utils.exporter import validate_exposition
+            body = c.mgr.exporter.render()
+            assert validate_exposition(body) == [], \
+                validate_exposition(body)[:5]
+            assert 'ceph_tpu_tenant_ops_total{tenant="acme"}' in body
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_ec_fullwrite_dup_row_replicated_to_shards():
+    """PR-8 carried the reqid dup journal on the delta path only;
+    the full-write path must now replicate it through the shard
+    transactions too — every acting member can answer the resend
+    after a primary loss."""
+    async def main():
+        c = await LocalCluster(n_osds=3).start()
+        try:
+            pid = await c.create_pool("dup_ec", pg_num=4,
+                                      pool_type="erasure")
+            await c.wait_health(pid)
+            io = c.client.io_ctx("dup_ec")
+            await io.write_full("dup-obj", b"d" * 2048)
+            src = c.client.msgr.entity
+            tid = c.client._tid
+            from ceph_tpu.osd.osdmap import pg_t
+            m = c.client.osdmap
+            pool = m.pools[pid]
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg("dup-obj", pid))
+            _up, _upp, acting, prim = m.pg_to_up_acting_osds(pgid)
+            by_id = {o.whoami: o for o in c.live_osds}
+            answered = 0
+            for osd_id in acting:
+                osd = by_id.get(osd_id)
+                if osd is None:
+                    continue
+                pg = osd.pgs.get(pg_t(pid, pgid.ps))
+                if pg is None:
+                    continue
+                dup = pg.lookup_reqid(src, tid)
+                assert dup is not None, \
+                    "member osd.%d holds no dup row" % osd_id
+                assert dup["result"] == 0
+                answered += 1
+            assert answered >= 2, \
+                "dup row replicated to %d members only" % answered
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- cluster: traffic generator + SLO edges end to end -----------------------
+
+
+def test_noisy_neighbor_slo_raise_and_clear():
+    """A bully flood under a sub-ms latency target drives the bully
+    tenant into SLO violation through the REAL pipeline (OSD tenant
+    hists -> mgr burn engine -> digest -> committed health edge);
+    once traffic stops and the windows decay, the alerts clear."""
+    async def main():
+        c = await LocalCluster(
+            n_osds=3, with_mgr=True,
+            conf={
+                # everything is 'bad': any completed op exceeds the
+                # target, so the flood burns its budget immediately
+                "slo_latency_target_ms": 0.001,
+                "slo_fast_window": 1.5,
+                "slo_slow_window": 3.0,
+                "slo_min_ops": 5,
+            }).start()
+        try:
+            pid = await c.create_pool("noisy", pg_num=4, size=3)
+            await c.wait_health(pid)
+            gen = TrafficGenerator.build(
+                c.client, pid,
+                {"bully": {"streams": 3, "window": 3,
+                           "obj_bytes": 1024, "n_objects": 4}},
+                seed=3)
+            stats = await gen.run(2.5)
+            assert stats["bully"]["n"] > 10
+            assert stats["bully"]["errors"] == 0
+
+            def raised():
+                leader = c.leader()
+                if leader is None:
+                    return False
+                checks = leader.health_mon.checks()
+                chk = (checks.get("SLO_BURN")
+                       or checks.get("SLO_LATENCY"))
+                return (chk is not None
+                        and "bully" in chk.get("tenants", ()))
+
+            await wait_for(raised, 30.0, what="bully SLO alert")
+            leader = c.leader()
+            # the edge is paxos-COMMITTED, not soft state
+            assert "bully" in (
+                leader.health_mon.persisted["sloburn"]
+                + leader.health_mon.persisted["slolat"])
+            # acked writes survive; quiet windows clear the alerts
+            await gen.verify()
+
+            def cleared():
+                leader = c.leader()
+                if leader is None:
+                    return False
+                checks = leader.health_mon.checks()
+                return ("SLO_BURN" not in checks
+                        and "SLO_LATENCY" not in checks)
+
+            await wait_for(cleared, 45.0,
+                           what="SLO alerts cleared after quiet")
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+def test_bully_tenant_thrash_round():
+    """One bully_tenant thrash round end to end: the flood runs
+    mid-round beside the workload, zero acked writes are lost, and
+    the round's SLO oracle holds (no victim alert once healthy)."""
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True,
+                               seed=19).start()
+        try:
+            pid = await c.create_pool("bt", pg_num=4, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("bt")
+            wl = Workload(io, seed=19, prefix="bt").start()
+            th = ClusterThrasher(c, seed=19,
+                                 actions=[("bully_tenant", 0)],
+                                 hold=1.0)
+            await th.run(pid, wl)
+            await wl.stop()
+            await wl.verify()
+            # the worst-tenant beacon slice reaches the mon's soft
+            # state shape (may be empty when nothing was slow)
+            leader = c.leader()
+            assert leader is not None
+            assert isinstance(leader.osd_slow_tenants, dict)
+        finally:
+            await c.stop()
+
+    run(main())
