@@ -63,6 +63,17 @@ def device_for(chip_index: int):
     return devs[chip_index % len(devs)]
 
 
+def backend() -> str:
+    """The jax backend serving this mesh ("cpu" when jax is unusable).
+    The dispatch-stream bench gate keys its published comparisons on
+    this: CPU-CI figures never gate a real-TPU run and vice versa."""
+    try:
+        import jax
+        return str(jax.default_backend())
+    except Exception:       # pragma: no cover - jax baked into image
+        return "cpu"
+
+
 def affinity(osd_id: int, n_chips: int) -> int:
     """OSD -> chip affinity: deterministic modulo placement, so
     co-located daemons land on distinct chips until the mesh is full

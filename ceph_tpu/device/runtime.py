@@ -95,26 +95,35 @@ class DispatchTicket:
     Stamps: t_enqueue (admission requested) -> t_admit (queue granted)
     -> t_launch (dispatch handed to the device) -> t_done.  queue_wait
     and device_s are the two stages the exporter and the OpTracker
-    attribute separately.  `chip` names the mesh chip the dispatch ran
-    on (the exporter's chip label).  `tenant` attributes the dispatch
-    to the tenant whose ops it carried — the single tenant when every
-    batched item agreed, the literal "mixed" when a flush batched
-    several tenants' stripes, None for tenant-less work (recovery,
-    scrub, mapping)."""
+    attribute separately.  `t_enqueue` may be passed explicitly so the
+    wait an op spent *before* the dispatch existed counts too: the
+    stream stamps the earliest admitted op's arrival, the flush path
+    its batch's first append — queue_wait is then arrival->grant, not
+    merely device-queue wait.  `chip` names the mesh chip the dispatch
+    ran on (the exporter's chip label).  `tenant` attributes the
+    dispatch to the tenant whose ops it carried — the single tenant
+    when every batched item agreed, the literal "mixed" when a flush
+    batched several tenants' stripes, None for tenant-less work
+    (recovery, scrub, mapping).  `stream` marks a slot dispatch of the
+    continuous per-chip stream (False: a legacy/degradation flush)."""
 
     __slots__ = ("seq", "klass", "bucket", "nbytes", "chip",
                  "t_enqueue", "t_admit", "t_launch", "t_done", "ok",
-                 "error", "tenant")
+                 "error", "tenant", "stream")
 
     def __init__(self, seq: int, klass: str, bucket: int, nbytes: int,
-                 chip: int = 0, tenant: str | None = None):
+                 chip: int = 0, tenant: str | None = None,
+                 t_enqueue: float | None = None,
+                 stream: bool = False):
         self.seq = seq
         self.klass = klass
         self.bucket = bucket
         self.nbytes = nbytes
         self.chip = chip
         self.tenant = tenant
-        self.t_enqueue = time.monotonic()
+        self.stream = bool(stream)
+        self.t_enqueue = (time.monotonic() if t_enqueue is None
+                          else float(t_enqueue))
         self.t_admit = 0.0
         self.t_launch = 0.0
         self.t_done = 0.0
@@ -137,6 +146,7 @@ class DispatchTicket:
         return {"seq": self.seq, "klass": self.klass,
                 "bucket": self.bucket, "bytes": self.nbytes,
                 "chip": self.chip, "tenant": self.tenant,
+                "stream": self.stream,
                 "queue_wait": self.queue_wait,
                 "device_s": self.device_s, "ok": self.ok,
                 "error": self.error}
@@ -326,6 +336,18 @@ class ChipRuntime:
         self._listeners: list = []     # on_state_change(fallback: bool)
         self._jdev = None              # lazy jax device handle
         self._jdev_resolved = False
+        # continuous dispatch stream (device.stream): created lazily
+        # on first stream-mode submit so flush-mode/loop-less callers
+        # never pay for it
+        self._stream = None
+
+    @property
+    def stream(self):
+        """This chip's persistent dispatch stream (lazy)."""
+        if self._stream is None:
+            from .stream import DispatchStream
+            self._stream = DispatchStream(self)
+        return self._stream
 
     # -- placement ---------------------------------------------------------
 
@@ -387,9 +409,12 @@ class ChipRuntime:
     # -- tickets -----------------------------------------------------------
 
     def open_ticket(self, klass: str, bucket: int, nbytes: int,
-                    tenant: str | None = None) -> DispatchTicket:
+                    tenant: str | None = None,
+                    t_enqueue: float | None = None,
+                    stream: bool = False) -> DispatchTicket:
         return DispatchTicket(self.rt.next_seq(), klass, bucket,
-                              nbytes, chip=self.index, tenant=tenant)
+                              nbytes, chip=self.index, tenant=tenant,
+                              t_enqueue=t_enqueue, stream=stream)
 
     async def admit(self, ticket: DispatchTicket,
                     cost: float | None = None) -> None:
@@ -571,6 +596,10 @@ class ChipRuntime:
 
     def metrics(self) -> dict:
         util = self.utilization()
+        # dispatch-stream telemetry (zeros/identity until the first
+        # stream-mode submit creates the stream — metrics() must
+        # never instantiate it)
+        s = self._stream
         return {
             "device_queue_depth": self.queue.depth,
             "device_inflight": self.queue.inflight,
@@ -591,6 +620,15 @@ class ChipRuntime:
             "device_util_busy": util["busy_frac"],
             "device_util_queue_wait": util["queue_wait_frac"],
             "device_util_idle": util["idle_frac"],
+            # continuous dispatch stream: payload fraction of slot
+            # capacity, mean arrival->slot-grant latency, ops retired
+            # independently, and ops still pending admission
+            "device_slot_occupancy": round(
+                s.slot_occupancy if s is not None else 1.0, 4),
+            "device_admission_wait": round(
+                s.admission_wait_mean if s is not None else 0.0, 6),
+            "device_stream_retires": s.retired if s is not None else 0,
+            "device_stream_pending": s.pending if s is not None else 0,
         }
 
 
@@ -616,6 +654,19 @@ class DeviceRuntime:
         self._probe_cap = 1.0
         self.shard_min_words = _SHARD_MIN_WORDS
         self.util_window = 10.0     # utilization-integral window (s)
+        # continuous dispatch stream (device.stream): mode + geometry.
+        # "stream" is the architecture default — the flush batcher
+        # survives behind "flush" as the degradation route and the
+        # bench baseline
+        self.dispatch_mode = "stream"
+        self.stream_interval = 100e-6   # admission-loop idle tick (s)
+        self.stream_slot_words = 1 << 19  # slot-group geometry cap
+        self.stream_max_slots = 4         # in-flight slots per chip
+        self.stream_weights = dict(weights)
+        # per-tenant dmClock rows the stream orders admission by
+        # (osd_mclock_tenant_qos; weight column only — reservation
+        # and limit stay host-side in the op scheduler)
+        self.tenant_qos: dict[str, tuple] = {}
         self.chips: list[ChipRuntime] = [
             ChipRuntime(self, i, weights, max_inflight, max_queue)
             for i in range(max(1, n))]
@@ -676,6 +727,33 @@ class DeviceRuntime:
             self.util_window = max(
                 0.1, float(conf["device_util_window"]))
         except (KeyError, TypeError, ValueError):
+            pass
+        # dispatch-stream mode + geometry + per-tenant admission rows
+        try:
+            self.dispatch_mode = str(conf["device_dispatch_mode"])
+            self.stream_interval = max(
+                1e-6, int(conf["device_stream_interval_us"]) / 1e6)
+            self.stream_slot_words = max(
+                _MIN_BUCKET, int(conf["device_stream_slot_words"]))
+            self.stream_max_slots = max(
+                1, int(conf["device_stream_max_slots"]))
+        except (KeyError, TypeError, ValueError):
+            pass
+        try:
+            from ..osd.scheduler import parse_tenant_qos
+            self.tenant_qos = parse_tenant_qos(
+                str(conf.get("osd_mclock_tenant_qos", "") or ""))
+        except Exception:
+            pass
+        # flush-mode tunables ride along: the loop's batcher adopts
+        # the conf window/size triggers (the stream ignores both)
+        try:
+            from ..ec.batcher import DeviceBatcher
+            bat = DeviceBatcher.get()
+            bat.window_us = max(1, int(conf["ec_batch_flush_us"]))
+            bat.max_batch_bytes = max(
+                1 << 12, int(conf["ec_batch_max_bytes"]))
+        except (KeyError, TypeError, ValueError, RuntimeError):
             pass
 
     # -- mesh placement ----------------------------------------------------

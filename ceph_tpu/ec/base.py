@@ -191,7 +191,16 @@ class ErasureCode(ErasureCodeInterface):
 
         Exact under GF linearity for any matrix codec.  This is the
         scalar numpy path — `delta_async` routes the same math through
-        the device batcher and falls back here."""
+        the device batcher and falls back here.
+
+        Sub-word-aligned regions (w=16/32, length not a word
+        multiple): the tail is zero-padded to the word boundary and
+        the returned parity deltas carry the word-aligned length — a
+        sub-word overwrite dirties its whole containing parity word
+        (GF(2^w) products mix bits across the word), so callers must
+        apply the delta over the word-aligned envelope of the region
+        (the region's START must already be word-aligned; the OSD
+        delta path floors/ceils its column intervals)."""
         dm = self._device_matrix()
         if dm is None:
             raise ValueError(
@@ -202,13 +211,16 @@ class ErasureCode(ErasureCodeInterface):
         matrix, w = dm
         m = len(matrix)
         dtype = np.dtype(self._word_dtype(w))
-        arrs = {int(j): np.frombuffer(d, dtype=dtype)
-                for j, d in deltas.items()}
-        lengths = {a.shape[0] for a in arrs.values()}
+        lengths = {len(d) for d in deltas.values()}
         if len(lengths) > 1:
             raise ValueError(
                 "delta regions have differing lengths %s" % lengths)
-        n = lengths.pop() if lengths else 0
+        word = dtype.itemsize
+        pad = (-(lengths.pop() if lengths else 0)) % word
+        arrs = {int(j): np.frombuffer(
+                    bytes(d) + b"\0" * pad if pad else d, dtype=dtype)
+                for j, d in deltas.items()}
+        n = next(iter(arrs.values())).shape[0] if arrs else 0
         out: dict[int, bytes] = {}
         for i in range(m):
             acc = np.zeros(n, dtype=dtype)
@@ -236,8 +248,13 @@ class ErasureCode(ErasureCodeInterface):
         for untouched data chunks — zero rows contribute nothing under
         GF linearity, so delta flushes share the encode streams and
         compiled bucket programs, and batch with ordinary full writes
-        into the same device dispatch.  Host fallback (offload off,
-        chip poisoned, word-misaligned region) is `parity_delta`'s
+        into the same device dispatch.  Sub-word-aligned regions on
+        w=16/32 codecs are zero-padded to the word boundary and
+        dispatch on device like any other delta (they used to fall
+        back to host): the returned parity deltas carry the
+        word-aligned length, identical to `parity_delta`'s host
+        semantics, and callers apply them over the aligned envelope.
+        Host fallback (offload off, chip poisoned) is `parity_delta`'s
         numpy path; DeviceBusy and mid-flush device loss degrade
         inside the batcher the same way encode flushes do.  `on_ticket`
         receives the flush's DispatchTicket (exact per-op
@@ -259,16 +276,17 @@ class ErasureCode(ErasureCodeInterface):
             raise ValueError(
                 "delta regions have differing lengths %s" % lengths)
         nbytes = lengths.pop()
-        if (nbytes == 0 or nbytes % word
-                or not device_offload_enabled()
+        if (nbytes == 0 or not device_offload_enabled()
                 or not DeviceRuntime.get().chip_available(chip)):
             return self.parity_delta(deltas)
+        pad = (-nbytes) % word
         k = self.get_data_chunk_count()
-        arr = np.zeros((k, nbytes // word),
+        arr = np.zeros((k, (nbytes + pad) // word),
                        dtype=self._word_dtype(w))
         for j, d in deltas.items():
-            arr[int(j)] = np.frombuffer(d,
-                                        dtype=self._word_dtype(w))
+            arr[int(j)] = np.frombuffer(
+                bytes(d) + b"\0" * pad if pad else d,
+                dtype=self._word_dtype(w))
         parity = await DeviceBatcher.get().encode(
             matrix, w, arr, klass=klass or K_CLIENT_EC,
             on_ticket=on_ticket, chip=chip, tenant=tenant)

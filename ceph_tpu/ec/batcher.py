@@ -4,13 +4,26 @@ SURVEY.md "hard parts": 4KiB stripes are tiny against dispatch/HBM
 latency — the TPU win only materialises when many in-flight stripes
 ride one dispatch.  This is the aggregation layer the reference doesn't
 need (ISA-L encodes synchronously per call inside the OSD thread,
-src/erasure-code/isa/ErasureCodeIsa.cc:129): concurrent `encode_async`
-calls from any number of PGs/objects in the same event loop are queued
-per (coding-matrix, w, service-class) key and flushed as ONE device
-matmul batch — either when the pending payload reaches
-`max_batch_bytes` or when the oldest entry has waited `window_us`
-(deadline flush keeps p99 bounded, the way the reference bounds
-batching with per-op deadlines elsewhere).
+src/erasure-code/isa/ErasureCodeIsa.cc:129).
+
+Two dispatch architectures share this module's staging/encode path:
+
+* **stream** (``device_dispatch_mode=stream``, the default):
+  `encode` is a thin enqueue shim onto the caller chip's persistent
+  dispatch stream (ceph_tpu.device.stream) — continuous admission
+  into fixed-geometry slots, independent per-slot retire, no flush
+  barrier.  The stream's slot dispatches call back into
+  `stream_dispatch` below, so staging, mesh sharding, tickets and
+  host degradation are identical in both modes.
+* **flush** (the legacy architecture, kept as the bench baseline and
+  the degradation route): concurrent `encode_async` calls from any
+  number of PGs/objects in the same event loop are queued per
+  (coding-matrix, w, service-class) key and flushed as ONE device
+  matmul batch — either when the pending payload reaches
+  `max_batch_bytes` (conf ``ec_batch_max_bytes``) or when the oldest
+  entry has waited `window_us` (conf ``ec_batch_flush_us``; the
+  deadline flush keeps p99 bounded, the way the reference bounds
+  batching with per-op deadlines elsewhere).
 
 Every flush routes through the shared device runtime
 (ceph_tpu.device.runtime) onto a mesh **chip** — the caller's
@@ -89,28 +102,37 @@ def host_encode(matrix, w: int, data: np.ndarray) -> np.ndarray:
     return gf.matmul_words(m, data, int(w))
 
 
+def tenant_label(tenants) -> str | None:
+    """A dispatch's tenant attribution: the one tenant every batched
+    item agreed on, "mixed" when several tenants' stripes share the
+    dispatch, None for tenant-less work."""
+    distinct = {t for t in tenants if t is not None}
+    if not distinct:
+        return None
+    if len(distinct) == 1:
+        return next(iter(distinct))
+    return "mixed"
+
+
 class _PendingBatch:
     __slots__ = ("arrays", "futures", "tickets", "tenants", "n_words",
-                 "timer")
+                 "timer", "t_first")
 
     def __init__(self):
+        import time
         self.arrays: list[np.ndarray] = []   # each [k, n_i] words
         self.futures: list[asyncio.Future] = []
         self.tickets: list = []              # per-item on_ticket cbs
         self.tenants: list = []              # per-item tenant keys
         self.n_words = 0
         self.timer = None
+        # first item's arrival: the flush ticket's t_enqueue, so
+        # queue_wait honestly includes the batch-window wait (the
+        # figure the dispatch stream is gated against)
+        self.t_first = time.monotonic()
 
     def tenant_label(self) -> str | None:
-        """The flush's tenant attribution: the one tenant every item
-        agreed on, "mixed" when several tenants' stripes batched into
-        this dispatch, None for tenant-less work."""
-        distinct = {t for t in self.tenants if t is not None}
-        if not distinct:
-            return None
-        if len(distinct) == 1:
-            return next(iter(distinct))
-        return "mixed"
+        return tenant_label(self.tenants)
 
 
 class DeviceBatcher:
@@ -123,6 +145,9 @@ class DeviceBatcher:
 
     def __init__(self, window_us: int = 300,
                  max_batch_bytes: int = 8 << 20):
+        # flush-mode tunables; conf-backed (ec_batch_flush_us /
+        # ec_batch_max_bytes, adopted via DeviceRuntime.configure) so
+        # the bench can sweep them
         self.window_us = window_us
         self.max_batch_bytes = max_batch_bytes
         self._pending: dict[tuple, _PendingBatch] = {}
@@ -193,7 +218,21 @@ class DeviceBatcher:
         after the device call — exact per-op dispatch attribution
         (the primary shard's ticket when the flush sharded across the
         mesh).  Host-fallback flushes deliver no ticket (there was no
-        device dispatch to attribute)."""
+        device dispatch to attribute).
+
+        Dispatch architecture: under ``device_dispatch_mode=stream``
+        (the default) this call is a thin enqueue shim onto the
+        caller's chip's persistent dispatch stream (device.stream) —
+        continuous admission, independent retire.  The accumulate-
+        and-flush path below survives as the ``flush`` mode (bench
+        baseline) and as the stream's degradation route."""
+        rt = DeviceRuntime.get()
+        if rt.dispatch_mode == "stream":
+            target = rt.route(chip)
+            if target is not None:
+                return await target.stream.encode(
+                    matrix, int(w), np.ascontiguousarray(data),
+                    klass, on_ticket=on_ticket, tenant=tenant)
         key = (tuple(tuple(r) for r in matrix), int(w), klass,
                None if chip is None else int(chip))
         loop = asyncio.get_event_loop()
@@ -227,42 +266,85 @@ class DeviceBatcher:
             pb.timer.cancel()
         asyncio.get_event_loop().create_task(self._flush_async(key, pb))
 
+    async def _device_dispatch(self, rt, target, matrix_key, w: int,
+                               klass: str, parts: list[np.ndarray],
+                               n: int, tenant: str | None,
+                               t_enqueue: float | None,
+                               stream: bool):
+        """The shared device attempt both architectures ride: shard
+        plan -> single-chip or mesh-sharded encode, flush timing
+        recorded.  Returns (out, ticket) — (None, None) when the
+        device pushed back or was lost (caller degrades to the host
+        codec)."""
+        if target is None or not target.available:
+            return None, None
+        import time
+        t0 = time.perf_counter()
+        plan = rt.shard_plan(target, n)
+        if len(plan) == 1:
+            out, ticket = await self._encode_shard(
+                target, matrix_key, int(w), klass, parts, n,
+                solo=True, tenant=tenant, t_enqueue=t_enqueue,
+                stream=stream)
+        else:
+            out, ticket = await self._encode_sharded(
+                rt, plan, matrix_key, int(w), klass, parts,
+                tenant=tenant, t_enqueue=t_enqueue, stream=stream)
+        if out is not None:
+            dt = time.perf_counter() - t0
+            self.last_flush_s = dt
+            self.flush_seconds += dt
+            self.flush_history.append(dt)
+            if len(self.flush_history) > 512:
+                del self.flush_history[:256]
+        return out, ticket
+
+    def _host_dispatch(self, rt, target, chip_idx, matrix_key, w: int,
+                       parts: list[np.ndarray]) -> np.ndarray:
+        """Host-codec degradation route (device lost / DeviceBusy):
+        bit-parity with the device path by construction.  Raises on a
+        real codec error — the caller must fail the awaiting futures,
+        never hang them."""
+        flat = (parts[0] if len(parts) == 1
+                else np.concatenate(parts, axis=1))
+        out = host_encode([list(r) for r in matrix_key], w, flat)
+        (target if target is not None
+         else rt.chip(chip_idx)).host_fallbacks += 1
+        self.host_flushes += 1
+        return out
+
+    async def stream_dispatch(self, chip, matrix_key, w: int,
+                              klass: str, parts: list[np.ndarray],
+                              n: int, tenant: str | None = None,
+                              t_enqueue: float | None = None):
+        """One stream slot's dispatch (device.stream DispatchStream):
+        the same device path flushes ride — ragged bucket-ladder
+        staging on the slot's chip, mesh sharding for oversized
+        groups — with the host codec as the degradation route.
+        Returns (out, ticket-or-None); raises only on a host-codec
+        failure."""
+        rt = chip.rt
+        out, ticket = await self._device_dispatch(
+            rt, chip if chip.available else None, matrix_key, w,
+            klass, parts, n, tenant, t_enqueue, stream=True)
+        if out is None:
+            out = self._host_dispatch(rt, chip, chip.index,
+                                      matrix_key, w, parts)
+        self.batches_flushed += 1
+        self.items_encoded += len(parts)
+        return out, ticket
+
     async def _flush_async(self, key, pb: _PendingBatch) -> None:
         matrix_key, w, klass, chip_idx = key
         rt = DeviceRuntime.get()
-        import time
-        n = pb.n_words
-        out = None
-        ticket = None
         target = rt.route(chip_idx)
-        if target is not None and target.available:
-            t0 = time.perf_counter()
-            plan = rt.shard_plan(target, n)
-            tenant = pb.tenant_label()
-            if len(plan) == 1:
-                out, ticket = await self._encode_shard(
-                    target, matrix_key, int(w), klass, pb.arrays, n,
-                    solo=True, tenant=tenant)
-            else:
-                out, ticket = await self._encode_sharded(
-                    rt, plan, matrix_key, int(w), klass, pb.arrays,
-                    tenant=tenant)
-            if out is not None:
-                dt = time.perf_counter() - t0
-                self.last_flush_s = dt
-                self.flush_seconds += dt
-                self.flush_history.append(dt)
-                if len(self.flush_history) > 512:
-                    del self.flush_history[:256]
+        out, ticket = await self._device_dispatch(
+            rt, target, matrix_key, int(w), klass, pb.arrays,
+            pb.n_words, pb.tenant_label(), pb.t_first, stream=False)
         if out is None:
             try:
-                flat = (pb.arrays[0] if len(pb.arrays) == 1
-                        else np.concatenate(pb.arrays, axis=1))
-                out = host_encode([list(r) for r in matrix_key], w,
-                                  flat)
-                (target if target is not None
-                 else rt.chip(chip_idx)).host_fallbacks += 1
-                self.host_flushes += 1
+                out = self._host_dispatch(rt, target, chip_idx,
+                                          matrix_key, w, pb.arrays)
             except Exception as e:
                 # a host-path failure is a real codec error: it must
                 # reach the awaiting OSD ops (they would otherwise
@@ -294,7 +376,9 @@ class DeviceBatcher:
     async def _encode_shard(self, chip, matrix_key, w: int,
                             klass: str, parts: list[np.ndarray],
                             n: int, solo: bool,
-                            tenant: str | None = None):
+                            tenant: str | None = None,
+                            t_enqueue: float | None = None,
+                            stream: bool = False):
         """One chip's slice of a flush: admit on the chip's queue,
         stage the ragged total into its pooled bucket-ladder buffers,
         dispatch on its device.  Returns (parity [m, n], ticket).
@@ -322,7 +406,8 @@ class DeviceBatcher:
         padded = sum(seg for _lo, seg in plan)
         ticket = chip.open_ticket(klass, padded,
                                   n * k * dtype().itemsize,
-                                  tenant=tenant)
+                                  tenant=tenant, t_enqueue=t_enqueue,
+                                  stream=stream)
         try:
             await chip.admit(ticket)
         except DeviceBusy:
@@ -388,7 +473,9 @@ class DeviceBatcher:
 
     async def _encode_sharded(self, rt, plan, matrix_key, w: int,
                               klass: str, arrays: list[np.ndarray],
-                              tenant: str | None = None):
+                              tenant: str | None = None,
+                              t_enqueue: float | None = None,
+                              stream: bool = False):
         """Mesh-shard one oversized flush across the plan's chips:
         contiguous column slices encode concurrently (proven
         collective-free over the stripe axis) and reassemble
@@ -399,7 +486,8 @@ class DeviceBatcher:
         parts = await asyncio.gather(*[
             self._encode_shard(chip, matrix_key, w, klass,
                                [flat[:, lo:hi]], hi - lo, solo=False,
-                               tenant=tenant)
+                               tenant=tenant, t_enqueue=t_enqueue,
+                               stream=stream)
             for chip, lo, hi in plan])
         out = np.concatenate([p for p, _t in parts], axis=1)
         ticket = next((t for _p, t in parts if t is not None), None)

@@ -503,6 +503,14 @@ class FusedEncoder:
         """(k, P) uint32 -> (m, P) uint32, device-resident."""
         return self._fn_for(data32.shape[1])(data32)
 
+    @property
+    def program_count(self) -> int:
+        """Distinct compiled tile programs this encoder holds — the
+        encoder-side ground truth the dispatch-stream bench reports
+        beside the runtime's note_program bookkeeping (the two must
+        agree on 'a handful': slots reuse the fixed tile family)."""
+        return len(self._fns)
+
     def __call__(self, data: np.ndarray) -> np.ndarray:
         k, n = data.shape
         pad = (-n) % 4
@@ -555,9 +563,19 @@ class DeviceEncoder:
         else:
             self._fn = functools.partial(encode_xla, self._bm, w=self.w)
         self._decoders: dict[tuple, "DeviceEncoder"] = {}
+        self._shapes: set[tuple] = set()    # traced input shapes
 
     def __call__(self, data: jax.Array) -> jax.Array:
+        self._shapes.add((int(data.shape[0]), int(data.shape[1])))
         return self._fn(data)
+
+    @property
+    def program_count(self) -> int:
+        """Distinct input shapes this encoder has traced (one XLA
+        program each under jit's shape-keyed cache) — the encoder-side
+        ground truth for the dispatch-stream bench's compile-budget
+        cross-check."""
+        return len(self._shapes)
 
     def encode_batch(self, stripes: np.ndarray) -> jax.Array:
         """(batch, k, chunk_bytes) uint8 -> (batch, m, chunk_bytes)."""

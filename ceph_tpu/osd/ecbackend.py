@@ -468,6 +468,10 @@ class ECPGBackend:
                                       t.device_s)
             if top is not None:
                 top.mark_event("device_dispatched")
+                if getattr(t, "stream", False):
+                    # the op's slot retired it independently of any
+                    # co-resident slot (the continuous-dispatch path)
+                    top.mark_event("device_stream_retired")
                 top.note("device_ticket", t.dump())
                 if top.tenant is not None:
                     self.osd.note_tenant_stage(
@@ -753,9 +757,14 @@ class ECPGBackend:
         pool = self.osd.osdmap.pools[pg.pool_id]
         codec = self.codec(pool)
         matrix = getattr(codec, "matrix", None)
-        if (not matrix or getattr(codec, "w", 0) != 8
+        if (not matrix or getattr(codec, "w", 0) not in (8, 16, 32)
                 or codec.get_chunk_mapping()):
             return None
+        # w=16/32: parity changes at word granularity (GF products
+        # mix bits across the word), so column intervals align to the
+        # word boundary below; the data-chunk writes themselves stay
+        # byte-granular
+        word = codec.w // 8
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
         m = n - k
@@ -791,6 +800,8 @@ class ECPGBackend:
         if total * 4 > size:
             return None                      # big span: full RMW wins
         cs = codec.get_chunk_size(size)
+        if cs % word:
+            return None          # word-ragged chunk layout: full RMW
         # per-chunk parts: {j: [(c0, new_bytes), ...]} in column space
         per_chunk: dict[int, list] = {}
         for off, data in writes:
@@ -802,10 +813,13 @@ class ECPGBackend:
                 per_chunk.setdefault(j, []).append(
                     (c0, data[pos - off:pos - off + take]))
                 pos += take
-        # merged column intervals (parity changes exactly there); a
+        # merged column intervals (parity changes exactly there),
+        # floored/ceiled to the codec's word boundary — a sub-word
+        # overwrite dirties its whole containing parity word; a
         # boundary-crossing write yields ranges at OPPOSITE chunk ends
         # — they must stay separate reads, never one covering span
-        raw_ivs = sorted((c0, c0 + len(d))
+        raw_ivs = sorted(((c0 // word) * word,
+                          min(cs, -(-(c0 + len(d)) // word) * word))
                          for parts in per_chunk.values()
                          for c0, d in parts)
         ivs: list[list[int]] = []

@@ -80,6 +80,25 @@ DEVICE_DISPATCH_WEIGHTS = {
 TENANT_DEFAULT_PROFILE = (0.05, 1.0, 1.00)
 
 
+def device_admission_weight(klass: str, tenant: str | None,
+                            tenant_qos: dict[str, tuple] | None,
+                            ) -> float:
+    """Proportional admission weight of one op at the DEVICE layer
+    (the dispatch stream's WFQ tags, device/stream.py): the class
+    share from DEVICE_DISPATCH_WEIGHTS times, for tenant-stamped
+    client-EC work, the tenant's dmClock weight column (its
+    `osd_mclock_tenant_qos` row, default TENANT_DEFAULT_PROFILE).
+    Reservation and limit stay host-side in the op scheduler — the
+    device honors the proportional ordering, which is the column that
+    decides who a contended accelerator serves next."""
+    base = DEVICE_DISPATCH_WEIGHTS.get(klass, 1.0)
+    if tenant is None or klass != "client-ec":
+        return base
+    row = (tenant_qos or {}).get(tenant)
+    wgt = row[1] if row is not None else TENANT_DEFAULT_PROFILE[1]
+    return base * max(float(wgt), 1e-9)
+
+
 def parse_tenant_qos(spec: str) -> dict[str, tuple]:
     """Parse the `osd_mclock_tenant_qos` conf string:
     "bully:0.05:0.5:0.15,victim:0.30:4:1.0" ->

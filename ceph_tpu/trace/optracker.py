@@ -104,6 +104,8 @@ class TrackedOp:
                     "chip": t.get("chip"),
                     "klass": t.get("klass"),
                     "bucket": t.get("bucket"),
+                    # continuous-dispatch slot vs legacy flush
+                    "stream": t.get("stream"),
                     "queue_wait": t.get("queue_wait"),
                     "device_s": t.get("device_s"),
                     "dispatches": len(tickets),

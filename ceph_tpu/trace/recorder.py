@@ -178,6 +178,9 @@ def note_ticket(ticket) -> None:
         "seq": ticket.seq, "klass": ticket.klass,
         "bucket": ticket.bucket, "bytes": ticket.nbytes,
         "tenant": getattr(ticket, "tenant", None),
+        # continuous-dispatch slot vs legacy/degradation flush: the
+        # before/after is visible on the same Perfetto device lanes
+        "stream": bool(getattr(ticket, "stream", False)),
         "chip": ticket.chip, "t_enqueue": ticket.t_enqueue,
         "t_admit": ticket.t_admit, "t_launch": ticket.t_launch,
         "t_done": ticket.t_done, "ok": ticket.ok,
@@ -346,6 +349,7 @@ def chrome_trace(rings: dict[str, list[dict]],
                          "bucket": t.get("bucket"),
                          "bytes": t.get("bytes"),
                          "tenant": t.get("tenant"),
+                         "stream": t.get("stream"),
                          "queue_wait": t.get("queue_wait"),
                          "ok": t.get("ok")}})
 

@@ -50,7 +50,8 @@ OP_STAGES = frozenset({
     "aborted_interval_change", "aborted_pool_deleted",
     # EC backend (osd/ecbackend.py)
     "ec_write_started", "ec_encode_start", "ec_encoded",
-    "device_dispatched", "ec_sub_write_sent", "ec_sub_write_acked",
+    "device_dispatched", "device_stream_retired",
+    "ec_sub_write_sent", "ec_sub_write_acked",
     "ec_sub_write_timeout", "ec_write_done", "ec_read_done",
     "ec_shard_applied", "ec_delta_rmw", "ec_delta_done",
     "ec_error_reply",
@@ -75,6 +76,12 @@ DEVICE_SERIES = frozenset({
     "device_fallback_count", "device_heal_count",
     "device_queue_rejected",
     "device_util_busy", "device_util_queue_wait", "device_util_idle",
+    # continuous dispatch stream (device/stream.py): slot occupancy
+    # (payload fraction of dispatched slot capacity), admission-loop
+    # latency (mean arrival->slot-grant seconds), independent-retire
+    # and pending-admission counts
+    "device_slot_occupancy", "device_admission_wait",
+    "device_stream_retires", "device_stream_pending",
     # families prom_lines emits beside the metrics() gauges
     "device_chips", "device_dispatch_seconds",
 })
@@ -111,12 +118,24 @@ CONSUMER_STAGE_REFS = {
         "queued", "ec_encode_start", "ec_encoded", "ec_write_done",
         "device_dispatched",
     ),
+    "tests/test_dispatch_stream.py": (
+        "device_stream_retired",
+    ),
 }
 
 CONSUMER_SERIES_REFS = {
     "tests/test_flight_recorder.py": (
         "device_util_busy", "device_util_queue_wait",
         "device_util_idle",
+    ),
+    # the continuous-dispatch bench leg and its tests consume the
+    # stream series by literal name
+    "bench.py": (
+        "device_slot_occupancy", "device_admission_wait",
+    ),
+    "tests/test_dispatch_stream.py": (
+        "device_slot_occupancy", "device_admission_wait",
+        "device_stream_retires", "device_stream_pending",
     ),
 }
 

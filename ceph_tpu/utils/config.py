@@ -275,8 +275,15 @@ DEFAULT_SCHEMA: list[Option] = [
            " trimmed tail are backfilled instead of log-recovered)"),
     Option("ec_batch_max_stripes", OPT_INT, 4096,
            "max stripes aggregated into one device EC dispatch"),
-    Option("ec_batch_flush_us", OPT_INT, 200,
-           "deadline before a partial EC batch is flushed (µs)"),
+    Option("ec_batch_flush_us", OPT_INT, 300,
+           "flush-mode deadline before a partial EC batch is flushed"
+           " (µs): the window the DEADLINE flush rides when"
+           " device_dispatch_mode=flush (the continuous stream has no"
+           " flush barrier and ignores it)"),
+    Option("ec_batch_max_bytes", OPT_INT, 8 << 20,
+           "flush-mode size trigger: a pending EC batch at or above"
+           " this many staged bytes flushes immediately instead of"
+           " waiting out ec_batch_flush_us"),
     Option("osd_objectstore", OPT_STR, "memstore",
            "backing store engine (src/common/options osd_objectstore)",
            enum_allowed=("memstore", "kstore", "extentstore")),
@@ -302,6 +309,30 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("device_warmup", OPT_INT, 1,
            "pre-compile common EC shape buckets when a profile's codec"
            " is first built (0 disables)"),
+    Option("device_dispatch_mode", OPT_STR, "stream",
+           "EC dispatch architecture: 'stream' runs the persistent"
+           " per-chip dispatch stream (continuous admission into"
+           " fixed-geometry slots, independent retire — the"
+           " continuous-batching recipe from LLM serving);"
+           " 'flush' keeps the legacy accumulate-and-flush batcher"
+           " (also the stream's host-fallback/DeviceBusy degradation"
+           " route and the bench baseline)",
+           enum_allowed=("stream", "flush")),
+    Option("device_stream_interval_us", OPT_INT, 100,
+           "admission-loop idle tick (µs) of the per-chip dispatch"
+           " stream: the loop wakes immediately on arrivals and slot"
+           " completions, and at most this long apart otherwise"),
+    Option("device_stream_slot_words", OPT_INT, 1 << 19,
+           "slot-ladder geometry cap: max words one stream slot group"
+           " stages (a group covers its words with the pow2 bucket"
+           " ladder, so slot programs are the same compiled family"
+           " flush batching uses; ops larger than this mesh-shard"
+           " like oversized flushes)"),
+    Option("device_stream_max_slots", OPT_INT, 4,
+           "concurrent slot dispatches a chip's stream keeps in"
+           " flight; further admissions stay pending in the stream"
+           " (where a later-arriving urgent class can still overtake)"
+           " instead of parking deep in the device queue"),
     Option("device_shard_min_words", OPT_INT, 1 << 19,
            "EC flushes at or above this many words per chunk shard"
            " column-wise across every available mesh chip (the"

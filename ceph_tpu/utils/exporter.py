@@ -239,7 +239,11 @@ def device_runtime_lines(prefix: str = "ceph_tpu") -> list[str]:
     zero), compile count, fallback state, the windowed utilization
     integrals (``device_util_busy`` / ``device_util_queue_wait`` /
     ``device_util_idle`` — the per-chip saturation signal the flight
-    recorder's accounting derives), and the device_dispatch_seconds
+    recorder's accounting derives), the continuous-dispatch stream
+    gauges (``device_slot_occupancy`` — payload fraction of dispatched
+    slot capacity, ``device_admission_wait`` — mean arrival->grant
+    seconds of the admission loop, plus the independent-retire and
+    pending counts), and the device_dispatch_seconds
     histogram — every dispatch ticket feeds these, so the
     accelerator's behavior is scrapeable beside the daemon counters.
     Every series carries a ``chip`` label (one per mesh chip, so a
