@@ -1696,6 +1696,295 @@ def _bench_pgmap_fold(n_rows: int = 100_000) -> dict:
     return out
 
 
+def _synth_stat_rows(n_rows: int, n_daemons: int = 64,
+                     seed: int = 23) -> dict:
+    """Deterministic synthetic report set grouped by daemon (the
+    ingest benchmark's offered load): every stat column populated,
+    including the scrub/misplaced columns the fold sums."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    pools = rng.integers(1, 13, n_rows)
+    daemons = rng.integers(0, n_daemons, n_rows)
+    objs = rng.integers(0, 100, n_rows)
+    wops = rng.integers(0, 10000, n_rows)
+    by_daemon: dict = {}
+    for i in range(n_rows):
+        by_daemon.setdefault("osd.%d" % daemons[i], []).append({
+            "pgid": "%d.%x" % (pools[i], i), "pool": int(pools[i]),
+            "state": "active" if i % 7 else "peering",
+            "num_objects": int(objs[i]),
+            "num_bytes": int(objs[i]) << 20, "degraded": int(i % 5),
+            "misplaced": int(objs[i]) % 3, "unfound": 0,
+            "log_size": 10, "scrub_errors": int(i % 97 == 0),
+            "read_ops": int(wops[i]), "read_bytes": 0,
+            "write_ops": int(wops[i]),
+            "write_bytes": int(wops[i]) << 12,
+            "recovery_ops": 0, "recovery_bytes": 0})
+    return by_daemon
+
+
+def _digest_mismatches(a: dict, b: dict) -> list:
+    """Structural comparison of two PGMap digests (the golden-equal
+    oracle of the ingest gate): ints exact, floats to 1e-9 rel."""
+    errs = []
+    for k in ("num_pgs", "pg_states", "inactive_pgs",
+              "inconsistent_pgs"):
+        if a.get(k) != b.get(k):
+            errs.append(k)
+    if set(a["pools"]) != set(b["pools"]):
+        errs.append("pool-id set")
+        return errs
+    for pid in a["pools"]:
+        ra, rb = a["pools"][pid], b["pools"][pid]
+        for k in set(ra) | set(rb):
+            va, vb = ra.get(k), rb.get(k)
+            if isinstance(va, float) or isinstance(vb, float):
+                scale = max(abs(va), abs(vb), 1e-12)
+                if abs(va - vb) > 1e-9 * scale:
+                    errs.append("pool %s %s" % (pid, k))
+            elif va != vb:
+                errs.append("pool %s %s" % (pid, k))
+    for k, va in a["totals"].items():
+        vb = b["totals"][k]
+        if abs(va - vb) > 1e-9 * max(abs(va), abs(vb), 1e-12):
+            errs.append("totals %s" % k)
+    return errs
+
+
+def bench_ingest(n_rows: int = 100_000,
+                 sweep_rows: int = 500_000) -> dict:
+    """The --scale ladder's ingest leg (telemetry fabric): the same
+    synthetic report set through the row-wise dict path and the
+    packed columnar fast path of the SAME PGMap, pinned golden
+    against DictPGMap, plus the >=500k-PG digest sweep the columnar
+    wire format unlocks.  Both paths warm on an untimed first
+    generation (cold-start row allocation is a boot-time cost,
+    reported as cold_*_s) and are compared on two steady-state
+    generations — the cadence a live mgr actually runs at.  Publishes
+    rows/s + end-to-end report->digest latency into SCALE.json behind
+    the gate."""
+    import jax
+
+    from ceph_tpu.mgr.daemon import ingest_prom_lines
+    from ceph_tpu.mgr.pgmap import DictPGMap, PGMap
+    from ceph_tpu.msg.statblock import block_nbytes, pack_stat_rows
+    from ceph_tpu.utils.exporter import validate_exposition
+
+    by_daemon = _synth_stat_rows(n_rows)
+
+    def bump(reports, w, r):
+        return {d: [dict(row, write_ops=row["write_ops"] + w,
+                         recovery_ops=row["recovery_ops"] + r)
+                    for row in rows]
+                for d, rows in reports.items()}
+
+    # three report generations: gen0 warms the store (cold-start row
+    # allocation is a boot-time cost, reported separately), gens 1+2
+    # are the timed steady-state ingest both paths are compared on
+    gens = [by_daemon, bump(by_daemon, 32, 8), bump(by_daemon, 64, 24)]
+    t0 = time.perf_counter()
+    gen_blocks = [{d: pack_stat_rows(rows) for d, rows in g.items()}
+                  for g in gens]
+    pack_s = (time.perf_counter() - t0) / len(gens)
+    wire_bytes = sum(block_nbytes(b) for b in gen_blocks[0].values())
+
+    def ingest(pm, reports, as_blocks, stamp):
+        for d in reports:
+            if as_blocks:
+                pm.apply_report(d, None, None, stamp,
+                                pg_stats_cols=reports[d])
+            else:
+                pm.apply_report(d, reports[d], None, stamp)
+
+    stamps = (100.0, 104.0, 108.0)
+    pm_row = PGMap(stale_after=1e9)
+    t0 = time.perf_counter()
+    ingest(pm_row, gens[0], False, stamps[0])
+    cold_rowwise_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for g, stamp in zip(gens[1:], stamps[1:]):
+        ingest(pm_row, g, False, stamp)
+    rowwise_s = time.perf_counter() - t0
+
+    pm_col = PGMap(stale_after=1e9)
+    t0 = time.perf_counter()
+    ingest(pm_col, gen_blocks[0], True, stamps[0])
+    cold_columnar_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for blocks, stamp in zip(gen_blocks[1:], stamps[1:]):
+        ingest(pm_col, blocks, True, stamp)
+    columnar_s = time.perf_counter() - t0
+
+    ref = DictPGMap(stale_after=1e9)
+    for g, stamp in zip(gens, stamps):
+        ingest(ref, g, False, stamp)
+    mismatches = _digest_mismatches(ref.digest(now=108.0),
+                                    pm_col.digest(now=108.0))
+    mismatches += _digest_mismatches(ref.digest(now=108.0),
+                                     pm_row.digest(now=108.0))
+
+    # end-to-end report->digest latency: one full report generation
+    # (pack at the producers + vectorized mgr merge + digest fold)
+    t0 = time.perf_counter()
+    fresh = {d: pack_stat_rows(rows)
+             for d, rows in gens[2].items()}
+    ingest(pm_col, fresh, True, 112.0)
+    dig = pm_col.digest(now=112.0)
+    e2e_s = time.perf_counter() - t0
+    assert dig["num_pgs"] == n_rows
+
+    # the >=500k-PG digest sweep: columnar blocks vs the legacy
+    # row path (DictPGMap), digest output golden-identical
+    sweep: dict = {"rows": sweep_rows}
+    sweep_by = _synth_stat_rows(sweep_rows, seed=29)
+    sweep_bumped = {d: [dict(r, write_ops=r["write_ops"] + 16)
+                        for r in rows]
+                    for d, rows in sweep_by.items()}
+    sweep_blocks = [
+        (stamp, {d: pack_stat_rows(rows) for d, rows in rep.items()})
+        for stamp, rep in ((100.0, sweep_by), (104.0, sweep_bumped))]
+    pm_sweep = PGMap(stale_after=1e9)
+    t0 = time.perf_counter()
+    for stamp, reports in sweep_blocks:
+        for d, blk in reports.items():
+            pm_sweep.apply_report(d, None, None, stamp,
+                                  pg_stats_cols=blk)
+    sweep["ingest_s"] = round(time.perf_counter() - t0, 4)
+    t0 = time.perf_counter()
+    dig_sweep = pm_sweep.digest(now=104.0)
+    sweep["digest_s"] = round(time.perf_counter() - t0, 4)
+    sweep["num_pgs"] = dig_sweep["num_pgs"]
+    sweep["rows_per_s"] = round(2 * sweep_rows / sweep["ingest_s"])
+    ref_sweep = DictPGMap(stale_after=1e9)
+    ingest(ref_sweep, sweep_by, False, 100.0)
+    ingest(ref_sweep, sweep_bumped, False, 104.0)
+    sweep["mismatches"] = _digest_mismatches(
+        ref_sweep.digest(now=104.0), dig_sweep)
+    sweep["fallback_rows"] = pm_sweep.ingest["fallback_rows"]
+
+    # the ingest exporter surface renders clean (the drift lint's
+    # bench-side consumer refs: assert the families by literal)
+    lines = ingest_prom_lines(pm_col)
+    assert any(ln.startswith("ceph_tpu_mgr_ingest_seconds")
+               for ln in lines)
+    assert any(ln.startswith("ceph_tpu_mgr_report_rows_total")
+               for ln in lines)
+    lint = validate_exposition("\n".join(lines))
+
+    return {
+        "metric": "ingest_plane",
+        "rows": n_rows,
+        "backend": jax.default_backend(),
+        "rowwise_s": round(rowwise_s, 4),
+        "columnar_s": round(columnar_s, 4),
+        "cold_rowwise_s": round(cold_rowwise_s, 4),
+        "cold_columnar_s": round(cold_columnar_s, 4),
+        "speedup_x": round(rowwise_s / max(columnar_s, 1e-9), 1),
+        "rows_per_s": round(2 * n_rows / max(columnar_s, 1e-9)),
+        "pack_s": round(pack_s, 4),
+        "wire_bytes": wire_bytes,
+        "report_to_digest_s": round(e2e_s, 4),
+        "golden_equal": not mismatches,
+        "mismatches": mismatches[:8],
+        "fallback_rows": pm_col.ingest["fallback_rows"],
+        "exposition_errors": lint[:8],
+        "sweep": sweep,
+    }
+
+
+def _gate_ingest(rec: dict, min_speedup: float = 20.0) -> dict:
+    """Ingest-leg regression gate: the columnar fast path must be
+    >= min_speedup x the row-wise loop, bit-golden against the
+    legacy path (both sizes), never fall back to the row loop, render
+    a lint-clean exposition, and hold rows/s against the published
+    same-backend SCALE.json figure (3x allowance, like the other
+    scale timings)."""
+    import os
+    failures = []
+    if rec["speedup_x"] < min_speedup:
+        failures.append("ingest speedup %.1fx < %.0fx"
+                        % (rec["speedup_x"], min_speedup))
+    if not rec["golden_equal"]:
+        failures.append("columnar digest diverged from the legacy"
+                        " row path: %s" % rec["mismatches"])
+    sweep = rec.get("sweep") or {}
+    if sweep.get("mismatches"):
+        failures.append("digest sweep diverged: %s"
+                        % sweep["mismatches"])
+    if sweep.get("num_pgs") != sweep.get("rows"):
+        failures.append("digest sweep dropped rows (%s of %s)"
+                        % (sweep.get("num_pgs"), sweep.get("rows")))
+    if rec.get("fallback_rows") or sweep.get("fallback_rows"):
+        failures.append("columnar ingest fell back to the row loop")
+    if rec.get("exposition_errors"):
+        failures.append("ingest exposition lint: %s"
+                        % rec["exposition_errors"])
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SCALE.json")
+    try:
+        with open(path) as f:
+            prev = (json.load(f).get("measured") or {}).get("ingest")
+    except Exception:
+        prev = None
+    if (prev and prev.get("rows") == rec["rows"]
+            and prev.get("backend") == rec["backend"]
+            and rec["rows_per_s"] < prev.get("rows_per_s", 0) / 3):
+        failures.append(
+            "ingest %d rows/s regressed past 3x under the published"
+            " %d rows/s" % (rec["rows_per_s"], prev["rows_per_s"]))
+    return {"ok": not failures, "failures": failures}
+
+
+def _publish_ingest(rec: dict) -> None:
+    """Merge the ingest leg into SCALE.json's measured map (the shell
+    legs stay whatever the last full --scale run published) and
+    BASELINE.json's published map.  A failed gate publishes nothing.
+    """
+    import os
+    if not rec.get("gate", {}).get("ok"):
+        return
+    root = os.path.dirname(os.path.abspath(__file__))
+    keep = ("metric", "rows", "backend", "rowwise_s", "columnar_s",
+            "speedup_x", "rows_per_s", "pack_s", "wire_bytes",
+            "report_to_digest_s", "sweep")
+    try:
+        path = os.path.join(root, "SCALE.json")
+        doc = {}
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            pass
+        doc.setdefault("measured", {})["ingest"] = {
+            k: rec[k] for k in keep if k in rec}
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+        return
+    try:
+        path = os.path.join(root, "BASELINE.json")
+        with open(path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})["telemetry_fabric"] = {
+            "rows": rec["rows"],
+            "backend": rec["backend"],
+            "ingest_speedup_x": rec["speedup_x"],
+            "ingest_rows_per_s": rec["rows_per_s"],
+            "report_to_digest_s": rec["report_to_digest_s"],
+            "sweep_rows": rec["sweep"]["rows"],
+            "sweep_digest_s": rec["sweep"]["digest_s"],
+            "source": "bench.py --scale/--ingest",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+
+
 def bench_scale(sizes: tuple = (1000,)) -> dict:
     """--scale mode: boot shell clusters through the real mon path
     (ceph_tpu.scale), churn topology, and publish the control-plane
@@ -1765,9 +2054,14 @@ def bench_scale(sizes: tuple = (1000,)) -> dict:
         "metric": "scale_plane",
         "legs": legs,
         "pgmap_fold": _bench_pgmap_fold(),
+        "ingest": bench_ingest(),
     }
+    rec["ingest"]["gate"] = _gate_ingest(rec["ingest"])
     rec["gate"] = _gate_scale(rec)
+    rec["gate"]["failures"] += rec["ingest"]["gate"]["failures"]
+    rec["gate"]["ok"] = not rec["gate"]["failures"]
     _publish_scale(rec)
+    _publish_ingest(rec["ingest"])
     return rec
 
 
@@ -1836,11 +2130,16 @@ def _publish_scale(rec: dict) -> None:
                 doc = json.load(f)
         except Exception:
             pass
-        doc["measured"] = {
+        measured = {
             "source": "bench.py --scale",
             "legs": rec["legs"],
             "pgmap_fold": rec["pgmap_fold"],
         }
+        # the ingest section is published by _publish_ingest (also
+        # reachable via --ingest alone); keep whatever is committed
+        if (doc.get("measured") or {}).get("ingest"):
+            measured["ingest"] = doc["measured"]["ingest"]
+        doc["measured"] = measured
         with open(path, "w") as f:
             json.dump(doc, f, indent=1)
             f.write("\n")
@@ -1900,6 +2199,28 @@ def main() -> None:
             # the recorder's overhead budget and the utilization
             # accounting are guarded artifacts: a >5% cost, a dead
             # span feed, or idle-only integrals is a CI failure
+            sys.exit(1)
+        return
+    if "--ingest" in sys.argv:
+        # the telemetry-fabric ingest leg alone (the full --scale
+        # ladder boots 1k+ shells; this re-measures just the stat
+        # pipeline and merges into SCALE.json's ingest section)
+        i = sys.argv.index("--ingest")
+        n_rows, sweep_rows = 100_000, 500_000
+        if i + 1 < len(sys.argv) and \
+                sys.argv[i + 1].replace(",", "").isdigit():
+            parts = [int(s) for s in sys.argv[i + 1].split(",") if s]
+            n_rows = parts[0]
+            if len(parts) > 1:
+                sweep_rows = parts[1]
+        rec = bench_ingest(n_rows, sweep_rows)
+        rec["gate"] = _gate_ingest(rec)
+        _publish_ingest(rec)
+        print(json.dumps(rec))
+        if not rec["gate"]["ok"]:
+            # the ingest figures are guarded artifacts: a fast-path
+            # fallback, a digest divergence from the legacy row
+            # path, or a rows/s regression is a CI failure
             sys.exit(1)
         return
     if "--scale" in sys.argv:

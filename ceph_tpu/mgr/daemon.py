@@ -32,6 +32,37 @@ from ..utils.exporter import PrometheusExporter
 from .pgmap import PGMap, RATE_KEYS
 
 
+def ingest_prom_lines(pgmap) -> list[str]:
+    """Telemetry-fabric ingest families rendered from a PGMap's
+    accounting (module-level so `bench.py --scale`'s ingest leg can
+    lint the exposition without a live Manager): per-format report
+    row/byte counters, the apply-latency histogram, the row-loop
+    fallback counter, and the visible prune counters."""
+    from ..utils.exporter import hist_lines
+    ing = pgmap.ingest
+    lines: list[str] = []
+    for fam, key in (("ceph_tpu_mgr_report_rows_total", "rows"),
+                     ("ceph_tpu_mgr_report_bytes_total", "bytes")):
+        lines.append("# TYPE %s counter" % fam)
+        for fmt in ("columnar", "legacy"):
+            lines.append('%s{format="%s"} %d'
+                         % (fam, fmt, ing[key][fmt]))
+    lines.extend(hist_lines("ceph_tpu_mgr_ingest_seconds",
+                            ing["seconds_hist"]))
+    lines.append(
+        "# TYPE ceph_tpu_mgr_ingest_fallback_rows_total counter")
+    lines.append("ceph_tpu_mgr_ingest_fallback_rows_total %d"
+                 % ing["fallback_rows"])
+    lines.append("# TYPE ceph_tpu_mgr_rows_pruned_total counter")
+    for reason, count in (("stale", pgmap.pruned_stale),
+                          ("pool", pgmap.pruned_pool),
+                          ("daemon", pgmap.pruned_daemons)):
+        lines.append(
+            'ceph_tpu_mgr_rows_pruned_total{reason="%s"} %d'
+            % (reason, count))
+    return lines
+
+
 class Manager:
     def __init__(self, mon_addr, ctx: Context | None = None,
                  balance_interval: float = 5.0):
@@ -127,8 +158,10 @@ class Manager:
                 "epoch": msg.epoch,
                 "stamp": now,
             }
-            self.pgmap.apply_report(msg.daemon, msg.pg_stats,
-                                    msg.osd_stats, now)
+            self.pgmap.apply_report(
+                msg.daemon, msg.pg_stats, msg.osd_stats, now,
+                pg_stats_cols=getattr(msg, "pg_stats_cols", None),
+                nbytes=getattr(msg, "wire_bytes", None))
             return True
         if isinstance(msg, MMonCommandAck):
             fut = self._cmd_futures.pop(msg.tid, None)
@@ -182,6 +215,7 @@ class Manager:
         exp.add_renderer(self._render_pgmap)
         exp.add_renderer(self._render_event_plane)
         exp.add_renderer(self._render_tenants)
+        exp.add_renderer(self._render_ingest)
 
     def _total_slow_ops(self) -> int:
         """Cluster-wide slow-op count aggregated from the per-daemon
@@ -390,6 +424,12 @@ class Manager:
                     lines.append('%s{tenant="%s"} %g' % (fam, t, v))
         return lines
 
+    def _render_ingest(self) -> list[str]:
+        """Telemetry-fabric ingest observability: report rows/bytes
+        by wire format, apply latency, fallback + prune counters —
+        the stat pipeline measured like every other plane."""
+        return ingest_prom_lines(self.pgmap)
+
     # -- stats loop (PGMap digest -> monitors) -----------------------------
 
     async def _stats_loop(self) -> None:
@@ -403,6 +443,18 @@ class Manager:
                 continue
             now = asyncio.get_event_loop().time()
             try:
+                # reclaim rows the folds already ignore: dead
+                # primaries past the prune window + deleted pools —
+                # counted (ceph_tpu_mgr_rows_pruned_total), never
+                # silent.  The pool filter only engages once the mgr
+                # holds a pool table (a lagging map must not wipe
+                # fresh rows; they would be refiltered next tick).
+                self.pgmap.prune(
+                    now,
+                    pools=(set(self.osdmap.pools)
+                           if self.osdmap.pools else None),
+                    after=float(self.ctx.conf.get(
+                        "mgr_stats_prune_after", 60.0)))
                 digest = self.pgmap.digest(now, self.osdmap)
                 # tenant SLO plane: ingest this tick's cumulative
                 # tenant rows, evaluate the burn windows, and ship

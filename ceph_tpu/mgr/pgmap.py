@@ -21,20 +21,38 @@ Counter resets (primary restart or failover) surface as negative
 deltas and clamp to zero — exactly one digest period of undercounted
 rate, never a negative or wildly inflated one.
 
-**Columnar storage** (the scale-plane shape): at 100k-1M PG rows the
-per-tick fold (pool totals + state counts + digest) dominates the
-mgr, so rows live in flat numpy columns — one int64/float64 array per
-stat — and every fold is a vectorized masked pass (staleness window,
-pool filter, per-pool segment sums) instead of a python dict walk.
-Ingest stays row-wise (one primary's report is small); the fold is
-where the rows multiply.  `DictPGMap` below preserves the original
-dict-of-rows implementation as the golden reference the columnar fold
-is pinned against (and the fold micro-benchmark's baseline).
+**Columnar storage + columnar ingest** (the telemetry fabric): at
+100k-1M PG rows both the per-tick fold AND the per-report merge
+dominate the mgr, so rows live in flat numpy columns — one
+int64/float64 array per stat — keyed by the integer pgid key
+``pool << 32 | seed`` rather than the pgid string.  Folds are
+vectorized masked passes (staleness window, pool filter, per-pool
+segment sums), and a packed columnar report block
+(``msg.statblock``: the MMgrReport ``pg_stats_cols`` field) merges as
+ONE searchsorted + masked scatter per report — rate derivation,
+counter-reset clamping and primary-change resets included — instead
+of a python loop per row.  Legacy dict-shaped ``pg_stats`` rows take
+the original row-wise path into the same columns, so mixed fleets
+converge to one digest.  `DictPGMap` below preserves the original
+dict-of-rows implementation as the golden reference both paths are
+pinned against (and the ingest/fold micro-benchmarks' baseline).
+
+**Pruning**: stale rows (dead primaries past the prune window) and
+deleted-pool rows compact OUT of the column store as a vectorized
+keep-mask pass, with visible counters (``pruned_stale`` /
+``pruned_pool`` / ``pruned_daemons`` — the exporter's
+``ceph_tpu_mgr_rows_pruned_total``) instead of silent drops; the
+staleness *fold* masks are unchanged, pruning only reclaims rows the
+folds already ignore.
 """
 
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
+
+from ..msg import statblock
 
 RATE_COUNTERS = ("read_ops", "read_bytes", "write_ops", "write_bytes",
                  "recovery_ops", "recovery_bytes")
@@ -52,6 +70,33 @@ _INT_COLS = (("pool", "pool", None),
              ("log_size", "log_size", "log_size"),
              ("scrub_errors", "scrub_errors", "scrub_errors"))
 
+# the packed wire block's column orders must mirror the store's (the
+# scatter assigns positionally); a drift here is a bug, not a skew
+assert statblock.STAT_CTR_COLS == RATE_COUNTERS
+assert statblock.STAT_INT_COLS == tuple(w for _c, w, _o in _INT_COLS)
+
+
+def _new_ingest() -> dict:
+    """Ingest accounting shared by PGMap and DictPGMap: reports/rows/
+    bytes per wire format, the apply-latency pow2-µs histogram
+    (``ceph_tpu_mgr_ingest_seconds``), and the count of block rows
+    that had to fall back to the row-wise loop (the fast-path
+    coverage oracle — 0 in a healthy fleet)."""
+    return {"reports": {"columnar": 0, "legacy": 0},
+            "rows": {"columnar": 0, "legacy": 0},
+            "bytes": {"columnar": 0, "legacy": 0},
+            "fallback_rows": 0,
+            "seconds_hist": [0] * 32}
+
+
+def _note_ingest(ing: dict, fmt: str, rows: int, nbytes: int,
+                 seconds: float) -> None:
+    ing["reports"][fmt] += 1
+    ing["rows"][fmt] += rows
+    ing["bytes"][fmt] += int(nbytes)
+    us = int(seconds * 1e6)
+    ing["seconds_hist"][max(0, min(31, us.bit_length() - 1))] += 1
+
 
 class _RatesView:
     """Read-only dict-shaped view over the rate columns (the
@@ -61,7 +106,7 @@ class _RatesView:
         self._pm = pm
 
     def _row(self, pgid) -> int | None:
-        row = self._pm._idx.get(pgid)
+        row = self._pm._row_of(pgid)
         if row is None or not self._pm._has_rate[row]:
             return None
         return row
@@ -83,17 +128,27 @@ class _RatesView:
 class PGMap:
     def __init__(self, stale_after: float = 15.0):
         self.stale_after = float(stale_after)
-        # pgid -> row index into the columns
-        self._idx: dict[str, int] = {}
         self._n = 0
         self._cap = 0
         self._int: dict[str, np.ndarray] = {}       # int64 stats
         self._ctr: list[np.ndarray] = []            # RATE_COUNTERS
         self._rate: list[np.ndarray] = []           # RATE_KEYS
+        self._keys = np.empty(0, np.int64)          # pool<<32|seed
         self._stamp = np.empty(0, np.float64)
         self._from = np.empty(0, np.int32)          # interned daemon
         self._state = np.empty(0, np.int16)         # interned state
         self._has_rate = np.empty(0, bool)
+        # (sorted key array, row-of-sorted-position) — the searchsorted
+        # index; None = dirty.  Rows allocated since the last rebuild
+        # sit in _pending so scalar lookups never force a resort.
+        self._sorted: tuple[np.ndarray, np.ndarray] | None = None
+        self._pending: dict[int, int] = {}
+        # daemon code -> (last block's key array, resolved rows):
+        # the steady-state ingest shortcut (cleared on compaction)
+        self._daemon_rows: dict[int, tuple] = {}
+        # pgids outside the canonical "pool.seed" shape get synthetic
+        # negative keys (never collide with parsed keys, which are >=0)
+        self._str_keys: dict[str, int] = {}
         self._daemon_codes: dict[str, int] = {}
         self._state_codes: dict[str, int] = {}
         self._state_names: list[str] = []
@@ -101,46 +156,125 @@ class PGMap:
         # daemon -> {"op_size_hist_bytes_pow2": [...], "_stamp": t}
         # (bounded: one row per reporting daemon, never per-PG)
         self.osd_stats: dict[str, dict] = {}
+        # daemon -> stamp of its last report of ANY shape (freshness
+        # axis: shells report pg rows with osd_stats=None)
+        self.report_stamps: dict[str, float] = {}
+        self.ingest = _new_ingest()
+        self.pruned_stale = 0
+        self.pruned_pool = 0
+        self.pruned_daemons = 0
 
     # -- column plumbing ---------------------------------------------------
 
-    def _grow(self) -> None:
-        new_cap = max(256, self._cap * 2)
+    def _grow(self, need: int) -> None:
+        new_cap = max(256, self._cap)
+        while new_cap < need:
+            new_cap *= 2
         pad = new_cap - self._cap
 
         def ext(arr, fill=0):
             return np.concatenate(
                 [arr, np.full(pad, fill, arr.dtype)])
 
-        for k in list(self._int):
-            self._int[k] = ext(self._int[k])
-        self._ctr = [ext(a) for a in self._ctr]
-        self._rate = [ext(a) for a in self._rate]
-        self._stamp = ext(self._stamp)
-        self._from = ext(self._from, -1)
-        self._state = ext(self._state)
-        self._has_rate = ext(self._has_rate, False)
+        if not self._cap:
+            self._int = {c: np.zeros(new_cap, np.int64)
+                         for c, _w, _o in _INT_COLS}
+            self._ctr = [np.zeros(new_cap, np.float64)
+                         for _ in RATE_COUNTERS]
+            self._rate = [np.zeros(new_cap, np.float64)
+                          for _ in RATE_KEYS]
+            self._keys = np.zeros(new_cap, np.int64)
+            self._stamp = np.zeros(new_cap, np.float64)
+            self._from = np.full(new_cap, -1, np.int32)
+            self._state = np.zeros(new_cap, np.int16)
+            self._has_rate = np.zeros(new_cap, bool)
+        else:
+            for k in list(self._int):
+                self._int[k] = ext(self._int[k])
+            self._ctr = [ext(a) for a in self._ctr]
+            self._rate = [ext(a) for a in self._rate]
+            self._keys = ext(self._keys)
+            self._stamp = ext(self._stamp)
+            self._from = ext(self._from, -1)
+            self._state = ext(self._state)
+            self._has_rate = ext(self._has_rate, False)
         self._cap = new_cap
 
-    def _alloc_row(self, pgid: str) -> int:
-        if not self._cap:
-            self._int = {c: np.zeros(256, np.int64)
-                         for c, _w, _o in _INT_COLS}
-            self._ctr = [np.zeros(256, np.float64)
-                         for _ in RATE_COUNTERS]
-            self._rate = [np.zeros(256, np.float64)
-                          for _ in RATE_KEYS]
-            self._stamp = np.zeros(256, np.float64)
-            self._from = np.full(256, -1, np.int32)
-            self._state = np.zeros(256, np.int16)
-            self._has_rate = np.zeros(256, bool)
-            self._cap = 256
-        elif self._n >= self._cap:
-            self._grow()
+    def _pgid_key(self, pgid: str) -> int:
+        try:
+            pool_s, dot, seed_s = pgid.partition(".")
+            if dot:
+                pool = int(pool_s)
+                seed = int(seed_s, 16)
+                if pool >= 0 and 0 <= seed <= statblock._SEED_MAX:
+                    return (pool << 32) | seed
+            raise ValueError(pgid)
+        except ValueError:
+            k = self._str_keys.get(pgid)
+            if k is None:
+                k = -(len(self._str_keys) + 1)
+                self._str_keys[pgid] = k
+            return k
+
+    def _ensure_index(self) -> None:
+        if self._sorted is not None and not self._pending:
+            return
+        keys = self._keys[:self._n]
+        order = np.argsort(keys, kind="stable").astype(np.int64)
+        self._sorted = (keys[order], order)
+        self._pending.clear()
+
+    def _row_of_key(self, key: int) -> int | None:
+        row = self._pending.get(key)
+        if row is not None:
+            return row
+        if self._sorted is None:
+            self._ensure_index()
+        sk, sr = self._sorted
+        i = int(np.searchsorted(sk, key))
+        if i < sk.size and sk[i] == key:
+            return int(sr[i])
+        return None
+
+    def _row_of(self, pgid: str) -> int | None:
+        return self._row_of_key(self._pgid_key(pgid))
+
+    def _alloc_row(self, key: int) -> int:
+        if self._n >= self._cap:
+            self._grow(self._n + 1)
         row = self._n
         self._n += 1
-        self._idx[pgid] = row
+        self._keys[row] = key
+        self._pending[key] = row
         return row
+
+    def _alloc_rows(self, new_keys: np.ndarray) -> None:
+        """Bulk allocation for a columnar block's unseen pgids: one
+        capacity growth, one key scatter, and an O(n+m) merge of the
+        (sorted) new keys into the sorted index — never a resort, so
+        a fleet's worth of first-sight blocks stays linear."""
+        m = new_keys.size
+        need = self._n + m
+        if need > self._cap:
+            self._grow(need)
+        new_rows = np.arange(self._n, need, dtype=np.int64)
+        self._keys[self._n:need] = new_keys
+        self._n = need
+        sk, sr = self._sorted
+        # one manual two-array merge (np.insert would re-derive the
+        # destination mask per array): new keys land at their sorted
+        # positions, the old index shifts around them
+        dest = np.searchsorted(sk, new_keys) + np.arange(m)
+        total = sk.size + m
+        out_k = np.empty(total, np.int64)
+        out_r = np.empty(total, np.int64)
+        hole = np.ones(total, bool)
+        hole[dest] = False
+        out_k[dest] = new_keys
+        out_r[dest] = new_rows
+        out_k[hole] = sk
+        out_r[hole] = sr
+        self._sorted = (out_k, out_r)
 
     def _daemon_code(self, daemon: str) -> int:
         code = self._daemon_codes.get(daemon)
@@ -164,24 +298,59 @@ class PGMap:
     # -- ingest ------------------------------------------------------------
 
     def apply_report(self, daemon: str, pg_stats: list | None,
-                     osd_stats: dict | None, stamp: float) -> None:
+                     osd_stats: dict | None, stamp: float,
+                     pg_stats_cols: dict | None = None,
+                     nbytes: int | None = None) -> None:
         """Fold one daemon's report in.  `stamp` is the receiver's
-        clock at arrival (injectable for exact-delta tests)."""
+        clock at arrival (injectable for exact-delta tests).
+        ``pg_stats_cols`` is the packed columnar block (statblock) the
+        vectorized merge ingests; dict-shaped ``pg_stats`` rows keep
+        the row-wise path.  A malformed block falls back to the row
+        loop (counted in ``ingest["fallback_rows"]``) — never raises.
+        """
+        t0 = _time.perf_counter()
+        self.report_stamps[daemon] = stamp
         if osd_stats:
             row = dict(osd_stats)
             row["_stamp"] = stamp
             self.osd_stats[daemon] = row
-        if not pg_stats:
-            return
-        did = self._daemon_code(daemon)
+        fmt = "legacy"
+        n_rows = len(pg_stats or ())
+        if pg_stats_cols is not None:
+            fmt = "columnar"
+            did = self._daemon_code(daemon)
+            try:
+                n_rows += self._apply_cols(did, pg_stats_cols, stamp)
+            except Exception:
+                try:
+                    rows = statblock.unpack_stat_rows(pg_stats_cols)
+                except Exception:
+                    rows = []
+                self.ingest["fallback_rows"] += len(rows)
+                n_rows += len(rows)
+                self._apply_rows(did, rows, stamp)
+        if pg_stats:
+            self._apply_rows(self._daemon_code(daemon), pg_stats,
+                             stamp)
+        if nbytes is None:
+            nbytes = (statblock.block_nbytes(pg_stats_cols)
+                      if pg_stats_cols is not None else 0)
+        _note_ingest(self.ingest, fmt, n_rows, nbytes,
+                     _time.perf_counter() - t0)
+
+    def _apply_rows(self, did: int, pg_stats: list,
+                    stamp: float) -> None:
+        """The original row-wise merge (legacy dict rows + the
+        malformed-block fallback)."""
         for st in pg_stats:
             pgid = st.get("pgid")
             if not pgid:
                 continue
-            row = self._idx.get(pgid)
+            key = self._pgid_key(pgid)
+            row = self._row_of_key(key)
             fresh = row is None
             if fresh:
-                row = self._alloc_row(pgid)
+                row = self._alloc_row(key)
             same_primary = (not fresh and self._from[row] == did)
             if same_primary:
                 dt = stamp - self._stamp[row]
@@ -205,6 +374,136 @@ class PGMap:
                 st.get("state", "unknown"))
             self._from[row] = did
             self._stamp[row] = stamp
+
+    def _apply_cols(self, did: int, block: dict, stamp: float) -> int:
+        """The vectorized merge: one searchsorted over the int64 pgid
+        keys, bulk allocation for unseen PGs, then masked column
+        scatters reproducing the row loop's exact semantics — rate
+        derivation over the per-row dt, counter-reset clamping at 0,
+        rate reset on primary change, state dictionary translation."""
+        cols = statblock.block_cols(block)
+        n = cols["n"]
+        if not n:
+            return 0
+        keys = (cols["pg_pool"] << 32) | cols["pg_seed"]
+        # steady-state shortcut: a primary's PG set rarely changes
+        # between reports, so its key->row resolution is cached and
+        # revalidated with one vector compare (row indices are stable
+        # until a prune compaction, which clears the cache)
+        cached = self._daemon_rows.get(did)
+        if cached is not None and cached[0].size == n \
+                and np.array_equal(cached[0], keys):
+            rows = cached[1]
+        else:
+            self._ensure_index()
+            sk, sr = self._sorted
+            rows = np.empty(n, np.int64)
+            if sk.size:
+                pos = np.minimum(np.searchsorted(sk, keys),
+                                 sk.size - 1)
+                found = sk[pos] == keys
+                rows[found] = sr[pos[found]]
+            else:
+                found = np.zeros(n, bool)
+            if not found.all():
+                miss = ~found
+                # allocation order == sorted key order, so unique's
+                # inverse indexes the new rows directly (no re-search)
+                uniq, inv = np.unique(keys[miss],
+                                      return_inverse=True)
+                base = self._n
+                self._alloc_rows(uniq)
+                rows[miss] = base + inv
+            self._daemon_rows[did] = (keys, rows)
+        # rate semantics, row-loop exact: same primary + dt>0 derives
+        # clamped rates; a primary change (or fresh row: _from == -1)
+        # zeroes them; same primary with dt<=0 leaves them untouched
+        same = self._from[rows] == did
+        dt = stamp - self._stamp[rows]
+        rate_ok = same & (dt > 0)
+        if rate_ok.any():
+            rr = rows[rate_ok]
+            dtv = dt[rate_ok]
+            for i in range(len(RATE_COUNTERS)):
+                cur = cols["ctrs"][i][rate_ok].astype(np.float64)
+                self._rate[i][rr] = np.maximum(
+                    0.0, (cur - self._ctr[i][rr]) / dtv)
+            self._has_rate[rr] = True
+        reset = ~same
+        if reset.any():
+            rr = rows[reset]
+            self._has_rate[rr] = False
+            for i in range(len(RATE_KEYS)):
+                self._rate[i][rr] = 0.0
+        for (c, _w, _o), arr in zip(_INT_COLS, cols["ints"]):
+            self._int[c][rows] = arr
+        for i in range(len(RATE_COUNTERS)):
+            self._ctr[i][rows] = cols["ctrs"][i].astype(np.float64)
+        names = cols["state_names"]
+        if names:
+            trans = np.asarray([self._state_code(s) for s in names],
+                               np.int16)
+            self._state[rows] = trans[cols["state"]]
+        self._from[rows] = did
+        self._stamp[rows] = stamp
+        return n
+
+    # -- pruning -----------------------------------------------------------
+
+    def prune(self, now: float, pools: set | None = None,
+              after: float | None = None) -> dict:
+        """Compact stale rows (no report within `after`, default the
+        staleness window) and deleted-pool rows out of the column
+        store, and expire per-daemon extras the same way.  Every drop
+        is counted (``pruned_stale`` / ``pruned_pool`` /
+        ``pruned_daemons`` -> ``ceph_tpu_mgr_rows_pruned_total``) —
+        rows leave the mgr visibly, never silently.  The fold masks
+        are unchanged; pruning reclaims rows they already ignore."""
+        after = self.stale_after if after is None else float(after)
+        n = self._n
+        dropped_stale = dropped_pool = 0
+        if n:
+            fresh = (now - self._stamp[:n]) <= after
+            keep = fresh
+            if pools is not None:
+                in_pool = np.isin(
+                    self._int["pool"][:n],
+                    np.fromiter((int(p) for p in pools), np.int64,
+                                count=len(pools)))
+                dropped_pool = int(np.count_nonzero(fresh & ~in_pool))
+                keep = fresh & in_pool
+            dropped_stale = int(np.count_nonzero(~fresh))
+            k = int(np.count_nonzero(keep))
+            if k < n:
+                idx = np.nonzero(keep)[0]
+                for c in self._int:
+                    self._int[c][:k] = self._int[c][idx]
+                for arr in self._ctr:
+                    arr[:k] = arr[idx]
+                for arr in self._rate:
+                    arr[:k] = arr[idx]
+                self._keys[:k] = self._keys[idx]
+                self._stamp[:k] = self._stamp[idx]
+                self._from[:k] = self._from[idx]
+                self._state[:k] = self._state[idx]
+                self._has_rate[:k] = self._has_rate[idx]
+                self._n = k
+                self._sorted = None
+                self._pending.clear()
+                self._daemon_rows.clear()   # row indices moved
+                self.pruned_stale += dropped_stale
+                self.pruned_pool += dropped_pool
+            else:
+                dropped_stale = dropped_pool = 0
+        dropped_daemons = 0
+        for d in [d for d, t in self.report_stamps.items()
+                  if now - t > after]:
+            del self.report_stamps[d]
+            self.osd_stats.pop(d, None)
+            dropped_daemons += 1
+        self.pruned_daemons += dropped_daemons
+        return {"stale": dropped_stale, "pool": dropped_pool,
+                "daemons": dropped_daemons}
 
     # -- vectorized fold ---------------------------------------------------
 
@@ -295,6 +594,27 @@ class PGMap:
                 total[i] += n
         return total
 
+    def report_freshness(self, now: float) -> dict:
+        """Per-daemon report-age summary (bounded: one scalar pass
+        over the stamps, never per-PG data): daemon count, the worst
+        age + its daemon, how many daemons are past the staleness
+        window, and the cumulative prune counters — the digest's
+        `reports` section `status` renders as its max-age/stale line.
+        """
+        out = {"daemons": len(self.report_stamps),
+               "max_age": 0.0, "max_age_daemon": None, "stale": 0,
+               "pruned_stale_rows": self.pruned_stale,
+               "pruned_pool_rows": self.pruned_pool,
+               "pruned_daemons": self.pruned_daemons}
+        for d, t in self.report_stamps.items():
+            age = max(0.0, now - t)
+            if age > out["max_age"] or out["max_age_daemon"] is None:
+                out["max_age"] = round(age, 3)
+                out["max_age_daemon"] = d
+            if age > self.stale_after:
+                out["stale"] += 1
+        return out
+
     def digest(self, now: float, osdmap=None) -> dict:
         """The mon-bound digest (MMonMgrDigest payload): everything
         `status`/`df`/`osd pool stats` and the PG_* health checks
@@ -349,14 +669,18 @@ class PGMap:
             # chip -> windowed busy/queue-wait/idle fractions (the
             # `status` device-utilization line + QoS oracles)
             "device_util": device_util,
+            # per-daemon report freshness + prune visibility (the
+            # `status` max-age/stale-count line)
+            "reports": self.report_freshness(now),
         }
 
 
 class DictPGMap:
     """The original dict-of-rows PGMap: the golden reference the
-    columnar fold is pinned against (tests/test_scale.py) and the
-    baseline for the `bench.py --scale` fold micro-benchmark.  Keep
-    its fold semantics bit-for-bit when touching either class."""
+    columnar fold AND the columnar ingest path are pinned against
+    (tests/test_scale.py, tests/test_ingest.py) and the baseline for
+    the `bench.py --scale` ingest/fold micro-benchmarks.  Keep its
+    semantics bit-for-bit when touching either class."""
 
     def __init__(self, stale_after: float = 15.0):
         self.stale_after = float(stale_after)
@@ -366,16 +690,31 @@ class DictPGMap:
         self.rates: dict[str, dict] = {}
         # daemon -> {"op_size_hist_bytes_pow2": [...], "_stamp": t}
         self.osd_stats: dict[str, dict] = {}
+        self.report_stamps: dict[str, float] = {}
+        self.ingest = _new_ingest()
+        self.pruned_stale = 0
+        self.pruned_pool = 0
+        self.pruned_daemons = 0
 
     # -- ingest ------------------------------------------------------------
 
     def apply_report(self, daemon: str, pg_stats: list | None,
-                     osd_stats: dict | None, stamp: float) -> None:
+                     osd_stats: dict | None, stamp: float,
+                     pg_stats_cols: dict | None = None,
+                     nbytes: int | None = None) -> None:
+        t0 = _time.perf_counter()
+        self.report_stamps[daemon] = stamp
         if osd_stats:
             row = dict(osd_stats)
             row["_stamp"] = stamp
             self.osd_stats[daemon] = row
-        for st in pg_stats or []:
+        fmt = "legacy"
+        rows = list(pg_stats or ())
+        if pg_stats_cols is not None:
+            # the golden reference has no fast path: unpack and walk
+            fmt = "columnar"
+            rows = statblock.unpack_stat_rows(pg_stats_cols) + rows
+        for st in rows:
             pgid = st.get("pgid")
             if not pgid:
                 continue
@@ -393,6 +732,38 @@ class DictPGMap:
             else:
                 self.rates.pop(pgid, None)
             self.pg_stats[pgid] = cur
+        if nbytes is None:
+            nbytes = (statblock.block_nbytes(pg_stats_cols)
+                      if pg_stats_cols is not None else 0)
+        _note_ingest(self.ingest, fmt, len(rows), nbytes,
+                     _time.perf_counter() - t0)
+
+    # -- pruning -----------------------------------------------------------
+
+    def prune(self, now: float, pools: set | None = None,
+              after: float | None = None) -> dict:
+        after = self.stale_after if after is None else float(after)
+        dropped_stale = dropped_pool = 0
+        for pgid, st in list(self.pg_stats.items()):
+            if now - st["_stamp"] > after:
+                dropped_stale += 1
+            elif pools is not None and st.get("pool") not in pools:
+                dropped_pool += 1
+            else:
+                continue
+            del self.pg_stats[pgid]
+            self.rates.pop(pgid, None)
+        self.pruned_stale += dropped_stale
+        self.pruned_pool += dropped_pool
+        dropped_daemons = 0
+        for d in [d for d, t in self.report_stamps.items()
+                  if now - t > after]:
+            del self.report_stamps[d]
+            self.osd_stats.pop(d, None)
+            dropped_daemons += 1
+        self.pruned_daemons += dropped_daemons
+        return {"stale": dropped_stale, "pool": dropped_pool,
+                "daemons": dropped_daemons}
 
     # -- views -------------------------------------------------------------
 
@@ -442,4 +813,5 @@ class DictPGMap:
 
     live_osd_stats = PGMap.live_osd_stats
     op_size_hist = PGMap.op_size_hist
+    report_freshness = PGMap.report_freshness
     digest = PGMap.digest

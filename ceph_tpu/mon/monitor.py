@@ -1310,6 +1310,21 @@ class Monitor:
                         totals.get("recovery_bytes_s") or 0.0),
                 },
             }
+            # report-freshness line: how stale the digest's inputs
+            # are (daemon count, worst report age + who, daemons past
+            # the staleness window, visible prune totals) — absent
+            # reporters must never read as "all healthy and idle"
+            rep = dig.get("reports")
+            if rep:
+                out["pgmap"]["reports"] = {
+                    "daemons": int(rep.get("daemons") or 0),
+                    "max_age": float(rep.get("max_age") or 0.0),
+                    "max_age_daemon": rep.get("max_age_daemon"),
+                    "stale": int(rep.get("stale") or 0),
+                    "pruned_rows": (
+                        int(rep.get("pruned_stale_rows") or 0)
+                        + int(rep.get("pruned_pool_rows") or 0)),
+                }
             # device-utilization line: per-chip windowed busy /
             # queue-wait / idle fractions from the digest, so chip
             # saturation is visible in one `status` call cluster-wide

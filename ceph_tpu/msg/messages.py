@@ -144,11 +144,26 @@ class MMgrReport(Message):
     carries the per-PG stat rows of every PG the daemon is primary for
     (the MPGStats slice — object/byte counts, degraded/misplaced/
     unfound tallies, cumulative client-IO and recovery counters);
-    osd_stats carries daemon-wide extras (the op-size histogram)."""
+    osd_stats carries daemon-wide extras (the op-size histogram).
+
+    pg_stats_cols is the packed columnar form of the same rows
+    (msg.statblock: parallel typed arrays + dictionary-encoded pgids
+    and states) — the telemetry-fabric wire format the mgr ingests
+    as one vectorized merge.  A report carries EITHER pg_stats_cols
+    (columnar producers) or pg_stats (legacy dict rows); the mgr
+    accepts both, so mixed fleets converge to one digest.  Reports
+    without the columnar field encode byte-identically to the
+    pre-columnar wire form (legacy frames stay pinned)."""
 
     TYPE = "mgr_report"
     FIELDS = ("daemon", "epoch", "perf", "pg_states", "num_pgs",
-              "num_objects", "pg_stats", "osd_stats")
+              "num_objects", "pg_stats", "osd_stats", "pg_stats_cols")
+
+    def to_wire(self) -> dict:
+        d = {f: getattr(self, f) for f in self.FIELDS}
+        if d.get("pg_stats_cols") is None:
+            del d["pg_stats_cols"]      # legacy frames stay byte-stable
+        return d
 
 
 @register

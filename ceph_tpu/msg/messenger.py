@@ -442,6 +442,9 @@ class Connection:
                         return      # transport fault: replay later
                     continue        # lossy: the frame vanishes
                 msg = decode_message(payload)  # poison frame = fault
+                # received payload size: the ingest bytes accounting
+                # (mgr report telemetry) reads it off the message
+                msg.wire_bytes = len(payload)
                 self.msgr.note_peer_clock(
                     msg.src, getattr(msg, "send_stamp", None))
                 # dedup: a lossless session replays after reconnect,

@@ -2706,11 +2706,24 @@ class OSD:
                                **self.device_chip.utilization()}
             except Exception:
                 device_util = None
+        # telemetry fabric: ship the stat rows as ONE packed columnar
+        # block (parallel typed arrays, pgids/states dictionary-
+        # encoded) so the mgr's merge is a vectorized scatter, not a
+        # row loop; conf-gated off -> legacy dict rows (mixed fleets
+        # converge to the same digest)
+        pg_stats_cols = None
+        if pg_stats and self.ctx.conf.get("osd_stats_columnar", True):
+            from ..msg.statblock import pack_stat_rows
+            try:
+                pg_stats_cols = pack_stat_rows(pg_stats)
+                pg_stats = None
+            except Exception:
+                pg_stats_cols = None    # odd pgid: keep dict rows
         self.msgr.send_to(addr, MMgrReport(
             daemon="osd.%d" % self.whoami, epoch=self.osdmap.epoch,
             perf=self.ctx.perf.dump(), pg_states=states,
             num_pgs=len(self.pgs), num_objects=num_objects,
-            pg_stats=pg_stats,
+            pg_stats=pg_stats, pg_stats_cols=pg_stats_cols,
             osd_stats={"op_size_hist_bytes_pow2":
                        list(self.op_size_hist),
                        # raw-capacity axis for `df` + the exporter

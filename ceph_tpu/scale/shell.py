@@ -419,6 +419,17 @@ class ShellOSD:
             addr = getattr(self.osdmap, "mgr_addr", "")
             if addr:
                 states = {"active": len(self.pg_model)}
+                # telemetry fabric: a 10k-shell fleet's reports are
+                # the mgr's hot path — ship packed columnar blocks
+                # (vectorized mgr merge) unless conf-gated back to
+                # legacy dict rows (mixed-fleet compat)
+                pg_stats = self._pg_rows() or None
+                pg_stats_cols = None
+                if pg_stats and self.ctx.conf.get(
+                        "osd_stats_columnar", True):
+                    from ..msg.statblock import pack_stat_rows
+                    pg_stats_cols = pack_stat_rows(pg_stats)
+                    pg_stats = None
                 self.msgr.send_to(addr, MMgrReport(
                     daemon="osd.%d" % self.whoami,
                     epoch=self.osdmap.epoch,
@@ -426,7 +437,8 @@ class ShellOSD:
                     num_pgs=len(self.pg_model),
                     num_objects=(len(self.pg_model)
                                  * self.objects_per_pg),
-                    pg_stats=self._pg_rows(),
+                    pg_stats=pg_stats,
+                    pg_stats_cols=pg_stats_cols,
                     osd_stats=None), entity_hint="mgr")
             # drain AFTER reporting: a churn's misplaced rise must be
             # observable in at least one report before the simulated

@@ -49,6 +49,9 @@ FAST_CONF = {
     "osd_mgr_report_interval": 0.3,
     "mgr_stats_period": 0.25,
     "mgr_stats_stale_after": 5.0,
+    # stale-row compaction (visible prune counters) within a round:
+    # rows mask out of the folds at 5s and are reclaimed at 6s
+    "mgr_stats_prune_after": 6.0,
     # integrity plane at dev pacing: scrub is ALWAYS ON — every PG
     # shallow-scrubs every few seconds and deep-scrubs (digest vs
     # hinfo vote) soon after, so silent rot surfaces within a thrash

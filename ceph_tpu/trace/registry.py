@@ -102,6 +102,35 @@ TENANT_SERIES = frozenset({
     "ceph_tpu_tenant_slo_burn_slow", "ceph_tpu_tenant_p99_ms",
 })
 
+# telemetry fabric: the mgr's report-ingest exporter families
+# (rendered by mgr/daemon.py ingest_prom_lines) — report rows/bytes
+# per wire format, the apply-latency histogram, the row-loop
+# fallback counter, and the visible stale/pool prune counters
+MGR_SERIES = frozenset({
+    "ceph_tpu_mgr_report_rows_total",
+    "ceph_tpu_mgr_report_bytes_total",
+    "ceph_tpu_mgr_ingest_seconds",
+    "ceph_tpu_mgr_ingest_fallback_rows_total",
+    "ceph_tpu_mgr_rows_pruned_total",
+})
+
+# consumers referencing the ingest families by literal (the bench
+# ingest leg asserts its exposition render; the ingest tests pin the
+# scrape surface) — every entry must be registered AND present
+CONSUMER_MGR_REFS = {
+    "bench.py": (
+        "ceph_tpu_mgr_ingest_seconds",
+        "ceph_tpu_mgr_report_rows_total",
+    ),
+    "tests/test_ingest.py": (
+        "ceph_tpu_mgr_report_rows_total",
+        "ceph_tpu_mgr_report_bytes_total",
+        "ceph_tpu_mgr_ingest_seconds",
+        "ceph_tpu_mgr_ingest_fallback_rows_total",
+        "ceph_tpu_mgr_rows_pruned_total",
+    ),
+}
+
 # which stage names each consumer file references by literal; the
 # lint demands every entry be registered AND literally present in the
 # file, so a stage rename that misses a consumer fails here
@@ -294,6 +323,46 @@ def lint_tenant_plane(root: str | None = None) -> list[str]:
     return errors
 
 
+def lint_mgr_plane(root: str | None = None) -> list[str]:
+    """Telemetry-fabric drift lint: every registered mgr ingest
+    family must literally appear in the mgr's renderer (a family
+    rename cannot silently drop a series), and every consumer
+    reference must be a registered family still literally present in
+    the consumer's source."""
+    errors: list[str] = []
+    base = _repo_root(root)
+    mgr_path = os.path.join(base, "ceph_tpu", "mgr", "daemon.py")
+    try:
+        with open(mgr_path) as f:
+            mgr_src = f.read()
+    except OSError:
+        errors.append("ceph_tpu/mgr/daemon.py is missing")
+        mgr_src = ""
+    for fam in sorted(MGR_SERIES):
+        if fam not in mgr_src:
+            errors.append(
+                "registered mgr ingest series %r is not rendered by"
+                " ceph_tpu/mgr/daemon.py" % fam)
+    for relpath, names in sorted(CONSUMER_MGR_REFS.items()):
+        path = os.path.join(base, relpath)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            errors.append("consumer %s is missing" % relpath)
+            continue
+        for name in names:
+            if name not in MGR_SERIES:
+                errors.append(
+                    "%s references unregistered mgr series %r"
+                    % (relpath, name))
+            if name not in src:
+                errors.append(
+                    "%s no longer references mgr series %r (stale"
+                    " CONSUMER_MGR_REFS entry?)" % (relpath, name))
+    return errors
+
+
 def lint_consumers(root: str | None = None) -> list[str]:
     """Every consumer reference must be a registered name AND still
     literally present in the consumer's source."""
@@ -335,7 +404,9 @@ def lint_consumers(root: str | None = None) -> list[str]:
 
 def lint_repo(root: str | None = None) -> list[str]:
     """The tier-1 drift lint: emission sites vs registry vs consumer
-    references, plus the live device-series check and the tenant
-    SLO plane (stage histograms + exporter families)."""
+    references, plus the live device-series check, the tenant SLO
+    plane (stage histograms + exporter families), and the mgr
+    telemetry-fabric ingest families."""
     return (lint_emissions(root) + lint_device_series()
-            + lint_consumers(root) + lint_tenant_plane(root))
+            + lint_consumers(root) + lint_tenant_plane(root)
+            + lint_mgr_plane(root))

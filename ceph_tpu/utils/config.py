@@ -350,6 +350,19 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("mgr_stats_stale_after", OPT_FLOAT, 15.0,
            "per-PG stat rows older than this are dropped from the"
            " PGMap (a dead primary's last report must age out)"),
+    Option("mgr_stats_prune_after", OPT_FLOAT, 60.0,
+           "per-PG stat rows (and per-daemon report extras) with no"
+           " refresh within this window are COMPACTED out of the"
+           " mgr's column store, visibly counted"
+           " (ceph_tpu_mgr_rows_pruned_total); folds already mask"
+           " them at mgr_stats_stale_after, pruning reclaims the"
+           " rows"),
+    Option("osd_stats_columnar", OPT_BOOL, True,
+           "ship per-PG stat rows as a packed columnar block"
+           " (MMgrReport pg_stats_cols, the telemetry-fabric wire"
+           " format the mgr ingests as one vectorized merge); off ="
+           " legacy dict-shaped rows (mixed fleets converge to the"
+           " same digest either way)"),
     Option("mon_crash_warn_age", OPT_FLOAT, 14 * 24 * 3600.0,
            "un-archived crash reports newer than this raise the"
            " RECENT_CRASH health warning (mgr/crash warn_recent_"
