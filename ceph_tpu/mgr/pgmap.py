@@ -89,10 +89,16 @@ def _new_ingest() -> dict:
             "seconds_hist": [0] * 32}
 
 
-def _note_ingest(ing: dict, fmt: str, rows: int, nbytes: int,
+def _note_ingest(ing: dict, fmt: str, cols_rows: int,
+                 legacy_rows: int, nbytes: int,
                  seconds: float) -> None:
+    """One report's accounting.  The report counts once under its
+    dominant format (columnar if a block is present); row counts
+    split by the wire shape each row actually arrived in, so a
+    mixed-field report never skews the per-format rows series."""
     ing["reports"][fmt] += 1
-    ing["rows"][fmt] += rows
+    ing["rows"]["columnar"] += cols_rows
+    ing["rows"]["legacy"] += legacy_rows
     ing["bytes"][fmt] += int(nbytes)
     us = int(seconds * 1e6)
     ing["seconds_hist"][max(0, min(31, us.bit_length() - 1))] += 1
@@ -206,7 +212,8 @@ class PGMap:
             if dot:
                 pool = int(pool_s)
                 seed = int(seed_s, 16)
-                if pool >= 0 and 0 <= seed <= statblock._SEED_MAX:
+                if (0 <= pool <= statblock._POOL_MAX
+                        and 0 <= seed <= statblock._SEED_MAX):
                     return (pool << 32) | seed
             raise ValueError(pgid)
         except ValueError:
@@ -315,19 +322,20 @@ class PGMap:
             row["_stamp"] = stamp
             self.osd_stats[daemon] = row
         fmt = "legacy"
-        n_rows = len(pg_stats or ())
+        cols_rows = 0
         if pg_stats_cols is not None:
             fmt = "columnar"
             did = self._daemon_code(daemon)
             try:
-                n_rows += self._apply_cols(did, pg_stats_cols, stamp)
+                cols_rows = self._apply_cols(did, pg_stats_cols,
+                                             stamp)
             except Exception:
                 try:
                     rows = statblock.unpack_stat_rows(pg_stats_cols)
                 except Exception:
                     rows = []
                 self.ingest["fallback_rows"] += len(rows)
-                n_rows += len(rows)
+                cols_rows = len(rows)
                 self._apply_rows(did, rows, stamp)
         if pg_stats:
             self._apply_rows(self._daemon_code(daemon), pg_stats,
@@ -335,7 +343,8 @@ class PGMap:
         if nbytes is None:
             nbytes = (statblock.block_nbytes(pg_stats_cols)
                       if pg_stats_cols is not None else 0)
-        _note_ingest(self.ingest, fmt, n_rows, nbytes,
+        _note_ingest(self.ingest, fmt, cols_rows,
+                     len(pg_stats or ()), nbytes,
                      _time.perf_counter() - t0)
 
     def _apply_rows(self, did: int, pg_stats: list,
@@ -395,6 +404,15 @@ class PGMap:
                 and np.array_equal(cached[0], keys):
             rows = cached[1]
         else:
+            # duplicate pgids within one block would hit the masked
+            # scatters with repeated indices (last-write-wins) and a
+            # single rate derivation — not the row loop's
+            # per-occurrence semantics.  Producers mint unique pgids;
+            # a malformed block takes the row-wise fallback.  (A cache
+            # hit implies the key set already passed this check.)
+            ks = np.sort(keys)
+            if n > 1 and (ks[1:] == ks[:-1]).any():
+                raise ValueError("duplicate pgids in block")
             self._ensure_index()
             sk, sr = self._sorted
             rows = np.empty(n, np.int64)
@@ -487,6 +505,14 @@ class PGMap:
                 self._from[:k] = self._from[idx]
                 self._state[:k] = self._state[idx]
                 self._has_rate[:k] = self._has_rate[idx]
+                # reset the freed tail: _alloc_row/_alloc_rows only
+                # write _keys, so a PG later allocated onto a recycled
+                # slot must read _from == -1 (fresh), never a dead
+                # row's primary — else the merge would derive a rate
+                # from the dead row's counters/stamp
+                self._from[k:n] = -1
+                self._stamp[k:n] = 0.0
+                self._has_rate[k:n] = False
                 self._n = k
                 self._sorted = None
                 self._pending.clear()
@@ -709,11 +735,15 @@ class DictPGMap:
             row["_stamp"] = stamp
             self.osd_stats[daemon] = row
         fmt = "legacy"
-        rows = list(pg_stats or ())
+        legacy_rows = list(pg_stats or ())
+        rows = legacy_rows
+        cols_rows = 0
         if pg_stats_cols is not None:
             # the golden reference has no fast path: unpack and walk
             fmt = "columnar"
-            rows = statblock.unpack_stat_rows(pg_stats_cols) + rows
+            unpacked = statblock.unpack_stat_rows(pg_stats_cols)
+            cols_rows = len(unpacked)
+            rows = unpacked + legacy_rows
         for st in rows:
             pgid = st.get("pgid")
             if not pgid:
@@ -735,8 +765,8 @@ class DictPGMap:
         if nbytes is None:
             nbytes = (statblock.block_nbytes(pg_stats_cols)
                       if pg_stats_cols is not None else 0)
-        _note_ingest(self.ingest, fmt, len(rows), nbytes,
-                     _time.perf_counter() - t0)
+        _note_ingest(self.ingest, fmt, cols_rows, len(legacy_rows),
+                     nbytes, _time.perf_counter() - t0)
 
     # -- pruning -----------------------------------------------------------
 

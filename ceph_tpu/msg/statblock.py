@@ -55,6 +55,11 @@ STAT_FLOAT_COLS = ("last_scrub_stamp", "last_deep_scrub_stamp")
 # high bits); pg_num tops out far below this
 _SEED_MAX = (1 << 32) - 1
 
+# pool must fit the high 31 bits so ``pool << 32 | seed`` stays inside
+# a signed int64 (negative keys are the mgr's synthetic string-key
+# space); out-of-range pgids keep the legacy dict-row path
+_POOL_MAX = (1 << 31) - 1
+
 
 def _i64(vals) -> bytes:
     return np.asarray(vals, dtype="<i8").tobytes()
@@ -76,10 +81,12 @@ def pack_stat_rows(rows: list[dict]) -> dict:
         pool_s, dot, seed_s = str(st["pgid"]).partition(".")
         if not dot:
             raise ValueError("non-canonical pgid %r" % st["pgid"])
-        pg_pool[i] = int(pool_s)
-        pg_seed[i] = int(seed_s, 16)
-        if pg_pool[i] < 0 or not 0 <= pg_seed[i] <= _SEED_MAX:
+        pool = int(pool_s)
+        seed = int(seed_s, 16)
+        if not (0 <= pool <= _POOL_MAX and 0 <= seed <= _SEED_MAX):
             raise ValueError("pgid %r out of key range" % st["pgid"])
+        pg_pool[i] = pool
+        pg_seed[i] = seed
         s = st.get("state", "unknown")
         code = state_codes.get(s)
         if code is None:

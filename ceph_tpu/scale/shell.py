@@ -428,8 +428,11 @@ class ShellOSD:
                 if pg_stats and self.ctx.conf.get(
                         "osd_stats_columnar", True):
                     from ..msg.statblock import pack_stat_rows
-                    pg_stats_cols = pack_stat_rows(pg_stats)
-                    pg_stats = None
+                    try:
+                        pg_stats_cols = pack_stat_rows(pg_stats)
+                        pg_stats = None
+                    except Exception:
+                        pg_stats_cols = None  # odd pgid: keep rows
                 self.msgr.send_to(addr, MMgrReport(
                     daemon="osd.%d" % self.whoami,
                     epoch=self.osdmap.epoch,

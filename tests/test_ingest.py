@@ -334,6 +334,101 @@ def test_malformed_block_falls_back_visibly():
     assert pm.rates["1.1"]["write_ops_s"] == 0.0  # stamps moved on
 
 
+def test_prune_then_reingest_no_ghost_rates():
+    """A PG landing on a slot freed by prune() compaction must read
+    as FRESH: the recycled slot's leftover _from/_stamp/_ctr must
+    never feed a rate derivation (the golden DictPGMap restarts
+    rates after a delete-then-recreate / age-out-then-return)."""
+    col = PGMap(stale_after=1e9)
+    ref = DictPGMap(stale_after=1e9)
+    rows = [_full_row("1.%x" % i, 1, "active", i) for i in range(8)]
+    rows2 = [dict(r, write_ops=r["write_ops"] + 40) for r in rows]
+    for pm in (col, ref):
+        pm.apply_report("osd.0", None, None, 100.0,
+                        pg_stats_cols=pack_stat_rows(rows))
+        pm.apply_report("osd.0", None, None, 104.0,
+                        pg_stats_cols=pack_stat_rows(rows2))
+        assert pm.rates["1.0"]["write_ops_s"] == pytest.approx(10.0)
+        # everything ages out and compacts away...
+        pm.prune(1000.0, after=10.0)
+    assert col.num_rows == 0
+    # ...then the SAME daemon re-reports the same pgids much later
+    # with restarted (lower) counters — onto the recycled slots
+    rows3 = [dict(r, write_ops=1, read_ops=0) for r in rows]
+    for pm in (col, ref):
+        pm.apply_report("osd.0", None, None, 2000.0,
+                        pg_stats_cols=pack_stat_rows(rows3))
+    for r in rows:
+        # fresh rows: no comparable base, rates must NOT derive from
+        # the dead slots' counters/stamps
+        assert col.rates.get(r["pgid"]) is None, r["pgid"]
+        assert ref.rates.get(r["pgid"]) is None, r["pgid"]
+    # and the next delta derives normally on both paths
+    rows4 = [dict(r, write_ops=81, read_ops=16) for r in rows3]
+    for pm in (col, ref):
+        pm.apply_report("osd.0", None, None, 2004.0,
+                        pg_stats_cols=pack_stat_rows(rows4))
+        assert pm.rates["1.3"]["write_ops_s"] == pytest.approx(20.0)
+    _assert_digests_equal(ref.digest(now=2004.0),
+                          col.digest(now=2004.0))
+    assert col.ingest["fallback_rows"] == 0
+
+
+def test_duplicate_pgids_in_block_fall_back_rowwise():
+    """Duplicate pgids inside ONE block would make the masked scatter
+    last-write-wins with a single rate derivation — not the row
+    loop's per-occurrence semantics — so the block is rejected into
+    the visible row-wise fallback and stays golden-identical."""
+    rows = [_full_row("1.1", 1, "active", 1),
+            _full_row("1.1", 1, "active", 5),
+            _full_row("1.2", 1, "active", 2)]
+    blk = pack_stat_rows(rows)
+    pm = PGMap(stale_after=1e9)
+    ref = DictPGMap(stale_after=1e9)
+    for p in (pm, ref):
+        p.apply_report("osd.0", None, None, 100.0,
+                       pg_stats_cols=blk)
+    assert pm.ingest["fallback_rows"] == len(rows)
+    assert pm.num_rows == 2
+    _assert_digests_equal(ref.digest(now=100.0),
+                          pm.digest(now=100.0))
+
+
+def test_pool_id_overflow_keeps_legacy_path():
+    """pool >= 2**31 would overflow the int64 ``pool << 32`` merge
+    key: the packer refuses (producer keeps dict rows) and the mgr
+    routes the pgid to the synthetic string-key space instead of
+    raising (or silently wrapping negative) in the report handler."""
+    huge = 1 << 31
+    row = _full_row("%d.0" % huge, huge, "active", 2)
+    with pytest.raises(ValueError):
+        pack_stat_rows([row])
+    pm = PGMap(stale_after=1e9)
+    ref = DictPGMap(stale_after=1e9)
+    for p in (pm, ref):
+        p.apply_report("osd.0", [row], None, 100.0)
+        p.apply_report("osd.0", [dict(row, write_ops=row["write_ops"]
+                                      + 40)], None, 104.0)
+    assert pm.rates[row["pgid"]]["write_ops_s"] == pytest.approx(10.0)
+    _assert_digests_equal(ref.digest(now=104.0),
+                          pm.digest(now=104.0))
+
+
+def test_mixed_field_report_rows_split_by_format():
+    """A report carrying BOTH a columnar block and legacy dict rows
+    accounts each portion under its own rows format (the bytes and
+    the one report count ride the dominant columnar format)."""
+    for pm in (PGMap(stale_after=1e9), DictPGMap(stale_after=1e9)):
+        blk = pack_stat_rows([_full_row("1.0", 1, "active", 0)])
+        legacy = [_full_row("2.0", 2, "active", 1),
+                  _full_row("2.1", 2, "active", 2)]
+        pm.apply_report("osd.0", legacy, None, 100.0,
+                        pg_stats_cols=blk)
+        assert pm.ingest["rows"] == {"columnar": 1, "legacy": 2}
+        assert pm.ingest["reports"] == {"columnar": 1, "legacy": 0}
+        assert pm.ingest["bytes"]["columnar"] == block_nbytes(blk)
+
+
 def test_duplicate_and_odd_pgids_keep_working():
     """Odd pgid strings (legacy rows outside the canonical shape)
     still land via synthetic keys, and canonical rows keep the fast
