@@ -1300,6 +1300,204 @@ def bench_device_delta(n_objs: int = 48, delta_bytes: int = 8192,
     return asyncio.run(asyncio.wait_for(run(), 600))
 
 
+def bench_device_repair(n_objs: int = 6,
+                        obj_bytes: int = 256 << 10) -> dict:
+    """--device `repair_traffic` leg: the recovery-codec plane end to
+    end at the codec/runtime level — LRC, SHEC and CLAY encode AND
+    single-failure repair through the ragged dispatch path, against
+    the RS baseline at matched durability (RS k=8,m=4 vs LRC
+    k=8,m=4,l=3).
+
+    Per codec (fresh runtime per leg so the compile budget is
+    per-family, like the other device legs):
+
+    * device encode (`encode_async`) bit-identical to the host codec;
+    * a planted single data-shard loss repaired from EXACTLY the
+      shard set `minimum_to_decode` plans — LRC reads its local
+      group, SHEC its shingle window, CLAY only the q^(t-1) repair
+      planes per helper (sub-chunk ranged), RS its k survivors — on
+      device (`decode_async`/`repair_async`), bit-identical to the
+      stored shard;
+    * repair-bytes-read accounted per codec (summed fetched survivor
+      bytes of the minimal plan) and mirrored on the chip's
+      `device_repair_bytes_read`/`device_repair_bytes_moved` gauges.
+
+    Gate (`_gate_device_repair`): every parity oracle holds, each
+    codec leg stays within the <=8-program compile budget, no host
+    fallbacks, and LRC single-failure repair-bytes-read <= 0.5x the
+    RS baseline for the same objects.  Published into BASELINE.json
+    `published.repair_traffic`."""
+    import asyncio
+    import os
+
+    os.environ.setdefault("CEPH_TPU_EC_OFFLOAD", "1")
+
+    PROFILES = (
+        ("rs", "jerasure", {"technique": "reed_sol_van",
+                            "k": "8", "m": "4", "w": "8"}),
+        ("lrc", "lrc", {"k": "8", "m": "4", "l": "3"}),
+        ("shec", "shec", {"k": "8", "m": "4", "c": "3", "w": "8"}),
+        ("clay", "clay", {"k": "4", "m": "2"}),
+    )
+
+    async def leg(name: str, plugin: str, profile: dict) -> dict:
+        from ceph_tpu.device.runtime import (DeviceRuntime,
+                                             K_RECOVERY_EC)
+        from ceph_tpu.ec.plugin import ErasureCodePluginRegistry
+
+        codec = ErasureCodePluginRegistry.instance().factory(
+            plugin, dict(profile))
+        n = codec.get_chunk_count()
+        k = codec.get_data_chunk_count()
+        rt = DeviceRuntime.reset()
+        chip = rt.chips[0]
+        rng = np.random.default_rng(43)
+        objs = [rng.integers(0, 256, obj_bytes,
+                             dtype=np.uint8).tobytes()
+                for _ in range(n_objs)]
+        host = [codec.encode(set(range(n)), d) for d in objs]
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(*[
+            codec.encode_async(set(range(n)), d) for d in objs])
+        enc_wall = time.perf_counter() - t0
+        parity_ok = all(outs[i][c] == host[i][c]
+                        for i in range(n_objs) for c in host[i])
+        # single data-shard loss: repair each object from EXACTLY
+        # the minimal plan, device-dispatched, vs the stored shard
+        mapping = codec.get_chunk_mapping()
+        lost = mapping[0] if mapping else 0
+        sub = codec.get_sub_chunk_count()
+        repair_read = 0
+        repair_ok = True
+        t0 = time.perf_counter()
+        for i in range(n_objs):
+            avail = set(range(n)) - {lost}
+            plan = dict(codec.minimum_to_decode({lost}, avail))
+            cs = len(host[i][lost])
+            sc = cs // sub
+            partial = any(list(runs) != [(0, sub)]
+                          for runs in plan.values())
+            if partial:
+                subchunks = {
+                    h: b"".join(host[i][h][off * sc:(off + cnt) * sc]
+                                for off, cnt in runs)
+                    for h, runs in plan.items()}
+                obj_read = sum(len(b) for b in subchunks.values())
+                rebuilt = await codec.repair_async(
+                    lost, subchunks, klass=K_RECOVERY_EC)
+            else:
+                chunks = {h: host[i][h] for h in plan}
+                obj_read = sum(len(b) for b in chunks.values())
+                rebuilt = (await codec.decode_async(
+                    {lost}, chunks, klass=K_RECOVERY_EC))[lost]
+            repair_read += obj_read
+            repair_ok = repair_ok and rebuilt == host[i][lost]
+            chip.note_repair(obj_read, len(rebuilt))
+        rep_wall = time.perf_counter() - t0
+        metrics = chip.metrics()
+        import jax
+        return {
+            "plugin": plugin,
+            "profile": {kk: str(v) for kk, v in profile.items()},
+            "k": k, "n": n,
+            "backend": jax.default_backend(),
+            "encode_gibps": round(
+                n_objs * obj_bytes / max(enc_wall, 1e-9) / (1 << 30),
+                3),
+            "repair_s": round(rep_wall, 4),
+            "parity_ok": bool(parity_ok),
+            "repair_ok": bool(repair_ok),
+            "repair_bytes_read": repair_read,
+            "repair_bytes_read_per_obj": repair_read // n_objs,
+            "compile_count": rt.compile_count,
+            "host_fallbacks": rt.host_fallbacks,
+            "device_repair_bytes_read":
+                metrics["device_repair_bytes_read"],
+            "device_repair_bytes_moved":
+                metrics["device_repair_bytes_moved"],
+        }
+
+    async def run() -> dict:
+        rec: dict = {"metric": "repair_traffic",
+                     "n_objs": n_objs, "obj_bytes": obj_bytes}
+        for name, plugin, profile in PROFILES:
+            rec[name] = await leg(name, plugin, profile)
+        rs = rec["rs"]["repair_bytes_read"]
+        for name in ("lrc", "shec", "clay"):
+            # CLAY's smaller k normalizes per data byte: ratios are
+            # repair-read per object over the RS repair-read per
+            # object at the leg's own k (reported, LRC gated)
+            rec[name]["repair_vs_rs"] = round(
+                rec[name]["repair_bytes_read"] / max(rs, 1), 4)
+        return rec
+
+    return asyncio.run(asyncio.wait_for(run(), 600))
+
+
+def _gate_device_repair(rec: dict) -> dict:
+    """Regression gate for the recovery-codec plane: device parity
+    bit-identical for every codec's encode AND repair, per-leg
+    compile budget held, no host fallbacks, and LRC single-failure
+    repair-bytes-read at most half the RS baseline's for the same
+    planted loss (the ~k/l locality win, measured)."""
+    failures = []
+    for name in ("rs", "lrc", "shec", "clay"):
+        leg = rec.get(name) or {}
+        if not leg.get("parity_ok"):
+            failures.append("%s device encode parity mismatch" % name)
+        if not leg.get("repair_ok"):
+            failures.append("%s device repair parity mismatch" % name)
+        if leg.get("compile_count", 99) > 8:
+            failures.append("%s leg compiled %d > 8 programs"
+                            % (name, leg.get("compile_count")))
+        if leg.get("host_fallbacks"):
+            failures.append("%s leg fell back to host" % name)
+        if not leg.get("device_repair_bytes_read"):
+            failures.append("%s leg accounted no repair bytes on its"
+                            " chip" % name)
+    rs = (rec.get("rs") or {}).get("repair_bytes_read", 0)
+    lrc = (rec.get("lrc") or {}).get("repair_bytes_read", 1 << 60)
+    if not rs or lrc > 0.5 * rs:
+        failures.append(
+            "LRC repair read %d bytes, above 0.5x the RS baseline %d"
+            % (lrc, rs))
+    return {"ok": not failures, "failures": failures}
+
+
+def _publish_repair(rec: dict, gate: dict) -> None:
+    """Fold the repair-traffic figures into BASELINE.json's published
+    map (backend recorded).  A failed gate publishes nothing."""
+    import os
+    if not gate.get("ok"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})["repair_traffic"] = {
+            "backend": rec["rs"]["backend"],
+            "unit": "bytes read per single-shard repair",
+            "rs_bytes_per_obj":
+                rec["rs"]["repair_bytes_read_per_obj"],
+            "lrc_bytes_per_obj":
+                rec["lrc"]["repair_bytes_read_per_obj"],
+            "shec_bytes_per_obj":
+                rec["shec"]["repair_bytes_read_per_obj"],
+            "clay_bytes_per_obj":
+                rec["clay"]["repair_bytes_read_per_obj"],
+            "lrc_vs_rs": rec["lrc"]["repair_vs_rs"],
+            "shec_vs_rs": rec["shec"]["repair_vs_rs"],
+            "clay_vs_rs": rec["clay"]["repair_vs_rs"],
+            "source": "bench.py --device",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+
+
 def bench_continuous_dispatch(ops_per_tenant: int = 96,
                               n_tenants: int = 4) -> dict:
     """--device `continuous_dispatch` leg: the direction-1 mixed
@@ -2249,11 +2447,20 @@ def main() -> None:
         rec["ec_gate"] = _gate_device_ec(rec["ragged"], rec["delta"])
         _publish_device_ec(rec["ragged"], rec["delta"],
                            rec["ec_gate"])
+        rec["repair"] = bench_device_repair()
+        rec["repair"]["gate"] = _gate_device_repair(rec["repair"])
+        _publish_repair(rec["repair"], rec["repair"]["gate"])
         rec["continuous"] = bench_continuous_dispatch()
         rec["continuous"]["gate"] = _gate_continuous(rec["continuous"])
         _publish_continuous(rec["continuous"])
         rec["mesh"] = bench_device_mesh()
         print(json.dumps(rec))
+        if not rec["repair"]["gate"]["ok"]:
+            # the recovery-codec figures are guarded artifacts: a
+            # parity mismatch, a compile-budget blowup, or an LRC
+            # repair that stopped beating the RS baseline's bytes
+            # moved is a CI failure, not a quieter JSON
+            sys.exit(1)
         if not rec["continuous"]["gate"]["ok"]:
             # the dispatch-stream figures are guarded artifacts: a
             # parity/budget/waste break, a TPU run where the stream

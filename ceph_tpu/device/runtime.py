@@ -319,6 +319,12 @@ class ChipRuntime:
         self.staged_payload_words = 0
         self.staged_pad_words = 0
         self.staged_pow2_pad_words = 0
+        # repair-traffic accounting (direction-3 codec plane): bytes
+        # the recovery flows bound to this chip read from survivors
+        # and pushed to rebuilt shards — the observable the
+        # locality-aware codecs (LRC/SHEC/CLAY) exist to shrink
+        self.repair_bytes_read = 0
+        self.repair_bytes_moved = 0
         # dispatch telemetry
         self.tickets: list[DispatchTicket] = []     # bounded ring
         self.dispatch_buckets_us = [0] * _HIST_BUCKETS
@@ -405,6 +411,16 @@ class ChipRuntime:
         self.staged_pow2_pad_words += max(
             0, DeviceRuntime.bucket_for(payload_words)
             - int(payload_words))
+
+    def note_repair(self, bytes_read: int, bytes_moved: int) -> None:
+        """Account one shard repair's traffic on this chip: survivor
+        bytes sourced (`bytes_read` — what minimum_to_decode's
+        minimal shard set actually fetched) and rebuilt bytes pushed
+        (`bytes_moved`).  Exported as the chip-labeled
+        device_repair_bytes_read/_moved series the repair-traffic
+        bench leg gates on."""
+        self.repair_bytes_read += max(0, int(bytes_read))
+        self.repair_bytes_moved += max(0, int(bytes_moved))
 
     # -- tickets -----------------------------------------------------------
 
@@ -629,6 +645,10 @@ class ChipRuntime:
                 s.admission_wait_mean if s is not None else 0.0, 6),
             "device_stream_retires": s.retired if s is not None else 0,
             "device_stream_pending": s.pending if s is not None else 0,
+            # repair-traffic plane: survivor bytes read / rebuilt
+            # bytes pushed by the recovery flows bound to this chip
+            "device_repair_bytes_read": self.repair_bytes_read,
+            "device_repair_bytes_moved": self.repair_bytes_moved,
         }
 
 

@@ -131,9 +131,43 @@ class ErasureCode(ErasureCodeInterface):
     def _device_matrix(self):
         """(matrix, w) when this codec is a plain GF(2^w) matrix code
         whose encode is a region matmul — the shape the device batcher
-        offloads.  None keeps the sync host path (layered/shingled
-        codes, bit-search codes)."""
+        offloads.  None keeps the sync host path for the base
+        encode/decode routing (layered/shingled codes override the
+        async entry points instead and dispatch their own step
+        matrices through `_device_matmul`)."""
         return None
+
+    def device_families(self) -> list[tuple]:
+        """The (matrix, w) program families this codec's device
+        dispatches ride — what `warmup_ec` should pre-compile at OSD
+        boot so the first flush/repair after boot hits the compile
+        cache.  Plain matrix codecs have exactly their coding matrix;
+        layered/shingled codecs override with their per-step matrices
+        (LRC layers, SHEC single-failure decode, CLAY MDS rows)."""
+        dm = self._device_matrix()
+        return [dm] if dm is not None else []
+
+    async def _device_matmul(self, matrix, w: int, data,
+                             klass: str | None = None,
+                             on_ticket=None, chip: int | None = None,
+                             tenant: str | None = None):
+        """One batched GF(2^w) region matmul on the caller's affinity
+        chip via the device batcher ([rows, k] x [k, n] words ->
+        [rows, n]), or None when the device plane is unavailable
+        (offload disabled / chip poisoned) so the caller takes its
+        bit-identical host path.  Once admitted, DeviceBusy and
+        mid-dispatch chip loss degrade INSIDE the batcher (host
+        re-encode, futures retired exactly once), exactly like the RS
+        flush path."""
+        from ..device.runtime import DeviceRuntime, K_CLIENT_EC
+        from .batcher import DeviceBatcher, device_offload_enabled
+        if not device_offload_enabled() \
+                or not DeviceRuntime.get().chip_available(chip):
+            return None
+        return await DeviceBatcher.get().encode(
+            [list(r) for r in matrix], int(w), data,
+            klass=klass or K_CLIENT_EC, on_ticket=on_ticket,
+            chip=chip, tenant=tenant)
 
     @staticmethod
     def _word_dtype(w: int):

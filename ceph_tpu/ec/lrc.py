@@ -175,6 +175,180 @@ class ErasureCodeLrc(ErasureCode):
             out.setdefault(i, bytes(size))
         return out
 
+    # -- device offload ----------------------------------------------------
+
+    def device_families(self) -> list[tuple]:
+        """Distinct per-layer coding matrices (the encode program
+        families: one global RS + one shared local-group family under
+        the k/m/l shorthand) plus the hot repair shape — a single
+        data loss reconstructed inside its local group."""
+        from .batcher import reconstruct_matrix
+        fams: list[tuple] = []
+        seen: set = set()
+        for ly in self.layers:
+            dm = getattr(ly.codec, "_device_matrix", lambda: None)()
+            if dm is None:
+                continue
+            key = (tuple(tuple(r) for r in dm[0]), dm[1])
+            if key not in seen:
+                seen.add(key)
+                fams.append(dm)
+        for ly in reversed(self.layers):
+            dm = getattr(ly.codec, "_device_matrix", lambda: None)()
+            if dm is None or not ly.data:
+                continue
+            k = ly.codec.get_data_chunk_count()
+            n = k + len(ly.coding)
+            try:
+                rows, _chosen = reconstruct_matrix(
+                    k, dm[1], dm[0], (0,), tuple(range(1, n)))
+                fams.append((rows, dm[1]))
+            except Exception:
+                pass
+            break
+        return fams
+
+    async def encode_async(self, want_to_encode: set[int],
+                           data: bytes, klass: str | None = None,
+                           on_ticket=None, chip: int | None = None,
+                           tenant: str | None = None
+                           ) -> dict[int, bytes]:
+        """Layered encode with each layer's GF matmul batched onto
+        the device: layers dispatch in dependency waves (a local
+        layer waits for the global parities it treats as data), and
+        the independent local-group layers of one wave issue
+        concurrently so they share a flush/slot on the caller's
+        affinity chip.  Host fallback per layer under offload-off /
+        chip poison is `encode_chunks`' exact math."""
+        import asyncio
+
+        from ..device.runtime import DeviceRuntime
+        from .batcher import device_offload_enabled, host_encode
+        if (len(data) == 0 or not device_offload_enabled()
+                or not DeviceRuntime.get().chip_available(chip)):
+            return self.encode(want_to_encode, data)
+        import numpy as np
+        out = dict(self.encode_prepare(data))
+        size = len(next(iter(out.values())))
+
+        async def layer_encode(ly) -> None:
+            dm = getattr(ly.codec, "_device_matrix", lambda: None)()
+            if dm is None:
+                local = {j: out[c] for j, c in enumerate(ly.data)}
+                enc = ly.codec.encode_chunks(local)
+                nd = len(ly.data)
+                for idx, c in enumerate(ly.coding):
+                    out[c] = enc[nd + idx]
+                return
+            matrix, w = dm
+            arr = np.stack([
+                np.frombuffer(out[c], dtype=self._word_dtype(w))
+                for c in ly.data])
+            parity = await self._device_matmul(
+                matrix, w, arr, klass=klass, on_ticket=on_ticket,
+                chip=chip, tenant=tenant)
+            if parity is None:      # gate flipped mid-call
+                parity = host_encode(matrix, w, arr)
+            for idx, c in enumerate(ly.coding):
+                out[c] = np.ascontiguousarray(parity[idx]).tobytes()
+
+        pending = list(self.layers)
+        while pending:
+            ready = [ly for ly in pending
+                     if all(c in out for c in ly.data)]
+            if not ready:           # defensive: keep declared order
+                ready = pending[:1]
+            await asyncio.gather(*[layer_encode(ly) for ly in ready])
+            pending = [ly for ly in pending if ly not in ready]
+        for i in range(len(self.mapping)):
+            out.setdefault(i, bytes(size))
+        return {i: out[i] for i in want_to_encode}
+
+    async def _layer_decode(self, layer, local_want: set,
+                            local_avail: dict, klass, chip,
+                            on_ticket) -> dict[int, bytes]:
+        """One layer's repair as a device matmul: the layer's erased
+        chunks rebuild directly from its survivors through the cached
+        reconstruction rows (decode-as-encode, the same reformulation
+        the RS device path uses) — bit-identical to the layer codec's
+        host decode_chunks."""
+        import numpy as np
+
+        from .batcher import host_encode, reconstruct_matrix
+        dm = getattr(layer.codec, "_device_matrix", lambda: None)()
+        if dm is None:
+            return layer.codec.decode_chunks(local_want, local_avail)
+        matrix, w = dm
+        k = layer.codec.get_data_chunk_count()
+        erased = tuple(sorted(local_want))
+        have = tuple(sorted(local_avail))
+        rows, chosen = reconstruct_matrix(k, w, matrix, erased, have)
+        arr = np.stack([
+            np.frombuffer(local_avail[c], dtype=self._word_dtype(w))
+            for c in chosen])
+        words = await self._device_matmul(
+            rows, w, arr, klass=klass, on_ticket=on_ticket, chip=chip)
+        if words is None:
+            words = host_encode(rows, w, arr)
+        return {e: np.ascontiguousarray(words[i]).tobytes()
+                for i, e in enumerate(erased)}
+
+    async def decode_async(self, want_to_read: set[int],
+                           chunks: Mapping[int, bytes],
+                           klass: str | None = None,
+                           on_ticket=None,
+                           chip: int | None = None) -> dict[int, bytes]:
+        """`decode_chunks`' bottom-up layered repair with every layer
+        step batched onto the device — a single lost chunk repairs
+        from its local group of l+1 chunks (the locality property) as
+        ONE small dispatch on the caller's chip instead of a k-wide
+        host decode."""
+        from ..device.runtime import DeviceRuntime
+        from .batcher import device_offload_enabled
+        want = set(want_to_read)
+        chunks = dict(chunks)
+        if (want <= set(chunks)
+                or not device_offload_enabled()
+                or not DeviceRuntime.get().chip_available(chip)
+                or any(len(c) == 0 for c in chunks.values())):
+            return self.decode(want, chunks)
+        lengths = {len(c) for c in chunks.values()}
+        if len(lengths) != 1:
+            raise ValueError(
+                "surviving chunks have differing sizes %s" % lengths)
+        decoded = dict(chunks)
+        erasures = set(range(self.get_chunk_count())) - set(chunks)
+        progressed = True
+        while progressed and (want & erasures):
+            progressed = False
+            for layer in reversed(self.layers):
+                layer_erasures = layer.chunks_set & erasures
+                if not layer_erasures:
+                    continue
+                if len(layer_erasures) > len(layer.coding):
+                    continue
+                local_avail = {}
+                local_want = set()
+                for j, c in enumerate(layer.chunks):
+                    if c not in erasures:
+                        local_avail[j] = decoded[c]
+                    else:
+                        local_want.add(j)
+                rec = await self._layer_decode(
+                    layer, local_want, local_avail, klass, chip,
+                    on_ticket)
+                for j, c in enumerate(layer.chunks):
+                    if j in rec:
+                        decoded[c] = rec[j]
+                    erasures.discard(c)
+                progressed = True
+                if not (want & erasures):
+                    break
+        missing = want & erasures
+        if missing:
+            raise IOError("unable to read chunks %s" % sorted(missing))
+        return {i: bytes(decoded[i]) for i in want if i in decoded}
+
     # -- decode ------------------------------------------------------------
 
     def decode_chunks(self, want_to_read, chunks: Mapping[int, bytes]

@@ -146,6 +146,30 @@ class ErasureCodeShec(ErasureCode):
         padded = object_size + (-object_size) % alignment
         return padded // self.k
 
+    # -- device offload ----------------------------------------------------
+
+    def _device_matrix(self):
+        """SHEC's encode IS a plain GF(2^w) matmul — the shingled
+        matrix just carries zero coefficients outside each parity's
+        window — so encode/delta ride the base class's device path
+        unchanged (zero coefficients contribute nothing under GF
+        linearity, exactly like `delta_async`'s zero rows)."""
+        return self.matrix, self.w
+
+    def device_families(self) -> list[tuple]:
+        """Encode family + the most common repair shape (first data
+        chunk lost, everything else surviving): the decoding-matrix
+        rows the first post-boot repair will dispatch."""
+        fams = [(self.matrix, self.w)]
+        try:
+            avail = set(range(1, self.k + self.m))
+            rows, _cols, inv, _min = self._make_decoding({0}, avail)
+            if rows:
+                fams.append((inv, self.w))
+        except Exception:
+            pass            # unrecoverable layouts just skip warmup
+        return fams
+
     # -- encode ----------------------------------------------------------
 
     def _word_view(self, buf: bytes) -> np.ndarray:
@@ -294,6 +318,76 @@ class ErasureCodeShec(ErasureCode):
             mat = np.array([[self.matrix[i][j] for j in cols]],
                            dtype=np.uint32)
             out[k + i] = gf.matmul_words(mat, data, w)[0].tobytes()
+        return out
+
+    async def decode_async(self, want_to_read, chunks,
+                           klass: str | None = None,
+                           on_ticket=None,
+                           chip: int | None = None) -> dict[int, bytes]:
+        """`decode_chunks` with both matmuls batched onto the device
+        (the recovery/degraded-read hot call): the smallest-invertible
+        recovery system's inverse rides one dispatch, and erased
+        wanted parities re-encode as selected rows of the full coding
+        matrix — zero-padded outside their shingle windows, exactly
+        like `delta_async`'s zero rows — in a second.  The base
+        class's decode_async demands k survivors (the MDS floor);
+        SHEC's selling point is repairing from a shingle window of
+        fewer, so this override keeps the locality property on
+        device."""
+        from ..device.runtime import DeviceRuntime
+        from .batcher import device_offload_enabled
+        want = set(want_to_read)
+        chunks = dict(chunks)
+        if (want <= set(chunks)
+                or not device_offload_enabled()
+                or not DeviceRuntime.get().chip_available(chip)
+                or any(len(c) == 0 for c in chunks.values())):
+            return self.decode(want, chunks)
+        lengths = {len(c) for c in chunks.values()}
+        if len(lengths) != 1:
+            raise ValueError(
+                "surviving chunks have differing sizes %s" % lengths)
+        k, m, w = self.k, self.m, self.w
+        rows, cols, inv, _ = self._make_decoding(want, set(chunks))
+        buffers = {i: self._word_view(chunks[i]) for i in chunks}
+        out: dict[int, bytes] = {}
+        recovered: dict[int, np.ndarray] = {}
+        if rows:
+            srcs = np.stack([buffers[r] for r in rows])
+            rec = await self._device_matmul(
+                inv, w, srcs, klass=klass, on_ticket=on_ticket,
+                chip=chip)
+            if rec is None:     # gate flipped mid-call: host matmul
+                rec = gf.matmul_words(
+                    np.array(inv, dtype=np.uint32), srcs, w)
+            for i, c in enumerate(cols):
+                if c not in chunks:
+                    recovered[c] = np.ascontiguousarray(rec[i])
+                    if c in want:
+                        out[c] = recovered[c].tobytes()
+        par_rows = [i for i in range(m)
+                    if (k + i) in want and (k + i) not in chunks]
+        if par_rows:
+            n = next(iter(buffers.values())).shape[0] if buffers \
+                else 0
+            data = np.zeros((k, n), dtype=self._word_view(b"").dtype)
+            for j in range(k):
+                if any(self.matrix[i][j] for i in par_rows):
+                    data[j] = (buffers[j] if j in buffers
+                               else recovered[j])
+            sel = [[self.matrix[i][j] for j in range(k)]
+                   for i in par_rows]
+            par = await self._device_matmul(
+                sel, w, data, klass=klass, on_ticket=on_ticket,
+                chip=chip)
+            if par is None:
+                par = gf.matmul_words(
+                    np.array(sel, dtype=np.uint32), data, w)
+            for x, i in enumerate(par_rows):
+                out[k + i] = np.ascontiguousarray(par[x]).tobytes()
+        for i in want:
+            if i in chunks:
+                out[i] = bytes(chunks[i])
         return out
 
     # a shingle window (possibly fewer than k chunks) can repair its

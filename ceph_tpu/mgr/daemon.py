@@ -300,6 +300,30 @@ class Manager:
             fam = "ceph_tpu_cluster_%s" % g
             lines.append("# TYPE %s gauge" % fam)
             lines.append("%s %g" % (fam, totals[g]))
+        # repair-traffic plane: per-codec recovery bytes summed
+        # across the live fleet (read from survivors via
+        # minimum_to_decode's minimal sets / moved to rebuilt
+        # shards) — the codec-labeled figure the LRC-vs-RS oracle
+        # compares
+        repair: dict[str, dict] = {}
+        for row in self.pgmap.live_osd_stats(now).values():
+            for cname, rrow in (row.get("repair") or {}).items():
+                agg = repair.setdefault(str(cname),
+                                        {"read": 0, "moved": 0})
+                agg["read"] += int(rrow.get("read", 0) or 0)
+                agg["moved"] += int(rrow.get("moved", 0) or 0)
+        lines.append(
+            "# TYPE ceph_tpu_repair_bytes_read_total counter")
+        for cname in sorted(repair):
+            lines.append(
+                'ceph_tpu_repair_bytes_read_total{codec="%s"} %d'
+                % (cname, repair[cname]["read"]))
+        lines.append(
+            "# TYPE ceph_tpu_repair_bytes_moved_total counter")
+        for cname in sorted(repair):
+            lines.append(
+                'ceph_tpu_repair_bytes_moved_total{codec="%s"} %d'
+                % (cname, repair[cname]["moved"]))
         # integrity-plane summary series (the scrub_* families the
         # exporter lint pins): damaged-PG count beside the summed
         # error total the pool/cluster gauges above already carry

@@ -666,6 +666,10 @@ class PGMap:
         # identical figures off the same ChipRuntime ring)
         device_util: dict[int, dict] = {}
         dev_stamp: dict[int, float] = {}
+        # per-codec repair traffic: each daemon reports cumulative
+        # counters, the digest sums across the live fleet (the
+        # repair-bytes comparison oracle's committed surface)
+        repair_traffic: dict[str, dict] = {}
         for d, row in self.live_osd_stats(now).items():
             sf = row.get("statfs")
             if sf:
@@ -679,6 +683,13 @@ class PGMap:
                     device_util[chip] = {
                         k: v for k, v in du.items() if k != "chip"}
                     device_util[chip]["daemon"] = d
+            for cname, rrow in (row.get("repair") or {}).items():
+                agg = repair_traffic.setdefault(
+                    str(cname), {"read": 0, "moved": 0,
+                                 "objects": 0, "targeted": 0,
+                                 "full": 0})
+                for kk in agg:
+                    agg[kk] += int(rrow.get(kk, 0) or 0)
         return {
             "num_pgs": sum(r["num_pgs"] for r in per_pool.values()),
             "pg_states": states,
@@ -695,6 +706,9 @@ class PGMap:
             # chip -> windowed busy/queue-wait/idle fractions (the
             # `status` device-utilization line + QoS oracles)
             "device_util": device_util,
+            # codec -> summed recovery traffic counters (what the
+            # locality-aware codecs measurably save)
+            "repair_traffic": repair_traffic,
             # per-daemon report freshness + prune visibility (the
             # `status` max-age/stale-count line)
             "reports": self.report_freshness(now),

@@ -1417,8 +1417,21 @@ class Monitor:
                 raise ValueError("no erasure profile %r" % pname)
             k = int(profile.get("k", 2))
             m = int(profile.get("m", 1))
+            n = k + m
+            try:
+                # the codec is the authority on shard count: LRC's
+                # mapping adds local parities beyond k+m, so sizing
+                # from the profile ints would under-provision the
+                # acting set
+                from ..ec.plugin import ErasureCodePluginRegistry
+                codec = ErasureCodePluginRegistry.instance().factory(
+                    profile.get("plugin", "jerasure"), dict(profile))
+                k = codec.get_data_chunk_count()
+                n = codec.get_chunk_count()
+            except Exception:
+                pass
             pool = PGPool(id=pid, name=name, type=POOL_TYPE_ERASURE,
-                          size=k + m, min_size=k, pg_num=pg_num,
+                          size=n, min_size=k, pg_num=pg_num,
                           crush_rule=int(cmd.get("crush_rule", 1)),
                           erasure_code_profile=pname)
         else:

@@ -143,6 +143,21 @@ class OSD:
         self.perf.add_u64("comp_paced_ops",
                           "compression-pool ops paced through the"
                           " background device class")
+        # repair-traffic plane: what recovery actually moved, split
+        # by whether the minimal-shard-set (targeted) repair served
+        # it or the whole-object read + re-encode fallback did
+        self.perf.add_u64("repair_bytes_read",
+                          "survivor shard bytes read to rebuild"
+                          " lost shards")
+        self.perf.add_u64("repair_bytes_moved",
+                          "rebuilt shard bytes written/pushed by"
+                          " recovery")
+        self.perf.add_u64("repair_targeted",
+                          "shards rebuilt from the codec's minimal"
+                          " shard set")
+        self.perf.add_u64("repair_full",
+                          "shards rebuilt via whole-object read +"
+                          " re-encode")
         self._beacon_stamp = 0.0
         # one periodic scrub at a time per daemon (the reference's
         # scrubs_local bound collapsed to 1)
@@ -2731,6 +2746,13 @@ class OSD:
                        # per-chip device utilization (flight-recorder
                        # plane: saturation visible cluster-wide)
                        "device_util": device_util,
+                       # repair-traffic plane: per-codec recovery
+                       # bytes (read from survivors / moved to
+                       # rebuilt shards) — folded into the digest's
+                       # repair_traffic section + codec-labeled
+                       # exporter families
+                       "repair": {c: dict(r) for c, r in
+                                  self.ec.repair_traffic.items()},
                        # tenant SLO plane: cumulative per-tenant
                        # stage histograms + good/bad op counters —
                        # the mgr SLO engine's burn-rate input

@@ -129,6 +129,13 @@ class ECPGBackend:
         # telemetry: shard bytes fetched over the wire (RMW
         # amplification visibility; tests pin partial-write traffic)
         self.sub_read_bytes = 0
+        # repair-traffic accounting (per codec plugin): survivor
+        # bytes read through minimum_to_decode's minimal shard sets
+        # vs rebuilt bytes pushed — shipped in MMgrReport osd_stats
+        # and mirrored on the daemon's chip as chip-labeled series
+        self.repair_traffic: dict[str, dict[str, int]] = {}
+        # last degraded-read plan (tests assert fetched == minimal)
+        self.last_read_plan: dict | None = None
 
     # -- codec -------------------------------------------------------------
 
@@ -147,6 +154,39 @@ class ECPGBackend:
             self._maybe_warmup(c)
         return c
 
+    def _codec_name(self, pool) -> str:
+        """The pool codec's plugin name (the repair-traffic label)."""
+        prof = dict(self.osd.osdmap.erasure_code_profiles.get(
+            pool.erasure_code_profile or "default") or {})
+        return prof.get("plugin", "jerasure")
+
+    def note_repair(self, codec_name: str, bytes_read: int,
+                    bytes_moved: int, targeted: bool = True) -> None:
+        """Account one shard repair: `bytes_read` survivor bytes
+        sourced (the minimal-set fetch when `targeted`, the full
+        k-wide read otherwise) and `bytes_moved` rebuilt bytes
+        written/pushed.  Flows to the perf counters, the MMgrReport
+        osd_stats.repair row, and the daemon's chip gauges."""
+        row = self.repair_traffic.setdefault(
+            codec_name, {"read": 0, "moved": 0, "objects": 0,
+                         "targeted": 0, "full": 0})
+        row["read"] += max(0, int(bytes_read))
+        row["moved"] += max(0, int(bytes_moved))
+        row["objects"] += 1
+        row["targeted" if targeted else "full"] += 1
+        try:
+            self.osd.perf.inc("repair_bytes_read",
+                              max(0, int(bytes_read)))
+            self.osd.perf.inc("repair_bytes_moved",
+                              max(0, int(bytes_moved)))
+            self.osd.perf.inc("repair_targeted" if targeted
+                              else "repair_full")
+        except KeyError:
+            pass            # shells/tests without the counter set
+        chip = getattr(self.osd, "device_chip", None)
+        if chip is not None:
+            chip.note_repair(bytes_read, bytes_moved)
+
     def _maybe_warmup(self, codec) -> None:
         """First sight of a profile: pre-compile its common device
         buckets in the background (the runtime's boot warmup) so the
@@ -159,26 +199,32 @@ class ECPGBackend:
                 return
         except (KeyError, TypeError, ValueError):
             pass
-        dm = getattr(codec, "_device_matrix", lambda: None)()
-        if dm is None or not device_offload_enabled():
+        families = getattr(codec, "device_families",
+                           lambda: [])()
+        if not families or not device_offload_enabled():
             return
         rt = DeviceRuntime.get()
         if rt.chip_available(self._chip()):
-            matrix, w = dm
-            # workload-aware buckets from the daemon's op-size
-            # histogram when history exists; the static default list
-            # otherwise (first boot, cold daemon) — compiled on this
-            # OSD's own chip (the one its flushes will dispatch on)
-            derived = derive_warmup_buckets(
-                getattr(self.osd, "op_size_hist", None),
-                k=len(matrix[0]), w=w)
-            if derived:
-                self.osd.msgr.spawn(
-                    rt.warmup_ec(matrix, w, buckets=derived,
-                                 chip=self._chip()))
-            else:
-                self.osd.msgr.spawn(
-                    rt.warmup_ec(matrix, w, chip=self._chip()))
+            # every program family the codec's flushes AND repairs
+            # will dispatch (plain codecs: the coding matrix;
+            # LRC: per-layer matrices + the local-group repair rows;
+            # SHEC/CLAY: encode + single-failure decode shapes) —
+            # so the first repair after boot doesn't eat a JIT
+            # compile on the hot path.  Workload-aware buckets from
+            # the daemon's op-size histogram when history exists;
+            # the static default list otherwise — compiled on this
+            # OSD's own chip (the one its flushes dispatch on).
+            for matrix, w in families:
+                derived = derive_warmup_buckets(
+                    getattr(self.osd, "op_size_hist", None),
+                    k=len(matrix[0]), w=w)
+                if derived:
+                    self.osd.msgr.spawn(
+                        rt.warmup_ec(matrix, w, buckets=derived,
+                                     chip=self._chip()))
+                else:
+                    self.osd.msgr.spawn(
+                        rt.warmup_ec(matrix, w, chip=self._chip()))
 
     class _Locked:
         def __init__(self, backend, key):
@@ -1073,13 +1119,47 @@ class ECPGBackend:
             by_ver.setdefault(ver, {})[j] = (buf, size)
             attrs_by_ver.setdefault(ver, dict(lattrs))
         remote = [o for o in members if o != self.osd.whoami]
-        # ask the minimum first: enough members for k distinct shards
-        have = 1 if local is not None else 0
-        first = remote[:max(0, k - have)]
-        rest = remote[len(first):]
+        # ask the minimum first — planned through the codec's
+        # minimum_to_decode so locality-aware codecs (LRC local
+        # groups, SHEC shingle windows) fetch only their minimal
+        # shard set, not the first k members; shortfall still widens
+        # to everyone.  Falls back to the k-members heuristic when
+        # the plan fails (too few live members: widening handles it).
+        mapping = codec.get_chunk_mapping()
+        want_pos = ({mapping[i] for i in range(k)} if mapping
+                    else set(range(k)))
+        pos_member = {pos: osd_id
+                      for pos, osd_id in enumerate(pg.acting)
+                      if osd_id in members}
+        local_pos = next((p for p, o in pos_member.items()
+                          if o == self.osd.whoami), None)
+        minimal_pos = None
+        try:
+            minimal_pos = set(codec.minimum_to_decode(
+                want_pos, set(pos_member)))
+        except Exception:
+            pass
+        if minimal_pos is not None:
+            minimal_members = {pos_member[p] for p in minimal_pos}
+            first = [o for o in remote if o in minimal_members]
+        else:
+            have = 1 if local is not None else 0
+            first = remote[:max(0, k - have)]
+        rest = [o for o in remote if o not in first]
+        self.last_read_plan = {
+            "minimal": minimal_pos,
+            "local": local_pos,
+            "queried": {p for p, o in pos_member.items()
+                        if o in first},
+            "widened": False,
+        }
         for batch in ([first, rest] if first else [rest]):
             if not batch:
                 continue
+            if batch is rest:
+                self.last_read_plan["widened"] = True
+                self.last_read_plan["queried"] |= {
+                    p for p, o in pos_member.items() if o in rest}
             for sender, rows in \
                     (await self._sub_read(pg, oid, batch,
                                           snap=snap)).items():
@@ -1240,6 +1320,148 @@ class ECPGBackend:
                 stale[ho.name] = LogEntry.MODIFY
         return stale
 
+    async def _reconstruct_shard(self, pg: PG, oid: str, j: int,
+                                 klass: str, snap: int = None):
+        """Rebuild ONLY position j's shard from the codec's minimal
+        shard set (`minimum_to_decode({j}, survivors)`): LRC fetches
+        the local group, SHEC the shingle window, CLAY only the
+        repair planes (sub-chunk ranged reads), RS its k survivors —
+        repair traffic proportional to the minimal set instead of a
+        whole-object read + re-encode.  Returns
+        (shard_bytes, size, ver, attrs, bytes_read), or None when the
+        caller must fall back to the full read+re-encode path
+        (version skew, stale layout, missing hinfo, unplannable
+        loss).  The rebuilt shard is crc-checked against the
+        survivors' hinfo vector before it is trusted."""
+        import zlib
+        pool = self.osd.osdmap.pools[pg.pool_id]
+        codec = self.codec(pool)
+        n = codec.get_chunk_count()
+        avail = set()
+        pos_member: dict[int, int] = {}
+        for pos, osd_id in enumerate(pg.acting[:n]):
+            if pos == j or osd_id == ITEM_NONE or osd_id < 0:
+                continue
+            if osd_id == self.osd.whoami \
+                    or self.osd.osdmap.is_up(osd_id):
+                avail.add(pos)
+                pos_member[pos] = osd_id
+        try:
+            plan = dict(codec.minimum_to_decode({j}, avail))
+        except Exception:
+            return None
+        if not plan or any(p not in pos_member for p in plan):
+            return None
+        sub = codec.get_sub_chunk_count()
+        whole = [(0, sub)]
+        partial = any(list(runs) != whole for runs in plan.values())
+        ho = (hobject_t(oid) if snap is None
+              else hobject_t(oid, snap=snap))
+
+        async def fetch(pos: int, a: int = 0, ln: int = -1):
+            """(bytes, size, ver, attrs) of shard `pos` [a, a+ln), or
+            None."""
+            member = pos_member[pos]
+            if member == self.osd.whoami:
+                loc = self._local_shard(pg, ho)
+                if loc is None or loc[0] != pos:
+                    return None
+                buf = (loc[1] if ln < 0 else loc[1][a:a + ln])
+                return bytes(buf), loc[2], loc[3], loc[4]
+            rows = (await self._sub_read(
+                pg, oid, [member], snap=snap, off=a,
+                length=ln)).get(member) or []
+            if not rows:
+                return None
+            rj, buf, sz, rver, rattrs = rows[0]
+            if rj != pos:
+                return None         # stale layout: full path heals
+            return bytes(buf), sz, tuple(rver), (rattrs or {})
+
+        if partial:
+            # CLAY sub-chunk plan: learn the geometry from one
+            # survivor's attrs (length-0 ranged read), then fetch
+            # only each helper's repair planes
+            pre = await fetch(sorted(plan)[0], 0, 0)
+            if pre is None:
+                return None
+            _b, size, ver, attrs = pre
+            cs = codec.get_chunk_size(size)
+            if cs <= 0 or cs % sub:
+                return None
+            sc = cs // sub
+            keys, coros = [], []
+            for pos, runs in sorted(plan.items()):
+                for off, cnt in runs:
+                    keys.append(pos)
+                    coros.append(fetch(pos, off * sc, cnt * sc))
+            got = await asyncio.gather(*coros)
+            helper: dict[int, list[bytes]] = {}
+            nread = 0
+            for pos, res in zip(keys, got):
+                if res is None or res[2] != ver:
+                    return None
+                helper.setdefault(pos, []).append(res[0])
+                nread += len(res[0])
+            subchunks = {pos: b"".join(parts)
+                         for pos, parts in helper.items()}
+            expect = sum(cnt for runs in plan.values()
+                         for _o, cnt in runs) * sc
+            if sum(len(b) for b in subchunks.values()) != expect:
+                return None
+            repair = getattr(codec, "repair_async", None)
+            if repair is None:
+                return None
+            shard = await repair(j, subchunks, klass=klass,
+                                 chip=self._chip())
+        else:
+            got = await asyncio.gather(*[fetch(p)
+                                         for p in sorted(plan)])
+            chunks: dict[int, bytes] = {}
+            size = ver = attrs = None
+            nread = 0
+            for pos, res in zip(sorted(plan), got):
+                if res is None:
+                    return None
+                buf, sz, rver, rattrs = res
+                if ver is None:
+                    size, ver, attrs = sz, rver, dict(rattrs)
+                elif rver != ver:
+                    return None     # mixed generations: full path
+                if rattrs.get(HINFO_XATTR) and \
+                        not attrs.get(HINFO_XATTR):
+                    attrs = dict(rattrs)
+                chunks[pos] = buf
+                nread += len(buf)
+            lens = {len(c) for c in chunks.values()}
+            if len(lens) != 1 or 0 in lens:
+                return None
+            decoded = await codec.decode_async(
+                {j}, chunks, klass=klass, chip=self._chip())
+            shard = decoded[j]
+        hinfo_raw = (attrs or {}).get(HINFO_XATTR)
+        if not hinfo_raw:
+            return None
+        try:
+            crcs = [int(x) for x in hinfo_raw.split(b",")]
+        except ValueError:
+            return None
+        if len(crcs) != n \
+                or (zlib.crc32(shard) & 0xFFFFFFFF) != crcs[j]:
+            return None             # untrusted rebuild: full path
+        return shard, size, ver, attrs, nread
+
+    def _push_attrs(self, attrs: dict, j: int, size: int,
+                    ver) -> dict:
+        """Survivor attrs re-stamped for the rebuilt shard (hinfo is
+        already the full per-shard crc vector, identical on every
+        member)."""
+        out = dict(attrs)
+        out[SIZE_XATTR] = b"%d" % size
+        out[SHARD_XATTR] = b"%d" % j
+        out[VER_XATTR] = _ver_bytes(ver)
+        return out
+
     async def recover_peer_shards(self, pg: PG, osd_id: int,
                                   missing: dict) -> None:
         """Reconstruct each missing object's TARGET shard and push it
@@ -1266,44 +1488,82 @@ class ECPGBackend:
                 if op == LogEntry.DELETE:
                     pushes.append({"oid": oid, "delete": True})
                     continue
-                data, ver, rattrs = await self.read_object_attrs(
-                    pg, oid)
-                if data is None:
-                    pushes.append({"oid": oid, "delete": True})
-                    continue
                 n = codec.get_chunk_count()
                 from ..device.runtime import K_RECOVERY_EC
-                shards = await codec.encode_async(
-                    set(range(n)), data, klass=K_RECOVERY_EC,
-                    chip=self._chip())
-                # user xattrs: local shard first, else the attrs the
-                # surviving shards returned with the read replies (the
-                # primary's own shard may be missing too)
-                try:
-                    attrs = dict(self.osd.store.getattrs(
-                        pg.cid, hobject_t(oid)))
-                except NotFound:
-                    attrs = dict(rattrs or {})
-                attrs[SIZE_XATTR] = b"%d" % len(data)
-                attrs[SHARD_XATTR] = b"%d" % j
-                attrs[VER_XATTR] = _ver_bytes(ver)
-                attrs[HINFO_XATTR] = hinfo_bytes(shards)
-                pushes.append({"oid": oid, "delete": False,
-                               "data": shards[j], "attrs": attrs,
-                               "omap": {}})
+                cname = self._codec_name(pool)
+                # targeted repair first: rebuild ONLY the target's
+                # shard from the codec's minimal shard set (LRC local
+                # group / SHEC shingle window / CLAY repair planes /
+                # RS k survivors), with the bytes it actually moved
+                # accounted per codec
+                rec = await self._reconstruct_shard(
+                    pg, oid, j, K_RECOVERY_EC)
+                if rec is not None:
+                    shard, size, ver, rattrs, nread = rec
+                    attrs = self._push_attrs(rattrs, j, size, ver)
+                    pushes.append({"oid": oid, "delete": False,
+                                   "data": shard, "attrs": attrs,
+                                   "omap": {}})
+                    self.note_repair(cname, nread, len(shard))
+                else:
+                    # full path: whole-object read + re-encode (also
+                    # the version-skew / stale-layout healer)
+                    read0 = self.sub_read_bytes
+                    data, ver, rattrs = await self.read_object_attrs(
+                        pg, oid)
+                    if data is None:
+                        pushes.append({"oid": oid, "delete": True})
+                        continue
+                    shards = await codec.encode_async(
+                        set(range(n)), data, klass=K_RECOVERY_EC,
+                        chip=self._chip())
+                    # user xattrs: local shard first, else the attrs
+                    # the surviving shards returned with the read
+                    # replies (the primary's own shard may be missing
+                    # too)
+                    try:
+                        attrs = dict(self.osd.store.getattrs(
+                            pg.cid, hobject_t(oid)))
+                    except NotFound:
+                        attrs = dict(rattrs or {})
+                    attrs[SIZE_XATTR] = b"%d" % len(data)
+                    attrs[SHARD_XATTR] = b"%d" % j
+                    attrs[VER_XATTR] = _ver_bytes(ver)
+                    attrs[HINFO_XATTR] = hinfo_bytes(shards)
+                    pushes.append({"oid": oid, "delete": False,
+                                   "data": shards[j], "attrs": attrs,
+                                   "omap": {}})
+                    self.note_repair(
+                        cname, self.sub_read_bytes - read0,
+                        len(shards[j]), targeted=False)
                 # clone shards travel too (snap reads after recovery)
                 from . import snaps as snapmod
                 ssraw = attrs.get(snapmod.SNAPSET_ATTR)
                 if ssraw:
                     ss = denc.decode(ssraw)
                     for c in ss.get("clones", []):
-                        cd, cver, cattrs = await self.read_object_attrs(
-                            pg, oid, snap=int(c))
+                        crec = await self._reconstruct_shard(
+                            pg, oid, j, K_RECOVERY_EC, snap=int(c))
+                        if crec is not None:
+                            cshard, csz, cver, cattrs, cread = crec
+                            ca = self._push_attrs(cattrs, j, csz,
+                                                  cver)
+                            pushes.append({"oid": oid,
+                                           "snap": int(c),
+                                           "delete": False,
+                                           "data": cshard,
+                                           "attrs": ca, "omap": {}})
+                            self.note_repair(cname, cread,
+                                             len(cshard))
+                            continue
+                        cd, cver, cattrs = \
+                            await self.read_object_attrs(
+                                pg, oid, snap=int(c))
                         if cd is None:
                             continue
                         cshards = await codec.encode_async(
                             set(range(n)), cd, klass=K_RECOVERY_EC,
-                    chip=self._chip())
+                            chip=self._chip())
                         ca = dict(cattrs or {})
                         ca[SIZE_XATTR] = b"%d" % len(cd)
                         ca[SHARD_XATTR] = b"%d" % j
@@ -1342,20 +1602,39 @@ class ECPGBackend:
                     if self.osd.store.exists(pg.cid, ho):
                         t.remove(pg.cid, ho)
                 else:
-                    data, ver = await self.read_object(pg, oid)
-                    if data is None:
-                        pg.missing.pop(oid, None)
-                        continue
-                    codec = self.codec(
-                        self.osd.osdmap.pools[pg.pool_id])
-                    n = codec.get_chunk_count()
                     from ..device.runtime import K_RECOVERY_EC
-                    shards = await codec.encode_async(
-                        set(range(n)), data, klass=K_RECOVERY_EC,
-                    chip=self._chip())
-                    t = self._shard_txn(pg, ho, shards[j], j,
-                                        len(data), ver, None,
-                                        hinfo_bytes(shards))
+                    pool = self.osd.osdmap.pools[pg.pool_id]
+                    codec = self.codec(pool)
+                    cname = self._codec_name(pool)
+                    rec = await self._reconstruct_shard(
+                        pg, oid, j, K_RECOVERY_EC)
+                    if rec is not None:
+                        shard, size, ver, rattrs, nread = rec
+                        user = {ak: av for ak, av in rattrs.items()
+                                if ak not in (SIZE_XATTR,
+                                              SHARD_XATTR,
+                                              VER_XATTR,
+                                              HINFO_XATTR)}
+                        t = self._shard_txn(
+                            pg, ho, shard, j, size, ver, user,
+                            rattrs.get(HINFO_XATTR))
+                        self.note_repair(cname, nread, len(shard))
+                    else:
+                        read0 = self.sub_read_bytes
+                        data, ver = await self.read_object(pg, oid)
+                        if data is None:
+                            pg.missing.pop(oid, None)
+                            continue
+                        n = codec.get_chunk_count()
+                        shards = await codec.encode_async(
+                            set(range(n)), data, klass=K_RECOVERY_EC,
+                            chip=self._chip())
+                        t = self._shard_txn(pg, ho, shards[j], j,
+                                            len(data), ver, None,
+                                            hinfo_bytes(shards))
+                        self.note_repair(
+                            cname, self.sub_read_bytes - read0,
+                            len(shards[j]), targeted=False)
                 pg.missing.pop(oid, None)
                 pg.stats.note_recovery(1)
                 pg.persist_meta(t)

@@ -173,6 +173,16 @@ class ClusterThrasher:
                          its original bytes;
       corrupt_replica  — the replicated-pool analog (byte rot or a
                          divergent xattr on one replica);
+      repair_compare   — the repair-traffic oracle (ROADMAP
+                         direction 3): rebuild the SAME planted
+                         single-shard loss on an RS pool and an LRC
+                         pool through the recovery path's targeted
+                         minimal-set reconstruction, and demand the
+                         LRC repair read strictly fewer survivor
+                         bytes than the RS repair (the locality
+                         property, measured — not assumed) while
+                         both rebuilt shards are bit-identical to
+                         the stored originals;
       bully_tenant     — the tenant SLO-plane oracle: mid-round, a
                          bully tenant floods the thrashed pool (many
                          tenant-stamped streams, wide windows) while
@@ -207,7 +217,7 @@ class ClusterThrasher:
                    "pgp_num_grow", "ec_profile_swap",
                    "device_fallback", "chip_loss", "osd_crash",
                    "mixed_rmw", "corrupt_shard", "corrupt_replica",
-                   "bully_tenant")
+                   "bully_tenant", "repair_compare")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -262,7 +272,8 @@ class ClusterThrasher:
         if action in ("map_churn", "pg_num_grow", "pgp_num_grow",
                       "ec_profile_swap", "device_fallback",
                       "chip_loss", "mixed_rmw", "corrupt_shard",
-                      "corrupt_replica", "bully_tenant"):
+                      "corrupt_replica", "bully_tenant",
+                      "repair_compare"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -480,6 +491,21 @@ class ClusterThrasher:
             if c.client.osdmap.pools.get(pid) is None:
                 return
             await self._bully_tenant_round(c, pid, arg)
+        elif action == "repair_compare":
+            by_plugin: dict[str, int] = {}
+            for p in self._pool_ids:
+                pool = c.client.osdmap.pools.get(p)
+                if pool is None or not pool.erasure_code_profile:
+                    continue
+                prof = c.client.osdmap.erasure_code_profiles.get(
+                    pool.erasure_code_profile) or {}
+                by_plugin.setdefault(
+                    prof.get("plugin", "jerasure"), p)
+            rs_pid = by_plugin.get("jerasure", by_plugin.get("isa"))
+            lrc_pid = by_plugin.get("lrc")
+            if rs_pid is None or lrc_pid is None:
+                return              # needs both flavors under thrash
+            await self._repair_compare_round(c, rs_pid, lrc_pid, arg)
         elif action in ("corrupt_shard", "corrupt_replica"):
             want_ec = action == "corrupt_shard"
             pid = next(
@@ -558,6 +584,71 @@ class ClusterThrasher:
 
         await wait_for(pred, timeout,
                        what="victim-tenant SLO alerts cleared")
+
+    async def _repair_compare_round(self, c, rs_pid: int,
+                                    lrc_pid: int, seed: int) -> None:
+        """Plant the same single-shard loss on an RS pool and an LRC
+        pool, rebuild each through the recovery path's targeted
+        minimal-set reconstruction (`ECPGBackend._reconstruct_shard`
+        — the exact function `recover_peer_shards` dispatches), and
+        compare the survivor bytes each repair read: the LRC round
+        must read strictly fewer (its local group) than the RS round
+        (k whole chunks), and both rebuilt shards must be
+        bit-identical to the stored originals."""
+        from ..device.runtime import K_RECOVERY_EC
+        from ..osd.osdmap import pg_t
+        from ..store.objectstore import hobject_t
+        rng = random.Random("repaircmp-%r-%d" % (self.seed, seed))
+        payload = rng.randbytes(rng.randrange(16, 49) * 1024)
+        read_bytes: dict[str, int] = {}
+        for label, pid in (("rs", rs_pid), ("lrc", lrc_pid)):
+            pool = c.client.osdmap.pools[pid]
+            io = c.client.io_ctx(pool.name)
+            oid = "repaircmp-%d-%s" % (seed, label)
+            await asyncio.wait_for(io.write_full(oid, payload), 30.0)
+            await c.wait_health(pid, timeout=120.0)
+            m = c.client.osdmap
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg(oid, pid))
+            _up, _upp, acting, prim = m.pg_to_up_acting_osds(pgid)
+            alive = {o.whoami: o for o in c.live_osds}
+            primary = alive.get(prim)
+            assert primary is not None, "primary osd.%s dead" % prim
+            pg = primary.pgs[pg_t(pid, pgid.ps)]
+            # the planted loss: a non-primary DATA-shard holder (the
+            # shape where LRC's locality pays; data positions come
+            # from the codec's chunk mapping)
+            codec = primary.ec.codec(pool)
+            mapping = codec.get_chunk_mapping()
+            k = codec.get_data_chunk_count()
+            data_pos = ([mapping[i] for i in range(k)] if mapping
+                        else list(range(k)))
+            cands = [j for j in data_pos
+                     if j < len(acting) and acting[j] >= 0
+                     and acting[j] != prim and acting[j] in alive]
+            assert cands, "no non-primary data shard to lose"
+            j = cands[rng.randrange(len(cands))]
+            rec = await primary.ec._reconstruct_shard(
+                pg, oid, j, K_RECOVERY_EC)
+            assert rec is not None, (
+                "targeted %s repair fell back to the full path"
+                % label)
+            shard, _size, _ver, _attrs, nread = rec
+            holder = alive[acting[j]]
+            hpg = holder.pgs[pg_t(pid, pgid.ps)]
+            stored = holder.ec._local_shard(hpg, hobject_t(oid))
+            assert stored is not None and stored[0] == j, \
+                "victim osd.%d does not hold shard %d" \
+                % (acting[j], j)
+            assert bytes(stored[1]) == shard, (
+                "%s targeted repair rebuilt shard %d wrong"
+                % (label, j))
+            read_bytes[label] = nread
+        self.log.append("repair_compare: read_bytes=%r" % read_bytes)
+        assert read_bytes["lrc"] < read_bytes["rs"], (
+            "LRC single-shard repair read %d bytes, not fewer than"
+            " the RS repair's %d for the same loss" % (
+                read_bytes["lrc"], read_bytes["rs"]))
 
     async def _corrupt_round(self, c, pid: int, seed: int,
                              ec: bool) -> None:

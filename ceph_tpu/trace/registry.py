@@ -82,6 +82,10 @@ DEVICE_SERIES = frozenset({
     # and pending-admission counts
     "device_slot_occupancy", "device_admission_wait",
     "device_stream_retires", "device_stream_pending",
+    # repair-traffic plane (device/runtime.py note_repair): survivor
+    # bytes read vs rebuilt bytes pushed by the recovery flows bound
+    # to each chip — the figure the locality-aware codecs shrink
+    "device_repair_bytes_read", "device_repair_bytes_moved",
     # families prom_lines emits beside the metrics() gauges
     "device_chips", "device_dispatch_seconds",
 })
@@ -112,6 +116,11 @@ MGR_SERIES = frozenset({
     "ceph_tpu_mgr_ingest_seconds",
     "ceph_tpu_mgr_ingest_fallback_rows_total",
     "ceph_tpu_mgr_rows_pruned_total",
+    # repair-traffic plane: per-codec recovery bytes (read from
+    # survivors / moved to rebuilt shards) folded from the OSDs'
+    # osd_stats.repair rows into the digest and rendered codec-labeled
+    "ceph_tpu_repair_bytes_read_total",
+    "ceph_tpu_repair_bytes_moved_total",
 })
 
 # consumers referencing the ingest families by literal (the bench
@@ -128,6 +137,10 @@ CONSUMER_MGR_REFS = {
         "ceph_tpu_mgr_ingest_seconds",
         "ceph_tpu_mgr_ingest_fallback_rows_total",
         "ceph_tpu_mgr_rows_pruned_total",
+    ),
+    "tests/test_ec_recovery_codecs.py": (
+        "ceph_tpu_repair_bytes_read_total",
+        "ceph_tpu_repair_bytes_moved_total",
     ),
 }
 
@@ -157,14 +170,18 @@ CONSUMER_SERIES_REFS = {
         "device_util_busy", "device_util_queue_wait",
         "device_util_idle",
     ),
-    # the continuous-dispatch bench leg and its tests consume the
-    # stream series by literal name
+    # the continuous-dispatch + repair-traffic bench legs and their
+    # tests consume these series by literal name
     "bench.py": (
         "device_slot_occupancy", "device_admission_wait",
+        "device_repair_bytes_read", "device_repair_bytes_moved",
     ),
     "tests/test_dispatch_stream.py": (
         "device_slot_occupancy", "device_admission_wait",
         "device_stream_retires", "device_stream_pending",
+    ),
+    "tests/test_ec_recovery_codecs.py": (
+        "device_repair_bytes_read", "device_repair_bytes_moved",
     ),
 }
 
