@@ -1498,6 +1498,202 @@ def _publish_repair(rec: dict, gate: dict) -> None:
         rec["publish_error"] = repr(e)[:200]
 
 
+def bench_device_compress(n_objs: int = 24, seed: int = 41) -> dict:
+    """--device `compression` leg: the direction-3 compression plane
+    at the codec/runtime level — a seeded mixed-size, mixed-
+    compressibility corpus (repeating-unit text, all-zero runs,
+    incompressible random; 8 KiB – 256 KiB log-uniform) compressed
+    three ways on the same backend:
+
+    * **device tlz** — match planning dispatched through the chip's
+      background class (`compress_async`), token emission on host;
+    * **host tlz**  — the pure-numpy reference plan (`compress_host`),
+      the degradation target whose blobs must be BYTE-IDENTICAL;
+    * **host zlib-1** — the incumbent: what force-mode compression
+      pools burned event-loop CPU on before this plane existed.
+
+    Reports throughput for all three, compression ratios, the
+    bit-parity + decompress-roundtrip oracles, the compile budget,
+    and the chip's `device_compress_bytes_in` /
+    `device_compress_bytes_out` accounting.  Gated by
+    `_gate_device_compress`, published into BASELINE.json
+    `published.compression_plane`."""
+    import asyncio
+    import os
+
+    os.environ.setdefault("CEPH_TPU_EC_OFFLOAD", "1")
+
+    def corpus(rng) -> list[bytes]:
+        blobs = []
+        for i in range(n_objs):
+            size = int(np.exp(rng.uniform(np.log(8 << 10),
+                                          np.log(256 << 10))))
+            kind = i % 3
+            if kind == 0:       # text-like: repeating unit
+                unit = rng.integers(0x20, 0x7F, 24,
+                                    dtype=np.uint8).tobytes()
+                blobs.append(
+                    (unit * (size // len(unit) + 1))[:size])
+            elif kind == 1:     # all-zero runs
+                blobs.append(bytes(size))
+            else:               # incompressible
+                blobs.append(rng.integers(0, 256, size,
+                                          dtype=np.uint8).tobytes())
+        return blobs
+
+    async def run() -> dict:
+        import jax
+
+        from ceph_tpu.compress import create
+        from ceph_tpu.compress.tlz import (compress_async,
+                                           compress_host, decompress)
+        from ceph_tpu.device.runtime import DeviceRuntime
+
+        rng = np.random.default_rng(seed)
+        blobs = corpus(rng)
+        total = sum(len(b) for b in blobs)
+        rt = DeviceRuntime.reset()
+        chip = rt.chips[0]
+        await compress_async(blobs[0], chip=0)      # warm programs
+        t0 = time.perf_counter()
+        dev_out = []
+        for b in blobs:
+            out, path = await compress_async(b, chip=0)
+            dev_out.append((out, path))
+        dev_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        host_out = [compress_host(b) for b in blobs]
+        host_wall = time.perf_counter() - t0
+        zlib1 = create("zlib")
+        t0 = time.perf_counter()
+        zlib_out = [zlib1.compress(b) for b in blobs]
+        zlib_wall = time.perf_counter() - t0
+        parity_ok = all(d == h for (d, _p), h
+                        in zip(dev_out, host_out))
+        roundtrip_ok = all(decompress(d) == b
+                           for (d, _p), b in zip(dev_out, blobs))
+        device_paths = sum(1 for _d, p in dev_out if p == "device")
+        metrics = chip.metrics()
+        mibps = 1 / (1 << 20)
+        return {
+            "metric": "compression_plane",
+            "backend": jax.default_backend(),
+            "n_objs": n_objs,
+            "corpus_bytes": total,
+            "device_mibps": round(total / max(dev_wall, 1e-9)
+                                  * mibps, 2),
+            "host_tlz_mibps": round(total / max(host_wall, 1e-9)
+                                    * mibps, 2),
+            "zlib1_mibps": round(total / max(zlib_wall, 1e-9)
+                                 * mibps, 2),
+            "ratio_tlz": round(total / max(sum(
+                len(d) for d, _p in dev_out), 1), 3),
+            "ratio_zlib1": round(total / max(sum(
+                len(z) for z in zlib_out), 1), 3),
+            "parity_ok": bool(parity_ok),
+            "roundtrip_ok": bool(roundtrip_ok),
+            "device_path_blobs": device_paths,
+            "compile_count": rt.compile_count,
+            "host_fallbacks": rt.host_fallbacks,
+            "device_compress_bytes_in":
+                metrics["device_compress_bytes_in"],
+            "device_compress_bytes_out":
+                metrics["device_compress_bytes_out"],
+        }
+
+    return asyncio.run(asyncio.wait_for(run(), 600))
+
+
+def _gate_device_compress(rec: dict) -> dict:
+    """The compression-plane gate: device/host blob parity and
+    decompress roundtrip are hard failures anywhere, as are a compile
+    budget above 8 programs, host fallbacks, or dead
+    device_compress_bytes accounting.  The throughput verdict —
+    device tlz must at least match host zlib-1, the CPU the plane
+    exists to relieve — is strict on a TPU backend; on CPU CI a
+    device leg that cannot beat zlib's C loop records both figures
+    and DEFERS to the standing real-TPU run (ROADMAP direction 4),
+    exactly like the continuous-dispatch gate.  A published
+    same-backend device throughput also gates regressions (< 0.8x)."""
+    import os
+    failures = []
+    if not rec.get("parity_ok"):
+        failures.append("device tlz blobs diverged from the host"
+                        " reference")
+    if not rec.get("roundtrip_ok"):
+        failures.append("tlz blobs did not decompress to the corpus")
+    if rec.get("compile_count", 99) > 8:
+        failures.append("compression leg compiled %d > 8 programs"
+                        % rec.get("compile_count"))
+    if rec.get("host_fallbacks"):
+        failures.append("compression leg fell back to host")
+    if not rec.get("device_compress_bytes_in"):
+        failures.append("chip accounted no device_compress_bytes_in")
+    if not rec.get("device_path_blobs"):
+        failures.append("no blob actually took the device path")
+    deferred = False
+    beats = rec.get("device_mibps", 0.0) >= rec.get("zlib1_mibps",
+                                                    1e9)
+    if not beats:
+        if rec.get("backend") == "tpu":
+            failures.append(
+                "device tlz %.1f MiB/s did not reach host zlib-1"
+                " %.1f MiB/s on TPU"
+                % (rec.get("device_mibps", 0.0),
+                   rec.get("zlib1_mibps", 0.0)))
+        else:
+            deferred = True     # CPU CI cannot decide: real-TPU run
+    published = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            published = (json.load(f).get("published") or {}).get(
+                "compression_plane") or {}
+    except Exception:
+        published = {}
+    prev = published.get("device_mibps")
+    if (prev and published.get("backend") == rec.get("backend")
+            and rec.get("device_mibps", 0.0) < 0.8 * float(prev)):
+        failures.append(
+            "device tlz %.1f MiB/s regressed below 0.8x the"
+            " published %.1f MiB/s"
+            % (rec.get("device_mibps", 0.0), float(prev)))
+    return {"ok": not failures, "failures": failures,
+            "deferred": deferred, "beats_zlib1": beats}
+
+
+def _publish_compress(rec: dict) -> None:
+    """Fold the compression-plane figures into BASELINE.json's
+    published map (backend + defer flag recorded, like the
+    continuous-dispatch leg).  A failed gate publishes nothing."""
+    import os
+    if not rec.get("gate", {}).get("ok"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        keep = ("device_mibps", "host_tlz_mibps", "zlib1_mibps",
+                "ratio_tlz", "ratio_zlib1", "compile_count",
+                "corpus_bytes", "device_compress_bytes_in",
+                "device_compress_bytes_out")
+        doc.setdefault("published", {})["compression_plane"] = {
+            "backend": rec.get("backend"),
+            "unit": "MiB/s of raw corpus compressed",
+            "beats_zlib1": rec["gate"].get("beats_zlib1"),
+            "deferred_to_tpu": rec["gate"].get("deferred"),
+            **{k: rec.get(k) for k in keep},
+            "source": "bench.py --device",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+
+
 def bench_continuous_dispatch(ops_per_tenant: int = 96,
                               n_tenants: int = 4) -> dict:
     """--device `continuous_dispatch` leg: the direction-1 mixed
@@ -2453,6 +2649,10 @@ def main() -> None:
         rec["continuous"] = bench_continuous_dispatch()
         rec["continuous"]["gate"] = _gate_continuous(rec["continuous"])
         _publish_continuous(rec["continuous"])
+        rec["compression"] = bench_device_compress()
+        rec["compression"]["gate"] = _gate_device_compress(
+            rec["compression"])
+        _publish_compress(rec["compression"])
         rec["mesh"] = bench_device_mesh()
         print(json.dumps(rec))
         if not rec["repair"]["gate"]["ok"]:
@@ -2468,12 +2668,31 @@ def main() -> None:
             # regression is a CI failure (CPU runs that merely fail
             # to beat the ladder defer to the real-TPU decision)
             sys.exit(1)
+        if not rec["compression"]["gate"]["ok"]:
+            # the compression-plane figures are guarded artifacts: a
+            # device/host blob divergence, a failed roundtrip, a
+            # compile-budget blowup, or a same-backend throughput
+            # regression is a CI failure (CPU runs that merely fail
+            # to beat zlib's C loop defer to the real-TPU decision)
+            sys.exit(1)
         if not rec["mesh"]["gate"]["ok"] or not rec["ec_gate"]["ok"]:
             # the dp-scaling curve and the ragged/delta figures are
             # guarded artifacts: a regression below 0.8x linear /
             # 0.8x the published figures, a parity mismatch, or a
             # padding-waste blowup is a CI failure, not a quietly
             # worse JSON
+            sys.exit(1)
+        return
+    if "--compress" in sys.argv:
+        # the compression-plane leg alone (the full --device suite
+        # reruns every device leg; this re-measures just tlz and
+        # merges into BASELINE.json's compression_plane section)
+        _maybe_simulate_mesh()
+        rec = bench_device_compress()
+        rec["gate"] = _gate_device_compress(rec)
+        _publish_compress(rec)
+        print(json.dumps(rec))
+        if not rec["gate"]["ok"]:
             sys.exit(1)
         return
     if "--stats" in sys.argv:

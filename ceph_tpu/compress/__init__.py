@@ -118,6 +118,16 @@ register(ZlibCompressor())
 register(LzmaCompressor())
 register(Bz2Compressor())
 
+# the device-native LZ-class codec ("tlz", compress/tlz.py): match
+# planning dispatches on the daemon's affinity chip, token emission is
+# host-side, and the host reference emits byte-identical blobs — so
+# it registers like any other algorithm and every consumer (pool
+# compression, wire frames, recovery pushes) can decode it with the
+# sync interface alone
+from .tlz import TlzCompressor  # noqa: E402  (needs Compressor above)
+
+register(TlzCompressor())
+
 # optional third-party algorithms, loaded like dlopen'd plugins
 try:                                    # pragma: no cover
     import snappy as _snappy

@@ -1,0 +1,284 @@
+"""Vectorized LZ match planning: the compression plane's device kernel.
+
+"GPUs as Storage System Accelerators" (arXiv:1202.3669, PAPERS.md)
+measured compression offload profitable on accelerators a decade
+before TPUs, and the reason the host path hurts here is the same
+reason digests hurt: force-mode compression pools run `zlib.compress`
+one blob at a time ON the daemon's event loop.  This module turns the
+expensive phase of an LZ-class compressor — match FINDING — into one
+batched device dispatch over fixed-size independent blocks, leaving
+only the cheap sequential token emission on host (compress/tlz.py):
+
+* **4-byte-gram rolling hash** — every position i hashes its 4-gram
+  ``le32(data[i:i+4]) * 2654435761 >> (32 - HBITS)`` (the classic
+  LZ4 multiplicative hash), fully parallel across positions and
+  lanes.
+* **match-candidate gather via composite-key sort** — the sequential
+  hash-chain of a scalar LZ compressor ("most recent previous
+  position with my hash") is recovered WITHOUT sequential state: sort
+  positions by the composite key ``hash * width + pos`` (unique, so
+  any sort — host or device — yields the identical order) and each
+  position's candidate is its sorted predecessor when the hashes
+  match.  One argsort + one shifted compare per lane.
+* **vectorized match-length extension** — candidate/position byte
+  agreement is evaluated for all ``MAX_MATCH`` offsets at once as a
+  gather + compare + masked-cumprod-sum; the result is the exact
+  greedy match length a scalar memcmp loop would have produced
+  (capped at MAX_MATCH — the cap is part of the FORMAT, so host and
+  device emit identical tokens).
+* **fixed-geometry blocks on the pow2 lane ladder** — blocks are a
+  fixed ``TLZ_BLOCK`` wide (mixed-size blobs become a ragged count of
+  fixed blocks — the Ragged Paged Attention discipline,
+  arXiv:2604.15464: variable-length work inside fixed-geometry
+  programs), lanes bucket pow2 between ``_MIN_LANES`` and
+  ``_MAX_LANES``, and oversized batches chunk into several dispatches
+  of the SAME program, so the whole plane compiles at most
+  ``log2(_MAX_LANES/_MIN_LANES)+1`` programs (4 — well inside the
+  ≤8 budget).
+* **admission + degradation identical to the digest plane** —
+  dispatches ride the ``background`` class with DispatchTicket
+  attribution; offload disabled, chip poisoned, DeviceBusy, or a
+  mid-dispatch failure (which poisons THIS chip, per-chip
+  DEVICE_FALLBACK + probe heal) all land on the pure-numpy
+  `match_plan_host`, which is the same function by construction — the
+  caller cannot tell the paths apart except in telemetry.
+
+Bit-parity contract: `match_plan_host` and the jitted kernel compute
+the identical (candidate, match-length) arrays — integer sort of
+unique keys, exact uint8 compares — so compress/tlz.py emits
+byte-identical blobs whichever path served the plan (pinned by
+tests/test_tlz.py across seeded mixed-size corpora).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from .runtime import DeviceBusy, DeviceRuntime, K_BACKGROUND
+
+# block geometry: the format constants (compress/tlz.py embeds
+# TLZ_BLOCK in the container header; MAX_MATCH bounds every emitted
+# token's length) — changing either changes the wire format
+TLZ_BLOCK = 4096            # bytes per independent block (lane width)
+MAX_MATCH = 32              # match-extension cap (vectorization depth)
+MIN_MATCH = 4               # shortest emitted match (the 4-gram)
+
+_HBITS = 16                 # hash-table address bits
+_HASH_MUL = np.uint32(2654435761)
+
+_MIN_LANES = 8              # pow2 lane floor (tiny blobs share a program)
+_MAX_LANES = 64             # lane cap: bigger batches chunk, not compile
+
+
+def device_compress_enabled() -> bool:
+    """Device match planning defaults to on where device EC offload
+    is on (a real accelerator backend, or the CEPH_TPU_EC_OFFLOAD
+    test override); CEPH_TPU_COMPRESS_OFFLOAD=1/0 forces it
+    independently — the same gate shape as the digest plane."""
+    v = os.environ.get("CEPH_TPU_COMPRESS_OFFLOAD")
+    if v is not None:
+        return v not in ("0", "false", "no")
+    from ..ec.batcher import device_offload_enabled
+    return device_offload_enabled()
+
+
+def _pow2_lanes(n: int) -> int:
+    return 1 << max(int(n) - 1, _MIN_LANES - 1).bit_length()
+
+
+# -- host reference (and the device kernel's parity oracle) ---------------
+
+
+def match_plan_host(blocks: np.ndarray,
+                    lens: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(cand, mlen) for ``blocks`` [lanes, width] uint8 with per-lane
+    valid lengths ``lens``: cand[l, i] is the most recent position
+    j < i in lane l whose 4-gram hash equals position i's (-1 when
+    none), mlen[l, i] the number of agreeing bytes from (j, i)
+    forward, capped at MAX_MATCH and masked to the lane's valid
+    length.  Pure numpy — this IS the host fallback, and the device
+    kernel below is this function transcribed to jax."""
+    lanes, width = blocks.shape
+    idx = np.arange(width, dtype=np.int64)
+    b = blocks.astype(np.uint32)
+    g = [b[:, np.minimum(idx + t, width - 1)] for t in range(4)]
+    v = g[0] | (g[1] << np.uint32(8)) | (g[2] << np.uint32(16)) \
+        | (g[3] << np.uint32(24))
+    h = ((v * _HASH_MUL) >> np.uint32(32 - _HBITS)).astype(np.int64)
+    # composite key: unique per position, so ANY sort yields the same
+    # order (this is what makes host and device orders identical)
+    key = h * width + idx[None, :]
+    order = np.argsort(key, axis=1)
+    prev = np.concatenate(
+        [np.full((lanes, 1), -1, np.int64), order[:, :-1]], axis=1)
+    same = np.zeros((lanes, width), bool)
+    same[:, 1:] = np.take_along_axis(h, order[:, 1:], 1) \
+        == np.take_along_axis(h, order[:, :-1], 1)
+    cand_sorted = np.where(same, prev, -1)
+    cand = np.empty((lanes, width), np.int64)
+    np.put_along_axis(cand, order, cand_sorted, axis=1)
+    # vectorized match extension: masked leading-agreement count
+    t = np.arange(MAX_MATCH, dtype=np.int64)
+    gi = np.broadcast_to(np.minimum(idx[None, :, None] + t, width - 1),
+                         (lanes, width, MAX_MATCH))
+    gj = np.minimum(np.maximum(cand, 0)[:, :, None] + t, width - 1)
+    li = np.take_along_axis(blocks, gi.reshape(lanes, -1),
+                            1).reshape(lanes, width, MAX_MATCH)
+    lj = np.take_along_axis(blocks, gj.reshape(lanes, -1),
+                            1).reshape(lanes, width, MAX_MATCH)
+    valid = (idx[None, :, None] + t) < lens.astype(np.int64)[:, None,
+                                                             None]
+    ok = (li == lj) & valid & (cand >= 0)[:, :, None]
+    mlen = np.cumprod(ok.astype(np.int64), axis=2).sum(axis=2)
+    return cand.astype(np.int32), mlen.astype(np.int32)
+
+
+# -- device kernel ---------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def _kernel(lanes: int, width: int):
+    """One jitted match-planning program per (lanes, width) bucket:
+    hash, composite-key sort, predecessor gather, and the masked
+    cumprod match extension — the exact arithmetic of
+    `match_plan_host`."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(data, lens):
+        idx = jnp.arange(width, dtype=jnp.int32)
+        b = data.astype(jnp.uint32)
+        g = [b[:, jnp.minimum(idx + t, width - 1)] for t in range(4)]
+        v = g[0] | (g[1] << jnp.uint32(8)) \
+            | (g[2] << jnp.uint32(16)) | (g[3] << jnp.uint32(24))
+        h = ((v * jnp.uint32(_HASH_MUL))
+             >> jnp.uint32(32 - _HBITS)).astype(jnp.int32)
+        key = h * jnp.int32(width) + idx[None, :]
+        order = jnp.argsort(key, axis=1).astype(jnp.int32)
+        prev = jnp.concatenate(
+            [jnp.full((lanes, 1), -1, jnp.int32), order[:, :-1]],
+            axis=1)
+        h_sorted = jnp.take_along_axis(h, order, 1)
+        same = jnp.concatenate(
+            [jnp.zeros((lanes, 1), bool),
+             h_sorted[:, 1:] == h_sorted[:, :-1]], axis=1)
+        cand_sorted = jnp.where(same, prev, jnp.int32(-1))
+        lane_ix = jnp.arange(lanes, dtype=jnp.int32)[:, None]
+        cand = jnp.zeros((lanes, width), jnp.int32).at[
+            lane_ix, order].set(cand_sorted)
+        t = jnp.arange(MAX_MATCH, dtype=jnp.int32)
+        gi = jnp.broadcast_to(
+            jnp.minimum(idx[None, :, None] + t, width - 1),
+            (lanes, width, MAX_MATCH))
+        gj = jnp.minimum(jnp.maximum(cand, 0)[:, :, None] + t,
+                         width - 1)
+        li = jnp.take_along_axis(
+            data, gi.reshape(lanes, -1), 1).reshape(lanes, width,
+                                                    MAX_MATCH)
+        lj = jnp.take_along_axis(
+            data, gj.reshape(lanes, -1), 1).reshape(lanes, width,
+                                                    MAX_MATCH)
+        valid = (idx[None, :, None] + t) < lens[:, None, None]
+        ok = (li == lj) & valid & (cand >= 0)[:, :, None]
+        mlen = jnp.cumprod(ok.astype(jnp.int32), axis=2).sum(axis=2)
+        return cand, mlen
+
+    return jax.jit(run)
+
+
+def _stage_blocks(segs: list[bytes], lanes: int) -> tuple[np.ndarray,
+                                                          np.ndarray]:
+    lens = np.zeros(lanes, np.int32)
+    stage = np.zeros((lanes, TLZ_BLOCK), np.uint8)
+    for i, s in enumerate(segs):
+        a = np.frombuffer(s, np.uint8)
+        stage[i, :a.size] = a
+        lens[i] = a.size
+    return stage, lens
+
+
+async def match_batch(segs: list[bytes], chip: int | None = None,
+                      klass: str = K_BACKGROUND
+                      ) -> tuple[np.ndarray, np.ndarray, str]:
+    """Plan matches for every <= TLZ_BLOCK segment in device
+    dispatches on the caller's affinity chip; returns
+    (cand, mlen, path) where the arrays cover ``len(segs)`` lanes and
+    path is "device" or "host".  Any degradation (offload disabled,
+    chip lost, queue full, mid-dispatch failure — which poisons THIS
+    chip) lands on the numpy reference, which computes the identical
+    plan."""
+    n = len(segs)
+    if n == 0:
+        return (np.zeros((0, TLZ_BLOCK), np.int32),
+                np.zeros((0, TLZ_BLOCK), np.int32), "host")
+    rt = DeviceRuntime.get()
+    target = rt.route(chip)
+    if target is None or not target.available \
+            or not device_compress_enabled():
+        stage, lens = _stage_blocks(segs, n)
+        cand, mlen = match_plan_host(stage, lens)
+        return cand, mlen, "host"
+    cands: list[np.ndarray] = []
+    mlens: list[np.ndarray] = []
+    # oversized batches chunk into several dispatches of the same
+    # lane-capped program family instead of compiling wider ones
+    for lo in range(0, n, _MAX_LANES):
+        segs_c = segs[lo:lo + _MAX_LANES]
+        lanes = min(_pow2_lanes(len(segs_c)), _MAX_LANES)
+        total = sum(len(s) for s in segs_c)
+        ticket = target.open_ticket(klass, lanes, total)
+        try:
+            await target.admit(ticket)
+        except DeviceBusy:
+            stage, lens = _stage_blocks(segs_c, len(segs_c))
+            c, m = match_plan_host(stage, lens)
+            cands.append(c)
+            mlens.append(m)
+            target.host_fallbacks += 1
+            continue
+        stage = target.pool.lease((lanes, TLZ_BLOCK), np.uint8)
+        try:
+            import jax.numpy as jnp
+            lens = np.zeros(lanes, np.int32)
+            for i, s in enumerate(segs_c):
+                a = np.frombuffer(s, np.uint8)
+                stage[i, :a.size] = a
+                lens[i] = a.size
+            target.launch(ticket)       # injected-fault hook
+            c, m = _kernel(lanes, TLZ_BLOCK)(
+                target.place(jnp.asarray(stage)),
+                target.place(jnp.asarray(lens)))
+            c = np.asarray(c)[:len(segs_c)]
+            m = np.asarray(m)[:len(segs_c)]
+            target.note_program("tlz", (lanes, TLZ_BLOCK))
+            target.finish(ticket, ok=True)
+            target.note_staging(total // 4,
+                                (lanes * TLZ_BLOCK) // 4)
+            cands.append(c)
+            mlens.append(m)
+        except Exception as e:
+            # device loss mid-compress: poison THIS chip (per-chip
+            # DEVICE_FALLBACK + probe heal) and plan the rest on host
+            target.finish(ticket, ok=False, error=e)
+            target.poison(e)
+            st, lens = _stage_blocks(segs_c, len(segs_c))
+            c, m = match_plan_host(st, lens)
+            cands.append(c)
+            mlens.append(m)
+            target.host_fallbacks += 1
+            # remaining chunks go through route() again next loop —
+            # but this chip is poisoned now, so serve them on host
+            remaining = segs[lo + _MAX_LANES:]
+            if remaining:
+                st, lens = _stage_blocks(remaining, len(remaining))
+                c, m = match_plan_host(st, lens)
+                cands.append(c)
+                mlens.append(m)
+            return (np.concatenate(cands, 0),
+                    np.concatenate(mlens, 0), "host")
+        finally:
+            target.pool.release(stage)
+    return np.concatenate(cands, 0), np.concatenate(mlens, 0), "device"

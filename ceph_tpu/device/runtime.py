@@ -325,6 +325,13 @@ class ChipRuntime:
         # locality-aware codecs (LRC/SHEC/CLAY) exist to shrink
         self.repair_bytes_read = 0
         self.repair_bytes_moved = 0
+        # compression-plane accounting: raw bytes whose match
+        # planning dispatched on this chip vs the blob bytes emitted
+        # from those plans (device/lzkernel + compress/tlz) — the
+        # observable that says force-mode compression pools stopped
+        # burning host CPU here
+        self.compress_bytes_in = 0
+        self.compress_bytes_out = 0
         # dispatch telemetry
         self.tickets: list[DispatchTicket] = []     # bounded ring
         self.dispatch_buckets_us = [0] * _HIST_BUCKETS
@@ -421,6 +428,14 @@ class ChipRuntime:
         bench leg gates on."""
         self.repair_bytes_read += max(0, int(bytes_read))
         self.repair_bytes_moved += max(0, int(bytes_moved))
+
+    def note_compress(self, bytes_in: int, bytes_out: int) -> None:
+        """Account one device-planned compression: raw bytes in,
+        container bytes out.  Exported as the chip-labeled
+        device_compress_bytes_in/_out series the compression bench
+        leg and the thrasher's poison oracle read."""
+        self.compress_bytes_in += max(0, int(bytes_in))
+        self.compress_bytes_out += max(0, int(bytes_out))
 
     # -- tickets -----------------------------------------------------------
 
@@ -649,6 +664,10 @@ class ChipRuntime:
             # bytes pushed by the recovery flows bound to this chip
             "device_repair_bytes_read": self.repair_bytes_read,
             "device_repair_bytes_moved": self.repair_bytes_moved,
+            # compression plane: raw bytes match-planned on this chip
+            # vs emitted container bytes (ratio = in/out)
+            "device_compress_bytes_in": self.compress_bytes_in,
+            "device_compress_bytes_out": self.compress_bytes_out,
         }
 
 

@@ -1335,6 +1335,21 @@ class Monitor:
                     for chip, row in sorted(du.items(),
                                             key=lambda kv:
                                             int(kv[0]))}
+            # cross-codec repair-bytes panel: the digest's per-codec
+            # recovery-traffic totals rendered beside device_util, so
+            # the locality win (LRC local repairs vs RS k-fetches) is
+            # a `status` line, not a bench-only figure
+            rt = dig.get("repair_traffic") or {}
+            if rt:
+                out["repair_traffic"] = {
+                    str(codec): {
+                        "read": int(row.get("read") or 0),
+                        "moved": int(row.get("moved") or 0),
+                        "objects": int(row.get("objects") or 0),
+                        "targeted": int(row.get("targeted") or 0),
+                        "full": int(row.get("full") or 0),
+                    }
+                    for codec, row in sorted(rt.items())}
         return out
 
     def _pool_digest_rows(self) -> list[dict]:

@@ -143,6 +143,15 @@ class OSD:
         self.perf.add_u64("comp_paced_ops",
                           "compression-pool ops paced through the"
                           " background device class")
+        self.perf.add_u64("comp_device_blobs",
+                          "writefull blobs whose tlz match planning"
+                          " dispatched on this daemon's chip")
+        self.perf.add_u64("comp_host_blobs",
+                          "writefull blobs tlz-compressed on the"
+                          " host reference (degraded path)")
+        self.perf.add_u64("comp_size_mismatches",
+                          "reads refused because comp-size disagreed"
+                          " with the decompressed length")
         # repair-traffic plane: what recovery actually moved, split
         # by whether the minimal-shard-set (targeted) repair served
         # it or the whole-object read + re-encode fallback did
@@ -1790,7 +1799,17 @@ class OSD:
         grants instead of interleaving freely with them, so client EC
         flushes keep their share of the chip.  A full admission queue
         degrades to unpaced execution — pacing must never fail or
-        park the op itself."""
+        park the op itself.
+
+        Pools whose algorithm is the device-native "tlz" additionally
+        pre-plan their writefull compressions as device dispatches on
+        this OSD's affinity chip (compress/tlz.compress_async) BEFORE
+        the synchronous write executes — the expensive match phase
+        leaves the event loop, and because the device and host paths
+        emit byte-identical blobs, `_maybe_compress` consumes the
+        pre-computed blob without any correctness coupling (any
+        degradation inside compress_async already returned the host
+        reference's bytes)."""
         from ..device.runtime import (DeviceBusy, DeviceRuntime,
                                       K_BACKGROUND)
         chip = (self.device_chip if self.device_chip is not None
@@ -1799,6 +1818,29 @@ class OSD:
                             for op in msg.ops
                             if isinstance(op, dict)) / 65536.0)
         t0 = self.optracker.now()
+        comp_pre: dict[int, bytes] | None = None
+        pool = self.osdmap.pools.get(pg.pool_id)
+        if writes and pool is not None \
+                and pool.compression_algorithm == "tlz":
+            from ..compress import tlz
+            for i, op in enumerate(msg.ops):
+                if not (isinstance(op, dict)
+                        and op.get("op") == "writefull"):
+                    continue
+                data = op.get("data") or b""
+                if len(data) < 128:
+                    continue    # below _maybe_compress's floor
+                try:
+                    blob, path = await tlz.compress_async(
+                        data, chip=chip.index, klass=K_BACKGROUND)
+                except Exception:
+                    continue    # host path inside _maybe_compress
+                if comp_pre is None:
+                    comp_pre = {}
+                comp_pre[i] = blob
+                self.perf.inc("comp_device_blobs"
+                              if path == "device"
+                              else "comp_host_blobs")
         granted = False
         try:
             await chip.queue.admit(K_BACKGROUND, cost)
@@ -1808,7 +1850,8 @@ class OSD:
             pass        # overloaded: run unpaced, never fail the op
         try:
             if writes:
-                self._execute_write(pg, conn, msg)
+                self._execute_write(pg, conn, msg,
+                                    comp_pre=comp_pre)
             else:
                 self._serve_read(pg, conn, msg)
         finally:
@@ -1882,7 +1925,8 @@ class OSD:
     # object layer; src/compressor consumers) --------------------------
 
     def _maybe_compress(self, pool, pg: PG, ho, data: bytes,
-                        t: Transaction, cstate: dict) -> bytes:
+                        t: Transaction, cstate: dict,
+                        blob: bytes | None = None) -> bytes:
         """Full-object writes on a compression pool store the
         compressed image when it saves enough (the reference's
         required-ratio gate); the algorithm + logical size ride
@@ -1890,7 +1934,10 @@ class OSD:
         a self-describing blob.  EC pools skip — stripe math needs
         the raw bytes.  ``cstate`` tracks per-txn staged comp state
         (ho -> algo | None): later ops in the SAME MOSDOp must see
-        what earlier ops staged, not the committed attrs."""
+        what earlier ops staged, not the committed attrs.  ``blob``
+        is an optional pre-computed compression of exactly ``data``
+        (the device-planned tlz path) — byte-identical to what the
+        sync compressor would produce, so only the CPU cost differs."""
         from ..compress import OBJ_ALGO_ATTR, OBJ_SIZE_ATTR, create
 
         if pool is None or pool.compression_mode != "force" \
@@ -1898,7 +1945,8 @@ class OSD:
             self._clear_comp_attrs(pg, ho, t, cstate)
             cstate[ho] = (None, data)
             return data
-        blob = create(pool.compression_algorithm).compress(data)
+        if blob is None:
+            blob = create(pool.compression_algorithm).compress(data)
         if len(blob) * 10 >= len(data) * 9:     # <10% saved: keep raw
             self._clear_comp_attrs(pg, ho, t, cstate)
             cstate[ho] = (None, data)
@@ -1957,6 +2005,8 @@ class OSD:
             # truncated to zero: its logical image is empty, not a
             # corrupt stream
             raw = create(algo).decompress(blob) if blob else b""
+            if blob:
+                self._check_comp_size(pg, ho, raw)
         t.truncate(pg.cid, ho, 0)
         t.write(pg.cid, ho, 0, len(raw), raw)
         t.rmattr(pg.cid, ho, OBJ_ALGO_ATTR)
@@ -1973,9 +2023,31 @@ class OSD:
         from ..compress import create
 
         raw = create(algo).decompress(self.store.read(pg.cid, ho))
+        self._check_comp_size(pg, ho, raw)
         if length < 0:
             return raw[offset:]
         return raw[offset:offset + length]
+
+    def _check_comp_size(self, pg: PG, ho, raw: bytes) -> None:
+        """Decompress-side integrity: the stored `comp-size` attr and
+        the decompressed length must agree, or the read fails with a
+        CompressorError (EIO to the client) instead of silently
+        serving truncated/padded data.  The rot is scrub-visible —
+        deep scrub digests the stored blob AND the attrs, so a
+        tampered blob or size attr diverges from the healthy replicas
+        and repairs like any other inconsistency (the thrasher's
+        `corrupt_compressed` arm proves the loop end to end)."""
+        from ..compress import OBJ_SIZE_ATTR, CompressorError
+
+        try:
+            want = int(self.store.getattr(pg.cid, ho, OBJ_SIZE_ATTR))
+        except (NotFound, ValueError):
+            return      # no size attr staged (mid-txn states): skip
+        if want != len(raw):
+            self.perf.inc("comp_size_mismatches")
+            raise CompressorError(
+                "compressed object %s: comp-size attr %d disagrees"
+                " with decompressed length %d" % (ho, want, len(raw)))
 
     def _stat_decompressed(self, pg: PG, ho) -> int:
         from ..compress import OBJ_SIZE_ATTR
@@ -2062,10 +2134,15 @@ class OSD:
                 result = -5
         return outs, result
 
-    def _execute_write(self, pg: PG, conn, msg: MOSDOp) -> None:
+    def _execute_write(self, pg: PG, conn, msg: MOSDOp,
+                       comp_pre: dict[int, bytes] | None = None
+                       ) -> None:
         """prepare_transaction + issue_repop (PrimaryLogPG.cc:8869,
         11394).  Snapshot bookkeeping (make_writeable) runs first so
-        the clone ops ride the same replicated transaction."""
+        the clone ops ride the same replicated transaction.
+        ``comp_pre`` maps op-list indices to device-planned
+        compression blobs `_compression_paced` staged for writefull
+        ops (byte-identical to the sync compressor's output)."""
         from . import snaps as snapmod
         self._op_event(msg, "started_write")
         epoch = self.osdmap.epoch
@@ -2080,7 +2157,7 @@ class OSD:
         is_delete = False
         cstate: dict = {}   # per-txn staged compression state
         from ..compress import CompressorError
-        for op in msg.ops:
+        for op_i, op in enumerate(msg.ops):
             name = op["op"]
             if name == "write":
                 data = op["data"]
@@ -2109,8 +2186,9 @@ class OSD:
                     t.touch(pg.cid, ho)
                 pool0 = self.osdmap.pools.get(pg.pool_id)
                 try:
-                    stored = self._maybe_compress(pool0, pg, ho,
-                                                  data, t, cstate)
+                    stored = self._maybe_compress(
+                        pool0, pg, ho, data, t, cstate,
+                        blob=(comp_pre or {}).get(op_i))
                 except CompressorError as e:
                     outs.append({"error": str(e)})
                     result = -5

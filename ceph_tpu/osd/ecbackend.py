@@ -136,6 +136,9 @@ class ECPGBackend:
         self.repair_traffic: dict[str, dict[str, int]] = {}
         # last degraded-read plan (tests assert fetched == minimal)
         self.last_read_plan: dict | None = None
+        # last version-selection plan (tests assert the decode staged
+        # exactly the minimum_to_decode-costed shard set)
+        self.last_version_plan: dict | None = None
 
     # -- codec -------------------------------------------------------------
 
@@ -1171,32 +1174,66 @@ class ECPGBackend:
                         attrs_by_ver.setdefault(ver, dict(rattrs))
             best = self._best_version(codec, k, by_ver)
             if best is not None:
+                ver, use_pos = best
                 chunks = {j: b for j, (b, _s) in
-                          by_ver[best].items()}
-                size = next(iter(by_ver[best].values()))[1]
+                          by_ver[ver].items() if j in use_pos}
+                size = next(iter(by_ver[ver].values()))[1]
                 try:
                     data = await codec.decode_concat_async(
                         chunks, chip=self._chip())
                 except (IOError, OSError):
                     continue  # widen to the remaining members
-                return (data[:size], best,
-                        attrs_by_ver.get(best, {}))
+                return (data[:size], ver,
+                        attrs_by_ver.get(ver, {}))
         return None, None, None
 
     def _best_version(self, codec, k, by_ver):
-        """Newest version with a decodable shard set, else None.
-        Data positions come from the codec's chunk mapping — LRC-style
-        layouts do NOT put data at 0..k-1."""
+        """(version, decode shard set) for the newest version with a
+        decodable shard set, else None.  Data positions come from the
+        codec's chunk mapping — LRC-style layouts do NOT put data at
+        0..k-1.
+
+        Cost planning is `minimum_to_decode`-sized, not MDS-assumed:
+        the old code fed EVERY gathered shard of the winning version
+        to the decoder (the k-cost MDS assumption), which makes
+        recovery-codec pools stage shards the plan never needed —
+        SHEC decodes a shingle window, CLAY a sub-chunk plane subset,
+        LRC a local group.  Now each candidate version's minimal plan
+        is costed in sub-chunk units (a CLAY helper that ships
+        d/q planes costs d/q of a shard, not 1), the newest decodable
+        version still wins — serving an older version when a newer
+        one is readable would be a stale read, so cost can never
+        override recency — and the decode dispatch stages exactly the
+        planned set.  Every candidate's cost lands in
+        `last_version_plan` so tests and operators can audit what the
+        cheaper plan saved."""
         mapping = codec.get_chunk_mapping()
         want = ({mapping[i] for i in range(k)} if mapping
                 else set(range(k)))
+        sub = max(1, codec.get_sub_chunk_count())
+        candidates: dict = {}
+        best = None
         for ver in sorted(by_ver, reverse=True):
+            have = set(by_ver[ver])
             try:
-                codec.minimum_to_decode(want, set(by_ver[ver]))
-                return ver
+                plan = dict(codec.minimum_to_decode(want, have))
             except Exception:
                 continue
-        return None
+            use = set(plan) & have
+            if not use:
+                continue
+            cost = sum(sum(cnt for _off, cnt in plan[p]) / sub
+                       for p in use)
+            candidates[ver] = {"shards": sorted(use),
+                               "cost_chunks": round(cost, 4)}
+            if best is None:
+                best = (ver, use)
+        self.last_version_plan = (
+            None if best is None else
+            {"version": best[0], "shards": sorted(best[1]),
+             "cost_chunks": candidates[best[0]]["cost_chunks"],
+             "candidates": candidates})
+        return best
 
     async def _sub_read(self, pg: PG, oid: str,
                         members: list, snap: int = None,

@@ -173,6 +173,25 @@ class ClusterThrasher:
                          its original bytes;
       corrupt_replica  — the replicated-pool analog (byte rot or a
                          divergent xattr on one replica);
+      corrupt_compressed — the compression-plane integrity oracle:
+                         plant comp-size / blob rot in stored
+                         compressed images on one replica of a
+                         force-compression pool, prove the read path
+                         REFUSES to serve truncated data (EIO, never
+                         short bytes), deep scrub finds exactly the
+                         planted set, repair drains it, and the
+                         original bytes read back;
+      poison_mid_compress — the compression-plane fault oracle: arm
+                         a one-shot device fault on every live OSD's
+                         affinity chip, then drive compressible
+                         writefulls through a force-compression tlz
+                         pool — the mid-dispatch loss must poison
+                         only the dispatching chip, every write must
+                         complete on the bit-identical host reference
+                         (zero lost acked writes, futures retired
+                         exactly once), every stored blob must
+                         decompress to the original bytes, and the
+                         chip must heal;
       repair_compare   — the repair-traffic oracle (ROADMAP
                          direction 3): rebuild the SAME planted
                          single-shard loss on an RS pool and an LRC
@@ -217,6 +236,7 @@ class ClusterThrasher:
                    "pgp_num_grow", "ec_profile_swap",
                    "device_fallback", "chip_loss", "osd_crash",
                    "mixed_rmw", "corrupt_shard", "corrupt_replica",
+                   "corrupt_compressed", "poison_mid_compress",
                    "bully_tenant", "repair_compare")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
@@ -272,7 +292,8 @@ class ClusterThrasher:
         if action in ("map_churn", "pg_num_grow", "pgp_num_grow",
                       "ec_profile_swap", "device_fallback",
                       "chip_loss", "mixed_rmw", "corrupt_shard",
-                      "corrupt_replica", "bully_tenant",
+                      "corrupt_replica", "corrupt_compressed",
+                      "poison_mid_compress", "bully_tenant",
                       "repair_compare"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
@@ -506,6 +527,28 @@ class ClusterThrasher:
             if rs_pid is None or lrc_pid is None:
                 return              # needs both flavors under thrash
             await self._repair_compare_round(c, rs_pid, lrc_pid, arg)
+        elif action == "corrupt_compressed":
+            pid = next(
+                (p for p in self._pool_ids
+                 if (c.client.osdmap.pools.get(p) is not None
+                     and c.client.osdmap.pools[p]
+                     .compression_mode == "force"
+                     and not c.client.osdmap.pools[p]
+                     .erasure_code_profile)), None)
+            if pid is None:
+                return              # no compression pool under thrash
+            await self._corrupt_compressed_round(c, pid, arg)
+        elif action == "poison_mid_compress":
+            pid = next(
+                (p for p in self._pool_ids
+                 if (c.client.osdmap.pools.get(p) is not None
+                     and c.client.osdmap.pools[p]
+                     .compression_mode == "force"
+                     and not c.client.osdmap.pools[p]
+                     .erasure_code_profile)), None)
+            if pid is None:
+                return              # no compression pool under thrash
+            await self._poison_mid_compress_round(c, pid, arg)
         elif action in ("corrupt_shard", "corrupt_replica"):
             want_ec = action == "corrupt_shard"
             pid = next(
@@ -758,6 +801,186 @@ class ClusterThrasher:
             got = await asyncio.wait_for(io.read(oid), 30.0)
             assert got == want, \
                 "corrupt round lost %s after repair" % oid
+
+    async def _corrupt_compressed_round(self, c, pid: int,
+                                        seed: int) -> None:
+        """Compression-plane integrity: plant comp-size / blob rot in
+        one replica's stored compressed image, prove the read path
+        refuses to serve truncated data (EIO), deep scrub finds
+        EXACTLY the planted set, repair drains it to zero, and the
+        original bytes read back."""
+        from ..compress import OBJ_SIZE_ATTR
+        from ..osd.osdmap import pg_t
+        from ..store.objectstore import Transaction, hobject_t
+        pool = c.client.osdmap.pools[pid]
+        io = c.client.io_ctx(pool.name)
+        rng = random.Random("corruptcomp-%r-%d" % (self.seed, seed))
+        payloads = {}
+        for i in range(3):
+            oid = "comprot-%d-%d" % (seed, i)
+            unit = bytes(rng.randrange(0x20, 0x7F)
+                         for _ in range(16))
+            payloads[oid] = unit * rng.randrange(256, 1500)
+            await asyncio.wait_for(
+                io.write_full(oid, payloads[oid]), 30.0)
+        await c.wait_health(pid, timeout=120.0)
+        m = c.client.osdmap
+        alive = {o.whoami: o for o in c.live_osds}
+        planted: dict = {}          # ps -> set of planted oids
+        for idx, oid in enumerate(sorted(payloads)[:2]):
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg(oid, pid))
+            _up, _upp, acting, prim = m.pg_to_up_acting_osds(pgid)
+            members = [o for o in acting if o >= 0 and o in alive]
+            # first plant lands on the PRIMARY so the read-path guard
+            # is provably exercised; the second on a seeded member
+            victim = alive[prim if idx == 0 and prim in alive
+                           else members[rng.randrange(len(members))]]
+            pg = victim.pgs[pg_t(pid, pgid.ps)]
+            ho = hobject_t(oid)
+            assert victim.store.getattr(pg.cid, ho, "comp-alg"), \
+                "%s stored raw on osd.%d: payload did not" \
+                " compress" % (oid, victim.whoami)
+            mode = rng.choice(["size_attr", "blob"])
+            t = Transaction()
+            if mode == "size_attr":
+                # comp-size disagrees with the decompressed length:
+                # without the guard this SERVES wrong-length data
+                t.setattr(pg.cid, ho, OBJ_SIZE_ATTR,
+                          b"%d" % (len(payloads[oid]) + 7))
+            else:
+                # physically truncated blob: decompression fails
+                blob = victim.store.read(pg.cid, ho)
+                t.truncate(pg.cid, ho, 0)
+                t.write(pg.cid, ho, 0, len(blob) // 2,
+                        bytes(blob[:len(blob) // 2]))
+            victim.store.apply_transaction(t)
+            planted.setdefault(pgid.ps, set()).add(oid)
+            self.log.append("corrupt_compressed: %s %s on osd.%d"
+                            % (oid, mode, victim.whoami))
+            if victim.whoami == prim:
+                # the guard: a read THROUGH the rotted copy fails
+                # with EIO — truncated/padded bytes are never served
+                outs, res = victim._do_read_ops(
+                    pg, oid, [{"op": "read"}])
+                assert res == -5, (
+                    "rotted compressed read returned %r, not EIO"
+                    % ((outs, res),))
+        all_planted = {o for s in planted.values() for o in s}
+        # deep scrub finds EXACTLY the planted set, repair drains it,
+        # a re-scrub is clean, and the original bytes survive
+        for ps in sorted(planted):
+            osd, pg = c.pg_primary(pid, ps)
+            res = await osd.scrubber.scrub_pg(pg, deep=True,
+                                              recheck=True)
+            got = set(res["inconsistent"])
+            assert got == planted[ps], (
+                "deep scrub of %s found %r, planted %r"
+                % (pg.pgid, sorted(got), sorted(planted[ps])))
+        for ps in sorted(planted):
+            osd, pg = c.pg_primary(pid, ps)
+            res = await osd.scrubber.scrub_pg(pg, deep=True,
+                                              repair=True,
+                                              only=planted[ps])
+            assert res["repaired"] >= 1, res
+            assert res["residual"] == 0, res
+        for ps in sorted(planted):
+            osd, pg = c.pg_primary(pid, ps)
+            res = await osd.scrubber.scrub_pg(pg, deep=True,
+                                              recheck=True)
+            assert not (set(res["inconsistent"]) & all_planted), res
+        for oid, want in sorted(payloads.items()):
+            got = await asyncio.wait_for(io.read(oid), 30.0)
+            assert got == want, \
+                "corrupt_compressed lost %s after repair" % oid
+
+    async def _poison_mid_compress_round(self, c, pid: int,
+                                         seed: int) -> None:
+        """Chip loss mid-compress: arm a one-shot device fault on
+        every live OSD's affinity chip, then drive compressible
+        writefulls through the tlz pool — the dispatching chip
+        poisons mid-flight, every write completes on the
+        bit-identical host reference (zero lost acked writes), every
+        stored blob decompresses to the original bytes, and the
+        poisoned chips heal."""
+        from ..compress import create
+        from ..device.lzkernel import device_compress_enabled
+        from ..device.runtime import DeviceRuntime
+        from ..osd.osdmap import pg_t
+        from ..store.objectstore import hobject_t
+        from ..utils.backoff import wait_for
+        pool = c.client.osdmap.pools[pid]
+        if pool.compression_algorithm != "tlz":
+            await c.client.mon_command(
+                "osd pool set", pool=pool.name,
+                var="compression_algorithm", val="tlz")
+            await wait_for(
+                lambda: all(
+                    o.osdmap.pools.get(pid) is not None
+                    and o.osdmap.pools[pid].compression_algorithm
+                    == "tlz" for o in c.live_osds),
+                30.0, what="tlz algorithm visible on every OSD")
+            pool = c.client.osdmap.pools[pid]
+        io = c.client.io_ctx(pool.name)
+        rng = random.Random("poisoncomp-%r-%d" % (self.seed, seed))
+        rt = DeviceRuntime.get()
+        chips = {(o.device_chip if o.device_chip is not None
+                  else rt.chip_for(o.whoami)) for o in c.live_osds}
+        armed = device_compress_enabled()
+        pre_poison = {ch.index: ch.fallback_count for ch in chips}
+        if armed:
+            for ch in chips:
+                ch.inject_fault(1)
+        payloads = {}
+        for i in range(5):
+            oid = "poisoncomp-%d-%d" % (seed, i)
+            unit = bytes(rng.randrange(0x20, 0x7F)
+                         for _ in range(12))
+            payloads[oid] = unit * rng.randrange(300, 2000)
+        try:
+            # concurrent writefulls: the first dispatch consumes the
+            # fault mid-compress; gather raises if ANY write is lost
+            await asyncio.wait_for(asyncio.gather(*[
+                io.write_full(oid, p)
+                for oid, p in sorted(payloads.items())]), 60.0)
+        finally:
+            for ch in chips:
+                ch.clear_faults()
+        if armed:
+            assert any(ch.fallback_count > pre_poison[ch.index]
+                       for ch in chips), \
+                "no chip consumed the armed mid-compress fault"
+        # zero lost acked writes, and every stored blob decompresses
+        # to the original bytes on every live replica
+        m = c.client.osdmap
+        alive = {o.whoami: o for o in c.live_osds}
+        for oid, want in sorted(payloads.items()):
+            got = await asyncio.wait_for(io.read(oid), 30.0)
+            assert got == want, \
+                "acked write %s lost through the chip poison" % oid
+            pgid = pool.raw_pg_to_pg(
+                m.object_locator_to_pg(oid, pid))
+            _up, _upp, acting, _prim = m.pg_to_up_acting_osds(pgid)
+            for o in acting:
+                osd = alive.get(o)
+                if osd is None:
+                    continue
+                pg = osd.pgs.get(pg_t(pid, pgid.ps))
+                if pg is None:
+                    continue
+                ho = hobject_t(oid)
+                algo = osd.store.getattr(pg.cid, ho, "comp-alg")
+                assert algo == b"tlz", (oid, o, algo)
+                blob = osd.store.read(pg.cid, ho)
+                assert create("tlz").decompress(bytes(blob)) \
+                    == want, (
+                    "stored blob of %s on osd.%d does not decompress"
+                    " to the original bytes" % (oid, o))
+        self.log.append("poison_mid_compress: %d writes, armed=%r"
+                        % (len(payloads), armed))
+        # the probe loops heal every poisoned chip (faults cleared)
+        await wait_for(lambda: all(not ch.fallback for ch in chips),
+                       30.0, what="poisoned chips healed")
 
     async def _mixed_rmw_round(self, c, pid: int, seed: int) -> None:
         """Interleaved full rewrites + partial overwrites on the same

@@ -86,6 +86,10 @@ DEVICE_SERIES = frozenset({
     # bytes read vs rebuilt bytes pushed by the recovery flows bound
     # to each chip — the figure the locality-aware codecs shrink
     "device_repair_bytes_read", "device_repair_bytes_moved",
+    # compression plane (device/runtime.py note_compress): raw bytes
+    # match-planned on each chip vs emitted container bytes — the
+    # observable that force-mode pools stopped burning host CPU
+    "device_compress_bytes_in", "device_compress_bytes_out",
     # families prom_lines emits beside the metrics() gauges
     "device_chips", "device_dispatch_seconds",
 })
@@ -170,11 +174,15 @@ CONSUMER_SERIES_REFS = {
         "device_util_busy", "device_util_queue_wait",
         "device_util_idle",
     ),
-    # the continuous-dispatch + repair-traffic bench legs and their
-    # tests consume these series by literal name
+    # the continuous-dispatch + repair-traffic + compression bench
+    # legs and their tests consume these series by literal name
     "bench.py": (
         "device_slot_occupancy", "device_admission_wait",
         "device_repair_bytes_read", "device_repair_bytes_moved",
+        "device_compress_bytes_in", "device_compress_bytes_out",
+    ),
+    "tests/test_tlz.py": (
+        "device_compress_bytes_in", "device_compress_bytes_out",
     ),
     "tests/test_dispatch_stream.py": (
         "device_slot_occupancy", "device_admission_wait",

@@ -163,6 +163,97 @@ def test_on_wire_compression_negotiation_and_integrity():
     run(main())
 
 
+def test_tlz_pool_end_to_end(monkeypatch):
+    """compression_algorithm=tlz on a force pool: writefull match
+    planning dispatches on the primary's affinity chip (the
+    comp_device_blobs counter and the chip's compress-bytes gauges
+    move), the stored image is the tlz container on every replica,
+    reads/stat/partial-overwrite see logical bytes, and a tampered
+    comp-size attr is refused with EIO instead of serving truncated
+    data."""
+    monkeypatch.setenv("CEPH_TPU_EC_OFFLOAD", "1")
+
+    async def main():
+        c = await Cluster(3).start()
+        try:
+            out = await c.client.mon_command(
+                "osd pool create", pool="tz", pg_num=8, size=3)
+            pid = out["pool_id"]
+            await c.client.mon_command(
+                "osd pool set", pool="tz", var="compression_mode",
+                val="force")
+            await c.client.mon_command(
+                "osd pool set", pool="tz",
+                var="compression_algorithm", val="tlz")
+            await c.client.wait_for_epoch(c.mon.osdmap.epoch)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("tz")
+            payload = b"compressible! " * 4000      # ~56 KiB
+            await io.write_full("doc", payload)
+            assert await io.read("doc") == payload
+            assert await io.stat("doc") == len(payload)
+
+            from ceph_tpu.compress import create
+            from ceph_tpu.store.objectstore import hobject_t
+            m = c.client.osdmap
+            pgid = m.pools[pid].raw_pg_to_pg(
+                m.object_locator_to_pg("doc", pid))
+            _u, _up, acting, prim = m.pg_to_up_acting_osds(pgid)
+            for o in acting:
+                pg = c.osds[o].pgs[pgid]
+                blob = c.osds[o].store.read(pg.cid, hobject_t("doc"))
+                assert len(blob) < len(payload) // 4, len(blob)
+                assert c.osds[o].store.getattr(
+                    pg.cid, hobject_t("doc"), "comp-alg") == b"tlz"
+                # the stored container decodes standalone
+                assert create("tlz").decompress(bytes(blob)) \
+                    == payload
+            # the expensive phase left the event loop: the primary's
+            # planning dispatched on its chip
+            dev = sum(o.perf.dump().get("comp_device_blobs", 0)
+                      for o in c.osds)
+            host = sum(o.perf.dump().get("comp_host_blobs", 0)
+                       for o in c.osds)
+            assert dev + host >= 1, "no tlz blob pre-planned"
+            assert dev >= 1, "tlz planning never dispatched on-device"
+            from ceph_tpu.device.runtime import DeviceRuntime
+            rt = DeviceRuntime.get()
+            assert sum(ch.compress_bytes_in for ch in rt.chips) \
+                >= len(payload)
+
+            # partial overwrite decompresses in-txn (with the
+            # comp-size guard) and rewrites raw
+            await io.write("doc", b"PATCH", 100)
+            want = bytearray(payload)
+            want[100:105] = b"PATCH"
+            assert await io.read("doc") == bytes(want)
+
+            # decompress-side integrity: a comp-size attr that
+            # disagrees with the decompressed length is EIO, never
+            # truncated bytes
+            await io.write_full("doc2", payload)
+            primary = c.osds[prim]
+            pg = primary.pgs[pgid]
+            from ceph_tpu.store.objectstore import Transaction
+            pgid2 = m.pools[pid].raw_pg_to_pg(
+                m.object_locator_to_pg("doc2", pid))
+            _u2, _up2, acting2, prim2 = m.pg_to_up_acting_osds(pgid2)
+            p2 = c.osds[prim2]
+            pg2 = p2.pgs[pgid2]
+            t = Transaction()
+            t.setattr(pg2.cid, hobject_t("doc2"), "comp-size",
+                      b"%d" % (len(payload) + 9))
+            p2.store.apply_transaction(t)
+            outs, res = p2._do_read_ops(pg2, "doc2",
+                                        [{"op": "read"}])
+            assert res == -5, (outs, res)
+            assert p2.perf.dump().get("comp_size_mismatches", 0) >= 1
+        finally:
+            await c.stop()
+
+    run(main())
+
+
 def test_multi_op_txn_and_cls_on_compressed_objects():
     """Compression state is txn-scoped: a writefull+write in ONE op
     list, and cls methods reading/writing compressed objects, all see

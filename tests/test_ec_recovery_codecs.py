@@ -297,6 +297,48 @@ def test_digest_folds_repair_traffic():
         assert rep["jerasure"]["read"] == 300, rep
 
 
+def test_best_version_cost_planning_minimum_to_decode():
+    """Version selection is minimum_to_decode-costed, not
+    MDS-assumed: the newest decodable version still wins (recency is
+    correctness), but the decode stages exactly the minimal planned
+    shard set — and every candidate version's cost is recorded in
+    `last_version_plan` in sub-chunk units."""
+    from ceph_tpu.osd.ecbackend import ECPGBackend
+    be = ECPGBackend.__new__(ECPGBackend)
+    codec = _codec("shec", k=4, m=3, c=2, w=8)
+    n = codec.get_chunk_count()
+    k = codec.get_data_chunk_count()
+    data = b"version-plan " * 700
+    enc = codec.encode(set(range(n)), data)
+    old, new = (1, 5), (2, 9)
+    by_ver = {
+        old: {j: (enc[j], len(data)) for j in range(n)},
+        new: {j: (enc[j], len(data)) for j in (0, 1, 2, 4, 5)},
+    }
+    best = be._best_version(codec, k, by_ver)
+    assert best is not None
+    ver, use = best
+    assert ver == new, "newest decodable version must win"
+    assert use <= {0, 1, 2, 4, 5}
+    plan = be.last_version_plan
+    assert plan["version"] == new
+    assert set(plan["shards"]) == use
+    assert set(plan["candidates"]) == {old, new}
+    # the complete old version costs exactly its data set (want is
+    # fully present: no shingle fetch at all)
+    assert plan["candidates"][old]["cost_chunks"] == float(k)
+    # the winning plan is decodable from EXACTLY the planned set
+    out = codec.decode_concat({j: enc[j] for j in use})
+    assert out[:len(data)] == data
+    # a fully-present newest version decodes from its data shards
+    # alone — the gathered parity shards are never staged
+    by_ver2 = {new: {j: (enc[j], len(data)) for j in range(n)}}
+    ver2, use2 = be._best_version(codec, k, by_ver2)
+    assert ver2 == new
+    assert use2 == set(range(k))
+    assert be.last_version_plan["cost_chunks"] == float(k)
+
+
 # -- cluster e2e -----------------------------------------------------------
 
 
@@ -388,6 +430,13 @@ def test_lrc_cluster_write_kill_recover():
             assert "ceph_tpu_repair_bytes_moved_total" in text
             from ceph_tpu.utils.exporter import validate_exposition
             validate_exposition(text)
+            # ...and `status` renders the cross-codec repair-bytes
+            # panel beside device_util (the direction-3 follow-on)
+            st = await c.client.mon_command("status")
+            panel = st.get("repair_traffic") or {}
+            assert panel.get("lrc", {}).get("read", 0) > 0, st
+            assert set(panel["lrc"]) == {"read", "moved", "objects",
+                                         "targeted", "full"}
         finally:
             await c.stop()
 

@@ -1,0 +1,315 @@
+"""Device-native compression plane: the tlz codec end to end.
+
+Covers the direction-3 compression contract:
+
+* container roundtrip over the edge corpus — empty, sub-MIN_MATCH,
+  incompressible random, all-zero runs, exact-block-multiple and
+  boundary-straddling blobs;
+* device-vs-host BYTE-parity across seeded mixed-size corpora (the
+  blobs are pinned by digest so a format drift fails loudly);
+* greedy-plan determinism: the jitted kernel and the numpy reference
+  return identical (candidate, match-length) arrays;
+* compile budget: a mixed corpus stays within the <=8-program budget
+  (the lane-capped pow2 ladder compiles at most 4);
+* poison-mid-compress completes on the host reference with identical
+  bytes, poisons only the dispatching chip, and heals;
+* the chip-labeled `device_compress_bytes_in` /
+  `device_compress_bytes_out` series render through the exporter
+  (exposition-linted) and the trace registry lints clean in both
+  directions;
+* cluster thrash: the `poison_mid_compress` and `corrupt_compressed`
+  actions — zero lost acked writes, stored blobs decompress to the
+  original bytes, comp-size rot is refused at read time (EIO) and
+  repairs through the scrub plane.
+"""
+
+import asyncio
+import hashlib
+
+import numpy as np
+import pytest
+
+from ceph_tpu.compress import CompressorError, create
+from ceph_tpu.compress.tlz import (compress_async, compress_host,
+                                   decompress)
+from ceph_tpu.device.lzkernel import (MIN_MATCH, TLZ_BLOCK,
+                                      _stage_blocks, match_plan_host)
+from ceph_tpu.device.runtime import DeviceRuntime
+
+
+@pytest.fixture(autouse=True)
+def _offload(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_EC_OFFLOAD", "1")
+
+
+def run(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def _edge_corpus() -> dict[str, bytes]:
+    rng = np.random.default_rng(7)
+    text = b"the quick brown fox jumps over the lazy dog " * 300
+    return {
+        "empty": b"",
+        "one": b"x",
+        "sub_min_match": b"abc"[:MIN_MATCH - 1],
+        "tiny_repeat": b"ab" * 40,
+        "text": text,
+        "zeros": bytes(3 * TLZ_BLOCK + 17),
+        "random": rng.integers(0, 256, 2 * TLZ_BLOCK + 5,
+                               dtype=np.uint8).tobytes(),
+        "block_exact": (b"pattern!" * (TLZ_BLOCK // 8)),
+        "block_exact_x2": (b"pattern!" * (2 * TLZ_BLOCK // 8)),
+        "block_plus_one": (b"pattern!" * (TLZ_BLOCK // 8)) + b"Z",
+        "straddle": (b"0123456789abcdef" * 600)[:TLZ_BLOCK + 777],
+    }
+
+
+# -- container format ------------------------------------------------------
+
+
+def test_roundtrip_edge_corpus():
+    for name, data in _edge_corpus().items():
+        blob = compress_host(data)
+        assert decompress(blob) == data, name
+        # the registry plugin is the same function
+        c = create("tlz")
+        assert c.compress(data) == blob, name
+        assert c.decompress(blob) == data, name
+
+
+def test_compressible_blobs_shrink_and_random_stays_honest():
+    corp = _edge_corpus()
+    for name in ("text", "zeros", "block_exact", "straddle"):
+        blob = compress_host(corp[name])
+        assert len(blob) < len(corp[name]) // 2, (
+            name, len(blob), len(corp[name]))
+    # incompressible blocks ride the stored-raw escape: bounded
+    # overhead (header + 2 bytes per block), never unbounded blowup
+    rnd = corp["random"]
+    blob = compress_host(rnd)
+    assert len(blob) <= len(rnd) + 12 + 2 * (len(rnd) // TLZ_BLOCK
+                                             + 1)
+
+
+def test_corrupt_streams_raise_not_truncate():
+    data = _edge_corpus()["text"]
+    blob = compress_host(data)
+    with pytest.raises(CompressorError):
+        decompress(b"NOPE" + blob[4:])          # bad magic
+    with pytest.raises(CompressorError):
+        decompress(blob[:len(blob) // 2])       # truncated container
+    with pytest.raises(CompressorError):
+        decompress(blob + b"trailing")          # trailing garbage
+    with pytest.raises(CompressorError):
+        decompress(blob[:12])                   # header only
+    # a decoder must never return SHORT bytes for a corrupt stream:
+    # every failure above raised instead of returning data
+
+
+# -- device/host parity ----------------------------------------------------
+
+
+def test_plan_parity_device_vs_numpy():
+    """The jitted kernel returns the numpy reference's exact
+    (candidate, match-length) arrays for a mixed batch."""
+    from ceph_tpu.device.lzkernel import _kernel
+    rng = np.random.default_rng(11)
+    segs = [
+        bytes(TLZ_BLOCK),
+        rng.integers(0, 256, TLZ_BLOCK, dtype=np.uint8).tobytes(),
+        (b"lorem ipsum dolor " * 400)[:TLZ_BLOCK],
+        rng.integers(0, 4, TLZ_BLOCK, dtype=np.uint8).tobytes(),
+        b"tail-block-shorter-than-width" * 9,
+    ]
+    lanes = 8
+    stage, lens = _stage_blocks(segs, lanes)
+    want_c, want_m = match_plan_host(stage, lens)
+    import jax.numpy as jnp
+    got_c, got_m = _kernel(lanes, TLZ_BLOCK)(
+        jnp.asarray(stage), jnp.asarray(lens))
+    assert np.array_equal(np.asarray(got_c), want_c)
+    assert np.array_equal(np.asarray(got_m), want_m)
+
+
+# pinned digest of the seed-0 parity corpus's compressed blobs: a
+# format change (hash, block size, token layout, MAX_MATCH) must land
+# here consciously — stored data depends on the format being stable
+_CORPUS_SHA = "6b5a8a918a2b73648cdf56451168ba36e0e6ce3cd285582b0b595d576f27ab79"
+
+
+def _parity_corpus(seed: int) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(10):
+        size = int(rng.integers(1, 5 * TLZ_BLOCK))
+        kind = i % 3
+        if kind == 0:
+            unit = rng.integers(0x20, 0x7F, 16,
+                                dtype=np.uint8).tobytes()
+            out.append((unit * (size // 16 + 1))[:size])
+        elif kind == 1:
+            out.append(bytes(size))
+        else:
+            out.append(rng.integers(0, 256, size,
+                                    dtype=np.uint8).tobytes())
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_device_host_byte_parity_seeded(seed):
+    corpus = _parity_corpus(seed)
+
+    async def main():
+        DeviceRuntime.reset()
+        sha = hashlib.sha256()
+        for data in corpus:
+            dev, path = await compress_async(data)
+            host = compress_host(data)
+            assert dev == host, "parity at %d bytes" % len(data)
+            assert decompress(dev) == data
+            sha.update(dev)
+        return sha.hexdigest()
+
+    digest = run(main())
+    if seed == 0:
+        assert digest == _CORPUS_SHA, (
+            "tlz format drift: pinned corpus digest changed (%s)"
+            % digest)
+
+
+def test_compile_budget_mixed_corpus():
+    async def main():
+        rt = DeviceRuntime.reset()
+        for seed in (5, 6):
+            for data in _parity_corpus(seed):
+                blob, path = await compress_async(data)
+                assert path == "device"
+        assert rt.compile_count <= 8, rt.compile_count
+        kinds = {pk[0] for pk in rt.programs}
+        assert kinds == {"tlz"}, kinds
+
+    run(main())
+
+
+# -- degradation -----------------------------------------------------------
+
+
+def test_poison_mid_compress_completes_on_host():
+    data = _edge_corpus()["text"]
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        chip = rt.chips[0]
+        # clean device pass first (programs warm)
+        dev, path = await compress_async(data, chip=0)
+        assert path == "device"
+        chip.inject_fault(1)
+        got, path = await compress_async(data, chip=0)
+        # the mid-dispatch loss degraded THIS call to the host
+        # reference — same bytes, exactly one result — and poisoned
+        # only the dispatching chip
+        assert path == "host"
+        assert got == dev == compress_host(data)
+        assert chip.fallback, "dispatching chip not poisoned"
+        assert chip.fallback_count == 1
+        assert all(not c.fallback for c in rt.chips[1:])
+        # while poisoned, explicit-chip routing stays on host (the
+        # isolation contract: a poisoned chip is not borrowed around)
+        got2, path2 = await compress_async(data, chip=0)
+        assert path2 == "host" and got2 == dev
+        # faults drained -> the probe loop heals the chip
+        chip.clear_faults()
+        for _ in range(200):
+            if not chip.fallback:
+                break
+            await asyncio.sleep(0.02)
+        assert not chip.fallback, "chip never healed"
+
+    run(main())
+
+
+def test_offload_disabled_takes_host_path(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_COMPRESS_OFFLOAD", "0")
+    data = _edge_corpus()["straddle"]
+
+    async def main():
+        rt = DeviceRuntime.reset()
+        blob, path = await compress_async(data)
+        assert path == "host"
+        assert blob == compress_host(data)
+        assert rt.dispatches == 0
+
+    run(main())
+
+
+# -- telemetry -------------------------------------------------------------
+
+
+def test_exporter_series_and_registry_lint():
+    data = _edge_corpus()["text"]
+
+    async def main():
+        rt = DeviceRuntime.reset(chips=2)
+        blob, path = await compress_async(data, chip=1)
+        assert path == "device"
+        m = rt.chips[1].metrics()
+        assert m["device_compress_bytes_in"] == len(data)
+        assert m["device_compress_bytes_out"] == len(blob)
+        assert rt.chips[0].metrics()["device_compress_bytes_in"] == 0
+        text = "\n".join(rt.prom_lines()) + "\n"
+        assert 'ceph_tpu_device_compress_bytes_in{chip="1"}' in text
+        assert 'ceph_tpu_device_compress_bytes_out{chip="1"}' in text
+        from ceph_tpu.utils.exporter import validate_exposition
+        assert validate_exposition(text) == []
+
+    run(main())
+    # both directions of the drift lint: the new series must be
+    # registered AND still emitted/referenced everywhere
+    from ceph_tpu.trace import registry
+    assert registry.lint_repo() == []
+    assert "device_compress_bytes_in" in registry.DEVICE_SERIES
+    assert "device_compress_bytes_out" in registry.DEVICE_SERIES
+
+
+# -- cluster thrash --------------------------------------------------------
+
+
+def test_thrash_poison_and_corrupt_compressed():
+    """One cluster, both compression-plane thrash arms: a
+    poison-mid-compress round (chip loss mid-dispatch, zero lost
+    acked writes, blobs decompress to the originals, chip heals) and
+    a corrupt_compressed round (comp-size/blob rot is refused at read
+    time, detected exactly by deep scrub, repaired to clean)."""
+    from ceph_tpu.testing import ClusterThrasher, Workload
+    from ceph_tpu.testing.cluster import LocalCluster
+
+    async def main():
+        c = await LocalCluster(n_osds=3, n_mons=1, seed=2207,
+                               with_mgr=True).start()
+        try:
+            pid = await c.create_pool("tlzp", pg_num=4, size=3)
+            await c.client.mon_command(
+                "osd pool set", pool="tlzp", var="compression_mode",
+                val="force")
+            await c.client.mon_command(
+                "osd pool set", pool="tlzp",
+                var="compression_algorithm", val="tlz")
+            leader = c.leader()
+            await c.client.wait_for_epoch(leader.osdmap.epoch)
+            await c.wait_health(pid)
+            wl = Workload(c.client.io_ctx("tlzp"), seed=9,
+                          prefix="tlzw").start()
+            try:
+                th = ClusterThrasher(
+                    c, seed=2207,
+                    actions=["poison_mid_compress",
+                             "corrupt_compressed"])
+                await th.run([pid], wl)
+            finally:
+                await wl.stop()
+            await wl.verify()
+        finally:
+            await c.stop()
+
+    run(main(), timeout=420)
