@@ -1694,6 +1694,360 @@ def _publish_compress(rec: dict) -> None:
         rec["publish_error"] = repr(e)[:200]
 
 
+def _dedup_corpus(rng, n_objs: int) -> list:
+    """Seeded redundant corpus for the data-reduction legs: a small
+    vocabulary of multi-chunk payloads, each written verbatim by
+    several objects.  Identical content chunks identically (the
+    boundaries are content-defined), so the achievable dedup ratio is
+    ~n_objs/len(vocab) by construction — well above the 2x gate."""
+    from ceph_tpu.dedup import CHUNK_AVG
+    vocab = []
+    for _ in range(4):
+        n = int(rng.integers(3, 6))
+        vocab.append(rng.integers(0, 256, n * CHUNK_AVG,
+                                  dtype=np.uint8).tobytes())
+    return [vocab[i % len(vocab)] for i in range(n_objs)]
+
+
+def bench_dedup(n_objs: int = 12, seed: int = 47,
+                rounds: int = 5) -> dict:
+    """--dedup mode: the data-reduction plane's two legs.
+
+    (1) kernel: the content-defined boundary kernel and the batched
+    chunk fingerprints on-device vs the numpy/zlib references —
+    cut offsets and addresses must be bit-identical, the compile
+    budget is <= 8 programs, and the chip's fingerprint gauges
+    ("device_fingerprint_chunks" / "device_fingerprint_bytes") must
+    account the dispatched work.  Device vs host throughput is
+    reported; the verdict defers to a real accelerator on CPU CI.
+
+    (2) cluster: a LocalCluster dedup pool pair fed the seeded
+    redundant corpus — the measured dedup ratio (logical bytes over
+    unique chunk bytes + manifests actually in the stores) must
+    reach 2x, the plane's own bytes-stored/bytes-saved ledger must
+    match the chunk store's real usage, the telemetry pipeline
+    (osd_stats -> mgr digest dedup_pools -> mon status) must carry
+    the counters, and a thrashed round (chunk-index rot on a replica
+    majority + mid-chunk chip poison) must end deep-scrub-clean with
+    zero lost acked writes.
+
+    Published into BASELINE.json's `dedup_plane` behind the gate."""
+    import asyncio
+    import os
+    import zlib
+
+    os.environ.setdefault("CEPH_TPU_EC_OFFLOAD", "1")
+
+    async def kernel_leg() -> dict:
+        import jax
+
+        from ceph_tpu.dedup import (CHUNK_MAX, CHUNK_MIN,
+                                    boundary_batch, chunk_host,
+                                    fingerprint, fingerprint_batch,
+                                    split)
+        from ceph_tpu.device.runtime import DeviceRuntime
+
+        rt = DeviceRuntime.reset()
+        chip = rt.chips[0]
+        rng = np.random.default_rng(seed)
+        blobs = _dedup_corpus(rng, n_objs)
+        # warm (compiles) + parity oracles: device cuts and
+        # fingerprints vs the host references, bit-identical
+        cuts_dev, cut_path = await boundary_batch(blobs, chip=0)
+        cuts_host = [chunk_host(b) for b in blobs]
+        chunks = [ch for b, cuts in zip(blobs, cuts_dev)
+                  for ch in split(b, cuts)]
+        sizes_ok = all(
+            CHUNK_MIN <= len(ch) <= CHUNK_MAX
+            for b, cuts in zip(blobs, cuts_dev)
+            for ch in split(b, cuts)[:-1]) and all(
+            len(ch) <= CHUNK_MAX for ch in chunks)
+        fps_dev, fp_path = await fingerprint_batch(chunks, chip=0)
+        fps_host = [fingerprint(zlib.crc32(ch), len(ch))
+                    for ch in chunks]
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            await boundary_batch(blobs, chip=0)
+            await fingerprint_batch(chunks, chip=0)
+        dev_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for b in blobs:
+                chunk_host(b)
+            for ch in chunks:
+                zlib.crc32(ch)
+        host_wall = time.perf_counter() - t0
+        payload = sum(len(b) for b in blobs) * rounds
+        metrics = chip.metrics()
+        return {
+            "backend": jax.default_backend(),
+            "corpus_bytes": sum(len(b) for b in blobs),
+            "n_chunks": len(chunks),
+            "cuts_parity_ok": bool(cuts_dev == cuts_host),
+            "fingerprint_parity_ok": bool(fps_dev == fps_host),
+            "chunk_sizes_ok": bool(sizes_ok),
+            "boundary_path": cut_path,
+            "fingerprint_path": fp_path,
+            "device_mibps": round(payload / dev_wall / (1 << 20), 1),
+            "host_mibps": round(payload / host_wall / (1 << 20), 1),
+            "compile_count": rt.compile_count,
+            "host_fallbacks": rt.host_fallbacks,
+            "device_fingerprint_chunks":
+                metrics["device_fingerprint_chunks"],
+            "device_fingerprint_bytes":
+                metrics["device_fingerprint_bytes"],
+        }
+
+    async def cluster_leg() -> dict:
+        from ceph_tpu.dedup import parse_chunk_oid
+        from ceph_tpu.testing import ClusterThrasher, LocalCluster
+        from ceph_tpu.utils.backoff import wait_for
+
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            pid = await c.create_pool("dedupbench", pg_num=8, size=3)
+            cpid = await c.create_pool("dedupbench-chunks", pg_num=8,
+                                       size=3)
+            await c.client.mon_command(
+                "osd pool set", pool="dedupbench",
+                var="dedup_chunk_pool", val="dedupbench-chunks")
+            await wait_for(
+                lambda: getattr(c.client.osdmap.pools.get(pid),
+                                "dedup_chunk_pool", -1) == cpid,
+                30.0, what="dedup binding visible on the client")
+            await wait_for(
+                lambda: all(
+                    o.osdmap is not None
+                    and o.osdmap.pools.get(pid) is not None
+                    and getattr(o.osdmap.pools[pid],
+                                "dedup_chunk_pool", -1) == cpid
+                    for o in c.live_osds),
+                30.0, what="dedup binding visible on every OSD")
+            await c.wait_health(pid, timeout=120.0)
+            await c.wait_health(cpid, timeout=120.0)
+            io = c.client.io_ctx("dedupbench")
+            rng = np.random.default_rng(seed + 1)
+            blobs = _dedup_corpus(rng, n_objs)
+            logical = sum(len(b) for b in blobs)
+            t0 = time.perf_counter()
+            for i, b in enumerate(blobs):
+                await asyncio.wait_for(
+                    io.write_full("db-%d" % i, b), 30.0)
+            write_wall = time.perf_counter() - t0
+            readback_ok = True
+            for i, b in enumerate(blobs):
+                got = await asyncio.wait_for(io.read("db-%d" % i),
+                                             30.0)
+                readback_ok = readback_ok and got == b
+            # physical usage straight from the primaries' stores:
+            # unique chunk bytes + the manifest blobs the base keeps
+            chunk_bytes = chunks_in_store = manifest_bytes = 0
+            for o in c.live_osds:
+                for pg in o.pgs.values():
+                    if not pg.is_primary():
+                        continue
+                    for h in o.store.collection_list(pg.cid):
+                        if (pg.pool_id == cpid
+                                and parse_chunk_oid(h.name)
+                                is not None):
+                            chunk_bytes += len(
+                                o.store.read(pg.cid, h))
+                            chunks_in_store += 1
+                        elif (pg.pool_id == pid
+                                and h.name.startswith("db-")):
+                            manifest_bytes += len(
+                                o.store.read(pg.cid, h))
+            physical = chunk_bytes + manifest_bytes
+            ratio = round(logical / physical, 2) if physical else 0.0
+            # the plane's own ledger, summed across the primaries
+            # that planned the writes, vs the stores' reality
+            ledger = {"chunks_stored": 0, "chunks_deduped": 0,
+                      "bytes_stored": 0, "bytes_saved": 0}
+            for o in c.live_osds:
+                row = o.dedup.stats_row().get(str(pid)) or {}
+                for k in ledger:
+                    ledger[k] += int(row.get(k, 0))
+            accounting_ok = (
+                ledger["bytes_stored"] == chunk_bytes
+                and ledger["chunks_stored"] == chunks_in_store
+                and ledger["bytes_stored"] + ledger["bytes_saved"]
+                == logical)
+            # telemetry end to end: the counters must ride
+            # osd_stats -> mgr digest dedup_pools -> mon status
+            await c.wait_stats(
+                lambda d: int((((d or {}).get("dedup_pools") or {})
+                               .get(str(pid)) or {})
+                              .get("chunks_stored", 0))
+                == ledger["chunks_stored"],
+                60.0, what="dedup counters in the mgr digest")
+            st = await c.client.mon_command("status")
+            status_dedup = st.get("dedup")
+            # thrashed round: chunk-index rot outvoting repair +
+            # mid-chunk chip poison, each with its own oracles
+            th = ClusterThrasher(c, seed=seed, actions=[])
+            await th._corrupt_dedup_index_round(c, seed)
+            await th._poison_mid_chunk_round(c, seed)
+            sb = await c.scrub_pool(pid, deep=True, recheck=True)
+            sc = await c.scrub_pool(cpid, deep=True, recheck=True)
+            scrub_clean = (sb["errors"] == 0 and sc["errors"] == 0
+                           and not sb["inconsistent"]
+                           and not sc["inconsistent"])
+            lost = 0
+            for i, b in enumerate(blobs):
+                got = await asyncio.wait_for(io.read("db-%d" % i),
+                                             30.0)
+                if got != b:
+                    lost += 1
+            return {
+                "n_objs": n_objs,
+                "logical_bytes": logical,
+                "chunk_store_bytes": chunk_bytes,
+                "manifest_bytes": manifest_bytes,
+                "chunks_in_store": chunks_in_store,
+                "dedup_ratio": ratio,
+                "ledger": ledger,
+                "accounting_ok": bool(accounting_ok),
+                "readback_ok": bool(readback_ok),
+                "status_dedup_panel": status_dedup,
+                "write_mibps": round(
+                    logical / write_wall / (1 << 20), 1),
+                "scrub_clean": bool(scrub_clean),
+                "lost_acked_writes": lost,
+            }
+        finally:
+            await c.stop()
+
+    async def run() -> dict:
+        rec = {"metric": "dedup_plane"}
+        rec["kernel"] = await kernel_leg()
+        rec["backend"] = rec["kernel"]["backend"]
+        rec["cluster"] = await cluster_leg()
+        return rec
+
+    return asyncio.run(asyncio.wait_for(run(), 600))
+
+
+def _gate_dedup(rec: dict) -> dict:
+    """The data-reduction gate: device/host cut and fingerprint
+    parity, the compile budget, live fingerprint gauges, a >= 2x
+    dedup ratio whose ledger matches the chunk store's real usage,
+    and a thrashed round that ends deep-scrub-clean with zero lost
+    acked writes are hard failures anywhere.  The device-vs-host
+    throughput verdict defers to the standing real-TPU run on CPU
+    CI, like the compression and continuous-dispatch gates.  A
+    published same-backend device throughput gates regressions
+    (< 0.8x)."""
+    import os
+    failures = []
+    k = rec.get("kernel") or {}
+    cl = rec.get("cluster") or {}
+    if not k.get("cuts_parity_ok"):
+        failures.append("device boundary cuts diverged from the"
+                        " host reference")
+    if not k.get("fingerprint_parity_ok"):
+        failures.append("device fingerprints diverged from the host"
+                        " reference")
+    if not k.get("chunk_sizes_ok"):
+        failures.append("chunk sizes escaped [CHUNK_MIN, CHUNK_MAX]")
+    if k.get("boundary_path") != "device":
+        failures.append("boundary kernel did not take the device"
+                        " path")
+    if k.get("fingerprint_path") != "device":
+        failures.append("fingerprints did not take the device path")
+    if k.get("compile_count", 99) > 8:
+        failures.append("dedup leg compiled %d > 8 programs"
+                        % k.get("compile_count"))
+    if k.get("host_fallbacks"):
+        failures.append("dedup kernel leg fell back to host")
+    if not k.get("device_fingerprint_chunks"):
+        failures.append("chip accounted no device_fingerprint_chunks")
+    if cl.get("dedup_ratio", 0.0) < 2.0:
+        failures.append("dedup ratio %.2f below the 2x gate on the"
+                        " seeded redundant corpus"
+                        % cl.get("dedup_ratio", 0.0))
+    if not cl.get("accounting_ok"):
+        failures.append("dedup ledger does not match the chunk"
+                        " store's real usage")
+    if not cl.get("readback_ok"):
+        failures.append("corpus did not read back after dedup")
+    if not cl.get("status_dedup_panel"):
+        failures.append("mon status carried no dedup panel")
+    if not cl.get("scrub_clean"):
+        failures.append("thrashed round did not end deep-scrub-clean")
+    if cl.get("lost_acked_writes", 99):
+        failures.append("%r acked writes lost through the thrashed"
+                        " round" % cl.get("lost_acked_writes"))
+    deferred = False
+    beats = k.get("device_mibps", 0.0) >= k.get("host_mibps", 1e9)
+    if not beats:
+        if rec.get("backend") == "tpu":
+            failures.append(
+                "device chunking %.1f MiB/s did not reach the host"
+                " reference %.1f MiB/s on TPU"
+                % (k.get("device_mibps", 0.0),
+                   k.get("host_mibps", 0.0)))
+        else:
+            deferred = True     # CPU CI cannot decide: real-TPU run
+    published = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            published = (json.load(f).get("published") or {}).get(
+                "dedup_plane") or {}
+    except Exception:
+        published = {}
+    prev = published.get("device_mibps")
+    if (prev and published.get("backend") == rec.get("backend")
+            and k.get("device_mibps", 0.0) < 0.8 * float(prev)):
+        failures.append(
+            "device chunking %.1f MiB/s regressed below 0.8x the"
+            " published %.1f MiB/s"
+            % (k.get("device_mibps", 0.0), float(prev)))
+    return {"ok": not failures, "failures": failures,
+            "deferred": deferred, "beats_host": beats}
+
+
+def _publish_dedup(rec: dict) -> None:
+    """Fold the data-reduction figures into BASELINE.json's
+    published map.  A failed gate publishes nothing."""
+    import os
+    if not rec.get("gate", {}).get("ok"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        k = rec.get("kernel") or {}
+        cl = rec.get("cluster") or {}
+        doc.setdefault("published", {})["dedup_plane"] = {
+            "backend": rec.get("backend"),
+            "unit": "MiB/s of raw corpus chunked+fingerprinted",
+            "beats_host": rec["gate"].get("beats_host"),
+            "deferred_to_tpu": rec["gate"].get("deferred"),
+            "device_mibps": k.get("device_mibps"),
+            "host_mibps": k.get("host_mibps"),
+            "compile_count": k.get("compile_count"),
+            "corpus_bytes": k.get("corpus_bytes"),
+            "device_fingerprint_chunks":
+                k.get("device_fingerprint_chunks"),
+            "device_fingerprint_bytes":
+                k.get("device_fingerprint_bytes"),
+            "dedup_ratio": cl.get("dedup_ratio"),
+            "logical_bytes": cl.get("logical_bytes"),
+            "chunk_store_bytes": cl.get("chunk_store_bytes"),
+            "bytes_saved": (cl.get("ledger") or {}).get(
+                "bytes_saved"),
+            "source": "bench.py --dedup",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+
+
 def bench_continuous_dispatch(ops_per_tenant: int = 96,
                               n_tenants: int = 4) -> dict:
     """--device `continuous_dispatch` leg: the direction-1 mixed
@@ -2691,6 +3045,18 @@ def main() -> None:
         rec = bench_device_compress()
         rec["gate"] = _gate_device_compress(rec)
         _publish_compress(rec)
+        print(json.dumps(rec))
+        if not rec["gate"]["ok"]:
+            sys.exit(1)
+        return
+    if "--dedup" in sys.argv:
+        # the data-reduction plane: chunking/fingerprint kernel
+        # parity + the cluster dedup-ratio/accounting/thrash gate,
+        # merged into BASELINE.json's dedup_plane section
+        _maybe_simulate_mesh()
+        rec = bench_dedup()
+        rec["gate"] = _gate_dedup(rec)
+        _publish_dedup(rec)
         print(json.dumps(rec))
         if not rec["gate"]["ok"]:
             sys.exit(1)

@@ -1,0 +1,45 @@
+"""Data-reduction plane: content-defined chunking, batched chunk
+fingerprints, and a refcounted chunk store.
+
+A base pool opts in with ``osd pool set <base> dedup_chunk_pool
+<chunks>`` (both plain replicated; the mon validates).  Full-object
+writes on the base pool are chunked by a rolling-hash boundary kernel
+(one background-class device dispatch per write batch — `chunker`),
+each chunk fingerprinted through the digest plane's CRC lanes, and
+stored at most once in the chunk pool as a content-addressed object
+(``chunk.<crc32>-<size>``) whose refcount rides `osd.cls.refcount`
+(get = ref-or-create returning the committed size; last put
+self-deletes).  The base object keeps only a manifest — the ordered
+``[fingerprint, size]`` rows — plus two xattrs:
+
+* ``OBJ_MANIFEST_ATTR``: present (``b"1"``) iff the object's data is
+  a manifest blob, not raw bytes;
+* ``OBJ_LOGICAL_ATTR``: the logical (pre-dedup) size, so ``stat``
+  answers without materializing.
+
+Degradation is data-safety-first: any failure to reach the chunk
+store (chunk pool degraded, internal op timeouts) stores the object
+RAW — an acked write never depends on dedup machinery having worked.
+Snapshots and dedup do not compose (a clone would share chunks
+without holding refs), so writes carrying a snap context — or
+touching a pool with snapshots — store raw, and a manifested object
+is materialized back to raw before its first snapped mutation.
+"""
+
+from .chunker import (CHUNK_AVG, CHUNK_MAX, CHUNK_MIN,
+                      CHUNK_OID_PREFIX, boundary_batch,
+                      candidate_mask_host, chunk_host, chunk_oid,
+                      device_dedup_enabled, fingerprint,
+                      fingerprint_batch, parse_chunk_oid,
+                      resolve_cuts, split)
+from .plane import (OBJ_LOGICAL_ATTR, OBJ_MANIFEST_ATTR, DedupPlane,
+                    InternalObjecter, ObjecterError)
+
+__all__ = [
+    "CHUNK_AVG", "CHUNK_MAX", "CHUNK_MIN", "CHUNK_OID_PREFIX",
+    "DedupPlane", "InternalObjecter", "ObjecterError",
+    "OBJ_LOGICAL_ATTR", "OBJ_MANIFEST_ATTR",
+    "boundary_batch", "candidate_mask_host", "chunk_host",
+    "chunk_oid", "device_dedup_enabled", "fingerprint",
+    "fingerprint_batch", "parse_chunk_oid", "resolve_cuts", "split",
+]

@@ -332,6 +332,12 @@ class ChipRuntime:
         # burning host CPU here
         self.compress_bytes_in = 0
         self.compress_bytes_out = 0
+        # dedup-plane accounting: chunks and bytes whose content
+        # fingerprints digested on this chip's CRC lanes — the
+        # observable that says dedup fingerprinting stopped burning
+        # host CPU here
+        self.fingerprint_chunks = 0
+        self.fingerprint_bytes = 0
         # dispatch telemetry
         self.tickets: list[DispatchTicket] = []     # bounded ring
         self.dispatch_buckets_us = [0] * _HIST_BUCKETS
@@ -436,6 +442,13 @@ class ChipRuntime:
         leg and the thrasher's poison oracle read."""
         self.compress_bytes_in += max(0, int(bytes_in))
         self.compress_bytes_out += max(0, int(bytes_out))
+
+    def note_fingerprint(self, chunks: int, nbytes: int) -> None:
+        """Account one device-fingerprinted chunk batch on this chip.
+        Exported as the chip-labeled device_fingerprint_chunks/_bytes
+        series the dedup bench leg and `--dedup` gate read."""
+        self.fingerprint_chunks += max(0, int(chunks))
+        self.fingerprint_bytes += max(0, int(nbytes))
 
     # -- tickets -----------------------------------------------------------
 
@@ -668,6 +681,10 @@ class ChipRuntime:
             # vs emitted container bytes (ratio = in/out)
             "device_compress_bytes_in": self.compress_bytes_in,
             "device_compress_bytes_out": self.compress_bytes_out,
+            # dedup plane: chunks / bytes content-fingerprinted on
+            # this chip's CRC lanes
+            "device_fingerprint_chunks": self.fingerprint_chunks,
+            "device_fingerprint_bytes": self.fingerprint_bytes,
         }
 
 

@@ -670,6 +670,10 @@ class PGMap:
         # counters, the digest sums across the live fleet (the
         # repair-bytes comparison oracle's committed surface)
         repair_traffic: dict[str, dict] = {}
+        # per-pool dedup totals: each primary reports its cumulative
+        # data-reduction counters, the digest sums across the fleet
+        # (the `status` dedup panel + bench --dedup oracle surface)
+        dedup_pools: dict[str, dict] = {}
         for d, row in self.live_osd_stats(now).items():
             sf = row.get("statfs")
             if sf:
@@ -690,6 +694,13 @@ class PGMap:
                                  "full": 0})
                 for kk in agg:
                     agg[kk] += int(rrow.get(kk, 0) or 0)
+            for pid, drow in (row.get("dedup") or {}).items():
+                agg = dedup_pools.setdefault(
+                    str(pid), {"chunks_stored": 0,
+                               "chunks_deduped": 0,
+                               "bytes_stored": 0, "bytes_saved": 0})
+                for kk in agg:
+                    agg[kk] += int(drow.get(kk, 0) or 0)
         return {
             "num_pgs": sum(r["num_pgs"] for r in per_pool.values()),
             "pg_states": states,
@@ -709,6 +720,9 @@ class PGMap:
             # codec -> summed recovery traffic counters (what the
             # locality-aware codecs measurably save)
             "repair_traffic": repair_traffic,
+            # pool -> summed dedup counters (what the data-reduction
+            # plane measurably saves)
+            "dedup_pools": dedup_pools,
             # per-daemon report freshness + prune visibility (the
             # `status` max-age/stale-count line)
             "reports": self.report_freshness(now),

@@ -1350,6 +1350,24 @@ class Monitor:
                         "full": int(row.get("full") or 0),
                     }
                     for codec, row in sorted(rt.items())}
+            # data-reduction panel: the digest's per-pool dedup
+            # totals (chunks stored vs deduped, logical bytes saved)
+            # rendered beside repair_traffic — the dedup win is a
+            # `status` line, not a bench-only figure
+            dd = dig.get("dedup_pools") or {}
+            if dd:
+                out["dedup"] = {
+                    str(pid): {
+                        "chunks_stored": int(
+                            row.get("chunks_stored") or 0),
+                        "chunks_deduped": int(
+                            row.get("chunks_deduped") or 0),
+                        "bytes_stored": int(
+                            row.get("bytes_stored") or 0),
+                        "bytes_saved": int(
+                            row.get("bytes_saved") or 0),
+                    }
+                    for pid, row in sorted(dd.items())}
         return out
 
     def _pool_digest_rows(self) -> list[dict]:
@@ -1597,6 +1615,30 @@ class Monitor:
                 raise ValueError("no compressor %r (have %s)"
                                  % (val, available()))
             pool.compression_algorithm = val
+        elif key == "dedup_chunk_pool":
+            if val in ("", "none", "-1", -1):
+                pool.dedup_chunk_pool = -1
+            else:
+                cid = self._pool_id(str(val))
+                chunk = self.osdmap.pools[cid]
+                # the chunk store must be a plain replicated pool:
+                # content-addressed chunk bytes under compression or
+                # EC stripes would break the scrub's fingerprint
+                # verification, and a dedup'd chunk pool would recurse
+                if cid == pid:
+                    raise ValueError("pool cannot dedup into itself")
+                if pool.is_erasure() \
+                        or pool.compression_mode != "none":
+                    raise ValueError(
+                        "dedup requires a plain replicated base pool"
+                        " (no EC, compression off)")
+                if chunk.is_erasure() \
+                        or chunk.compression_mode != "none" \
+                        or chunk.dedup_chunk_pool >= 0:
+                    raise ValueError(
+                        "chunk pool must be plain replicated"
+                        " (no EC/compression/dedup)")
+                pool.dedup_chunk_pool = cid
         else:
             raise ValueError("cannot set %r" % key)
         pool.last_change = self.osdmap.epoch + 1

@@ -152,6 +152,38 @@ class OSD:
         self.perf.add_u64("comp_size_mismatches",
                           "reads refused because comp-size disagreed"
                           " with the decompressed length")
+        # data-reduction plane: dedup-pool ops paced through the
+        # background class, how the chunk/fingerprint kernels ran
+        # (device lanes vs host fallback), and what the chunk store
+        # absorbed vs deduplicated
+        self.perf.add_u64("dedup_paced_ops",
+                          "dedup-pool ops paced through the"
+                          " background device class")
+        self.perf.add_u64("dedup_chunk_device",
+                          "write batches whose chunk boundaries"
+                          " resolved from device candidate masks")
+        self.perf.add_u64("dedup_chunk_host",
+                          "write batches chunked by the host"
+                          " reference (degraded path)")
+        self.perf.add_u64("dedup_fp_device",
+                          "write batches fingerprinted in device"
+                          " crc32 lanes")
+        self.perf.add_u64("dedup_fp_host",
+                          "write batches fingerprinted by the host"
+                          " fallback loop")
+        self.perf.add_u64("dedup_chunks_stored",
+                          "chunks this osd stored as new chunk-pool"
+                          " objects")
+        self.perf.add_u64("dedup_chunks_deduped",
+                          "chunks answered by an existing chunk-pool"
+                          " object (a ref, no bytes)")
+        self.perf.add_u64("dedup_bytes_saved",
+                          "logical bytes deduplicated away (refs"
+                          " instead of stored copies)")
+        # the primary's side of the data-reduction plane (chunking,
+        # fingerprints, refcounted chunk store, internal objecter)
+        from ..dedup import DedupPlane
+        self.dedup = DedupPlane(self)
         # repair-traffic plane: what recovery actually moved, split
         # by whether the minimal-shard-set (targeted) repair served
         # it or the whole-object read + re-encode fallback did
@@ -554,6 +586,10 @@ class OSD:
               tenant=getattr(msg, "tenant", None))
         elif isinstance(msg, MOSDRepOpReply):
             self._handle_repop_reply(msg)
+        elif isinstance(msg, MOSDOpReply):
+            # reply to one of OUR internal ops (the dedup plane's
+            # objecter acting as a chunk-pool client): route by tid
+            return self.dedup.objecter.on_reply(msg)
         elif isinstance(msg, MOSDPGQuery):
             self._handle_pg_query(conn, msg)
         elif isinstance(msg, MOSDPGLog):
@@ -1773,6 +1809,14 @@ class OSD:
             self.msgr.spawn(
                 self._compression_paced(pg, conn, msg, writes))
             return
+        if getattr(pool, "dedup_chunk_pool", -1) >= 0 \
+                and not pool.is_erasure():
+            # dedup base pools: chunk/fingerprint planning plus the
+            # chunk-store I/O are async (internal objecter) and ride
+            # the same background admission class as compression
+            self.msgr.spawn(
+                self.dedup.handle_op(pg, conn, msg, writes))
+            return
         if writes:
             self._execute_write(pg, conn, msg)
         else:
@@ -2051,7 +2095,15 @@ class OSD:
 
     def _stat_decompressed(self, pg: PG, ho) -> int:
         from ..compress import OBJ_SIZE_ATTR
+        from ..dedup import OBJ_LOGICAL_ATTR
 
+        try:
+            # a manifested object's stored size is its manifest blob;
+            # stat answers the logical (pre-dedup) size
+            return int(self.store.getattr(pg.cid, ho,
+                                          OBJ_LOGICAL_ATTR))
+        except (NotFound, ValueError):
+            pass
         try:
             return int(self.store.getattr(pg.cid, ho, OBJ_SIZE_ATTR))
         except NotFound:
@@ -2135,14 +2187,19 @@ class OSD:
         return outs, result
 
     def _execute_write(self, pg: PG, conn, msg: MOSDOp,
-                       comp_pre: dict[int, bytes] | None = None
-                       ) -> None:
+                       comp_pre: dict[int, bytes] | None = None,
+                       dedup_pre: dict | None = None) -> None:
         """prepare_transaction + issue_repop (PrimaryLogPG.cc:8869,
         11394).  Snapshot bookkeeping (make_writeable) runs first so
         the clone ops ride the same replicated transaction.
         ``comp_pre`` maps op-list indices to device-planned
         compression blobs `_compression_paced` staged for writefull
-        ops (byte-identical to the sync compressor's output)."""
+        ops (byte-identical to the sync compressor's output).
+        ``dedup_pre`` is the dedup plane's plan: ``manifest`` maps
+        writefull op indices to a pre-built (manifest blob, logical
+        size) — or None for an explicit raw store — and
+        ``materialize`` carries the raw image of a manifested object
+        about to be mutated in place."""
         from . import snaps as snapmod
         self._op_event(msg, "started_write")
         epoch = self.osdmap.epoch
@@ -2156,6 +2213,21 @@ class OSD:
         head_whiteout = snapmod.is_whiteout(self.store, pg.cid, ho)
         is_delete = False
         cstate: dict = {}   # per-txn staged compression state
+        dmap = (dedup_pre or {}).get("manifest") or {}
+        if dedup_pre and dedup_pre.get("materialize") is not None:
+            from ..dedup import OBJ_LOGICAL_ATTR, OBJ_MANIFEST_ATTR
+            raw0 = dedup_pre["materialize"]
+            # a manifested object mutated in place: stage the
+            # materialized raw image (and drop the manifest attrs)
+            # ahead of the op list, so offset math sees logical bytes
+            if self.store.exists(pg.cid, ho):
+                t.truncate(pg.cid, ho, 0)
+            else:
+                t.touch(pg.cid, ho)
+            t.write(pg.cid, ho, 0, len(raw0), raw0)
+            t.rmattr(pg.cid, ho, OBJ_MANIFEST_ATTR)
+            t.rmattr(pg.cid, ho, OBJ_LOGICAL_ATTR)
+            cstate[ho] = (None, raw0)
         from ..compress import CompressorError
         for op_i, op in enumerate(msg.ops):
             name = op["op"]
@@ -2184,6 +2256,29 @@ class OSD:
                                   b"0")
                 else:
                     t.touch(pg.cid, ho)
+                if op_i in dmap:
+                    # dedup-planned writefull: store the manifest
+                    # blob (or an explicit raw image when planning
+                    # degraded) with the dedup attrs kept in step —
+                    # dedup base pools are compression-free by mon
+                    # validation, so the compression path is skipped
+                    from ..dedup import (OBJ_LOGICAL_ATTR,
+                                         OBJ_MANIFEST_ATTR)
+                    ent = dmap[op_i]
+                    if ent is not None:
+                        blob, logical = ent
+                        t.write(pg.cid, ho, 0, len(blob), blob)
+                        t.setattr(pg.cid, ho, OBJ_MANIFEST_ATTR,
+                                  b"1")
+                        t.setattr(pg.cid, ho, OBJ_LOGICAL_ATTR,
+                                  b"%d" % logical)
+                    else:
+                        t.write(pg.cid, ho, 0, len(data), data)
+                        t.rmattr(pg.cid, ho, OBJ_MANIFEST_ATTR)
+                        t.rmattr(pg.cid, ho, OBJ_LOGICAL_ATTR)
+                    cstate[ho] = (None, data)
+                    outs.append({})
+                    continue
                 pool0 = self.osdmap.pools.get(pg.pool_id)
                 try:
                     stored = self._maybe_compress(
@@ -2831,6 +2926,11 @@ class OSD:
                        # exporter families
                        "repair": {c: dict(r) for c, r in
                                   self.ec.repair_traffic.items()},
+                       # data-reduction plane: per-base-pool dedup
+                       # counters — folded into the digest's
+                       # dedup_pools section + pool-labeled exporter
+                       # families
+                       "dedup": self.dedup.stats_row(),
                        # tenant SLO plane: cumulative per-tenant
                        # stage histograms + good/bad op counters —
                        # the mgr SLO engine's burn-rate input

@@ -95,6 +95,10 @@ class PGPool:
     # the BlueStore blob-compression role): mode "none" | "force"
     compression_mode: str = "none"
     compression_algorithm: str = "zlib"
+    # data-reduction plane (pg_pool_t dedup_chunk_pool): writes to
+    # this pool chunk/fingerprint/dedup into the named chunk pool;
+    # -1 disables
+    dedup_chunk_pool: int = -1
 
     def __post_init__(self):
         if not self.pgp_num:
@@ -158,6 +162,7 @@ class PGPool:
             "removed_snaps": list(self.removed_snaps),
             "compression_mode": self.compression_mode,
             "compression_algorithm": self.compression_algorithm,
+            "dedup_chunk_pool": self.dedup_chunk_pool,
         }
 
     @classmethod
@@ -174,6 +179,7 @@ class PGPool:
         d.setdefault("removed_snaps", [])
         d.setdefault("compression_mode", "none")
         d.setdefault("compression_algorithm", "zlib")
+        d.setdefault("dedup_chunk_pool", -1)
         return cls(**d)
 
 
@@ -555,7 +561,8 @@ class OSDMap:
     #   1 — round-4 layout
     #   2 — +osd_up_thru, +pool compression fields (additive: compat
     #       stays 1, old decoders read their known keys)
-    STRUCT_V = 2
+    #   3 — +pool dedup_chunk_pool (additive, compat stays 1)
+    STRUCT_V = 3
     STRUCT_COMPAT = 1
 
     def encode(self) -> bytes:

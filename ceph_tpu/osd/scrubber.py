@@ -489,11 +489,35 @@ class Scrubber:
                 digests.setdefault(
                     (r["size"], r["digest"], r["attrs_digest"]),
                     []).append(o)
-            if len(digests) == 1 and len(present) == len(live):
+            # content-addressed chunk objects (the dedup chunk
+            # store) carry their truth in the oid — crc32 and size.
+            # Candidate auth copies must MATCH the address, so a
+            # majority of rotted replicas can never outvote one
+            # healthy copy, and unanimous rot is still detected
+            from ..dedup import parse_chunk_oid
+            named = parse_chunk_oid(oid)
+            keys = list(digests)
+            if named is not None:
+                good = [k for k in keys
+                        if k[1] == named[0] and k[0] == named[1]]
+                if not good:
+                    # every copy disagrees with its own address:
+                    # nothing to repair from — unrepairable residual
+                    result["errors"] += len(present)
+                    result["inconsistent"].append(oid)
+                    result["residual"] += len(present)
+                    self.osd.ctx.log.info(
+                        "osd", "scrub %d.%x %s: all copies diverge"
+                        " from the chunk address"
+                        % (pg.pool_id, pg.ps, oid))
+                    continue
+                keys = good
+            if len(digests) == 1 and len(present) == len(live) \
+                    and (named is None or len(keys) == len(digests)):
                 continue
             # authoritative = the majority digest, primary tiebreak
             auth_key = max(
-                digests,
+                keys,
                 key=lambda k: (len(digests[k]),
                                self.osd.whoami in digests[k]))
             bad = [o for o in live if o not in digests[auth_key]]

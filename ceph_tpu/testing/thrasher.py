@@ -294,7 +294,8 @@ class ClusterThrasher:
                       "chip_loss", "mixed_rmw", "corrupt_shard",
                       "corrupt_replica", "corrupt_compressed",
                       "poison_mid_compress", "bully_tenant",
-                      "repair_compare"):
+                      "repair_compare", "corrupt_dedup_index",
+                      "poison_mid_chunk"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -549,6 +550,10 @@ class ClusterThrasher:
             if pid is None:
                 return              # no compression pool under thrash
             await self._poison_mid_compress_round(c, pid, arg)
+        elif action == "corrupt_dedup_index":
+            await self._corrupt_dedup_index_round(c, arg)
+        elif action == "poison_mid_chunk":
+            await self._poison_mid_chunk_round(c, arg)
         elif action in ("corrupt_shard", "corrupt_replica"):
             want_ec = action == "corrupt_shard"
             pid = next(
@@ -977,6 +982,174 @@ class ClusterThrasher:
                     "stored blob of %s on osd.%d does not decompress"
                     " to the original bytes" % (oid, o))
         self.log.append("poison_mid_compress: %d writes, armed=%r"
+                        % (len(payloads), armed))
+        # the probe loops heal every poisoned chip (faults cleared)
+        await wait_for(lambda: all(not ch.fallback for ch in chips),
+                       30.0, what="poisoned chips healed")
+
+    async def _dedup_pool_pair(self, c, seed: int) -> tuple[int, int]:
+        """(base pool id, chunk pool id) for the dedup rounds: an
+        existing dedup binding if any pool has one, else an in-round
+        pair created through the mon (both plain replicated) and
+        waited visible on every live OSD."""
+        from ..utils.backoff import wait_for
+        for p, pool in sorted(c.client.osdmap.pools.items()):
+            if getattr(pool, "dedup_chunk_pool", -1) >= 0:
+                return p, pool.dedup_chunk_pool
+        base = "dthrash-%d" % seed
+        await c.client.mon_command("osd pool create", pool=base,
+                                   pg_num=4)
+        await c.client.mon_command("osd pool create",
+                                   pool=base + "-chunks", pg_num=4)
+        await c.client.mon_command("osd pool set", pool=base,
+                                   var="dedup_chunk_pool",
+                                   val=base + "-chunks")
+        await wait_for(
+            lambda: any(pl.name == base
+                        and getattr(pl, "dedup_chunk_pool", -1) >= 0
+                        for pl in c.client.osdmap.pools.values()),
+            30.0, what="dedup binding visible on the client")
+        pid = next(p for p, pl in c.client.osdmap.pools.items()
+                   if pl.name == base)
+        cpid = c.client.osdmap.pools[pid].dedup_chunk_pool
+        await wait_for(
+            lambda: all(
+                o.osdmap is not None
+                and o.osdmap.pools.get(pid) is not None
+                and getattr(o.osdmap.pools[pid],
+                            "dedup_chunk_pool", -1) == cpid
+                for o in c.live_osds),
+            30.0, what="dedup binding visible on every OSD")
+        await c.wait_health(pid, timeout=120.0)
+        await c.wait_health(cpid, timeout=120.0)
+        return pid, cpid
+
+    async def _corrupt_dedup_index_round(self, c, seed: int) -> None:
+        """Chunk-store integrity: write a redundant corpus through a
+        dedup pool, rot one content-addressed chunk object on ALL BUT
+        ONE replica (identical junk, so plain majority voting would
+        crown the rot), prove deep scrub detects EXACTLY the planted
+        object, repair restores from the single copy that still
+        matches its address, a re-scrub is clean, and every base
+        object reads back byte-identical."""
+        from ..dedup import CHUNK_MIN, parse_chunk_oid
+        from ..osd.osdmap import pg_t
+        from ..store.objectstore import Transaction, hobject_t
+        pid, cpid = await self._dedup_pool_pair(c, seed)
+        pool = c.client.osdmap.pools[pid]
+        io = c.client.io_ctx(pool.name)
+        rng = random.Random("dedrot-%r-%d" % (self.seed, seed))
+        shared = rng.randbytes(5 * CHUNK_MIN)
+        payloads = {}
+        for i in range(4):
+            oid = "dedrot-%d-%d" % (seed, i)
+            payloads[oid] = shared + rng.randbytes(CHUNK_MIN // 2)
+            await asyncio.wait_for(
+                io.write_full(oid, payloads[oid]), 30.0)
+        await c.wait_health(cpid, timeout=120.0)
+        alive = {o.whoami: o for o in c.live_osds}
+        # every content-addressed chunk object the store holds, via
+        # the chunk-pool primaries' collections
+        targets: list[tuple[int, str]] = []
+        for o in c.live_osds:
+            for pg in o.pgs.values():
+                if pg.pool_id != cpid or not pg.is_primary():
+                    continue
+                for h in o.store.collection_list(pg.cid):
+                    if parse_chunk_oid(h.name) is not None:
+                        targets.append((pg.ps, h.name))
+        assert targets, "no chunk objects landed in the chunk pool"
+        targets.sort()
+        ps, oid = targets[rng.randrange(len(targets))]
+        m = c.client.osdmap
+        _up, _upp, acting, _prim = m.pg_to_up_acting_osds(
+            pg_t(cpid, ps))
+        members = [o for o in acting if o >= 0 and o in alive]
+        if len(members) < 2:
+            return          # nothing to outvote on a 1-wide pool
+        survivor = members[rng.randrange(len(members))]
+        victims = [o for o in members if o != survivor]
+        blob0 = alive[survivor].store.read(
+            alive[survivor].pgs[pg_t(cpid, ps)].cid, hobject_t(oid))
+        junk = rng.randbytes(len(blob0))        # same junk: majority
+        for v in victims:
+            osd = alive[v]
+            pg = osd.pgs[pg_t(cpid, ps)]
+            t = Transaction()
+            t.truncate(pg.cid, hobject_t(oid), 0)
+            t.write(pg.cid, hobject_t(oid), 0, len(junk), junk)
+            osd.store.apply_transaction(t)
+        self.log.append("corrupt_dedup_index: %s rotted on %r,"
+                        " survivor osd.%d" % (oid, victims, survivor))
+        osd, pg = c.pg_primary(cpid, ps)
+        res = await osd.scrubber.scrub_pg(pg, deep=True, recheck=True)
+        assert set(res["inconsistent"]) == {oid}, (
+            "deep scrub of %s found %r, planted [%s]"
+            % (pg.pgid, sorted(res["inconsistent"]), oid))
+        res = await osd.scrubber.scrub_pg(pg, deep=True, repair=True,
+                                          only={oid})
+        assert res["repaired"] >= 1, res
+        assert res["residual"] == 0, res
+        res = await osd.scrubber.scrub_pg(pg, deep=True, recheck=True)
+        assert oid not in set(res["inconsistent"]), res
+        # the address-matching copy won: every replica holds the
+        # original chunk bytes again, and the base corpus reads back
+        for v in members:
+            got = alive[v].store.read(
+                alive[v].pgs[pg_t(cpid, ps)].cid, hobject_t(oid))
+            assert bytes(got) == bytes(blob0), (
+                "chunk %s on osd.%d not restored" % (oid, v))
+        for boid, want in sorted(payloads.items()):
+            got = await asyncio.wait_for(io.read(boid), 30.0)
+            assert got == want, (
+                "corrupt_dedup_index lost %s after repair" % boid)
+
+    async def _poison_mid_chunk_round(self, c, seed: int) -> None:
+        """Chip loss mid-chunk: arm a one-shot device fault on every
+        live OSD's affinity chip, then drive chunkable writefulls
+        through a dedup pool — the dispatching chip poisons
+        mid-flight, every write completes on the bit-identical host
+        reference (zero lost acked writes), every object reads back,
+        and the poisoned chips heal."""
+        from ..dedup import CHUNK_MIN, device_dedup_enabled
+        from ..device.runtime import DeviceRuntime
+        from ..utils.backoff import wait_for
+        pid, _cpid = await self._dedup_pool_pair(c, seed)
+        pool = c.client.osdmap.pools[pid]
+        io = c.client.io_ctx(pool.name)
+        rng = random.Random("poisonchunk-%r-%d" % (self.seed, seed))
+        rt = DeviceRuntime.get()
+        chips = {(o.device_chip if o.device_chip is not None
+                  else rt.chip_for(o.whoami)) for o in c.live_osds}
+        armed = device_dedup_enabled()
+        pre_poison = {ch.index: ch.fallback_count for ch in chips}
+        if armed:
+            for ch in chips:
+                ch.inject_fault(1)
+        shared = rng.randbytes(3 * CHUNK_MIN)
+        payloads = {}
+        for i in range(5):
+            oid = "poisonchunk-%d-%d" % (seed, i)
+            payloads[oid] = shared + rng.randbytes(
+                CHUNK_MIN // 4 * (i + 1))
+        try:
+            # concurrent writefulls: the first dispatch consumes the
+            # fault mid-chunk; gather raises if ANY write is lost
+            await asyncio.wait_for(asyncio.gather(*[
+                io.write_full(oid, p)
+                for oid, p in sorted(payloads.items())]), 60.0)
+        finally:
+            for ch in chips:
+                ch.clear_faults()
+        if armed:
+            assert any(ch.fallback_count > pre_poison[ch.index]
+                       for ch in chips), \
+                "no chip consumed the armed mid-chunk fault"
+        for oid, want in sorted(payloads.items()):
+            got = await asyncio.wait_for(io.read(oid), 30.0)
+            assert got == want, \
+                "acked write %s lost through the chip poison" % oid
+        self.log.append("poison_mid_chunk: %d writes, armed=%r"
                         % (len(payloads), armed))
         # the probe loops heal every poisoned chip (faults cleared)
         await wait_for(lambda: all(not ch.fallback for ch in chips),

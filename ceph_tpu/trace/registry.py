@@ -47,6 +47,9 @@ OP_STAGES = frozenset({
     "dropped_not_primary", "dropped_wrong_pg_after_split",
     "dropped_interval_change", "dropped_pool_deleted",
     "dup_answered_from_journal",
+    # dedup plane (dedup/plane.py)
+    "dedup_planned", "waiting_for_inflight_dup",
+    "dropped_inflight_dup",
     "aborted_interval_change", "aborted_pool_deleted",
     # EC backend (osd/ecbackend.py)
     "ec_write_started", "ec_encode_start", "ec_encoded",
@@ -63,6 +66,7 @@ OP_STAGE_PREFIXES = ("sent_osd.", "commit_rec_osd.", "reply_r")
 # flight-recorder background span names (FlightRecorder.span callers)
 BACKGROUND_SPANS = frozenset({
     "scrub", "deep_scrub", "recovery", "compression_paced",
+    "dedup_paced",
 })
 
 # per-chip device series (ChipRuntime.metrics keys + the families
@@ -90,6 +94,9 @@ DEVICE_SERIES = frozenset({
     # match-planned on each chip vs emitted container bytes — the
     # observable that force-mode pools stopped burning host CPU
     "device_compress_bytes_in", "device_compress_bytes_out",
+    # dedup plane (device/runtime.py note_fingerprint): chunks/bytes
+    # content-fingerprinted on each chip's CRC lanes
+    "device_fingerprint_chunks", "device_fingerprint_bytes",
     # families prom_lines emits beside the metrics() gauges
     "device_chips", "device_dispatch_seconds",
 })
@@ -125,6 +132,11 @@ MGR_SERIES = frozenset({
     # osd_stats.repair rows into the digest and rendered codec-labeled
     "ceph_tpu_repair_bytes_read_total",
     "ceph_tpu_repair_bytes_moved_total",
+    # data-reduction plane: per-pool dedup counters folded from the
+    # OSDs' osd_stats.dedup rows and rendered pool-labeled
+    "ceph_tpu_dedup_chunks_stored_total",
+    "ceph_tpu_dedup_chunks_deduped_total",
+    "ceph_tpu_dedup_bytes_saved_total",
 })
 
 # consumers referencing the ingest families by literal (the bench
@@ -145,6 +157,11 @@ CONSUMER_MGR_REFS = {
     "tests/test_ec_recovery_codecs.py": (
         "ceph_tpu_repair_bytes_read_total",
         "ceph_tpu_repair_bytes_moved_total",
+    ),
+    "tests/test_dedup.py": (
+        "ceph_tpu_dedup_chunks_stored_total",
+        "ceph_tpu_dedup_chunks_deduped_total",
+        "ceph_tpu_dedup_bytes_saved_total",
     ),
 }
 
@@ -167,6 +184,9 @@ CONSUMER_STAGE_REFS = {
     "tests/test_dispatch_stream.py": (
         "device_stream_retired",
     ),
+    "tests/test_dedup.py": (
+        "dedup_planned",
+    ),
 }
 
 CONSUMER_SERIES_REFS = {
@@ -180,6 +200,7 @@ CONSUMER_SERIES_REFS = {
         "device_slot_occupancy", "device_admission_wait",
         "device_repair_bytes_read", "device_repair_bytes_moved",
         "device_compress_bytes_in", "device_compress_bytes_out",
+        "device_fingerprint_chunks", "device_fingerprint_bytes",
     ),
     "tests/test_tlz.py": (
         "device_compress_bytes_in", "device_compress_bytes_out",
@@ -190,6 +211,9 @@ CONSUMER_SERIES_REFS = {
     ),
     "tests/test_ec_recovery_codecs.py": (
         "device_repair_bytes_read", "device_repair_bytes_moved",
+    ),
+    "tests/test_dedup.py": (
+        "device_fingerprint_chunks", "device_fingerprint_bytes",
     ),
 }
 
