@@ -1709,6 +1709,36 @@ def _dedup_corpus(rng, n_objs: int) -> list:
     return [vocab[i % len(vocab)] for i in range(n_objs)]
 
 
+def _shifted_corpus(rng, n_objs: int) -> list:
+    """Shifted/partial-overlap corpus: the `_dedup_corpus` vocabulary
+    with per-duplicate insert/delete skews — every copy beyond the
+    vocabulary's first carries a few small random insertions and
+    deletions at random offsets.  Fixed-block alignment breaks at the
+    first skew (every downstream block shifts), but content-defined
+    boundaries resynchronize within a chunk or two, so CDC still
+    matches most of the payload.  This is the corpus that separates
+    the two chunking disciplines."""
+    base = _dedup_corpus(rng, n_objs)
+    seen: set[bytes] = set()
+    out = []
+    for b in base:
+        if b not in seen:
+            seen.add(b)          # first copy of each vocab entry:
+            out.append(b)        # verbatim, the dedup anchor
+            continue
+        buf = bytearray(b)
+        for _ in range(int(rng.integers(1, 4))):
+            off = int(rng.integers(0, len(buf)))
+            n = int(rng.integers(1, 64))
+            if rng.integers(0, 2):
+                buf[off:off] = rng.integers(
+                    0, 256, n, dtype=np.uint8).tobytes()
+            else:
+                del buf[off:off + n]
+        out.append(bytes(buf))
+    return out
+
+
 def bench_dedup(n_objs: int = 12, seed: int = 47,
                 rounds: int = 5) -> dict:
     """--dedup mode: the data-reduction plane's two legs.
@@ -1796,6 +1826,52 @@ def bench_dedup(n_objs: int = 12, seed: int = 47,
                 metrics["device_fingerprint_chunks"],
             "device_fingerprint_bytes":
                 metrics["device_fingerprint_bytes"],
+        }
+
+    async def shifted_leg() -> dict:
+        """The partial-overlap leg: the same vocabulary with small
+        insert/delete skews applied to every duplicate.  Content-
+        defined chunking must keep deduplicating (boundaries
+        resynchronize past each skew); a fixed-block baseline on the
+        SAME corpus collapses toward 1x (each skew shifts every
+        downstream block).  The CDC-vs-fixed gap is the whole point
+        of the boundary kernel — published beside the verbatim
+        ratio."""
+        from ceph_tpu.dedup import (CHUNK_AVG, boundary_batch,
+                                    fingerprint, fingerprint_batch,
+                                    split)
+
+        rng = np.random.default_rng(seed + 2)
+        blobs = _shifted_corpus(rng, n_objs)
+        logical = sum(len(b) for b in blobs)
+        cuts, cut_path = await boundary_batch(blobs, chip=0)
+        chunks = [ch for b, c in zip(blobs, cuts)
+                  for ch in split(b, c)]
+        fps, fp_path = await fingerprint_batch(chunks, chip=0)
+        cdc_unique: dict = {}
+        for fp, ch in zip(fps, chunks):
+            cdc_unique.setdefault(fp, len(ch))
+        cdc_bytes = sum(cdc_unique.values())
+        # fixed-block baseline on the same skewed corpus: CHUNK_AVG
+        # blocks addressed by the same crc32+len fingerprint
+        fixed_unique: dict = {}
+        for b in blobs:
+            for off in range(0, len(b), CHUNK_AVG):
+                blk = b[off:off + CHUNK_AVG]
+                fixed_unique.setdefault(
+                    fingerprint(zlib.crc32(blk), len(blk)), len(blk))
+        fixed_bytes = sum(fixed_unique.values())
+        return {
+            "logical_bytes": logical,
+            "n_chunks": len(chunks),
+            "boundary_path": cut_path,
+            "fingerprint_path": fp_path,
+            "cdc_unique_bytes": cdc_bytes,
+            "fixed_block_unique_bytes": fixed_bytes,
+            "cdc_ratio": round(logical / cdc_bytes, 2)
+                if cdc_bytes else 0.0,
+            "fixed_block_ratio": round(logical / fixed_bytes, 2)
+                if fixed_bytes else 0.0,
         }
 
     async def cluster_leg() -> dict:
@@ -1921,6 +1997,7 @@ def bench_dedup(n_objs: int = 12, seed: int = 47,
         rec = {"metric": "dedup_plane"}
         rec["kernel"] = await kernel_leg()
         rec["backend"] = rec["kernel"]["backend"]
+        rec["shifted"] = await shifted_leg()
         rec["cluster"] = await cluster_leg()
         return rec
 
@@ -1965,6 +2042,18 @@ def _gate_dedup(rec: dict) -> dict:
         failures.append("dedup ratio %.2f below the 2x gate on the"
                         " seeded redundant corpus"
                         % cl.get("dedup_ratio", 0.0))
+    sh = rec.get("shifted") or {}
+    if sh.get("cdc_ratio", 0.0) <= sh.get("fixed_block_ratio", 99.0):
+        failures.append(
+            "CDC ratio %.2f did not beat the fixed-block baseline"
+            " %.2f on the shifted corpus — boundaries are not"
+            " resynchronizing past the skews"
+            % (sh.get("cdc_ratio", 0.0),
+               sh.get("fixed_block_ratio", 0.0)))
+    if sh.get("cdc_ratio", 0.0) < 1.3:
+        failures.append(
+            "CDC ratio %.2f on the shifted corpus below the 1.3x"
+            " floor" % sh.get("cdc_ratio", 0.0))
     if not cl.get("accounting_ok"):
         failures.append("dedup ledger does not match the chunk"
                         " store's real usage")
@@ -2021,6 +2110,7 @@ def _publish_dedup(rec: dict) -> None:
             doc = json.load(f)
         k = rec.get("kernel") or {}
         cl = rec.get("cluster") or {}
+        sh = rec.get("shifted") or {}
         doc.setdefault("published", {})["dedup_plane"] = {
             "backend": rec.get("backend"),
             "unit": "MiB/s of raw corpus chunked+fingerprinted",
@@ -2039,7 +2129,170 @@ def _publish_dedup(rec: dict) -> None:
             "chunk_store_bytes": cl.get("chunk_store_bytes"),
             "bytes_saved": (cl.get("ledger") or {}).get(
                 "bytes_saved"),
+            "shifted_dedup_ratio": sh.get("cdc_ratio"),
+            "shifted_fixed_block_ratio": sh.get("fixed_block_ratio"),
             "source": "bench.py --dedup",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    except Exception as e:
+        rec["publish_error"] = repr(e)[:200]
+
+
+def bench_observe(n_ticks: int = 5000, seed: int = 53) -> dict:
+    """--observe mode: the history plane's cost model.
+
+    The ring store rides the mgr's hot stats loop, so its contract is
+    cost, not just correctness: folding one digest tick (extract +
+    ingest + anomaly observe) must stay within 5% of the stats tick,
+    memory must stay under the ``max_cells`` ceiling no matter how
+    long the store runs, and a `perf history` query must render in
+    single-digit milliseconds.  This leg drives a synthetic digest
+    with realistic breadth (8 pools, 8 chips, 8 tenants — the same
+    series the real digest emits: "io.write_ops_s",
+    "device.busy_frac", ...) through thousands of ticks spanning
+    multiple tier windows, then plants a sustained busy-frac shift
+    and checks the anomaly engine raises it.  Published into
+    BASELINE.json's `history_plane` behind the gate."""
+    from ceph_tpu.mgr.history import AnomalyEngine, HistoryStore
+
+    tick_s = 1.0                # the mgr_stats_period default
+    rng = np.random.default_rng(seed)
+    store = HistoryStore()
+    engine = AnomalyEngine()
+    n_pools, n_chips, n_tenants = 8, 8, 8
+
+    def digest_at(i: int, busy0: float | None = None) -> dict:
+        busy = rng.uniform(0.2, 0.4, n_chips)
+        if busy0 is not None:
+            busy[0] = busy0
+        return {
+            "totals": {
+                "read_ops_s": float(rng.uniform(800, 1200)),
+                "write_ops_s": float(rng.uniform(400, 600)),
+                "read_bytes_s": float(rng.uniform(1e8, 2e8)),
+                "write_bytes_s": float(rng.uniform(5e7, 1e8)),
+                "recovery_ops_s": float(rng.uniform(0, 10)),
+                "recovery_bytes_s": float(rng.uniform(0, 1e6)),
+            },
+            "pools": {str(p): {"degraded": int(rng.integers(0, 3)),
+                               "misplaced": 0}
+                      for p in range(n_pools)},
+            "device_util": {
+                str(c): {"busy_frac": float(busy[c]),
+                         "queue_wait_frac":
+                             float(rng.uniform(0.0, 0.05))}
+                for c in range(n_chips)},
+            "slo": {"t%d" % t: {"p99_ms": float(rng.uniform(5, 9)),
+                                "burn_fast":
+                                    float(rng.uniform(0, 0.2))}
+                    for t in range(n_tenants)},
+            "repair_traffic": {"osd.0": {"read": 1 << 20,
+                                         "moved": 1 << 19}},
+            "dedup_pools": {"1": {"bytes_stored": 1 << 24,
+                                  "bytes_saved": 1 << 25}},
+        }
+
+    from ceph_tpu.mgr.history import extract_samples
+    t0 = 10_000_000.0
+    walls = []
+    for i in range(n_ticks):
+        d = digest_at(i)
+        now = t0 + i * tick_s
+        w0 = time.perf_counter()
+        samples = extract_samples(d)
+        store.ingest(now, d, samples=samples)
+        engine.observe(samples)
+        walls.append(time.perf_counter() - w0)
+    samples_per_tick = len(extract_samples(digest_at(0)))
+    # the planted pathology: chip 0 pinned hot long enough for the
+    # deaf defaults (z >= 6 sustained 8 ticks) to raise
+    raised = False
+    for i in range(n_ticks, n_ticks + 20):
+        d = digest_at(i, busy0=0.97)
+        samples = extract_samples(d)
+        store.ingest(t0 + i * tick_s, d, samples=samples)
+        active = engine.observe(samples)
+        raised = raised or "device.busy_frac[0]" in active
+    now = t0 + (n_ticks + 20) * tick_s
+    q_walls = []
+    for _ in range(200):
+        w0 = time.perf_counter()
+        store.query("io.write_ops_s", None, window=600.0, now=now)
+        store.query("device.busy_frac", "0", window=3600.0, now=now)
+        q_walls.append(time.perf_counter() - w0)
+    walls.sort()
+    q_walls.sort()
+    return {
+        "metric": "history_plane",
+        "tick_s": tick_s,
+        "n_ticks": n_ticks,
+        "samples_per_tick": samples_per_tick,
+        "mean_ingest_us": round(sum(walls) / len(walls) * 1e6, 1),
+        "p99_ingest_us": round(
+            walls[int(len(walls) * 0.99)] * 1e6, 1),
+        "ingest_budget_frac": round(
+            walls[int(len(walls) * 0.99)] / (0.05 * tick_s), 4),
+        "cells": store.cell_count(),
+        "max_cells": store.max_cells(),
+        "dropped_labels": store.dropped_labels,
+        "query_mean_ms": round(
+            sum(q_walls) / len(q_walls) * 1e3, 3),
+        "query_p99_ms": round(
+            q_walls[int(len(q_walls) * 0.99)] * 1e3, 3),
+        "anomaly_raised": bool(raised),
+    }
+
+
+def _gate_observe(rec: dict) -> dict:
+    """The history-plane gate: p99 ingest within 5% of the stats
+    tick, cells under the max_cells ceiling, queries under 10 ms
+    p99, and the planted sustained shift actually raised — each a
+    hard failure (the plane rides the mgr's hot loop; an overrun
+    here is a regression in every cluster's stats cadence)."""
+    failures = []
+    if rec.get("p99_ingest_us", 1e12) / 1e6 \
+            > 0.05 * rec.get("tick_s", 1.0):
+        failures.append(
+            "p99 ingest %.1f us exceeds 5%% of the %.1fs stats tick"
+            % (rec.get("p99_ingest_us", 0.0), rec.get("tick_s", 1.0)))
+    if rec.get("cells", 1 << 60) > rec.get("max_cells", 0):
+        failures.append(
+            "%d cells exceed the max_cells ceiling %d — the rings"
+            " are not pruning" % (rec.get("cells", 0),
+                                  rec.get("max_cells", 0)))
+    if rec.get("query_p99_ms", 1e9) > 10.0:
+        failures.append("query p99 %.3f ms exceeds the 10 ms bound"
+                        % rec.get("query_p99_ms", 0.0))
+    if not rec.get("anomaly_raised"):
+        failures.append("the planted sustained busy-frac shift did"
+                        " not raise an anomaly")
+    return {"ok": not failures, "failures": failures}
+
+
+def _publish_observe(rec: dict) -> None:
+    """Fold the history-plane cost figures into BASELINE.json's
+    published map.  A failed gate publishes nothing."""
+    import os
+    if not rec.get("gate", {}).get("ok"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})["history_plane"] = {
+            "unit": "us to fold one digest tick into the rings",
+            "tick_s": rec.get("tick_s"),
+            "samples_per_tick": rec.get("samples_per_tick"),
+            "mean_ingest_us": rec.get("mean_ingest_us"),
+            "p99_ingest_us": rec.get("p99_ingest_us"),
+            "ingest_budget_frac": rec.get("ingest_budget_frac"),
+            "cells": rec.get("cells"),
+            "max_cells": rec.get("max_cells"),
+            "query_p99_ms": rec.get("query_p99_ms"),
+            "source": "bench.py --observe",
         }
         with open(path, "w") as f:
             json.dump(doc, f, indent=2)
@@ -3059,6 +3312,22 @@ def main() -> None:
         _publish_dedup(rec)
         print(json.dumps(rec))
         if not rec["gate"]["ok"]:
+            sys.exit(1)
+        return
+    if "--observe" in sys.argv:
+        # the history-plane cost model: ring-store ingest overhead
+        # vs the stats-tick budget, the memory ceiling, query
+        # latency, and the planted-anomaly raise, merged into
+        # BASELINE.json's history_plane section
+        rec = bench_observe()
+        rec["gate"] = _gate_observe(rec)
+        _publish_observe(rec)
+        print(json.dumps(rec))
+        if not rec["gate"]["ok"]:
+            # the history-plane figures are guarded artifacts: an
+            # ingest overrun of the mgr's stats tick, an unbounded
+            # ring, a slow query, or a deaf anomaly engine is a CI
+            # failure, not a quieter JSON
             sys.exit(1)
         return
     if "--stats" in sys.argv:

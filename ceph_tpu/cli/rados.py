@@ -13,6 +13,10 @@ Analog of src/tools/rados (rados put/get/ls/rm/stat/df/bench):
     df, osd dump, recent cluster log, crash list) as JSON.
     `trace export` drives a few probe ops and writes the client's
     flight-recorder timeline as Chrome-trace / Perfetto JSON.
+    `watch-events` streams the mon's committed cluster events live
+    (the `ceph -w` analog; --from N resumes a cursor).
+    `perf history SERIES [LABEL]` renders the mon's downsampled
+    history rows for one series (--window seconds).
 """
 
 from __future__ import annotations
@@ -142,6 +146,40 @@ async def _run(args) -> int:
             else:
                 print(blob)
             return 0
+        if args.cmd == "watch-events":
+            # live committed-event stream (`ceph -w`): each row once,
+            # in seq order, surviving mon failover via the cursor
+            def show(row):
+                print("%d %.3f [%s] %s"
+                      % (row.get("seq", 0), row.get("stamp", 0.0),
+                         row.get("type"), row.get("message")))
+            client.watch_events(show, start=args.from_seq)
+            await asyncio.Event().wait()     # stream until ^C
+            return 0
+        if args.cmd == "perf":
+            if not args.args or args.args[0] != "history":
+                print("unknown perf subcommand %r"
+                      % (args.args[:1] or [""])[0], file=sys.stderr)
+                return 2
+            if len(args.args) < 2:
+                out = await client.mon_command("perf history")
+                for series, label in out.get("series") or []:
+                    print("%s%s" % (series,
+                                    "[%s]" % label if label else ""))
+                return 0
+            kw = {"series": args.args[1], "window": args.window}
+            if len(args.args) > 2:
+                kw["label"] = args.args[2]
+            out = await client.mon_command("perf history", **kw)
+            print("%s%s tier=%ss window=%ss"
+                  % (out["series"],
+                     "[%s]" % out["label"] if out["label"] else "",
+                     out.get("tier_s"), out.get("window")))
+            fmt = "%12s %5s %12s %12s %12s %12s"
+            print(fmt % ("T", "N", "MIN", "MAX", "AVG", "LAST"))
+            for t, n, lo, hi, avg, last in out.get("rows") or []:
+                print(fmt % (t, n, lo, hi, avg, last))
+            return 0
         io = client.io_ctx(args.pool)
         if args.snap:
             if args.cmd in ("put", "rm", "bench", "mksnap", "rmsnap"):
@@ -233,6 +271,10 @@ def main(argv=None) -> int:
     p.add_argument("-s", "--snap", default=None,
                    help="read from this pool snapshot")
     p.add_argument("--size", type=int, default=4096)
+    p.add_argument("--window", type=float, default=600.0,
+                   help="perf history window, seconds")
+    p.add_argument("--from", dest="from_seq", type=int, default=0,
+                   help="watch-events: resume after this seq")
     p.add_argument("cmd")
     p.add_argument("args", nargs="*")
     args = p.parse_args(argv)

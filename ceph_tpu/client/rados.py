@@ -18,7 +18,8 @@ import random
 
 from ..msg import Messenger
 from ..msg.messenger import ms_compress_from_conf
-from ..msg.messages import (MConfig, MMonCommand, MMonCommandAck, MMonSubscribe,
+from ..msg.messages import (MConfig, MMonCommand, MMonCommandAck,
+                            MMonEvents, MMonSubscribe, MMonWatchEvents,
                             MOSDBackoff, MOSDMapMsg, MOSDOp, MOSDOpReply,
                             MWatchNotify)
 from ..osd.osdmap import OSDMap, consume_map_payload, pg_t
@@ -105,6 +106,12 @@ class RadosClient:
         self._cmd_futures: dict[int, asyncio.Future] = {}
         # (pool, oid) -> callback(payload); re-registered on map change
         self._watch_cbs: dict[tuple, object] = {}
+        # cluster event-bus subscription (watch_events): callback per
+        # event row, cursor = highest seq delivered.  Seqs are
+        # cluster-wide identical, so the cursor survives mon failover
+        # — re-subscribing anywhere resumes with no gaps or dups
+        self._event_cb = None
+        self._event_cursor = 0
         # (pool, ps, oid|None) -> (primary_osd, backoff_id): PGs (oid
         # None) or single degraded objects an OSD told us to stop
         # resending to (MOSDBackoff); cleared on unblock, on a primary
@@ -167,6 +174,9 @@ class RadosClient:
         if isinstance(msg, MConfig):
             self.ctx.conf.apply_mon_values(msg.values or {})
             return True
+        if isinstance(msg, MMonEvents):
+            self._handle_events(msg)
+            return True
         if isinstance(msg, MOSDMapMsg):
             self._handle_map(msg)
         elif isinstance(msg, MOSDOpReply):
@@ -206,6 +216,13 @@ class RadosClient:
             self.msgr.send_to(self.mon_addr,
                               MMonSubscribe(start=self.osdmap.epoch + 1),
                               entity_hint="mon.0")
+            if self._event_cb is not None:
+                # resume the event stream from the cursor — every
+                # mon holds the identical committed sequence
+                self.msgr.send_to(
+                    self.mon_addr,
+                    MMonWatchEvents(start=self._event_cursor),
+                    entity_hint="mon.0")
         else:
             # an OSD session reset dropped our in-memory watches on
             # that primary even if the map is unchanged: re-register
@@ -242,6 +259,40 @@ class RadosClient:
                             (op.pool, op.pgid.ps) == key[:2] and \
                             (oid is None or op.oid == oid):
                         op.next_resend = now
+
+    # -- event bus (watch-events subscription) -----------------------------
+
+    def watch_events(self, callback, start: int = 0) -> None:
+        """Stream the mon's committed cluster events (the reference's
+        `ceph -w`): callback(row) per event, rows are
+        {seq, type, stamp, message, data?} in seq order.  `start` is
+        the exclusive cursor (0 = everything still retained).  The
+        subscription rides the mon session: resets re-subscribe from
+        the cursor, and the resend ticker renews it."""
+        self._event_cb = callback
+        self._event_cursor = max(int(start), 0)
+        self.msgr.send_to(self.mon_addr,
+                          MMonWatchEvents(start=self._event_cursor),
+                          entity_hint="mon.0")
+
+    def unwatch_events(self) -> None:
+        self._event_cb = None
+
+    def _handle_events(self, msg: MMonEvents) -> None:
+        """One MMonEvents batch: rows at or below the cursor are
+        duplicates (a renewal racing a push) and drop; the callback
+        sees each seq exactly once, in order."""
+        cb = self._event_cb
+        for row in (msg.events or []):
+            seq = int(row.get("seq") or 0)
+            if seq <= self._event_cursor:
+                continue
+            self._event_cursor = seq
+            if cb is not None:
+                try:
+                    cb(dict(row))
+                except Exception:
+                    pass
 
     def _backed_off(self, op: _InFlight) -> bool:
         """Blocked by a PG-wide backoff or an object-scoped one
@@ -420,6 +471,14 @@ class RadosClient:
                     self.mon_addr,
                     MMonSubscribe(start=self.osdmap.epoch + 1),
                     entity_hint="mon.0")
+                if self._event_cb is not None:
+                    # renewal doubles as loss repair: any committed
+                    # events a dropped push missed come back now
+                    # (the cursor dedups the overlap)
+                    self.msgr.send_to(
+                        self.mon_addr,
+                        MMonWatchEvents(start=self._event_cursor),
+                        entity_hint="mon.0")
             for op in list(self._inflight.values()):
                 if not op.oid or op.future.done():
                     continue    # pg-targeted (pgls) ops are fire-once

@@ -1155,7 +1155,9 @@ class DeviceRuntime:
         mesh-size gauge.  TYPE is emitted once per family across
         chips (the exposition rule utils.exporter lints)."""
         from ..utils.exporter import hist_lines
-        lines = ["# TYPE %s_device_chips gauge" % prefix,
+        lines = ["# HELP %s_device_chips chips in the device mesh"
+                 % prefix,
+                 "# TYPE %s_device_chips gauge" % prefix,
                  "%s_device_chips %d" % (prefix, len(self.chips))]
         typed: set[str] = set()
         hist_typed: set[str] = set()
@@ -1165,10 +1167,13 @@ class DeviceRuntime:
                 base = "%s_%s" % (prefix, name)
                 if base not in typed:
                     typed.add(base)
+                    lines.append("# HELP %s per-chip %s" % (base, name))
                     lines.append("# TYPE %s gauge" % base)
                 lines.append("%s{%s} %g" % (base, label, float(val)))
             lines.extend(hist_lines(
                 "%s_device_dispatch_seconds" % prefix,
                 c.dispatch_buckets_us, labels=label,
-                typed=hist_typed))
+                typed=hist_typed,
+                desc="per-chip dispatch wall time "
+                     "(us pow2 buckets)"))
         return lines

@@ -32,6 +32,14 @@ from ..utils.exporter import PrometheusExporter
 from .pgmap import PGMap, RATE_KEYS
 
 
+def _fam_header(lines: list, fam: str, kind: str,
+                desc: str) -> None:
+    """Append one family's `# HELP` + `# TYPE` header (the
+    exposition-format pair the exporter lint requires)."""
+    lines.append("# HELP %s %s" % (fam, desc))
+    lines.append("# TYPE %s %s" % (fam, kind))
+
+
 def ingest_prom_lines(pgmap) -> list[str]:
     """Telemetry-fabric ingest families rendered from a PGMap's
     accounting (module-level so `bench.py --scale`'s ingest leg can
@@ -43,17 +51,22 @@ def ingest_prom_lines(pgmap) -> list[str]:
     lines: list[str] = []
     for fam, key in (("ceph_tpu_mgr_report_rows_total", "rows"),
                      ("ceph_tpu_mgr_report_bytes_total", "bytes")):
-        lines.append("# TYPE %s counter" % fam)
+        _fam_header(lines, fam, "counter",
+                    "MMgrReport stat %s ingested by wire format"
+                    % key)
         for fmt in ("columnar", "legacy"):
             lines.append('%s{format="%s"} %d'
                          % (fam, fmt, ing[key][fmt]))
     lines.extend(hist_lines("ceph_tpu_mgr_ingest_seconds",
-                            ing["seconds_hist"]))
-    lines.append(
-        "# TYPE ceph_tpu_mgr_ingest_fallback_rows_total counter")
+                            ing["seconds_hist"],
+                            desc="per-report PGMap apply latency"))
+    _fam_header(lines, "ceph_tpu_mgr_ingest_fallback_rows_total",
+                "counter",
+                "stat rows that fell back to the legacy row loop")
     lines.append("ceph_tpu_mgr_ingest_fallback_rows_total %d"
                  % ing["fallback_rows"])
-    lines.append("# TYPE ceph_tpu_mgr_rows_pruned_total counter")
+    _fam_header(lines, "ceph_tpu_mgr_rows_pruned_total", "counter",
+                "PGMap rows reclaimed, by prune reason")
     for reason, count in (("stale", pgmap.pruned_stale),
                           ("pool", pgmap.pruned_pool),
                           ("daemon", pgmap.pruned_daemons)):
@@ -94,6 +107,14 @@ class Manager:
         # ride the digest into the mon's SLO_LATENCY/SLO_BURN checks
         from .slo import SLOEngine
         self.slo = SLOEngine(self.ctx)
+        # history plane: fixed-memory downsampled rings fed each
+        # stats tick from the folded digest, plus the EWMA/z-score
+        # anomaly rules whose verdicts ride the digest into the
+        # mon's committed PERF_ANOMALY edge
+        from .history import AnomalyEngine, HistoryStore
+        self.history = HistoryStore(self.ctx)
+        self.anomaly = AnomalyEngine(self.ctx)
+        self.history_ingest_s = 0.0
         self.exporter = PrometheusExporter(self.ctx)
         # cluster-log handle: mgr events ride the same
         # LogClient -> MLog -> LogMonitor pipeline as OSD events
@@ -211,6 +232,18 @@ class Manager:
         exp.add_gauge("balancer_changes",
                       lambda: self.balancer_changes,
                       "upmap items committed by the balancer")
+        exp.add_gauge("history_cells",
+                      lambda: self.history.cell_count(),
+                      "retained history ring cells (bounded)")
+        exp.add_gauge("history_ticks",
+                      lambda: self.history.ticks,
+                      "digest ticks folded into the history rings")
+        exp.add_gauge("history_ingest_seconds",
+                      lambda: round(self.history_ingest_s, 6),
+                      "cumulative history-plane ingest time")
+        exp.add_gauge("history_anomalies_active",
+                      lambda: len(self.anomaly.active),
+                      "series currently flagged by the anomaly rules")
         exp.add_renderer(self._render_reports)
         exp.add_renderer(self._render_pgmap)
         exp.add_renderer(self._render_event_plane)
@@ -239,10 +272,13 @@ class Manager:
         lines: list[str] = []
         typed: set[str] = set()
 
-        def emit(family: str, label: str, value, kind="gauge"):
+        def emit(family: str, label: str, value, kind="gauge",
+                 desc=None):
             if family not in typed:
                 typed.add(family)
-                lines.append("# TYPE %s %s" % (family, kind))
+                _fam_header(lines, family, kind,
+                            desc or "per-daemon %s from MMgrReports"
+                            % family.split("ceph_tpu_daemon_")[-1])
             lines.append("%s%s %g" % (family, label, value))
 
         pg_totals: dict[str, int] = {}
@@ -263,7 +299,10 @@ class Manager:
                             "ceph_tpu_daemon_%s_%s" % (grp, cname),
                             val["buckets_us_pow2"],
                             labels='daemon="%s"' % daemon,
-                            typed=typed))
+                            typed=typed,
+                            desc="per-daemon %s.%s latency "
+                                 "histogram (us pow2 buckets)"
+                                 % (grp, cname)))
             emit("ceph_tpu_daemon_num_pgs", label,
                  rep.get("num_pgs") or 0)
             emit("ceph_tpu_daemon_num_objects", label,
@@ -272,7 +311,8 @@ class Manager:
                 pg_totals[state] = pg_totals.get(state, 0) + n
         for state in sorted(pg_totals):
             emit("ceph_tpu_pg_state", '{state="%s"}' % state,
-                 pg_totals[state])
+                 pg_totals[state],
+                 desc="cluster PG count by state")
         return lines
 
     def _render_pgmap(self) -> list[str]:
@@ -288,7 +328,8 @@ class Manager:
                   "unfound", "scrub_errors") + RATE_KEYS
         for g in gauges:
             fam = "ceph_tpu_pool_%s" % g
-            lines.append("# TYPE %s gauge" % fam)
+            _fam_header(lines, fam, "gauge",
+                        "per-pool %s from the PGMap fold" % g)
             for pid in sorted(per_pool):
                 name = (self.osdmap.pools[pid].name
                         if pid in self.osdmap.pools else str(pid))
@@ -298,7 +339,8 @@ class Manager:
                   for g in gauges}
         for g in gauges:
             fam = "ceph_tpu_cluster_%s" % g
-            lines.append("# TYPE %s gauge" % fam)
+            _fam_header(lines, fam, "gauge",
+                        "cluster-total %s from the PGMap fold" % g)
             lines.append("%s %g" % (fam, totals[g]))
         # repair-traffic plane: per-codec recovery bytes summed
         # across the live fleet (read from survivors via
@@ -312,14 +354,16 @@ class Manager:
                                         {"read": 0, "moved": 0})
                 agg["read"] += int(rrow.get("read", 0) or 0)
                 agg["moved"] += int(rrow.get("moved", 0) or 0)
-        lines.append(
-            "# TYPE ceph_tpu_repair_bytes_read_total counter")
+        _fam_header(lines, "ceph_tpu_repair_bytes_read_total",
+                    "counter",
+                    "survivor shard bytes read by recovery, by codec")
         for cname in sorted(repair):
             lines.append(
                 'ceph_tpu_repair_bytes_read_total{codec="%s"} %d'
                 % (cname, repair[cname]["read"]))
-        lines.append(
-            "# TYPE ceph_tpu_repair_bytes_moved_total counter")
+        _fam_header(lines, "ceph_tpu_repair_bytes_moved_total",
+                    "counter",
+                    "rebuilt shard bytes moved by recovery, by codec")
         for cname in sorted(repair):
             lines.append(
                 'ceph_tpu_repair_bytes_moved_total{codec="%s"} %d'
@@ -337,20 +381,23 @@ class Manager:
                                "chunks_deduped": 0, "bytes_saved": 0})
                 for kk in agg:
                     agg[kk] += int(drow.get(kk, 0) or 0)
-        lines.append(
-            "# TYPE ceph_tpu_dedup_chunks_stored_total counter")
+        _fam_header(lines, "ceph_tpu_dedup_chunks_stored_total",
+                    "counter",
+                    "chunks newly written to the chunk store")
         for pid in sorted(dedup):
             lines.append(
                 'ceph_tpu_dedup_chunks_stored_total{pool_id="%s"} %d'
                 % (pid, dedup[pid]["chunks_stored"]))
-        lines.append(
-            "# TYPE ceph_tpu_dedup_chunks_deduped_total counter")
+        _fam_header(lines, "ceph_tpu_dedup_chunks_deduped_total",
+                    "counter",
+                    "chunks answered by an existing content address")
         for pid in sorted(dedup):
             lines.append(
                 'ceph_tpu_dedup_chunks_deduped_total{pool_id="%s"} %d'
                 % (pid, dedup[pid]["chunks_deduped"]))
-        lines.append(
-            "# TYPE ceph_tpu_dedup_bytes_saved_total counter")
+        _fam_header(lines, "ceph_tpu_dedup_bytes_saved_total",
+                    "counter",
+                    "logical bytes that never hit the chunk store")
         for pid in sorted(dedup):
             lines.append(
                 'ceph_tpu_dedup_bytes_saved_total{pool_id="%s"} %d'
@@ -358,16 +405,21 @@ class Manager:
         # integrity-plane summary series (the scrub_* families the
         # exporter lint pins): damaged-PG count beside the summed
         # error total the pool/cluster gauges above already carry
-        lines.append("# TYPE ceph_tpu_scrub_inconsistent_pgs gauge")
+        _fam_header(lines, "ceph_tpu_scrub_inconsistent_pgs",
+                    "gauge",
+                    "PGs with unrepaired scrub inconsistencies")
         lines.append("ceph_tpu_scrub_inconsistent_pgs %d"
                      % self.pgmap.inconsistent_pgs(now, pools))
-        lines.append("# TYPE ceph_tpu_scrub_errors_total gauge")
+        _fam_header(lines, "ceph_tpu_scrub_errors_total", "gauge",
+                    "summed scrub error count across pools")
         lines.append("ceph_tpu_scrub_errors_total %d"
                      % totals.get("scrub_errors", 0))
         hist = self.pgmap.op_size_hist(now)
         if hist:
             fam = "ceph_tpu_cluster_op_size_bytes"
-            lines.append("# TYPE %s histogram" % fam)
+            _fam_header(lines, fam, "histogram",
+                        "client write size distribution "
+                        "(pow2 byte buckets)")
             cum = 0
             for i, n in enumerate(hist):
                 cum += n
@@ -387,7 +439,8 @@ class Manager:
         rows = self.pgmap.live_osd_stats(now)
         lines: list[str] = []
         fam = "ceph_tpu_log_messages_total"
-        lines.append("# TYPE %s counter" % fam)
+        _fam_header(lines, fam, "counter",
+                    "cluster-log emissions by daemon and level")
         clog_rows = {d: (row.get("log_messages") or {})
                      for d, row in rows.items()}
         clog_rows["mgr"] = self.clog.counts_wire()
@@ -398,7 +451,8 @@ class Manager:
                     % (fam, daemon, level, clog_rows[daemon][level]))
         for fam, key in (("ceph_tpu_osd_statfs_total_bytes", "total"),
                          ("ceph_tpu_osd_statfs_used_bytes", "used")):
-            lines.append("# TYPE %s gauge" % fam)
+            _fam_header(lines, fam, "gauge",
+                        "per-OSD store statfs %s bytes" % key)
             for daemon in sorted(rows):
                 sf = rows[daemon].get("statfs")
                 if sf:
@@ -454,7 +508,8 @@ class Manager:
         lines: list[str] = []
         for fam, key in (("ceph_tpu_tenant_ops_total", "ops"),
                          ("ceph_tpu_tenant_errors_total", "errors")):
-            lines.append("# TYPE %s counter" % fam)
+            _fam_header(lines, fam, "counter",
+                        "per-tenant %s (cardinality-capped)" % key)
             for t in sorted(rows):
                 lines.append('%s{tenant="%s"} %d'
                              % (fam, t, rows[t][key]))
@@ -463,14 +518,17 @@ class Manager:
             lines.extend(hist_lines("ceph_tpu_tenant_op_seconds",
                                     rows[t]["total_hist"],
                                     labels='tenant="%s"' % t,
-                                    typed=typed))
+                                    typed=typed,
+                                    desc="per-tenant end-to-end op "
+                                         "latency (us pow2 buckets)"))
         slo = self.slo.evaluate(now)
         for fam, key in (("ceph_tpu_tenant_slo_burn_fast",
                           "burn_fast"),
                          ("ceph_tpu_tenant_slo_burn_slow",
                           "burn_slow"),
                          ("ceph_tpu_tenant_p99_ms", "p99_ms")):
-            lines.append("# TYPE %s gauge" % fam)
+            _fam_header(lines, fam, "gauge",
+                        "per-tenant SLO engine %s" % key)
             for t in sorted(slo):
                 if t not in rows:
                     continue    # capped out of the label space
@@ -518,6 +576,18 @@ class Manager:
                 self.slo.ingest(now,
                                 self.pgmap.live_osd_stats(now))
                 digest["slo"] = self.slo.evaluate(now)
+                # history plane: one extraction pass feeds both the
+                # downsampled rings and the anomaly rules; active
+                # anomalies ride the digest so the mon can commit
+                # the PERF_ANOMALY raise/clear edges through paxos
+                import time as _wall
+                t_h0 = _wall.perf_counter()
+                from .history import extract_samples
+                samples = extract_samples(digest)
+                self.history.ingest(_wall.time(), digest,
+                                    samples=samples)
+                digest["anomalies"] = self.anomaly.observe(samples)
+                self.history_ingest_s += _wall.perf_counter() - t_h0
             except Exception as e:
                 self.ctx.log.info("mgr", "digest failed: %r" % e)
                 continue

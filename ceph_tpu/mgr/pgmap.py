@@ -674,6 +674,11 @@ class PGMap:
         # data-reduction counters, the digest sums across the fleet
         # (the `status` dedup panel + bench --dedup oracle surface)
         dedup_pools: dict[str, dict] = {}
+        # long-flow progress rows (recovery drains, scrub sweeps):
+        # keyed "daemon:flowid" so two OSDs' drains never collide —
+        # `status` renders them and the mon leader diffs them into
+        # progress_start/finish bus events
+        progress: dict[str, dict] = {}
         for d, row in self.live_osd_stats(now).items():
             sf = row.get("statfs")
             if sf:
@@ -701,6 +706,8 @@ class PGMap:
                                "bytes_stored": 0, "bytes_saved": 0})
                 for kk in agg:
                     agg[kk] += int(drow.get(kk, 0) or 0)
+            for fid, prow in (row.get("progress") or {}).items():
+                progress["%s:%s" % (d, fid)] = dict(prow)
         return {
             "num_pgs": sum(r["num_pgs"] for r in per_pool.values()),
             "pg_states": states,
@@ -723,6 +730,9 @@ class PGMap:
             # pool -> summed dedup counters (what the data-reduction
             # plane measurably saves)
             "dedup_pools": dedup_pools,
+            # daemon:flowid -> fraction-complete rows for long
+            # background flows (the `status` progress section)
+            "progress": progress,
             # per-daemon report freshness + prune visibility (the
             # `status` max-age/stale-count line)
             "reports": self.report_freshness(now),

@@ -162,15 +162,30 @@ class Monitor:
         # mutations ride the same paxos stream as map changes via
         # pending_svc
         from .services import (AuthMonitor, ConfigMonitor,
-                               CrashMonitor, HealthMonitor,
-                               LogMonitor)
+                               CrashMonitor, EventMonitor,
+                               HealthMonitor, LogMonitor)
 
         self.config_mon = ConfigMonitor(self)
         self.auth_mon = AuthMonitor(self)
         self.health_mon = HealthMonitor(self)
         self.log_mon = LogMonitor(self)
         self.crash_mon = CrashMonitor(self)
+        self.event_mon = EventMonitor(self)
         self.pending_svc: dict[str, list] = {}
+        # event-bus subscribers: conn -> last seq sent (each mon
+        # serves ITS subscribers from the replicated event log)
+        self.event_subs: dict = {}
+        # leader-side progress-row memory: digest key -> last
+        # fraction, the edge detector behind progress_start/finish
+        # events (soft state — a new leader re-announces in-flight
+        # flows, which a cursor dedups by seq, not by content)
+        self._progress_seen: dict = {}
+        # mon-side history rings: every mon folds each arriving mgr
+        # digest into its own store and serves `perf history` locally
+        # — no mon<->mgr query protocol, survives leader elections,
+        # and a dead mgr leaves explicit bucket gaps
+        from ..mgr.history import HistoryStore
+        self.history = HistoryStore(self.ctx)
         # service state loads BEFORE _load(): crash recovery replays
         # a pending blob through the same apply path, which rewrites
         # the persisted service images — replaying onto empty dicts
@@ -180,6 +195,7 @@ class Monitor:
         self.log_mon.load()
         self.health_mon.load()
         self.crash_mon.load()
+        self.event_mon.load()
         self._load()
 
     def _parse_disallowed(self, raw: str) -> set[int]:
@@ -244,7 +260,15 @@ class Monitor:
                 self.health_mon.apply(svc["health"], tx)
             if svc.get("crash"):
                 self.crash_mon.apply(svc["crash"], tx)
+            if svc.get("events"):
+                self.event_mon.apply(svc["events"], tx)
             self.store.submit_transaction(tx)
+            # committed events fan out from EVERY mon to its own
+            # watch-events subscribers (seqs are identical cluster-
+            # wide, so a client that re-subscribes elsewhere after an
+            # election resumes its cursor without gaps or dups)
+            if svc.get("events"):
+                self._push_events()
             if svc.get("config"):
                 self.config_mon.push_all()
             # committed = durable on a quorum: ack clog entries and
@@ -515,6 +539,74 @@ class Monitor:
             out.append(raw)
         return out
 
+    # -- event bus (EventMonitor fan-out) ----------------------------------
+
+    def emit_event(self, etype: str, message: str,
+                   data: dict | None = None) -> None:
+        """Stage one cluster event for the paxos-committed event log
+        (leader-only; EventMonitor.emit guards).  The single funnel
+        every emission site — health edges, boots, mark-downs,
+        progress transitions — goes through."""
+        self.event_mon.emit(etype, message, data=data)
+
+    def _push_events(self) -> None:
+        """Incremental fan-out after an events commit: each
+        subscriber gets exactly the committed rows past its cursor."""
+        from ..msg.messages import MMonEvents
+        for conn, have in list(self.event_subs.items()):
+            if not conn.is_open:
+                del self.event_subs[conn]
+                continue
+            rows = self.event_mon.after(have)
+            if not rows:
+                continue
+            conn.send(MMonEvents(events=rows,
+                                 last_seq=self.event_mon.last_seq))
+            self.event_subs[conn] = int(rows[-1]["seq"])
+
+    def _diff_progress(self, progress: dict) -> None:
+        """Leader-side edge detector over the digest's progress rows:
+        a new key emits progress_start, reaching 1.0 (or vanishing
+        short of it — daemon died, rows pruned) emits
+        progress_finish.  Exactly one finish per flow: a row that
+        lingers at 1.0 until the osd prunes it stays silent."""
+        seen = self._progress_seen
+        for key, row in progress.items():
+            frac = float(row.get("fraction") or 0.0)
+            prev = seen.get(key)
+            if prev is None:
+                self.emit_event(
+                    "progress_start", "%s %s started"
+                    % (row.get("kind"), key),
+                    data={"key": key, "kind": row.get("kind")})
+                seen[key] = frac
+                if frac >= 1.0:
+                    # the flow ran start-to-finish between two
+                    # digests: the bar never showed partial progress,
+                    # but the start/finish pair still must
+                    self.emit_event(
+                        "progress_finish", "%s %s complete"
+                        % (row.get("kind"), key),
+                        data={"key": key, "kind": row.get("kind"),
+                              "fraction": 1.0})
+            elif prev < 1.0 and frac >= 1.0:
+                self.emit_event(
+                    "progress_finish", "%s %s complete"
+                    % (row.get("kind"), key),
+                    data={"key": key, "kind": row.get("kind"),
+                          "fraction": 1.0})
+                seen[key] = frac
+            else:
+                seen[key] = max(prev, frac)
+        for key in [k for k in seen if k not in progress]:
+            if seen[key] < 1.0:
+                self.emit_event(
+                    "progress_finish", "%s ended at %d%%"
+                    % (key, int(seen[key] * 100)),
+                    data={"key": key,
+                          "fraction": round(seen[key], 4)})
+            del seen[key]
+
     def _send_map(self, conn, have: int = -1) -> None:
         if 0 <= have < self.osdmap.epoch:
             # bounded incremental catch-up: a subscriber a few epochs
@@ -555,8 +647,20 @@ class Monitor:
                               "accepted_pn")})
             return True
         from ..msg.messages import (MCrashReport, MLog, MLogAck,
-                                    MMonMgrDigest, MOSDBeacon,
-                                    MOSDPGTemp)
+                                    MMonMgrDigest, MMonWatchEvents,
+                                    MOSDBeacon, MOSDPGTemp)
+        if isinstance(msg, MMonWatchEvents):
+            # watch-events subscription (subscribe AND cursor renewal
+            # both land here): record the client's cursor and serve
+            # any committed backlog past it immediately
+            self.event_subs[conn] = int(msg.start or 0)
+            rows = self.event_mon.after(int(msg.start or 0))
+            if rows:
+                from ..msg.messages import MMonEvents
+                conn.send(MMonEvents(
+                    events=rows, last_seq=self.event_mon.last_seq))
+                self.event_subs[conn] = int(rows[-1]["seq"])
+            return True
         if isinstance(msg, MLog):
             self._handle_log(conn, msg.entries or [])
             return True
@@ -571,6 +675,10 @@ class Monitor:
         if isinstance(msg, MMonMgrDigest):
             self.mgr_digest = msg.digest or {}
             self.mgr_digest_stamp = time.monotonic()
+            # EVERY mon folds the digest into its local history rings
+            # (wall clock keys the buckets — a dead mgr leaves a hole,
+            # and whichever mon serves `perf history` has the data)
+            self.history.ingest(time.time(), self.mgr_digest)
             if self.is_leader() and \
                     (not self.multi or self.mpaxos.active):
                 totals = self.mgr_digest.get("totals") or {}
@@ -590,6 +698,13 @@ class Monitor:
                      if v.get("latency_violation")],
                     [t for t, v in slo.items()
                      if v.get("burn_alert")])
+                # history-plane anomaly edges: commit the shifted
+                # series names so PERF_ANOMALY survives elections
+                self.health_mon.maybe_commit_anomaly(
+                    self.mgr_digest.get("anomalies") or {})
+                # progress-row edges -> progress_start/finish events
+                self._diff_progress(
+                    self.mgr_digest.get("progress") or {})
             return True
         if isinstance(msg, MOSDBeacon):
             # beacons are derived soft state: EVERY mon records them,
@@ -657,6 +772,7 @@ class Monitor:
 
     def ms_handle_reset(self, conn) -> None:
         self.subscribers.pop(conn, None)
+        self.event_subs.pop(conn, None)
         if self.multi and conn.peer_entity.startswith("mon."):
             try:
                 rank = int(conn.peer_entity.split(".", 1)[1])
@@ -722,6 +838,17 @@ class Monitor:
             for e in sorted(batch, key=key):
                 if key(e) > base:
                     self.queue_svc_op("log", ("append", dict(e)))
+                    # daemon-originated ERR/WRN entries mirror onto
+                    # the event bus (the fresh-entry queue point is
+                    # the natural resend dedup).  Mon-self lines stay
+                    # off it — their transitions already ride as
+                    # dedicated health_edge / osd_* / progress types.
+                    if (e.get("level") in ("ERR", "WRN")
+                            and who != self.name):
+                        self.emit_event(
+                            "clog", str(e.get("message", "")),
+                            data={"who": who,
+                                  "level": e.get("level")})
 
     def _ack_log_commit(self, ops: list) -> None:
         tops: dict[str, tuple[int, int]] = {}
@@ -841,6 +968,8 @@ class Monitor:
                           % (osd, addr, self.osdmap.epoch))
         self.log_mon.append("INF", "osd.%d boot (epoch %d)"
                             % (osd, self.osdmap.epoch))
+        self.emit_event("osd_boot", "osd.%d booted at %s"
+                        % (osd, addr), data={"osd": osd})
 
     def _cmd_pg_scrub(self, prefix: str, cmd: dict) -> dict:
         """`ceph pg scrub|deep-scrub|repair <pgid>` (OSDMonitor
@@ -1029,6 +1158,9 @@ class Monitor:
                           % (target, len(reports)))
         self.log_mon.append("WRN", "osd.%d marked down (%d reporters)"
                             % (target, len(reports)))
+        self.emit_event("osd_down", "osd.%d marked down (%d "
+                        "reporters)" % (target, len(reports)),
+                        data={"osd": target})
         inc = self._pending()
         inc.new_state[target] = OSD_UP  # xor clears UP
         del self.failure_info[target]
@@ -1073,6 +1205,8 @@ class Monitor:
                 changed = True
                 self.ctx.log.info("mon", "marking osd.%d out" % osd)
                 self.log_mon.append("WRN", "osd.%d auto-out" % osd)
+                self.emit_event("osd_out", "osd.%d auto-out" % osd,
+                                data={"osd": osd})
         if changed:
             self._propose_pending()
 
@@ -1149,12 +1283,24 @@ class Monitor:
 
     def _run_command(self, prefix: str, cmd: dict) -> dict:
         # service command surfaces (ConfigMonitor/AuthMonitor/
-        # HealthMonitor/LogMonitor/CrashMonitor)
+        # HealthMonitor/LogMonitor/CrashMonitor/EventMonitor)
         for svc in (self.config_mon, self.auth_mon, self.health_mon,
-                    self.log_mon, self.crash_mon):
+                    self.log_mon, self.crash_mon, self.event_mon):
             out = svc.command(prefix, cmd)
             if out is not None:
                 return out
+        if prefix == "perf history":
+            # read-only history query against THIS mon's rings (the
+            # digest broadcast feeds every mon identically modulo
+            # arrival time); no series -> the retained inventory
+            series = cmd.get("series")
+            if not series:
+                return {"series": [[s, lb] for s, lb
+                                   in self.history.series_names()],
+                        "stats": self.history.stats()}
+            return self.history.query(
+                str(series), label=cmd.get("label"),
+                window=float(cmd.get("window") or 600.0))
         if prefix in _AUDIT_PREFIXES:
             # command provenance on the audit channel (the reference
             # mon's audit clog): only state-mutating prefixes — an
@@ -1354,6 +1500,18 @@ class Monitor:
             # totals (chunks stored vs deduped, logical bytes saved)
             # rendered beside repair_traffic — the dedup win is a
             # `status` line, not a bench-only figure
+            # progress panel: in-flight background flows (recovery
+            # drains, scrub sweeps) as fraction-complete rows — the
+            # reference's `ceph -s` progress section
+            prog = dig.get("progress") or {}
+            if prog:
+                out["progress"] = {
+                    str(k): {"kind": row.get("kind"),
+                             "done": int(row.get("done") or 0),
+                             "total": int(row.get("total") or 0),
+                             "fraction": float(
+                                 row.get("fraction") or 0.0)}
+                    for k, row in sorted(prog.items())}
             dd = dig.get("dedup_pools") or {}
             if dd:
                 out["dedup"] = {

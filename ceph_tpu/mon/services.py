@@ -43,8 +43,10 @@ AUTH_KEY = b"svc:auth"
 LOG_KEY = b"svc:log"
 HEALTH_KEY = b"svc:health"
 CRASH_KEY = b"svc:crash"
+EVENTS_KEY = b"svc:events"
 
 LOG_CAP = 1000
+EVENT_CAP = 1000
 
 
 class ConfigMonitor:
@@ -228,7 +230,8 @@ class HealthMonitor:
         self.persisted: dict = {"slow": {}, "devflb": {},
                                 "pgdeg": 0, "pgavail": 0,
                                 "scruberr": 0, "pgdmg": 0,
-                                "slolat": [], "sloburn": []}
+                                "slolat": [], "sloburn": [],
+                                "perfanom": []}
 
     # -- persistence / replay ------------------------------------------
 
@@ -249,7 +252,9 @@ class HealthMonitor:
                 "slolat": sorted(str(t)
                                  for t in (d.get("slolat") or [])),
                 "sloburn": sorted(str(t)
-                                  for t in (d.get("sloburn") or []))}
+                                  for t in (d.get("sloburn") or [])),
+                "perfanom": sorted(
+                    str(t) for t in (d.get("perfanom") or []))}
 
     def apply(self, ops: list, tx) -> None:
         """Deterministic commit apply (every mon runs this)."""
@@ -268,7 +273,7 @@ class HealthMonitor:
                     self.persisted["devflb"].pop(int(osd), None)
             elif op[0] in ("pgdeg", "pgavail", "scruberr", "pgdmg"):
                 self.persisted[op[0]] = int(op[1])
-            elif op[0] in ("slolat", "sloburn"):
+            elif op[0] in ("slolat", "sloburn", "perfanom"):
                 self.persisted[op[0]] = sorted(
                     str(t) for t in (op[1] or []))
         tx.set(HEALTH_KEY, denc.encode(
@@ -279,7 +284,19 @@ class HealthMonitor:
              "scruberr": int(self.persisted["scruberr"]),
              "pgdmg": int(self.persisted["pgdmg"]),
              "slolat": list(self.persisted["slolat"]),
-             "sloburn": list(self.persisted["sloburn"])}))
+             "sloburn": list(self.persisted["sloburn"]),
+             "perfanom": list(self.persisted["perfanom"])}))
+
+    def _edge(self, level: str, check: str, message: str) -> None:
+        """One health-check transition: clog it (the reference clogs
+        every edge) AND mirror it onto the event bus, so a live
+        watch-events cursor sees the raise/clear the moment it
+        commits."""
+        self.mon.log_mon.append(level, message)
+        emit = getattr(self.mon, "emit_event", None)
+        if emit is not None:
+            emit("health_edge", message,
+                 data={"check": check, "raised": level != "INF"})
 
     def maybe_commit(self, osd: int, slow: int, devflb: int) -> None:
         """Leader-side: stage a health svc op when a beacon changes
@@ -304,12 +321,14 @@ class HealthMonitor:
             # the health op, so every mon's `log last` shows them
             if (int(slow) > 0) != (cur > 0):
                 if int(slow):
-                    self.mon.log_mon.append(
-                        "WRN", "Health check failed: %d slow ops on "
+                    self._edge(
+                        "WRN", "SLOW_OPS",
+                        "Health check failed: %d slow ops on "
                         "osd.%d (SLOW_OPS)" % (int(slow), osd))
                 else:
-                    self.mon.log_mon.append(
-                        "INF", "Health check cleared: SLOW_OPS "
+                    self._edge(
+                        "INF", "SLOW_OPS",
+                        "Health check cleared: SLOW_OPS "
                         "(osd.%d)" % osd)
         cur = pending_val("devflb")
         if cur is None:
@@ -318,13 +337,15 @@ class HealthMonitor:
             self.mon.queue_svc_op("health",
                                   ("devflb", osd, int(devflb)))
             if int(devflb):
-                self.mon.log_mon.append(
-                    "WRN", "Health check failed: osd.%d on host "
+                self._edge(
+                    "WRN", "DEVICE_FALLBACK",
+                    "Health check failed: osd.%d on host "
                     "fallback, device chip %d lost "
                     "(DEVICE_FALLBACK)" % (osd, int(devflb) - 1))
             else:
-                self.mon.log_mon.append(
-                    "INF", "Health check cleared: DEVICE_FALLBACK "
+                self._edge(
+                    "INF", "DEVICE_FALLBACK",
+                    "Health check cleared: DEVICE_FALLBACK "
                     "(osd.%d)" % osd)
 
     def maybe_commit_digest(self, degraded: int, inactive: int,
@@ -363,12 +384,14 @@ class HealthMonitor:
             if (val > 0) != (cur > 0):
                 self.mon.queue_svc_op("health", (kind, val))
                 if val:
-                    self.mon.log_mon.append(
-                        "WRN", "Health check failed: %s (%s)"
+                    self._edge(
+                        "WRN", check,
+                        "Health check failed: %s (%s)"
                         % (what % val, check))
                 else:
-                    self.mon.log_mon.append(
-                        "INF", "Health check cleared: %s" % check)
+                    self._edge(
+                        "INF", check,
+                        "Health check cleared: %s" % check)
 
     def maybe_commit_slo(self, lat_tenants: list,
                          burn_tenants: list) -> None:
@@ -398,16 +421,48 @@ class HealthMonitor:
             self.mon.queue_svc_op("health", (kind, val))
             if bool(val) != bool(cur):
                 if val:
-                    self.mon.log_mon.append(
-                        "WRN", "Health check failed: tenant(s) %s "
+                    self._edge(
+                        "WRN", check,
+                        "Health check failed: tenant(s) %s "
                         "%s (%s)"
                         % (",".join(val),
                            "over latency objective"
                            if kind == "slolat"
                            else "burning SLO error budget", check))
                 else:
-                    self.mon.log_mon.append(
-                        "INF", "Health check cleared: %s" % check)
+                    self._edge(
+                        "INF", check,
+                        "Health check cleared: %s" % check)
+
+    def maybe_commit_anomaly(self, anomalies: dict) -> None:
+        """Leader-side: persist the ACTIVE PERF_ANOMALY series names
+        from the mgr digest through paxos — same edges-only contract
+        as the SLO sets (a steady anomaly burns no rounds; the name
+        list commits when it changes), so a freshly elected leader
+        still names the shifted series before any digest reaches
+        it."""
+        pend = self.mon.pending_svc.get("health", [])
+        val = sorted(map(str, anomalies or ()))
+        cur = None
+        for op in reversed(pend):
+            if op[0] == "perfanom":
+                cur = list(op[1])
+                break
+        if cur is None:
+            cur = list(self.persisted["perfanom"])
+        if val == cur:
+            return
+        self.mon.queue_svc_op("health", ("perfanom", val))
+        if bool(val) != bool(cur):
+            if val:
+                self._edge(
+                    "WRN", "PERF_ANOMALY",
+                    "Health check failed: sustained perf shift on "
+                    "series %s (PERF_ANOMALY)" % ",".join(val))
+            else:
+                self._edge(
+                    "INF", "PERF_ANOMALY",
+                    "Health check cleared: PERF_ANOMALY")
 
     # -- merged beacon views -------------------------------------------
 
@@ -546,6 +601,8 @@ class HealthMonitor:
                              if v.get("latency_violation"))
             slo_burn = sorted(t for t, v in slo_detail.items()
                               if v.get("burn_alert"))
+            anom_detail = dig.get("anomalies") or {}
+            anom = sorted(anom_detail)
         else:
             degraded = int(self.persisted["pgdeg"])
             unfound = 0
@@ -556,6 +613,8 @@ class HealthMonitor:
             # warning until digests reach this mon
             slo_lat = list(self.persisted["slolat"])
             slo_burn = list(self.persisted["sloburn"])
+            anom_detail = {}
+            anom = list(self.persisted["perfanom"])
         if degraded or unfound:
             detail = ["%d object copies degraded" % degraded]
             if unfound:
@@ -629,6 +688,25 @@ class HealthMonitor:
                     else "tenant %s burning error budget "
                          "(committed edge)" % t
                     for t in slo_burn[:10]]}
+        # PERF_ANOMALY (the history plane, mgr/history.py): a series
+        # whose EWMA z-score ran hot for the sustain window.  A fresh
+        # digest carries the live magnitude; the paxos-committed name
+        # list fills in for a freshly elected leader.
+        if anom:
+            out["PERF_ANOMALY"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "%d series shifted from baseline: %s"
+                           % (len(anom), anom[:10]),
+                "series": anom,
+                "detail": [
+                    "%s at %.4g vs baseline %.4g (z=%.1f)"
+                    % (n, (anom_detail.get(n) or {}).get("value", 0),
+                       (anom_detail.get(n) or {}).get("mean", 0),
+                       (anom_detail.get(n) or {}).get("z", 0))
+                    if n in anom_detail
+                    else "%s shifted from baseline "
+                         "(committed edge)" % n
+                    for n in anom[:10]]}
         # RECENT_CRASH (the crash module's health check): any
         # un-archived crash report newer than mon_crash_warn_age.
         # The crash table is itself paxos-committed, so a freshly
@@ -874,3 +952,78 @@ class CrashMonitor:
             self.mon.queue_svc_op("crash", ("rm", cmd.get("id")))
             return {}
         return None
+
+
+class EventMonitor:
+    """Bounded, sequence-numbered cluster event log — the backing
+    store of the `rados watch-events` stream (the reference's
+    `ceph -w`).  Events (health edges, clog ERR/WRN, osd boot / down /
+    out, progress start/finish) commit through paxos as
+    ``("emit", row)`` svc ops; the seq is assigned DETERMINISTICALLY
+    at apply() time (``last_seq + 1``), so every monitor holds an
+    identical contiguous sequence and a cursor survives a leader
+    election with no gaps and no duplicate seqs.  Uncommitted pending
+    events die with a failed leader — the committed stream stays
+    contiguous, which is the cursor contract.  Stamps ride in the op
+    payload (set at emit time on the leader), so every mon applies
+    identical rows."""
+
+    def __init__(self, mon):
+        self.mon = mon
+        self.events: list[dict] = []    # capped ring, seq ascending
+        self.last_seq = 0
+
+    def load(self) -> None:
+        raw = self.mon.store.get(EVENTS_KEY)
+        if raw is None:
+            return
+        d = denc.decode(raw)
+        self.events = [dict(e) for e in (d.get("events") or [])]
+        self.last_seq = int(d.get("last_seq") or 0)
+
+    def apply(self, ops: list, tx) -> None:
+        for op in ops:
+            if op[0] != "emit":
+                continue
+            e = dict(op[1])
+            self.last_seq += 1
+            e["seq"] = self.last_seq
+            self.events.append(e)
+        if len(self.events) > EVENT_CAP:
+            self.events = self.events[-EVENT_CAP:]
+        tx.set(EVENTS_KEY, denc.encode(
+            {"events": self.events, "last_seq": self.last_seq}))
+
+    def emit(self, etype: str, message: str,
+             data: dict | None = None) -> None:
+        """Leader-side: stage one event for the next paxos round.
+        Peons never originate events (their trigger sites — beacon
+        edges, digest folds — only run on the leader anyway; this
+        guard makes stray calls harmless)."""
+        if not self.mon.is_leader():
+            return
+        row = {"type": str(etype), "message": str(message),
+               "stamp": time.time()}
+        if data:
+            row["data"] = dict(data)
+        self.mon.queue_svc_op("events", ("emit", row))
+
+    def after(self, cursor: int, limit: int = 500) -> list[dict]:
+        """Committed events with seq > cursor (the incremental read
+        every MMonEvents batch and `events` command serves).  A
+        cursor older than the ring floor simply starts at the floor —
+        the ring is bounded; history that aged out is gone."""
+        cursor = int(cursor)
+        if not self.events or cursor >= self.last_seq:
+            return []
+        # ring is seq-ascending and contiguous: index directly
+        floor = int(self.events[0]["seq"])
+        start = max(0, cursor - floor + 1)
+        return [dict(e) for e in self.events[start:start + limit]]
+
+    def command(self, prefix: str, cmd: dict):
+        if prefix != "events":
+            return None
+        rows = self.after(int(cmd.get("after", 0)),
+                          limit=int(cmd.get("n", 500)))
+        return {"events": rows, "last_seq": self.last_seq}

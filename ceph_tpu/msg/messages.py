@@ -235,6 +235,31 @@ class MOSDPGTemp(Message):
 
 
 @register
+class MMonWatchEvents(Message):
+    """Client -> mon: subscribe to the committed event stream from
+    cursor `start` (exclusive — the MMonSubscribe shape applied to
+    the event bus).  Sent again with the current cursor to renew
+    after a reconnect; the mon replies with any committed backlog
+    past the cursor and pushes MMonEvents batches as commits land."""
+
+    TYPE = "mon_watch_events"
+    FIELDS = ("start",)
+
+
+@register
+class MMonEvents(Message):
+    """mon -> watching client: committed event rows past the
+    subscriber's cursor, seq-ascending ({seq, type, stamp, message,
+    data?}); last_seq is the mon's committed top.  Seqs are assigned
+    at paxos apply, so every mon streams the identical contiguous
+    sequence — a client that re-subscribes elsewhere after an
+    election resumes with no gaps and no duplicates."""
+
+    TYPE = "mon_events"
+    FIELDS = ("events", "last_seq")
+
+
+@register
 class MMonCommand(Message):
     """Generic admin command (MMonCommand.h): {"prefix": ..., args}."""
 

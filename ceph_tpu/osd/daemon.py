@@ -203,6 +203,10 @@ class OSD:
         # one periodic scrub at a time per daemon (the reference's
         # scrubs_local bound collapsed to 1)
         self._scrub_running = False
+        # long-flow progress rows (recovery drains, scrub sweeps):
+        # shipped in osd_stats["progress"] each MMgrReport
+        from .progress import ProgressTracker
+        self.progress = ProgressTracker()
         # client write-size histogram (pow2 byte buckets, cumulative):
         # reported to the mgr for the cluster op-size profile and used
         # to derive workload-aware device warmup buckets (bucket i
@@ -1456,6 +1460,24 @@ class OSD:
         if fr is not None and had:
             fr.span("recovery", t0, meta={"pgid": str(pg.pgid)})
 
+    def _note_recovery_progress(self, pg: PG) -> None:
+        """Drain the PG's recovery progress row: outstanding work is
+        what the primary still lacks plus what its peers lack; zero
+        outstanding finishes the bar."""
+        outstanding = (len(pg.missing)
+                       + sum(len(m)
+                             for m in pg.peer_missing.values()))
+        self.progress.drain("recovery/%s" % pg.pgid, outstanding)
+
+    def _progress_rows(self) -> dict:
+        """Report-time progress snapshot: refresh each primary's
+        recovery drain first so a flow whose last push landed between
+        reports still reaches 1.0 rather than stalling."""
+        for pg in self.pgs.values():
+            if pg.is_primary():
+                self._note_recovery_progress(pg)
+        return self.progress.rows()
+
     async def _replicated_recover(self, pg: PG) -> None:
         """Paced replicated recovery: pull/push in chunks, each chunk
         admitted through the mClock 'recovery' class so client I/O
@@ -1467,6 +1489,11 @@ class OSD:
         pg._recovery_flow = True
         had_work = bool(pg.missing
                         or any(pg.peer_missing.values()))
+        if had_work:
+            self.progress.start(
+                "recovery", str(pg.pgid),
+                total=len(pg.missing) + sum(
+                    len(m) for m in pg.peer_missing.values()))
         t_rec0 = self.optracker.now()
         chunk = 16
         acting0 = list(pg.acting)
@@ -1529,6 +1556,8 @@ class OSD:
         finally:
             pg._recovery_flow = False
             self._span_recovery(pg, t_rec0, had_work)
+            if had_work:
+                self._note_recovery_progress(pg)
 
     async def _ec_recover(self, pg: PG) -> None:
         """EC recovery: reconstruct (never copy) shards
@@ -1540,6 +1569,11 @@ class OSD:
         pg._recovery_flow = True
         had_work = bool(pg.missing
                         or any(pg.peer_missing.values()))
+        if had_work:
+            self.progress.start(
+                "recovery", str(pg.pgid),
+                total=len(pg.missing) + sum(
+                    len(m) for m in pg.peer_missing.values()))
         t_rec0 = self.optracker.now()
         try:
             await self.ec.recover_primary_shards(pg)
@@ -1550,6 +1584,8 @@ class OSD:
         finally:
             pg._recovery_flow = False
             self._span_recovery(pg, t_rec0, had_work)
+            if had_work:
+                self._note_recovery_progress(pg)
         if not pg.missing:
             self._requeue_waiters(pg)
 
@@ -1641,6 +1677,7 @@ class OSD:
             # progress counted here (peer pushes count on the reply)
             pg.stats.note_recovery(len(done), sum(
                 len(p.get("data") or b"") for p in msg.pushes))
+            self._note_recovery_progress(pg)
         conn.send(MOSDPGPushReply(pool=msg.pool, ps=msg.ps,
                                   epoch=msg.epoch, oids=done))
         if pg.is_primary() and not pg.missing:
@@ -1660,6 +1697,7 @@ class OSD:
                 if pm.pop(oid, None) is not None:
                     recovered += 1
             pg.stats.note_recovery(recovered)
+            self._note_recovery_progress(pg)
             # degraded-object writes park until their replicas are
             # whole again: re-gate them now
             if pg.waiting_for_active and pg.state == STATE_ACTIVE:
@@ -2701,6 +2739,8 @@ class OSD:
         PG_DAMAGED spuriously.  Failures are logged, never crash
         reports — an interval change or pool delete mid-scrub is
         routine, not a post-mortem."""
+        fid = self.progress.start(
+            "deep-scrub" if deep else "scrub", str(pg.pgid), total=1)
         try:
             res = await self.scrubber.scrub_pg(pg, deep=deep,
                                                recheck=True)
@@ -2717,6 +2757,7 @@ class OSD:
                 % (self.whoami, pg.pgid, e))
         finally:
             self._scrub_running = False
+            self.progress.finish(fid)
 
     def _maybe_send_beacon(self) -> None:
         """MOSDBeacon to the mons: liveness plus the slow-op count
@@ -2945,7 +2986,11 @@ class OSD:
                                      | set(self.tenant_ops))},
                        # clog emission counters
                        # (ceph_tpu_log_messages_total)
-                       "log_messages": self.clog.counts_wire()}),
+                       "log_messages": self.clog.counts_wire(),
+                       # long-flow progress rows (recovery drains,
+                       # scrub sweeps) — digest progress section +
+                       # progress_start/finish events on the bus
+                       "progress": self._progress_rows()}),
             entity_hint="mgr")
 
     def _handle_ping(self, conn, msg: MOSDPing) -> None:

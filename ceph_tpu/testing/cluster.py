@@ -83,6 +83,10 @@ FAST_CONF = {
     "slo_fast_window": 2.0,
     "slo_slow_window": 5.0,
     "slo_min_ops": 10,
+    # history plane at dev pacing: a sub-second finest tier so `perf
+    # history` rows fill (and mgr-death gaps are visible) within a
+    # thrash round — production tiers are 5s/30s/5min
+    "history_tiers": "0.5:120,2:120,10:288",
 }
 
 
@@ -294,6 +298,27 @@ class LocalCluster:
 
     async def mark_in(self, i: int) -> None:
         await self.client.mon_command("osd in", id=i)
+
+    # -- mgr helpers -------------------------------------------------------
+
+    async def kill_mgr(self) -> None:
+        """Hard-stop the manager: digests stop flowing, the mons'
+        staleness clock starts, and history rings record a gap."""
+        if self.mgr is not None:
+            await self.mgr.shutdown()
+            self.mgr = None
+
+    async def revive_mgr(self):
+        """Start a FRESH manager (new PGMap, new history rings — the
+        mgr is soft state): daemons re-report within an interval and
+        digests resume."""
+        from ..mgr import Manager
+        self.mgr = Manager(self.mon_addrs,
+                           Context("mgr", conf_overrides=self.conf))
+        self.mgr.balancer_enabled = False
+        self._install_injector(self.mgr.msgr, "mgr")
+        await self.mgr.start()
+        return self.mgr
 
     # -- pools / health ----------------------------------------------------
 
@@ -657,6 +682,17 @@ class LocalCluster:
         """Recovery objects/s from the digest (0.0 pre-digest)."""
         v = self._digest_total("recovery_ops_s")
         return 0.0 if v is None else float(v)
+
+    # -- event bus (committed-stream oracle) -------------------------------
+
+    def event_stream(self, start: int = 0) -> list[dict]:
+        """Test oracle for the mon event bus: subscribes the harness
+        client's cursor and returns the LIVE list rows append to —
+        each committed event exactly once, in seq order, surviving
+        mon failover (assert on seq contiguity for gap/dup checks)."""
+        rows: list[dict] = []
+        self.client.watch_events(rows.append, start=start)
+        return rows
 
     async def wait_stats(self, pred, timeout: float = 30.0,
                          what: str = "stats condition") -> None:

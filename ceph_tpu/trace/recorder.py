@@ -352,6 +352,29 @@ def chrome_trace(rings: dict[str, list[dict]],
                          "stream": t.get("stream"),
                          "queue_wait": t.get("queue_wait"),
                          "ok": t.get("ok")}})
+        # counter tracks (ph:"C"): per-chip in-flight dispatches
+        # (busy: +1 at launch, -1 at done) and queue depth (+1 at
+        # enqueue, -1 at launch), edge-walked from the same tickets
+        # — Perfetto renders them as the counter view of the
+        # utilization integrals, beside the slices they explain
+        for chip in sorted({int(t.get("chip") or 0) for t in device}):
+            edges: list[tuple[float, str, int]] = []
+            for t in device:
+                if int(t.get("chip") or 0) != chip:
+                    continue
+                if t.get("t_enqueue") and t.get("t_launch"):
+                    edges.append((t["t_enqueue"], "queue_depth", 1))
+                    edges.append((t["t_launch"], "queue_depth", -1))
+                if t.get("t_launch") and t.get("t_done"):
+                    edges.append((t["t_launch"], "busy", 1))
+                    edges.append((t["t_done"], "busy", -1))
+            counts = {"busy": 0, "queue_depth": 0}
+            for stamp, key, delta in sorted(edges):
+                counts[key] += delta
+                events.append({
+                    "ph": "C", "name": "chip-%d %s" % (chip, key),
+                    "cat": "device", "pid": dpid, "ts": us(stamp),
+                    "args": {key: counts[key]}})
 
     # stable order: metadata first, then slices sorted by ts (a
     # stable sort keeps a stage slice after its enclosing op slice at
@@ -371,6 +394,7 @@ _REQUIRED_KEYS = {
     "s": ("id", "ph", "ts", "pid", "tid"),
     "t": ("id", "ph", "ts", "pid", "tid"),
     "f": ("id", "ph", "ts", "pid", "tid"),
+    "C": ("name", "ph", "ts", "pid", "args"),
 }
 
 
@@ -378,9 +402,11 @@ def validate_chrome_trace(doc) -> list[str]:
     """Chrome-trace schema lint (the test oracle, shaped like
     utils.exporter.validate_exposition): the document must carry a
     `traceEvents` list, every event its phase's required keys with
-    numeric stamps and non-negative durations, and complete (`X`)
-    events must appear in non-decreasing `ts` order per (pid, tid)
-    track.  Returns human-readable violations; empty means clean."""
+    numeric stamps and non-negative durations, complete (`X`) events
+    in non-decreasing `ts` order per (pid, tid) track, and counter
+    (`C`) events carrying numeric, never-negative sample values in
+    non-decreasing `ts` order per (pid, name) counter track.
+    Returns human-readable violations; empty means clean."""
     errors: list[str] = []
     if not isinstance(doc, dict) or not isinstance(
             doc.get("traceEvents"), list):
@@ -421,4 +447,24 @@ def validate_chrome_trace(doc) -> list[str]:
                     "event %d: ts %.3f regresses on track %r"
                     % (i, ts, track))
             last_ts[track] = ts
+        elif ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args:
+                errors.append("event %d: counter without samples" % i)
+                continue
+            for k, v in args.items():
+                if not isinstance(v, (int, float)):
+                    errors.append(
+                        "event %d: counter %r sample %r non-numeric"
+                        % (i, k, v))
+                elif v < 0:
+                    errors.append(
+                        "event %d: counter %r went negative (%g) — "
+                        "unbalanced edge walk" % (i, k, v))
+            ctrack = ("C", ev["pid"], ev["name"])
+            if ts < last_ts.get(ctrack, float("-inf")):
+                errors.append(
+                    "event %d: counter ts %.3f regresses on %r"
+                    % (i, ts, ctrack))
+            last_ts[ctrack] = ts
     return errors

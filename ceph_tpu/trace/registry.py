@@ -139,6 +139,46 @@ MGR_SERIES = frozenset({
     "ceph_tpu_dedup_bytes_saved_total",
 })
 
+# history plane: the downsampled series names mgr/history.py's
+# extract_samples emits from each digest tick (the `perf history`
+# query namespace and the anomaly engine's watch list)
+HISTORY_SERIES = frozenset({
+    "io.read_ops_s", "io.write_ops_s",
+    "io.read_bytes_s", "io.write_bytes_s",
+    "recovery.ops_s", "recovery.bytes_s",
+    "pg.degraded", "pg.misplaced",              # label: pool id
+    "device.busy_frac", "device.queue_wait_frac",   # label: chip
+    "tenant.p99_ms", "tenant.burn_fast",        # label: tenant
+    "repair.bytes_read", "repair.bytes_moved",
+    "dedup.bytes_stored", "dedup.bytes_saved",
+})
+
+# event bus: the committed event types the mon emits (EventMonitor
+# rows; `watch-events` / event_stream consumers switch on these)
+EVENT_TYPES = frozenset({
+    "health_edge", "clog", "osd_boot", "osd_down", "osd_out",
+    "progress_start", "progress_finish",
+})
+
+# consumers referencing history series / event types by literal —
+# every entry must be registered AND still present in the file
+CONSUMER_HISTORY_REFS = {
+    "bench.py": (
+        "io.write_ops_s", "device.busy_frac",
+    ),
+    "tests/test_history.py": (
+        "io.write_ops_s", "device.busy_frac", "tenant.p99_ms",
+        "pg.degraded",
+    ),
+}
+
+CONSUMER_EVENT_REFS = {
+    "tests/test_events.py": (
+        "health_edge", "osd_boot", "osd_down",
+        "progress_start", "progress_finish",
+    ),
+}
+
 # consumers referencing the ingest families by literal (the bench
 # ingest leg asserts its exposition render; the ingest tests pin the
 # scrape surface) — every entry must be registered AND present
@@ -451,11 +491,98 @@ def lint_consumers(root: str | None = None) -> list[str]:
     return errors
 
 
+_HISTORY_SERIES_RE = re.compile(r'"([a-z]+\.[a-z0-9_]+)"')
+
+_EVENT_EMIT_RE = re.compile(r'\bemit(?:_event)?\(\s*"([a-z_]+)"')
+
+
+def lint_history_plane(root: str | None = None) -> list[str]:
+    """History-plane drift lint: every dotted series literal in
+    mgr/history.py (the single emission module) must be registered
+    in HISTORY_SERIES and vice versa, and every consumer reference
+    must be a registered series still literally present in the
+    consumer's source."""
+    errors: list[str] = []
+    base = _repo_root(root)
+    hist_path = os.path.join(base, "ceph_tpu", "mgr", "history.py")
+    try:
+        with open(hist_path) as f:
+            hist_src = f.read()
+    except OSError:
+        return ["ceph_tpu/mgr/history.py is missing"]
+    emitted = set(_HISTORY_SERIES_RE.findall(hist_src))
+    for name in sorted(emitted - HISTORY_SERIES):
+        errors.append("history series %r emitted by mgr/history.py"
+                      " is not registered in"
+                      " trace.registry.HISTORY_SERIES" % name)
+    for name in sorted(HISTORY_SERIES - emitted):
+        errors.append("registered history series %r is no longer"
+                      " emitted by mgr/history.py" % name)
+    for relpath, names in sorted(CONSUMER_HISTORY_REFS.items()):
+        path = os.path.join(base, relpath)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            errors.append("consumer %s is missing" % relpath)
+            continue
+        for name in names:
+            if name not in HISTORY_SERIES:
+                errors.append(
+                    "%s references unregistered history series %r"
+                    % (relpath, name))
+            if '"%s"' % name not in src:
+                errors.append(
+                    "%s no longer references history series %r"
+                    " (stale CONSUMER_HISTORY_REFS entry?)"
+                    % (relpath, name))
+    return errors
+
+
+def lint_event_plane(root: str | None = None) -> list[str]:
+    """Event-bus drift lint: every event type emitted in the mon
+    package (`emit_event("...")` / the HealthMonitor's `emit("...")`
+    funnel) must be registered in EVENT_TYPES and vice versa, and
+    every consumer reference must be registered AND still literally
+    present in the consumer's source."""
+    errors: list[str] = []
+    base = _repo_root(root)
+    mon_pkg = os.path.join(base, "ceph_tpu", "mon")
+    emitted: set[str] = set()
+    for _path, src in _iter_sources(mon_pkg):
+        emitted.update(_EVENT_EMIT_RE.findall(src))
+    for name in sorted(emitted - EVENT_TYPES):
+        errors.append("emitted event type %r is not registered in"
+                      " trace.registry.EVENT_TYPES" % name)
+    for name in sorted(EVENT_TYPES - emitted):
+        errors.append("registered event type %r is no longer"
+                      " emitted by the mon" % name)
+    for relpath, names in sorted(CONSUMER_EVENT_REFS.items()):
+        path = os.path.join(base, relpath)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            errors.append("consumer %s is missing" % relpath)
+            continue
+        for name in names:
+            if name not in EVENT_TYPES:
+                errors.append(
+                    "%s references unregistered event type %r"
+                    % (relpath, name))
+            if '"%s"' % name not in src:
+                errors.append(
+                    "%s no longer references event type %r (stale"
+                    " CONSUMER_EVENT_REFS entry?)" % (relpath, name))
+    return errors
+
+
 def lint_repo(root: str | None = None) -> list[str]:
     """The tier-1 drift lint: emission sites vs registry vs consumer
     references, plus the live device-series check, the tenant SLO
-    plane (stage histograms + exporter families), and the mgr
-    telemetry-fabric ingest families."""
+    plane (stage histograms + exporter families), the mgr
+    telemetry-fabric ingest families, and the history/event planes."""
     return (lint_emissions(root) + lint_device_series()
             + lint_consumers(root) + lint_tenant_plane(root)
-            + lint_mgr_plane(root))
+            + lint_mgr_plane(root) + lint_history_plane(root)
+            + lint_event_plane(root))

@@ -484,4 +484,36 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("slo_min_ops", OPT_INT, 30,
            "minimum ops observed in the fast window before a"
            " tenant's SLO verdicts count (no alerts from noise)"),
+    # -- history plane (downsampled metric rings + anomaly edges) --------
+    Option("history_tiers", OPT_STR, "5:120,30:120,300:288",
+           "downsampling ladder of the history rings as"
+           " 'width_s:cells' pairs (default: ten minutes at 5s, an"
+           " hour at 30s, a day at 5min — fixed memory by"
+           " construction)"),
+    Option("history_label_max", OPT_INT, 32,
+           "distinct label values any history series may retain;"
+           " overflow labels are dropped AND counted"
+           " (dropped_labels), never silently folded"),
+    Option("history_anomaly_series", OPT_STR,
+           "device.busy_frac,device.queue_wait_frac,"
+           "tenant.p99_ms,tenant.burn_fast",
+           "comma-separated HISTORY_SERIES names the anomaly engine"
+           " watches for sustained upward shifts"),
+    Option("history_anomaly_z", OPT_FLOAT, 6.0,
+           "one-sided z-score a watched series must sustain to"
+           " raise PERF_ANOMALY (deliberately deaf: routine load"
+           " swings never page)"),
+    Option("history_anomaly_clear_z", OPT_FLOAT, 2.0,
+           "z-score a raised series must drop below (sustained) to"
+           " clear; between raise and clear the baseline is frozen"),
+    Option("history_anomaly_sustain", OPT_INT, 8,
+           "consecutive hot ticks before a shifted series raises"),
+    Option("history_anomaly_clear", OPT_INT, 4,
+           "consecutive cooled ticks before a raised series clears"),
+    Option("history_anomaly_min_samples", OPT_INT, 60,
+           "warm-up samples before a series' z-scores count (a"
+           " fresh baseline must settle before it can page)"),
+    Option("history_anomaly_alpha", OPT_FLOAT, 0.05,
+           "EWMA weight of the anomaly baseline's mean/variance"
+           " once warmed up"),
 ]

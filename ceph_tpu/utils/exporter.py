@@ -25,23 +25,26 @@ def _metric_name(*parts: str) -> str:
 
 
 def hist_lines(base: str, buckets: list,
-               labels: str = "", typed: set | None = None) -> list[str]:
+               labels: str = "", typed: set | None = None,
+               desc: str = "") -> list[str]:
     """Prometheus histogram series from a PerfCounters power-of-two
     microsecond histogram (bucket i counts samples < 2^(i+1) µs).
     `labels` is an optional pre-rendered label body ('daemon="osd.0"')
     merged into each bucket's le label — the per-daemon form the mgr
     renders from MMgrReports.  `typed` is an optional cross-call set
-    of family names that already emitted their `# TYPE` line: the
-    family's TYPE is emitted exactly once even when the same base
-    renders for many daemons (the exposition-format rule the lint
-    pins)."""
+    of family names that already emitted their `# HELP`/`# TYPE`
+    header: the header is emitted exactly once even when the same
+    base renders for many daemons (the exposition-format rule the
+    lint pins)."""
     lines = []
+    header = ["# HELP %s %s" % (base, desc or "pow2 histogram"),
+              "# TYPE %s histogram" % base]
     if typed is not None:
         if base not in typed:
             typed.add(base)
-            lines.append("# TYPE %s histogram" % base)
+            lines.extend(header)
     elif not labels:
-        lines.append("# TYPE %s histogram" % base)
+        lines.extend(header)
     cum = 0
     sep = "," if labels else ""
     for i, n in enumerate(buckets):
@@ -80,26 +83,33 @@ class PrometheusExporter:
                 v = float(fn())
             except Exception:
                 continue
-            if desc:
-                lines.append("# HELP %s %s" % (name, desc))
+            lines.append("# HELP %s %s"
+                         % (name, desc or "gauge %s" % name))
             lines.append("# TYPE %s gauge" % name)
             lines.append("%s %g" % (name, v))
         dump = self.ctx.perf.dump()
+        descs = self.ctx.perf.descriptions()
         for group, counters in sorted(dump.items()):
             for cname, val in sorted(counters.items()):
                 base = _metric_name(self.prefix, group, cname)
+                desc = (descs.get(group) or {}).get(cname) \
+                    or "perf counter %s.%s" % (group, cname)
                 if isinstance(val, dict) \
                         and "buckets_us_pow2" in val:
                     lines.extend(hist_lines(base,
-                                            val["buckets_us_pow2"]))
+                                            val["buckets_us_pow2"],
+                                            desc=desc))
                 elif isinstance(val, dict):
                     # avg/time counters dump {avgcount, sum, ...}
                     for sub, sv in sorted(val.items()):
                         if isinstance(sv, (int, float)):
+                            lines.append("# HELP %s_%s %s (%s)"
+                                         % (base, sub, desc, sub))
                             lines.append("# TYPE %s_%s counter"
                                          % (base, sub))
                             lines.append("%s_%s %g" % (base, sub, sv))
                 elif isinstance(val, (int, float)):
+                    lines.append("# HELP %s %s" % (base, desc))
                     lines.append("# TYPE %s counter" % base)
                     lines.append("%s %g" % (base, val))
         for fn in self.__dict__.get("_renderers", []):
@@ -159,12 +169,13 @@ def validate_exposition(text: str,
                         ) -> list[str]:
     """Lint an exposition document (text format 0.0.4): every emitted
     series must carry a valid metric name and belong to a family that
-    declared a `# TYPE` line before its first sample (histogram
-    `_bucket`/`_count`/`_sum` suffixes resolve to their base family).
-    Returns a list of human-readable violations — empty means clean.
-    Guards the growing series surface: a family added without a TYPE
-    line breaks real Prometheus servers only at scrape time; this
-    makes it a unit-test failure instead.
+    declared BOTH a `# HELP` and a `# TYPE` line before its first
+    sample (histogram `_bucket`/`_count`/`_sum` suffixes resolve to
+    their base family).  Returns a list of human-readable violations
+    — empty means clean.  Guards the growing series surface: a family
+    added without its header breaks real Prometheus servers (or ships
+    undocumented) only at scrape time; this makes it a unit-test
+    failure instead.
 
     Cardinality guard: no (family, label) pair may carry more than
     `max_label_card` distinct label VALUES (None disables).  An
@@ -174,6 +185,7 @@ def validate_exposition(text: str,
     it becomes a TSDB incident."""
     errors: list[str] = []
     typed: set[str] = set()
+    helped: set[str] = set()
     # (family, label name) -> set of observed label values
     label_vals: dict[tuple[str, str], set] = {}
     for ln, line in enumerate(text.splitlines(), 1):
@@ -187,6 +199,8 @@ def validate_exposition(text: str,
                     errors.append("line %d: bad family name %r"
                                   % (ln, parts[2]))
                 typed.add(parts[2])
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                helped.add(parts[2])
             continue
         m = _SERIES_RE.match(line)
         if m is None:
@@ -204,6 +218,9 @@ def validate_exposition(text: str,
                 break
         if family not in typed:
             errors.append("line %d: series %r has no # TYPE line"
+                          % (ln, name))
+        if family not in helped:
+            errors.append("line %d: series %r has no # HELP line"
                           % (ln, name))
         if max_label_card is not None and m.group("labels"):
             for lm in _LABEL_RE.finditer(m.group("labels")):

@@ -111,6 +111,11 @@ class PerfCounters:
         return self._Timer(self, name)
 
     # -- dump ------------------------------------------------------------
+    def descriptions(self) -> dict[str, str]:
+        """name -> declared help text (the exporter's # HELP source)."""
+        with self._lock:
+            return {name: c.desc for name, c in self._counters.items()}
+
     def dump(self) -> dict[str, Any]:
         out: dict[str, Any] = {}
         with self._lock:
@@ -150,3 +155,9 @@ class PerfCountersCollection:
         with self._lock:
             groups = dict(self._groups)
         return {name: pc.dump() for name, pc in groups.items()}
+
+    def descriptions(self) -> dict[str, dict[str, str]]:
+        with self._lock:
+            groups = dict(self._groups)
+        return {name: pc.descriptions()
+                for name, pc in groups.items()}

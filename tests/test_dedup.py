@@ -453,6 +453,8 @@ def test_bench_dedup_gate_logic():
             "host_fallbacks": 0, "device_fingerprint_chunks": 10,
             "device_fingerprint_bytes": 1000,
             "device_mibps": 1e9, "host_mibps": 2e9},
+        "shifted": {
+            "cdc_ratio": 1.6, "fixed_block_ratio": 1.1},
         "cluster": {
             "dedup_ratio": 2.5, "accounting_ok": True,
             "readback_ok": True, "status_dedup_panel": {"1": {}},
@@ -470,6 +472,12 @@ def test_bench_dedup_gate_logic():
     g = bench._gate_dedup(bad)
     assert not g["ok"]
     assert len(g["failures"]) >= 5, g
+    # the shifted corpus must beat fixed-block addressing
+    skew = copy.deepcopy(good)
+    skew["shifted"] = {"cdc_ratio": 1.1, "fixed_block_ratio": 1.2}
+    g = bench._gate_dedup(skew)
+    assert not g["ok"]
+    assert any("resynchroniz" in f for f in g["failures"]), g
     tpu = copy.deepcopy(good)
     tpu["backend"] = "tpu"      # slower-than-host is a TPU failure
     g = bench._gate_dedup(tpu)

@@ -1,0 +1,294 @@
+"""Cluster event bus, progress tracking, and the PERF_ANOMALY edge.
+
+Unit coverage for the EventMonitor ring (deterministic seq assignment
+at apply, bounded retention, cursor reads) and the ProgressTracker's
+drain-shaped monotonic bars, plus the cluster oracles: osd lifecycle
+events on a live watch-events stream, recovery-drain progress
+start/finish pairs, and the end-to-end anomaly proof — a planted
+sustained perf shift raises a paxos-committed PERF_ANOMALY health
+edge that survives a leader election, clears when the signal recedes,
+and leaves the shift visible in `perf history`, with the event
+cursor seeing every seq exactly once through it all.
+"""
+
+import asyncio
+import time
+
+from ceph_tpu.mon.services import EVENT_CAP, EventMonitor
+from ceph_tpu.osd.progress import ProgressTracker
+from ceph_tpu.testing import LocalCluster
+from ceph_tpu.utils.backoff import wait_for
+
+
+def run(coro, timeout=240):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+# -- EventMonitor ring (unit) -----------------------------------------------
+
+
+class _Tx:
+    def __init__(self):
+        self.kv = {}
+
+    def set(self, k, v):
+        self.kv[k] = v
+
+
+class _StubStore:
+    def get(self, k):
+        return None
+
+
+class _StubMon:
+    def __init__(self):
+        self.store = _StubStore()
+        self.ops = []
+
+    def is_leader(self):
+        return True
+
+    def queue_svc_op(self, svc, op):
+        self.ops.append((svc, op))
+
+
+def test_event_ring_seq_contiguity_and_cap():
+    """Seqs are assigned at apply() (identical on every mon), stay
+    contiguous through ring eviction, and cursor reads are exact,
+    bounded, and duplicate-free."""
+    em = EventMonitor(_StubMon())
+    for i in range(EVENT_CAP + 200):
+        em.apply([("emit", {"type": "clog",
+                            "message": "m%d" % i,
+                            "stamp": float(i)})], _Tx())
+    assert em.last_seq == EVENT_CAP + 200
+    assert len(em.events) == EVENT_CAP
+    seqs = [e["seq"] for e in em.events]
+    assert seqs == list(range(201, EVENT_CAP + 201))
+    assert em.after(em.last_seq) == []
+    rows = em.after(em.last_seq - 5)
+    assert [r["seq"] for r in rows] == list(
+        range(em.last_seq - 4, em.last_seq + 1))
+    # a cursor older than the ring floor starts at the floor: aged-
+    # out history is gone, not resynthesized
+    rows = em.after(0, limit=3)
+    assert [r["seq"] for r in rows] == [201, 202, 203]
+
+
+# -- ProgressTracker (unit) -------------------------------------------------
+
+
+def test_progress_tracker_monotonic_drain():
+    """Drain-shaped flows: the total GROWS when new work is revealed
+    mid-drain, the fraction never regresses, outstanding=0 finishes,
+    and finished rows linger then prune."""
+    pt = ProgressTracker()
+    fid = pt.start("recovery", "1.0s0", total=10)
+    pt.drain(fid, 6)
+    assert pt.rows()[fid]["fraction"] == 0.4
+    pt.drain(fid, 12)               # newly revealed missing objects
+    row = pt.rows()[fid]
+    assert row["total"] == 12 and row["fraction"] == 0.4
+    pt.drain(fid, 3)
+    assert pt.rows()[fid]["fraction"] == 0.75
+    pt.drain(fid, 0)
+    row = pt.rows()[fid]
+    assert row["fraction"] == 1.0 and row["done"] == row["total"]
+    assert fid in pt.rows(now=time.time() + 5.0)
+    assert fid not in pt.rows(now=time.time() + 60.0)
+    # a fresh start of the same flow begins a fresh bar
+    pt.start("recovery", "1.0s0", total=4)
+    assert pt.rows()[fid]["fraction"] == 0.0
+
+
+# -- cluster: lifecycle events on the live stream ---------------------------
+
+
+def test_cluster_event_stream_lifecycle():
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            rows = c.event_stream(start=0)
+            pid = await c.create_pool("ev", pg_num=8, size=3)
+            await c.wait_health(pid)
+            # the boots committed at bring-up reach a cursor-0
+            # subscriber (the bounded ring still retains them)
+            await wait_for(
+                lambda: sum(1 for r in rows
+                            if r["type"] == "osd_boot") >= 3,
+                30.0, what="osd_boot events on the stream")
+            io = c.client.io_ctx("ev")
+            for i in range(10):
+                await io.write_full("e-%d" % i, b"z" * 256)
+            await c.kill_osd(2)
+            await c.wait_osd_down(2)
+            await wait_for(
+                lambda: any(r["type"] == "osd_down" for r in rows),
+                30.0, what="osd_down event on the stream")
+            seqs = [r["seq"] for r in rows]
+            assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+            # the command surface serves the identical rows by cursor
+            out = await c.client.mon_command("events", after=0)
+            by_seq = {r["seq"]: r["type"] for r in out["events"]}
+            for r in rows:
+                assert by_seq.get(r["seq"]) == r["type"], r
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- cluster: recovery-drain progress rides the bus -------------------------
+
+
+def _keys(rows, etype, kind):
+    out = set()
+    for r in rows:
+        if r["type"] != etype:
+            continue
+        # digest keys are daemon-prefixed: "osd.0:recovery/1.2"
+        key = (r.get("data") or {}).get("key") or ""
+        if key.split(":", 1)[-1].startswith(kind + "/"):
+            out.add(key)
+    return out
+
+
+def test_recovery_progress_events():
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            rows = c.event_stream(start=0)
+            pid = await c.create_pool("prog", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("prog")
+            await c.kill_osd(2)
+            await c.wait_osd_down(2)
+            for i in range(24):
+                await io.write_full("p-%d" % i, b"q" * 2048)
+            await c.revive_osd(2)
+            # progress rows ride osd_stats into the digest
+            await c.wait_stats(
+                lambda d: (d or {}).get("progress"), 60.0,
+                what="progress rows in the mgr digest")
+            # every recovery drain that started also finishes —
+            # exactly the start/finish pairing the bus promises
+            await wait_for(
+                lambda: _keys(rows, "progress_start", "recovery")
+                and _keys(rows, "progress_start", "recovery")
+                <= _keys(rows, "progress_finish", "recovery"),
+                60.0, what="recovery progress start/finish pairs")
+            await c.wait_health(pid)
+        finally:
+            await c.stop()
+
+    run(main())
+
+
+# -- cluster: the PERF_ANOMALY edge, end to end -----------------------------
+
+# watch the client-write rate with hair-trigger thresholds: the idle
+# baseline is exactly zero, so the planted write burst is an
+# unmistakable sustained shift (production defaults are deaf — z>=6
+# for 8 ticks — and are exercised by the unit lifecycle test)
+ANOM_CONF = {
+    "history_anomaly_series": "io.write_ops_s",
+    "history_anomaly_min_samples": 6,
+    "history_anomaly_sustain": 3,
+    "history_anomaly_clear": 3,
+    "history_anomaly_z": 4.0,
+    "history_anomaly_clear_z": 1.0,
+}
+
+
+def test_perf_anomaly_edge_across_election():
+    async def main():
+        c = await LocalCluster(n_osds=3, n_mons=3, with_mgr=True,
+                               conf=ANOM_CONF).start()
+        stop_load = asyncio.Event()
+
+        async def load(io):
+            i = 0
+            while not stop_load.is_set():
+                await io.write_full("a-%d" % (i % 32), b"w" * 1024)
+                i += 1
+
+        loader = None
+        try:
+            rows = c.event_stream(start=0)
+            pid = await c.create_pool("anom", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("anom")
+            # idle baseline: let the engine warm past min_samples
+            # with write_ops_s pinned at zero
+            await asyncio.sleep(3.0)
+
+            loader = asyncio.ensure_future(load(io))
+            await wait_for(
+                lambda: any(r["type"] == "health_edge"
+                            and "PERF_ANOMALY" in r["message"]
+                            and "failed" in r["message"]
+                            for r in rows),
+                60.0, what="PERF_ANOMALY raise on the event bus")
+            # the committed edge names the shifted series
+            h = await c.client.mon_command("health")
+            assert "PERF_ANOMALY" in h["checks"]
+            assert "io.write_ops_s" in str(h["checks"]["PERF_ANOMALY"])
+
+            # leader election mid-anomaly: the committed edge makes
+            # the FRESH leader warn before any digest reaches it
+            old = c.leader()
+            rank = c.mons.index(old)
+            c.partition_mon(rank)
+
+            # the isolated old leader may still believe it leads
+            # until its lease lapses: look only at the survivors
+            def survivor_leader():
+                for m in c.mons:
+                    if m is not old and m.is_leader() \
+                            and m.mpaxos.active:
+                        return m
+                return None
+
+            await wait_for(lambda: survivor_leader() is not None,
+                           30.0, what="a new mon leader")
+            fresh = survivor_leader().health_mon.command(
+                "health", {})
+            assert "PERF_ANOMALY" in fresh["checks"], fresh
+            c.heal_mon(rank)
+            await c.wait_quorum()
+
+            # recede: the engine clears, the edge commits, the bus
+            # streams it to the same cursor
+            stop_load.set()
+            await loader
+            loader = None
+            await wait_for(
+                lambda: any(r["type"] == "health_edge"
+                            and "PERF_ANOMALY" in r["message"]
+                            and "cleared" in r["message"]
+                            for r in rows),
+                90.0, what="PERF_ANOMALY clear on the event bus")
+
+            # the shift is visible in the rings: recent max well
+            # above the idle baseline
+            q = await c.client.mon_command(
+                "perf history", series="io.write_ops_s",
+                window=55.0)
+            maxes = [r[3] for r in q["rows"]]
+            assert maxes and max(maxes) > 1.0, q
+
+            # cursor contract through the whole run — load, an
+            # election, a heal — every seq exactly once, in order,
+            # no gaps
+            seqs = [r["seq"] for r in rows]
+            assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+        finally:
+            stop_load.set()
+            if loader is not None:
+                try:
+                    await asyncio.wait_for(loader, 30.0)
+                except Exception:
+                    pass
+            await c.stop()
+
+    run(main())

@@ -184,8 +184,17 @@ def test_chrome_trace_export_and_validator():
     phases = [e["ph"] for e in evs if e.get("cat") == "flow"]
     assert "s" in phases and "f" in phases
     # device lane: the ticket renders on the chip's lane with its seq
-    dev = [e for e in evs if e.get("cat") == "device"]
+    dev = [e for e in evs
+           if e.get("cat") == "device" and e["ph"] == "X"]
     assert len(dev) == 1 and dev[0]["args"]["seq"] == 7
+    # counter tracks: per-chip busy / queue-depth "C" events
+    ctr = [e for e in evs if e["ph"] == "C"]
+    assert {e["name"] for e in ctr} \
+        == {"chip-0 busy", "chip-0 queue_depth"}
+    # queue-depth steps up at enqueue and back down by completion
+    depths = [e["args"]["queue_depth"] for e in ctr
+              if e["name"] == "chip-0 queue_depth"]
+    assert max(depths) >= 1 and depths[-1] == 0
     # background span rendered
     assert any(e.get("cat") == "background"
                and e["name"] == "deep_scrub" for e in evs)
@@ -428,7 +437,8 @@ def test_thrashed_ec_trace_complete_span_trees(monkeypatch,
                     stages_by_trace.setdefault(tr, set()).add(
                         e["name"])
             device_seqs = {e["args"]["seq"] for e in evs
-                           if e.get("cat") == "device"}
+                           if e.get("cat") == "device"
+                           and e["ph"] == "X"}
             assert device_seqs, "no device lanes in the trace"
 
             # map acked oids -> client write traces from the client's

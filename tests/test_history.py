@@ -1,0 +1,233 @@
+"""Cluster history plane: downsampled metric rings + anomaly engine.
+
+Unit coverage for ceph_tpu/mgr/history.py (the RRD-style ring store
+and the EWMA/z-score anomaly rules) plus the satellite-4 cluster
+oracle: killing the mgr under load leaves an EXPLICIT gap in the
+mon-side rings (missing bucket indices, never interpolated cells),
+`status` flags the digest unavailable, and a revived mgr resumes the
+feed without double-counting.
+"""
+
+import asyncio
+import time
+
+from ceph_tpu.mgr.history import (HISTORY_TIERS, AnomalyEngine,
+                                  HistoryStore, extract_samples)
+from ceph_tpu.testing import LocalCluster
+from ceph_tpu.utils.backoff import wait_for
+
+
+def run(coro, timeout=240):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def _digest(**kw):
+    d = {
+        "totals": {"read_ops_s": 10.0, "write_ops_s": 5.0,
+                   "read_bytes_s": 1024.0, "write_bytes_s": 512.0,
+                   "recovery_ops_s": 1.0, "recovery_bytes_s": 64.0},
+        "pools": {"1": {"degraded": 3, "misplaced": 2}},
+        "device_util": {"0": {"busy_frac": 0.5,
+                              "queue_wait_frac": 0.1}},
+        "slo": {"gold": {"p99_ms": 8.0, "burn_fast": 0.2}},
+        "repair_traffic": {"rs": {"read": 100, "moved": 50}},
+        "dedup_pools": {"1": {"bytes_stored": 10,
+                              "bytes_saved": 30}},
+    }
+    d.update(kw)
+    return d
+
+
+# -- extraction -------------------------------------------------------------
+
+
+def test_extract_samples_covers_series():
+    """One digest flattens into the registered series with the right
+    labels and values."""
+    samples = {(s, lb): v
+               for s, lb, v in extract_samples(_digest())}
+    assert samples[("io.write_ops_s", None)] == 5.0
+    assert samples[("io.read_bytes_s", None)] == 1024.0
+    assert samples[("pg.degraded", "1")] == 3.0
+    assert samples[("pg.misplaced", "1")] == 2.0
+    assert samples[("device.busy_frac", "0")] == 0.5
+    assert samples[("tenant.p99_ms", "gold")] == 8.0
+    assert samples[("tenant.burn_fast", "gold")] == 0.2
+    assert samples[("repair.bytes_read", None)] == 100.0
+    assert samples[("dedup.bytes_saved", None)] == 30.0
+
+
+# -- ring store -------------------------------------------------------------
+
+
+def test_history_memory_bounded_forever():
+    """Ingesting across 2x the coarsest tier's retention never
+    exceeds the max_cells ceiling: old buckets evict, per tier, per
+    series — the fixed-memory contract."""
+    store = HistoryStore()
+    span = max(w * c for w, c in HISTORY_TIERS)
+    step = 50.0
+    t0 = 1_000_000.0
+    for i in range(int(span * 2 / step)):
+        store.ingest(t0 + i * step, _digest())
+    assert store.cell_count() <= store.max_cells()
+    # the finest tier of one series respects its own cap
+    fine = store._rings[("io.write_ops_s", None)][0]
+    assert len(fine) <= HISTORY_TIERS[0][1]
+
+
+def test_history_query_downsamples_and_aggregates():
+    """Tier selection picks the finest tier covering the window, and
+    cells carry exact count/min/max/avg/last aggregates."""
+    store = HistoryStore(tiers=((1.0, 60), (10.0, 60)))
+    t0 = 10_000.0
+    for i in range(120):
+        store.note("io.write_ops_s", None, t0 + i * 0.5, float(i))
+    q = store.query("io.write_ops_s", window=30.0, now=t0 + 60)
+    assert q["tier_s"] == 1.0
+    q2 = store.query("io.write_ops_s", window=300.0, now=t0 + 60)
+    assert q2["tier_s"] == 10.0
+    t, count, mn, mx, avg, last = q2["rows"][0]
+    assert t == t0
+    assert count == 20 and mn == 0.0 and mx == 19.0 and last == 19.0
+    assert abs(avg - 9.5) < 1e-9
+
+
+def test_history_gap_stays_a_gap():
+    """A dead feed leaves MISSING bucket indices: the query renders
+    rows on both sides of the hole and nothing inside it, and the
+    per-bucket counts account every note exactly once."""
+    store = HistoryStore(tiers=((1.0, 1000),))
+    t0 = 1000.0
+    for i in range(10):
+        store.note("io.write_ops_s", None, t0 + i, 1.0)
+    for i in range(30, 40):        # 20 buckets of silence
+        store.note("io.write_ops_s", None, t0 + i, 2.0)
+    q = store.query("io.write_ops_s", window=100.0, now=t0 + 40)
+    ts = [r[0] for r in q["rows"]]
+    assert len(ts) == 20
+    assert not {t0 + i for i in range(10, 30)} & set(ts)
+    assert sum(r[1] for r in q["rows"]) == 20
+
+
+def test_history_label_cap_drops_and_counts():
+    """Label cardinality past the cap is dropped AND counted — never
+    silently folded; existing labels keep aggregating."""
+    store = HistoryStore()
+    for i in range(100):
+        store.note("pg.degraded", str(i), 1000.0, 1.0)
+    labels = {lb for s, lb in store.series_names()
+              if s == "pg.degraded"}
+    assert len(labels) == store.label_max == 32
+    assert store.dropped_labels == 68
+    store.note("pg.degraded", "5", 1001.0, 4.0)
+    q = store.query("pg.degraded", label="5", window=10.0,
+                    now=1001.0)
+    assert q["rows"] and q["rows"][-1][5] == 4.0
+
+
+# -- anomaly engine ---------------------------------------------------------
+
+
+def _tick(engine, value, n=1):
+    out = {}
+    for _ in range(n):
+        out = engine.observe([("device.busy_frac", "0", value)])
+    return out
+
+
+def test_anomaly_raise_freeze_and_clear():
+    """The full edge lifecycle on the deaf defaults: warm-up absorbs,
+    a shift must SUSTAIN to raise, the baseline freezes while hot (a
+    persistent shift cannot train itself back to normal), and the
+    clear needs its own sustained window."""
+    eng = AnomalyEngine()
+    assert _tick(eng, 0.3, 60) == {}        # warm-up baseline
+    assert "device.busy_frac[0]" not in _tick(eng, 0.9, 7)
+    active = _tick(eng, 0.9, 1)             # 8th hot tick: sustained
+    assert "device.busy_frac[0]" in active
+    assert active["device.busy_frac[0]"]["series"] \
+        == "device.busy_frac"
+    # 50 more hot ticks: still raised, baseline still ~0.3
+    active = _tick(eng, 0.9, 50)
+    assert "device.busy_frac[0]" in active
+    assert active["device.busy_frac[0]"]["mean"] < 0.4
+    # recede: 3 cold ticks hold, the 4th clears
+    assert "device.busy_frac[0]" in _tick(eng, 0.3, 3)
+    assert "device.busy_frac[0]" not in _tick(eng, 0.3, 1)
+
+
+def test_anomaly_watch_list_filters():
+    """Series outside the watched set never raise, no matter how
+    violent the shift (io rates swing with workload; only the
+    conf-listed series page by default)."""
+    eng = AnomalyEngine()
+    for _ in range(80):
+        eng.observe([("io.write_ops_s", None, 0.0)])
+    for _ in range(20):
+        out = eng.observe([("io.write_ops_s", None, 1e9)])
+    assert out == {}
+
+
+# -- satellite 4: mgr death leaves a gap, revival resumes cleanly -----------
+
+
+def test_mgr_death_gap_and_resume():
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True).start()
+        try:
+            # dev-paced digest TTL so `status` flags the dead mgr
+            # within the test window (production soft TTL is 30s)
+            for m in c.mons:
+                m.health_mon.SOFT_TTL = 2.0
+            pid = await c.create_pool("hist", pg_num=8, size=3)
+            await c.wait_health(pid)
+            io = c.client.io_ctx("hist")
+            for i in range(10):
+                await io.write_full("h-%d" % i, b"x" * 512)
+            mon = c.mons[0]
+            await wait_for(lambda: mon.history.ticks >= 4, 30.0,
+                           what="digest ticks folding into the"
+                                " mon's history rings")
+
+            ticks_before = mon.history.ticks
+            t_dead0 = time.time()
+            await c.kill_mgr()
+            # sit dead across several finest-tier buckets (0.5s at
+            # dev pacing) and past the digest TTL
+            await asyncio.sleep(2.5)
+            st = await c.client.mon_command("status")
+            assert st["pgmap"]["available"] is False, st["pgmap"]
+            assert mon.history.ticks == ticks_before
+            t_dead1 = time.time()
+
+            await c.revive_mgr()
+            for i in range(10):
+                await io.write_full("h2-%d" % i, b"y" * 512)
+            await wait_for(
+                lambda: mon.history.ticks > ticks_before + 2, 30.0,
+                what="history feed resuming after mgr revival")
+
+            q = await c.client.mon_command(
+                "perf history", series="io.write_ops_s",
+                window=55.0)
+            width = float(q["tier_s"])
+            rows = q["rows"]
+            assert rows, "no history rows after revival"
+            # the dead window is an explicit hole: no bucket lies
+            # strictly inside it (never an interpolated cell)
+            inside = [r for r in rows
+                      if r[0] > t_dead0 and r[0] + width < t_dead1]
+            assert not inside, inside
+            # rows exist on both sides of the hole
+            assert any(r[0] + width <= t_dead0 + width
+                       for r in rows)
+            assert any(r[0] >= t_dead1 for r in rows)
+            # no double-counting on resume: each bucket folds at
+            # most the digests one stats period can produce
+            cap = int(width / 0.25) + 2      # mgr_stats_period 0.25
+            assert all(r[1] <= cap for r in rows), rows
+        finally:
+            await c.stop()
+
+    run(main())

@@ -210,14 +210,18 @@ def test_exporter_lint_validates_and_catches():
         "# HELP x_total things",
         "# TYPE x_total counter",
         "x_total 3",
+        "# HELP h a histogram",
         "# TYPE h histogram",
         'h_bucket{le="2"} 1',
         'h_bucket{le="+Inf"} 2',
         "h_count 2",
+        "# HELP g a gauge",
         "# TYPE g gauge",
         'g{pool="a",pool_id="1"} 1.5',
     ])
     assert validate_exposition(good) == []
+    # a TYPE without a HELP fails the lint
+    assert validate_exposition("# TYPE nohelp gauge\nnohelp 1")
     # missing TYPE line
     assert validate_exposition("orphan_series 1")
     # invalid metric name

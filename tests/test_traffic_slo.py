@@ -197,11 +197,11 @@ def test_exporter_cardinality_guard():
     from ceph_tpu.utils.exporter import validate_exposition
 
     bounded = "\n".join(
-        ["# TYPE t_ops counter"]
+        ["# HELP t_ops ops", "# TYPE t_ops counter"]
         + ['t_ops{tenant="t%d"} 1' % i for i in range(10)])
     assert validate_exposition(bounded) == []
     flood = "\n".join(
-        ["# TYPE t_ops counter"]
+        ["# HELP t_ops ops", "# TYPE t_ops counter"]
         + ['t_ops{tenant="t%d"} 1' % i for i in range(200)])
     errs = validate_exposition(flood)
     assert errs and "unbounded label set" in errs[0]
