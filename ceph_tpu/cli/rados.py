@@ -6,7 +6,7 @@ Analog of src/tools/rados (rados put/get/ls/rm/stat/df/bench):
         -p POOL put NAME FILE | get NAME FILE | ls | rm NAME \\
         | stat NAME | df | bench SECONDS write [--size N] \\
         | mksnap SNAP | rmsnap SNAP | lssnap | report [OUT.json] \\
-        | trace export [OUT.json]
+        | trace export [OUT.json] | netstat
 
     Reads honor -s/--snap SNAPNAME (rados -s, snapshot reads).
     `report` writes the one-call diagnostics bundle (status, health,
@@ -17,6 +17,8 @@ Analog of src/tools/rados (rados put/get/ls/rm/stat/df/bench):
     (the `ceph -w` analog; --from N resumes a cursor).
     `perf history SERIES [LABEL]` renders the mon's downsampled
     history rows for one series (--window seconds).
+    `netstat` renders the cluster heartbeat RTT matrix, the slow
+    peer pairs, and per-daemon wire rates (`net status`).
 """
 
 from __future__ import annotations
@@ -155,6 +157,48 @@ async def _run(args) -> int:
                          row.get("type"), row.get("message")))
             client.watch_events(show, start=args.from_seq)
             await asyncio.Event().wait()     # stream until ^C
+            return 0
+        if args.cmd == "netstat":
+            # `rados netstat`: the cluster heartbeat RTT matrix +
+            # per-daemon wire rates, served from the mon's beacon
+            # soft state and mgr digest (`net status`)
+            out = await client.mon_command("net status")
+            matrix = out.get("rtt_ms") or {}
+            names = sorted(set(matrix)
+                           | {p for row in matrix.values()
+                              for p in row})
+            if names:
+                fmt = "%-8s" + " %8s" * len(names)
+                print(fmt % ("RTT_MS", *names))
+                for src in sorted(matrix):
+                    row = matrix[src]
+                    print(fmt % (src, *[
+                        ("%.2f" % row[d]) if d in row else "-"
+                        for d in names]))
+            else:
+                print("(no heartbeat RTT reports yet)")
+            slow = out.get("slow_pairs") or []
+            if slow:
+                print("slow pairs: %s" % ", ".join(slow))
+            daemons = out.get("daemons") or {}
+            if daemons:
+                dfmt = "%-8s %10s %10s %8s %8s %7s %9s"
+                print()
+                print(dfmt % ("DAEMON", "TX/S", "RX/S", "RESEND",
+                              "REPLAY", "QDEPTH", "RTTMAX_MS"))
+                for name in sorted(daemons):
+                    row = daemons[name]
+                    print(dfmt % (
+                        name,
+                        "%.0f" % row.get("tx_Bps", 0.0),
+                        "%.0f" % row.get("rx_Bps", 0.0),
+                        row.get("resends", 0),
+                        row.get("replays", 0),
+                        row.get("queue_depth", 0),
+                        "%.2f" % row.get("rtt_max_ms", 0.0)))
+            elif not out.get("daemons_available"):
+                print("(no mgr digest yet: per-daemon wire rates "
+                      "unavailable)")
             return 0
         if args.cmd == "perf":
             if not args.args or args.args[0] != "history":

@@ -249,6 +249,7 @@ class Manager:
         exp.add_renderer(self._render_event_plane)
         exp.add_renderer(self._render_tenants)
         exp.add_renderer(self._render_ingest)
+        exp.add_renderer(self._render_net)
 
     def _total_slow_ops(self) -> int:
         """Cluster-wide slow-op count aggregated from the per-daemon
@@ -542,6 +543,94 @@ class Manager:
         by wire format, apply latency, fallback + prune counters —
         the stat pipeline measured like every other plane."""
         return ingest_prom_lines(self.pgmap)
+
+    def _render_net(self) -> list[str]:
+        """Network-plane families (NET_SERIES): per-daemon resend/
+        replay/queue figures, per-peer wire byte totals and the
+        heartbeat RTT matrix.  Peer cardinality is capped per daemon
+        like tenant labels: the busiest peers keep their own rows,
+        the tail folds into "other" — a client-entity flood can
+        never blow up the exporter's label space."""
+        import asyncio as _aio
+        now = _aio.get_event_loop().time()
+        rows: dict[str, dict] = {}
+        for daemon, srow in sorted(
+                self.pgmap.live_osd_stats(now).items()):
+            nrow = srow.get("net")
+            if nrow:
+                rows[daemon] = nrow
+        if not rows:
+            return []
+        cap = max(1, int(self.ctx.conf.get("net_label_max", 8)))
+        lines: list[str] = []
+        for fam, key, kind, desc in (
+                ("ceph_tpu_net_resends_total", "resends", "counter",
+                 "lossless payloads requeued for session replay"),
+                ("ceph_tpu_net_replays_total", "replays", "counter",
+                 "duplicate frames absorbed by seq dedup after"
+                 " reconnect"),
+                ("ceph_tpu_net_mark_downs_total", "mark_downs",
+                 "counter", "administrative connection teardowns"),
+                ("ceph_tpu_net_queue_depth", "queue_depth", "gauge",
+                 "frames waiting in send queues")):
+            _fam_header(lines, fam, kind, desc)
+            for daemon in rows:
+                lines.append('%s{daemon="%s"} %g'
+                             % (fam, daemon,
+                                float(rows[daemon].get(key, 0)
+                                      or 0)))
+
+        def folded(peers: dict) -> dict:
+            if len(peers) <= cap:
+                return peers
+            keep = sorted(peers, key=lambda p:
+                          (-int(peers[p].get("tx_bytes", 0) or 0),
+                           p))[:cap - 1]
+            out = {p: peers[p] for p in keep}
+            other = {"tx_bytes": 0, "rx_bytes": 0}
+            for p, r in peers.items():
+                if p in out:
+                    continue
+                other["tx_bytes"] += int(r.get("tx_bytes", 0) or 0)
+                other["rx_bytes"] += int(r.get("rx_bytes", 0) or 0)
+            out["other"] = other
+            return out
+
+        for fam, key in (("ceph_tpu_net_peer_tx_bytes_total",
+                          "tx_bytes"),
+                         ("ceph_tpu_net_peer_rx_bytes_total",
+                          "rx_bytes")):
+            _fam_header(lines, fam, "counter",
+                        "per-peer wire %s (peer labels capped)"
+                        % key)
+            for daemon, nrow in rows.items():
+                for peer, prow in sorted(folded(
+                        dict(nrow.get("peers") or {})).items()):
+                    lines.append('%s{daemon="%s",peer="%s"} %d'
+                                 % (fam, daemon, peer,
+                                    int(prow.get(key, 0) or 0)))
+        fam = "ceph_tpu_net_rtt_ms"
+        _fam_header(lines, fam, "gauge",
+                    "per-peer heartbeat RTT, 5s window (ms)")
+        for daemon, nrow in rows.items():
+            rtt = dict(nrow.get("rtt_peers") or {})
+            worst = sorted(rtt, key=lambda p: (-rtt[p], p))[:cap]
+            for peer in sorted(worst):
+                lines.append('%s{daemon="%s",peer="osd.%s"} %g'
+                             % (fam, daemon, peer, rtt[peer]))
+        for fam, key, desc in (
+                ("ceph_tpu_net_backoff_seconds", "backoff_s",
+                 "active redial backoff ramp (worst peer)"),
+                ("ceph_tpu_net_handshake_seconds", "handshake_s",
+                 "last completed handshake latency (worst peer)")):
+            _fam_header(lines, fam, "gauge", desc)
+            for daemon, nrow in rows.items():
+                peers = nrow.get("peers") or {}
+                v = max((float(p.get(key, 0.0) or 0.0)
+                         for p in peers.values()), default=0.0)
+                lines.append('%s{daemon="%s"} %g'
+                             % (fam, daemon, v))
+        return lines
 
     # -- stats loop (PGMap digest -> monitors) -----------------------------
 

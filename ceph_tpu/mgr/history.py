@@ -105,6 +105,16 @@ def extract_samples(digest: dict) -> list:
         dd_saved += int(row.get("bytes_saved") or 0)
     out.append(("dedup.bytes_stored", None, float(dd_stored)))
     out.append(("dedup.bytes_saved", None, float(dd_saved)))
+    # network plane: per-daemon worst-peer RTT, send-queue depth and
+    # lossless resend rate — the AnomalyEngine watches rtt/resends so
+    # a degrading link pages like a degrading chip does
+    for daemon, row in (digest.get("net") or {}).items():
+        out.append(("net.rtt_ms", str(daemon),
+                    float(row.get("rtt_max_ms") or 0.0)))
+        out.append(("net.queue_depth", str(daemon),
+                    float(row.get("queue_depth") or 0)))
+        out.append(("net.resend_rate", str(daemon),
+                    float(row.get("resend_rate") or 0.0)))
     return out
 
 
@@ -211,6 +221,36 @@ class HistoryStore:
         return {"series": series, "label": label, "tier_s": width,
                 "window": window, "rows": rows}
 
+    def latest(self, series: str, label=None,
+               now: float | None = None):
+        """(last value, age seconds) of the newest retained cell for
+        one labeled series across all tiers — the stale-`status`
+        fallback serves it (annotated with its age) once the live
+        digest passes its TTL.  None when the series was never
+        fed."""
+        now = time.time() if now is None else now
+        ring = self._rings.get((series, label))
+        if ring is None:
+            return None
+        best = None
+        for (width, _cap), cells in zip(self._tiers, ring):
+            if not cells:
+                continue
+            b = max(cells)
+            t = (b + 1) * width
+            if best is None or t > best[0]:
+                best = (t, cells[b][_LAST])
+        if best is None:
+            return None
+        return best[1], max(0.0, now - best[0])
+
+    def labels_for(self, series: str) -> list:
+        """Retained labels for one series (the stale-panel fallback
+        enumerates device chips with it)."""
+        return sorted((lb for s, lb in self._rings
+                       if s == series and lb is not None),
+                      key=str)
+
     def cell_count(self) -> int:
         return sum(len(cells) for ring in self._rings.values()
                    for cells in ring)
@@ -258,7 +298,8 @@ class AnomalyEngine:
     def watched(self) -> tuple:
         spec = self._conf("history_anomaly_series", (
             "device.busy_frac", "device.queue_wait_frac",
-            "tenant.p99_ms", "tenant.burn_fast"))
+            "tenant.p99_ms", "tenant.burn_fast",
+            "net.rtt_ms", "net.resend_rate"))
         if isinstance(spec, str):
             spec = [s.strip() for s in spec.split(",") if s.strip()]
         return tuple(spec)

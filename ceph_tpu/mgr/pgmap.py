@@ -679,7 +679,32 @@ class PGMap:
         # `status` renders them and the mon leader diffs them into
         # progress_start/finish bus events
         progress: dict[str, dict] = {}
+        # network plane: one bounded row per reporting daemon — wire
+        # rates the producer computed over its own report interval,
+        # the RTT rollup, and the per-peer 5s RTTs (the cluster RTT
+        # matrix row); the full per-peer wire detail stays in
+        # osd_stats for the exporter and never rides the digest
+        net: dict[str, dict] = {}
         for d, row in self.live_osd_stats(now).items():
+            nrow = row.get("net")
+            if nrow:
+                net[d] = {
+                    "tx_Bps": float(nrow.get("tx_Bps", 0.0) or 0.0),
+                    "rx_Bps": float(nrow.get("rx_Bps", 0.0) or 0.0),
+                    "resends": int(nrow.get("resends", 0) or 0),
+                    "replays": int(nrow.get("replays", 0) or 0),
+                    "queue_depth": int(
+                        nrow.get("queue_depth", 0) or 0),
+                    "resend_rate": float(
+                        nrow.get("resend_rate", 0.0) or 0.0),
+                    "rtt_avg_ms": float(
+                        (nrow.get("rtt") or {}).get(
+                            "rtt_avg_ms", 0.0) or 0.0),
+                    "rtt_max_ms": float(
+                        (nrow.get("rtt") or {}).get(
+                            "rtt_max_ms", 0.0) or 0.0),
+                    "rtt_peers": dict(nrow.get("rtt_peers") or {}),
+                }
             sf = row.get("statfs")
             if sf:
                 osd_rows[d] = {"total": int(sf.get("total") or 0),
@@ -733,6 +758,9 @@ class PGMap:
             # daemon:flowid -> fraction-complete rows for long
             # background flows (the `status` progress section)
             "progress": progress,
+            # daemon -> wire rates + RTT matrix row (`net status`,
+            # the net.* history series, the slow-ping soft detail)
+            "net": net,
             # per-daemon report freshness + prune visibility (the
             # `status` max-age/stale-count line)
             "reports": self.report_freshness(now),

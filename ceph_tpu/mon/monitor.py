@@ -138,6 +138,11 @@ class Monitor:
         self.osd_slow_tenants: dict[int, tuple[dict, float]] = {}
         # osd -> (device_fallback flag, monotonic stamp)
         self.osd_device_fallback: dict[int, tuple[int, float]] = {}
+        # osd -> (beacon net slice {"rtt_ms": {peer: ms},
+        # "slow": [peers]}, monotonic stamp): the heartbeat RTT view
+        # behind OSD_SLOW_PING_TIME and `net status`; the leader
+        # commits pair-list transitions into the health svc state
+        self.osd_net: dict[int, tuple[dict, float]] = {}
         # latest PGMap digest from the mgr (MMonMgrDigest): soft state
         # every mon keeps (broadcast like beacons); feeds status/df/
         # pool-stats and the PG_DEGRADED / PG_AVAILABILITY checks; the
@@ -727,9 +732,17 @@ class Monitor:
             self.osd_slow_tenants[msg.osd] = (
                 dict(getattr(msg, "slow_tenants", None) or {}), now)
             self.osd_device_fallback[msg.osd] = (flb, now)
+            # heartbeat-RTT slice (the network plane): soft state on
+            # every mon; the leader commits slow-pair transitions so
+            # OSD_SLOW_PING_TIME survives elections.  Legacy beacons
+            # carry no net field and simply leave the matrix sparse.
+            self.osd_net[msg.osd] = (
+                dict(getattr(msg, "net", None) or {}), now)
             if self.is_leader() and \
                     (not self.multi or self.mpaxos.active):
                 self.health_mon.maybe_commit(msg.osd, slow, flb)
+                self.health_mon.maybe_commit_slow_ping(
+                    self._slow_ping_pairs(now))
             return True
         if isinstance(msg, (MOSDBoot, MOSDFailure, MOSDAlive,
                             MOSDPGTemp)) \
@@ -1301,6 +1314,11 @@ class Monitor:
             return self.history.query(
                 str(series), label=cmd.get("label"),
                 window=float(cmd.get("window") or 600.0))
+        if prefix == "net status":
+            # read-only network surface (like `perf history`, not
+            # audited): heartbeat RTT matrix from beacon soft state
+            # plus per-daemon wire rates from the digest
+            return self._cmd_net_status()
         if prefix in _AUDIT_PREFIXES:
             # command provenance on the audit channel (the reference
             # mon's audit clog): only state-mutating prefixes — an
@@ -1403,6 +1421,67 @@ class Monitor:
             return None
         return self.mgr_digest
 
+    def _slow_ping_pairs(self, now: float | None = None) -> list:
+        """Sorted "osd.A-osd.B" pair names any FRESH beacon net
+        slice flags slow — the OSD_SLOW_PING_TIME commit value (the
+        leader calls this per beacon; edges-only dedup in the health
+        monitor keeps steady state free of paxos rounds)."""
+        if now is None:
+            now = time.monotonic()
+        ttl = self.health_mon.SOFT_TTL
+        pairs: set[str] = set()
+        for osd, (nrow, stamp) in self.osd_net.items():
+            if now - stamp >= ttl:
+                continue
+            for peer in (nrow or {}).get("slow") or []:
+                try:
+                    p = int(peer)
+                except (TypeError, ValueError):
+                    continue
+                pairs.add("osd.%d-osd.%d"
+                          % (min(osd, p), max(osd, p)))
+        return sorted(pairs)
+
+    def _cmd_net_status(self) -> dict:
+        """`net status` (the `rados netstat` backend): the cluster
+        heartbeat RTT matrix from beacon soft state plus per-daemon
+        wire rates from the mgr digest — read-only, served from THIS
+        mon's view like `perf history`."""
+        now = time.monotonic()
+        ttl = self.health_mon.SOFT_TTL
+        matrix: dict[str, dict] = {}
+        for osd, (nrow, stamp) in sorted(self.osd_net.items()):
+            if now - stamp >= ttl:
+                continue
+            row: dict[str, float] = {}
+            for peer, ms in ((nrow or {}).get("rtt_ms")
+                             or {}).items():
+                try:
+                    row["osd.%d" % int(peer)] = round(
+                        float(ms), 3)
+                except (TypeError, ValueError):
+                    continue
+            matrix["osd.%d" % osd] = row
+        dig = self._digest_fresh()
+        net = (dig.get("net") or {}) if dig else {}
+        daemons = {
+            str(d): {
+                "tx_Bps": float(row.get("tx_Bps") or 0.0),
+                "rx_Bps": float(row.get("rx_Bps") or 0.0),
+                "resends": int(row.get("resends") or 0),
+                "replays": int(row.get("replays") or 0),
+                "queue_depth": int(row.get("queue_depth") or 0),
+                "resend_rate": float(
+                    row.get("resend_rate") or 0.0),
+                "rtt_avg_ms": float(row.get("rtt_avg_ms") or 0.0),
+                "rtt_max_ms": float(row.get("rtt_max_ms") or 0.0),
+            } for d, row in sorted(net.items())}
+        return {"rtt_ms": matrix,
+                "slow_pairs": self._slow_ping_pairs(now),
+                "reporting": len(matrix),
+                "daemons": daemons,
+                "daemons_available": dig is not None}
+
     def _cmd_status(self) -> dict:
         """`ceph -s`: mon/osd summary plus the PGMap data/io sections
         the digest carries (pg states, object+byte totals, client IO
@@ -1428,6 +1507,39 @@ class Monitor:
                 "available": False,
                 "status": "unavailable (no mgr digest)",
             }
+            # instead of the panels simply vanishing, serve the last
+            # retained history-ring cell for the io rates and
+            # device_util, each annotated with its age — stale data
+            # clearly labeled stale beats no data (ROADMAP
+            # carry-forward)
+            io_last: dict = {}
+            age_max = 0.0
+            for key, series in (("read_ops_s", "io.read_ops_s"),
+                                ("write_ops_s", "io.write_ops_s"),
+                                ("read_bytes_s", "io.read_bytes_s"),
+                                ("write_bytes_s",
+                                 "io.write_bytes_s")):
+                cell = self.history.latest(series)
+                if cell is not None:
+                    io_last[key] = cell[0]
+                    age_max = max(age_max, cell[1])
+            if io_last:
+                io_last["stale"] = True
+                io_last["age_s"] = round(age_max, 1)
+                out["pgmap"]["io_last"] = io_last
+            du_last: dict = {}
+            du_age = 0.0
+            for chip in self.history.labels_for("device.busy_frac"):
+                cell = self.history.latest("device.busy_frac",
+                                           label=chip)
+                if cell is None:
+                    continue
+                du_last[chip] = {"busy_frac": cell[0]}
+                du_age = max(du_age, cell[1])
+            if du_last:
+                out["device_util_last"] = {
+                    "stale": True, "age_s": round(du_age, 1),
+                    "chips": du_last}
         else:
             totals = dig.get("totals") or {}
             out["pgmap"] = {

@@ -231,7 +231,7 @@ class HealthMonitor:
                                 "pgdeg": 0, "pgavail": 0,
                                 "scruberr": 0, "pgdmg": 0,
                                 "slolat": [], "sloburn": [],
-                                "perfanom": []}
+                                "perfanom": [], "slowping": []}
 
     # -- persistence / replay ------------------------------------------
 
@@ -254,7 +254,9 @@ class HealthMonitor:
                 "sloburn": sorted(str(t)
                                   for t in (d.get("sloburn") or [])),
                 "perfanom": sorted(
-                    str(t) for t in (d.get("perfanom") or []))}
+                    str(t) for t in (d.get("perfanom") or [])),
+                "slowping": sorted(
+                    str(t) for t in (d.get("slowping") or []))}
 
     def apply(self, ops: list, tx) -> None:
         """Deterministic commit apply (every mon runs this)."""
@@ -273,7 +275,8 @@ class HealthMonitor:
                     self.persisted["devflb"].pop(int(osd), None)
             elif op[0] in ("pgdeg", "pgavail", "scruberr", "pgdmg"):
                 self.persisted[op[0]] = int(op[1])
-            elif op[0] in ("slolat", "sloburn", "perfanom"):
+            elif op[0] in ("slolat", "sloburn", "perfanom",
+                           "slowping"):
                 self.persisted[op[0]] = sorted(
                     str(t) for t in (op[1] or []))
         tx.set(HEALTH_KEY, denc.encode(
@@ -285,7 +288,8 @@ class HealthMonitor:
              "pgdmg": int(self.persisted["pgdmg"]),
              "slolat": list(self.persisted["slolat"]),
              "sloburn": list(self.persisted["sloburn"]),
-             "perfanom": list(self.persisted["perfanom"])}))
+             "perfanom": list(self.persisted["perfanom"]),
+             "slowping": list(self.persisted["slowping"])}))
 
     def _edge(self, level: str, check: str, message: str) -> None:
         """One health-check transition: clog it (the reference clogs
@@ -463,6 +467,36 @@ class HealthMonitor:
                 self._edge(
                     "INF", "PERF_ANOMALY",
                     "Health check cleared: PERF_ANOMALY")
+
+    def maybe_commit_slow_ping(self, pairs) -> None:
+        """Leader-side: persist the SLOW-PING PEER PAIRS (the network
+        plane, osd/network.py) through paxos — edges only, like the
+        SLO/anomaly sets: the "osd.A-osd.B" pair list commits when it
+        CHANGES, so a freshly elected leader still names the worst
+        peer pairs before any beacon reaches it."""
+        pend = self.mon.pending_svc.get("health", [])
+        val = sorted(map(str, pairs or ()))
+        cur = None
+        for op in reversed(pend):
+            if op[0] == "slowping":
+                cur = list(op[1])
+                break
+        if cur is None:
+            cur = list(self.persisted["slowping"])
+        if val == cur:
+            return
+        self.mon.queue_svc_op("health", ("slowping", val))
+        if bool(val) != bool(cur):
+            if val:
+                self._edge(
+                    "WRN", "OSD_SLOW_PING_TIME",
+                    "Health check failed: slow heartbeat pings on "
+                    "peer pair(s) %s (OSD_SLOW_PING_TIME)"
+                    % ",".join(val))
+            else:
+                self._edge(
+                    "INF", "OSD_SLOW_PING_TIME",
+                    "Health check cleared: OSD_SLOW_PING_TIME")
 
     # -- merged beacon views -------------------------------------------
 
@@ -707,6 +741,50 @@ class HealthMonitor:
                     else "%s shifted from baseline "
                          "(committed edge)" % n
                     for n in anom[:10]]}
+        # OSD_SLOW_PING_TIME (the network plane, osd/network.py):
+        # heartbeat RTT past the slow-ping threshold on a peer pair.
+        # Fresh beacon soft state (mon.osd_net) carries the live RTT
+        # magnitudes; the paxos-committed pair list fills in for a
+        # freshly elected leader.
+        tnow2 = _t.monotonic()
+        ping_detail: dict[str, float] = {}
+        ping_pairs: set[str] = set()
+        saw_net = False
+        for osd, (nrow, stamp) in getattr(
+                self.mon, "osd_net", {}).items():
+            if tnow2 - stamp >= self.SOFT_TTL:
+                continue
+            saw_net = True
+            rtts = (nrow or {}).get("rtt_ms") or {}
+            for peer in (nrow or {}).get("slow") or []:
+                try:
+                    p = int(peer)
+                except (TypeError, ValueError):
+                    continue
+                pair = "osd.%d-osd.%d" % (min(osd, p), max(osd, p))
+                ping_pairs.add(pair)
+                ms = rtts.get(str(p))
+                if ms is not None:
+                    ping_detail[pair] = max(
+                        ping_detail.get(pair, 0.0), float(ms))
+        if saw_net:
+            slow_pairs = sorted(ping_pairs)
+        else:
+            slow_pairs = list(self.persisted["slowping"])
+        if slow_pairs:
+            out["OSD_SLOW_PING_TIME"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "Slow heartbeat pings on %d peer "
+                           "pair(s): %s"
+                           % (len(slow_pairs), slow_pairs[:10]),
+                "pairs": slow_pairs,
+                "detail": [
+                    "%s heartbeat RTT %.1fms over threshold"
+                    % (pr, ping_detail[pr])
+                    if pr in ping_detail
+                    else "%s slow heartbeat pings "
+                         "(committed edge)" % pr
+                    for pr in slow_pairs[:10]]}
         # RECENT_CRASH (the crash module's health check): any
         # un-archived crash report newer than mon_crash_warn_age.
         # The crash table is itself paxos-committed, so a freshly

@@ -108,11 +108,21 @@ class MOSDBeacon(Message):
     per-tenant slice of slow_ops ({tenant: count}; tenant-less ops
     fold under "") so the SLOW_OPS health detail can name the worst
     tenant; legacy beacons without it read as no tenant attribution.
+    net carries the daemon's heartbeat RTT slice ({"rtt_ms":
+    {peer: ms}, "slow": [peers]}) feeding the mon's
+    OSD_SLOW_PING_TIME edge; beacons without it encode
+    byte-identically to the pre-net wire form.
     """
 
     TYPE = "osd_beacon"
     FIELDS = ("osd", "epoch", "slow_ops", "slow_tenants",
-              "device_fallback", "device_chip")
+              "device_fallback", "device_chip", "net")
+
+    def to_wire(self) -> dict:
+        d = {f: getattr(self, f) for f in self.FIELDS}
+        if d.get("net") is None:
+            del d["net"]        # legacy beacons stay byte-stable
+        return d
 
 
 @register

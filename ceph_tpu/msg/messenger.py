@@ -55,6 +55,125 @@ TAG_CLOSE = 4
 
 _HDR = struct.Struct(">BII")  # tag, length, crc32
 
+# wire-accounting switch: bench.py --net A/Bs the cost of the
+# telemetry plane, so disabling must short-circuit every hot-path
+# accounting touch (per-type dicts, queue-wait stamps)
+_ACCOUNTING = True
+
+
+def set_net_accounting(on: bool) -> None:
+    global _ACCOUNTING
+    _ACCOUNTING = bool(on)
+
+
+class WireStats:
+    """Per-peer wire accounting for one Connection (folded into the
+    Messenger's per-peer aggregate when the connection dies, so
+    counters survive connection churn and session reconnects).
+
+    Dump keys are registered in ``trace.registry.NET_STAGES`` and
+    consumed by the mgr exporter, ``collect_diagnostics()`` and the
+    ``bench.py --net`` leg.
+    """
+
+    __slots__ = ("tx_msgs", "tx_bytes", "rx_msgs", "rx_bytes",
+                 "by_type_tx", "by_type_rx", "queue_wait_s",
+                 "queue_wait_n", "queue_wait_max_s", "resends",
+                 "replays", "mark_downs", "handshakes", "handshake_s",
+                 "backoff_s")
+
+    def __init__(self):
+        self.tx_msgs = 0
+        self.tx_bytes = 0
+        self.rx_msgs = 0
+        self.rx_bytes = 0
+        self.by_type_tx: dict[str, list] = {}   # type -> [msgs, bytes]
+        self.by_type_rx: dict[str, list] = {}
+        self.queue_wait_s = 0.0
+        self.queue_wait_n = 0
+        self.queue_wait_max_s = 0.0
+        self.resends = 0            # lossless payloads requeued
+        self.replays = 0            # duplicate frames absorbed by seq
+        self.mark_downs = 0
+        self.handshakes = 0
+        self.handshake_s = 0.0      # last completed handshake latency
+        self.backoff_s = 0.0        # active redial ramp (0 = healthy)
+
+    def note_tx(self, mtype: str, nbytes: int) -> None:
+        self.tx_msgs += 1
+        self.tx_bytes += nbytes
+        row = self.by_type_tx.get(mtype)
+        if row is None:
+            row = self.by_type_tx[mtype] = [0, 0]
+        row[0] += 1
+        row[1] += nbytes
+
+    def note_rx(self, mtype: str, nbytes: int) -> None:
+        self.rx_msgs += 1
+        self.rx_bytes += nbytes
+        row = self.by_type_rx.get(mtype)
+        if row is None:
+            row = self.by_type_rx[mtype] = [0, 0]
+        row[0] += 1
+        row[1] += nbytes
+
+    def note_queue_wait(self, wait_s: float) -> None:
+        self.queue_wait_s += wait_s
+        self.queue_wait_n += 1
+        if wait_s > self.queue_wait_max_s:
+            self.queue_wait_max_s = wait_s
+
+    def note_handshake(self, latency_s: float) -> None:
+        self.handshakes += 1
+        self.handshake_s = latency_s
+
+    def fold(self, other: "WireStats") -> None:
+        self.tx_msgs += other.tx_msgs
+        self.tx_bytes += other.tx_bytes
+        self.rx_msgs += other.rx_msgs
+        self.rx_bytes += other.rx_bytes
+        for src, dst in ((other.by_type_tx, self.by_type_tx),
+                         (other.by_type_rx, self.by_type_rx)):
+            for mtype, (n, b) in src.items():
+                row = dst.get(mtype)
+                if row is None:
+                    row = dst[mtype] = [0, 0]
+                row[0] += n
+                row[1] += b
+        self.queue_wait_s += other.queue_wait_s
+        self.queue_wait_n += other.queue_wait_n
+        self.queue_wait_max_s = max(self.queue_wait_max_s,
+                                    other.queue_wait_max_s)
+        self.resends += other.resends
+        self.replays += other.replays
+        self.mark_downs += other.mark_downs
+        self.handshakes += other.handshakes
+        if other.handshakes:
+            self.handshake_s = other.handshake_s
+        self.backoff_s = max(self.backoff_s, other.backoff_s)
+
+    def dump(self, queue_depth: int = 0) -> dict:
+        return {
+            "tx_msgs": self.tx_msgs,
+            "tx_bytes": self.tx_bytes,
+            "rx_msgs": self.rx_msgs,
+            "rx_bytes": self.rx_bytes,
+            "by_type_tx": {t: list(v)
+                           for t, v in sorted(self.by_type_tx.items())},
+            "by_type_rx": {t: list(v)
+                           for t, v in sorted(self.by_type_rx.items())},
+            "queue_depth": queue_depth,
+            "queue_wait_s": self.queue_wait_s,
+            "queue_wait_n": self.queue_wait_n,
+            "queue_wait_max_s": self.queue_wait_max_s,
+            "resends": self.resends,
+            "replays": self.replays,
+            "mark_downs": self.mark_downs,
+            "handshakes": self.handshakes,
+            "handshake_s": self.handshake_s,
+            "backoff_s": self.backoff_s,
+        }
+
 
 def ms_compress_from_conf(conf) -> list[str]:
     """Wire-compression preference list from conf (ms_compress),
@@ -148,6 +267,7 @@ class Connection:
         self.rng = msgr._conn_rng(peer_addr or "inbound")
         self.out_seq = 0
         self.in_seq = 0
+        self.stats = WireStats()
         self.unacked: list[tuple[int, bytes]] = []
         self.out_q: asyncio.Queue = asyncio.Queue()
         self._open = True
@@ -172,13 +292,27 @@ class Connection:
         data = encode_message(msg, stamp=self.msgr.now())
         if self.policy.resend:
             self.unacked.append((msg.seq, data))
-        self.out_q.put_nowait((TAG_MSG, data))
+        if _ACCOUNTING:
+            self.stats.note_tx(msg.TYPE, len(data))
+            # queue-wait is SAMPLED 1-in-16: the clock-stamp pair
+            # (monotonic at enqueue + at pop) is the most expensive
+            # accounting instruction on this path, and the estimator
+            # only ever reports averages and maxima — both survive
+            # sampling.  Third element = enqueue stamp.
+            if self.out_seq & 0xF == 0:
+                self.out_q.put_nowait((TAG_MSG, data,
+                                       time.monotonic()))
+            else:
+                self.out_q.put_nowait((TAG_MSG, data))
+        else:
+            self.out_q.put_nowait((TAG_MSG, data))
 
     def mark_down(self) -> None:
         """Administrative teardown: no reset callback fires."""
         if not self._open:
             return
         self._open = False
+        self.stats.mark_downs += 1
         if self._writer is not None:
             # a partition must also block the graceful CLOSE: the peer
             # has to see a transport fault (dead host semantics, and
@@ -230,10 +364,17 @@ class Connection:
         self._supervisor = self.msgr.spawn(runner())
 
     async def _run_outbound(self) -> None:
-        backoff = 0.02
+        from ..utils.backoff import ExpBackoff
+
+        # a dedicated RNG keyed off the peer: the redial jitter must
+        # not perturb this connection's seeded failure schedule
+        bo = ExpBackoff(base=0.02, cap=2.0,
+                        rng=self.msgr._conn_rng(
+                            "%s|backoff" % self.peer_addr))
         while self._open:
             writer = None
             try:
+                t0 = time.monotonic()
                 host, port = self.peer_addr.rsplit(":", 1)
                 reader, writer = await asyncio.open_connection(
                     host, int(port))
@@ -249,10 +390,15 @@ class Connection:
                 if self.policy.lossy:
                     await self._die()
                     return
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 2.0)
+                delay = bo.next_delay()
+                # telemetry reads the ramp position off the stats
+                # block while the dial is down (ExpBackoff.state())
+                self.stats.backoff_s = bo.state()["interval_s"]
+                await asyncio.sleep(delay)
                 continue
-            backoff = 0.02
+            bo.reset()
+            self.stats.backoff_s = 0.0
+            self.stats.note_handshake(time.monotonic() - t0)
             closed = await self._session(reader, writer, framer, comp)
             if closed or self.policy.lossy:
                 await self._die()
@@ -351,7 +497,12 @@ class Connection:
                     raise
                 except Exception:
                     return
-            tag, payload = await self.out_q.get()
+            item = await self.out_q.get()
+            tag, payload = item[0], item[1]
+            if len(item) > 2 and _ACCOUNTING:
+                # queue wait: enqueue stamp -> pop (injected delays
+                # and socket drain are wire time, not queue time)
+                self.stats.note_queue_wait(time.monotonic() - item[2])
             try:
                 act = None
                 if tag == TAG_ACK:
@@ -445,6 +596,8 @@ class Connection:
                 # received payload size: the ingest bytes accounting
                 # (mgr report telemetry) reads it off the message
                 msg.wire_bytes = len(payload)
+                if _ACCOUNTING:
+                    self.stats.note_rx(msg.TYPE, len(payload))
                 self.msgr.note_peer_clock(
                     msg.src, getattr(msg, "send_stamp", None))
                 # dedup: a lossless session replays after reconnect,
@@ -455,6 +608,9 @@ class Connection:
                 # REORDERING as duplication and silently drop frames
                 dup = (msg.seq <= self.in_seq if self.policy.resend
                        else msg.seq == self.in_seq)
+                if dup and self.policy.resend and _ACCOUNTING:
+                    # a session-replay duplicate absorbed by seq
+                    self.stats.replays += 1
                 self.in_seq = max(self.in_seq, msg.seq)
                 if self.policy.resend:
                     # ack duplicates too: the original ack may have
@@ -502,8 +658,13 @@ class Connection:
             if item[0] == TAG_MSG:
                 pending.append(item)
         replay = {d: None for _, d in self.unacked}
+        if replay and _ACCOUNTING:
+            self.stats.resends += len(replay)
         for d in replay:
-            self.out_q.put_nowait((TAG_MSG, d))
+            if _ACCOUNTING:
+                self.out_q.put_nowait((TAG_MSG, d, time.monotonic()))
+            else:
+                self.out_q.put_nowait((TAG_MSG, d))
         for item in pending:
             if item[1] not in replay:
                 self.out_q.put_nowait(item)
@@ -564,6 +725,9 @@ class Messenger:
         # hook for injected skew/drift).
         self.clock_skew = 0.0
         self.clock_offsets: dict[str, float] = {}   # peer entity -> s
+        # per-peer wire accounting folded from dead connections (live
+        # connections keep their own WireStats; net_dump merges both)
+        self.net_folded: dict[str, WireStats] = {}
         # optional crash capture: when set, an exception escaping a
         # spawned task is handed here (the daemon writes a crash
         # report) instead of dying unobserved as an "exception was
@@ -795,6 +959,7 @@ class Messenger:
         closes the writer."""
         from ..utils import denc
 
+        t0 = time.monotonic()
         try:
             # pre-auth reads are time-bounded: an idle dialer must not
             # pin an accept handler (and thus shutdown) indefinitely
@@ -869,6 +1034,7 @@ class Messenger:
                         if s > peer.get("ack", 0)]
         if not conn.is_open:
             return False    # raced mark_down: nobody will run this
+        conn.stats.note_handshake(time.monotonic() - t0)
         conn._transports.put_nowait((reader, writer, framer, comp))
         return True
 
@@ -938,8 +1104,66 @@ class Messenger:
                     await res
 
     def _forget(self, conn: Connection) -> None:
+        # fold the dying connection's wire accounting into the
+        # per-peer aggregate (counters survive connection churn); the
+        # stats block is replaced so a second _forget cannot
+        # double-count
+        key = conn.peer_entity or conn.peer_addr or "?"
+        agg = self.net_folded.get(key)
+        if agg is None:
+            agg = self.net_folded[key] = WireStats()
+        agg.fold(conn.stats)
+        conn.stats = WireStats()
         if conn.peer_addr is not None:
             if self._conns.get(conn.peer_addr) is conn:
                 del self._conns[conn.peer_addr]
         elif conn in self._inbound:
             self._inbound.remove(conn)
+
+    # -- wire telemetry ------------------------------------------------------
+
+    def net_dump(self, cap: int | None = None) -> dict:
+        """Per-peer wire telemetry: folded dead-connection aggregates
+        merged with live connections.  Keys per peer are the
+        NET_STAGES-registered WireStats dump fields plus the live
+        send-queue depth.  With ``cap``, only the busiest ``cap - 1``
+        peers (by tx bytes) keep their own row and the tail folds
+        into ``"other"`` — the tenant-label cardinality rule applied
+        to peers (many short-lived clients must not grow the report
+        without bound)."""
+        merged: dict[str, WireStats] = {}
+        for key, st in self.net_folded.items():
+            agg = merged.setdefault(key, WireStats())
+            agg.fold(st)
+        depth: dict[str, int] = {}
+        for conn in list(self._conns.values()) + list(self._inbound):
+            key = conn.peer_entity or conn.peer_addr or "?"
+            agg = merged.setdefault(key, WireStats())
+            agg.fold(conn.stats)
+            depth[key] = depth.get(key, 0) + conn.out_q.qsize()
+        if cap is not None and len(merged) > cap:
+            keep = sorted(merged, key=lambda k:
+                          (-merged[k].tx_bytes, k))[:max(cap - 1, 1)]
+            other = WireStats()
+            other_depth = 0
+            for key in list(merged):
+                if key not in keep:
+                    other.fold(merged.pop(key))
+                    other_depth += depth.pop(key, 0)
+            merged["other"] = other
+            depth["other"] = other_depth
+        return {key: st.dump(queue_depth=depth.get(key, 0))
+                for key, st in sorted(merged.items())}
+
+    def prune_peer_state(self, live, prefix: str = "osd.") -> None:
+        """Drop dead peers' clock-offset and folded-wire entries.
+        Both tables are keyed by peer entity and otherwise grow
+        forever across thrash kill/revive cycles (every revived
+        daemon dials back from a fresh nonce).  Only entities under
+        ``prefix`` are considered — client/mon entries are someone
+        else's liveness to judge."""
+        live = set(live)
+        for table in (self.clock_offsets, self.net_folded):
+            for key in list(table):
+                if key.startswith(prefix) and key not in live:
+                    del table[key]

@@ -199,6 +199,21 @@ class OSD:
         self.perf.add_u64("repair_full",
                           "shards rebuilt via whole-object read +"
                           " re-encode")
+        # network observability plane: messenger lossless-resend /
+        # replay / mark_down totals surfaced as per-daemon counters,
+        # plus the per-peer heartbeat RTT tracker (admin:
+        # dump_osd_network; beacon net slice -> OSD_SLOW_PING_TIME)
+        self.perf.add_u64("msgr_resends",
+                          "lossless payloads requeued for session"
+                          " replay after reconnect")
+        self.perf.add_u64("msgr_replays",
+                          "duplicate frames absorbed by seq dedup"
+                          " after reconnect")
+        self.perf.add_u64("msgr_mark_downs",
+                          "administrative connection teardowns")
+        from .network import OsdNetwork
+        self.network = OsdNetwork(self.ctx)
+        self._net_prev: dict | None = None
         self._beacon_stamp = 0.0
         # one periodic scrub at a time per daemon (the reference's
         # scrubs_local bound collapsed to 1)
@@ -2666,6 +2681,27 @@ class OSD:
                 if osd >= self.osdmap.max_osd \
                         or not self.osdmap.is_up(osd):
                     del self.hb_last_rx[osd]
+            # network plane housekeeping: the RTT tracker prunes by
+            # the same rule, the messenger drops dead osd peers'
+            # clock-offset and folded-wire entries (both tables would
+            # otherwise grow forever across kill/revive cycles), the
+            # wire ring takes a cumulative per-peer byte sample for
+            # the chrome-trace counter tracks, and the messenger
+            # resend/replay totals land in the perf counters
+            alive = [osd for osd in range(self.osdmap.max_osd)
+                     if self.osdmap.is_up(osd)]
+            self.network.prune(alive)
+            self.msgr.prune_peer_state("osd.%d" % o for o in alive)
+            net_rows = self.msgr.net_dump()
+            self.network.sample_wire(
+                now, {k: v for k, v in net_rows.items()
+                      if k.startswith("osd.")})
+            self.perf.set("msgr_resends", sum(
+                r["resends"] for r in net_rows.values()))
+            self.perf.set("msgr_replays", sum(
+                r["replays"] for r in net_rows.values()))
+            self.perf.set("msgr_mark_downs", sum(
+                r["mark_downs"] for r in net_rows.values()))
             for osd in range(self.osdmap.max_osd):
                 if osd == self.whoami or not self.osdmap.is_up(osd):
                     continue
@@ -2793,7 +2829,12 @@ class OSD:
             # the worst tenant; legacy mons drop the unknown field
             slow_tenants=self.optracker.slow_tenants(),
             device_fallback=int(chip.fallback),
-            device_chip=chip.index))
+            device_chip=chip.index,
+            # heartbeat RTT slice (worst peers + slow set) feeding
+            # the mon's OSD_SLOW_PING_TIME edge; None until a peer
+            # answers a stamped ping, so the beacon stays
+            # byte-stable with legacy frames
+            net=self.network.beacon_slice()))
 
     def _obj_logical_size(self, pg: PG, ho, is_ec: bool) -> int:
         """Logical object bytes: an EC shard records the full logical
@@ -2990,8 +3031,53 @@ class OSD:
                        # long-flow progress rows (recovery drains,
                        # scrub sweeps) — digest progress section +
                        # progress_start/finish events on the bus
-                       "progress": self._progress_rows()}),
+                       "progress": self._progress_rows(),
+                       # network plane: per-peer wire counters, wire
+                       # rates over the report interval and the RTT
+                       # rollup — digest net section, net.* history
+                       # series, ceph_tpu_net_* exporter families
+                       "net": self._net_stats_row()}),
             entity_hint="mgr")
+
+    def _net_stats_row(self) -> dict:
+        """osd_stats["net"]: this daemon's wire/RTT slice for the mgr
+        digest.  Rates are computed here, over the report interval —
+        the digest is instantaneous soft state and only the producer
+        knows its own cadence.  Per-peer detail is cardinality-capped
+        at the messenger (worst peers kept, tail folded into
+        "other")."""
+        now = time.monotonic()
+        cap = max(1, int(self.ctx.conf.get("net_peer_max", 32)))
+        rows = self.msgr.net_dump(cap=cap)
+        tx = sum(r["tx_bytes"] for r in rows.values())
+        rx = sum(r["rx_bytes"] for r in rows.values())
+        resends = sum(r["resends"] for r in rows.values())
+        tx_bps = rx_bps = resend_rate = 0.0
+        prev = self._net_prev
+        if prev is not None:
+            dt = max(now - prev["t"], 1e-6)
+            tx_bps = max(0.0, (tx - prev["tx"]) / dt)
+            rx_bps = max(0.0, (rx - prev["rx"]) / dt)
+            resend_rate = max(0.0, (resends - prev["resends"]) / dt)
+        self._net_prev = {"t": now, "tx": tx, "rx": rx,
+                          "resends": resends}
+        return {
+            "tx_bytes": tx, "rx_bytes": rx,
+            "tx_Bps": round(tx_bps, 1), "rx_Bps": round(rx_bps, 1),
+            "resends": resends,
+            "replays": sum(r["replays"] for r in rows.values()),
+            "mark_downs": sum(r["mark_downs"]
+                              for r in rows.values()),
+            "queue_depth": sum(r["queue_depth"]
+                               for r in rows.values()),
+            "resend_rate": round(resend_rate, 3),
+            "peers": rows,
+            "rtt": self.network.summary(),
+            # per-peer 5s-window RTT (ms): the cluster RTT matrix row
+            "rtt_peers": {str(p): round(
+                pr.ewma.get("5s", 0.0) * 1000.0, 3)
+                for p, pr in sorted(self.network.peers.items())},
+        }
 
     def _handle_ping(self, conn, msg: MOSDPing) -> None:
         if msg.op == "ping":
@@ -3000,7 +3086,17 @@ class OSD:
                                epoch=self.osdmap.epoch
                                if self.osdmap else 0))
         else:
-            self.hb_last_rx[msg.osd] = time.monotonic()
+            now = time.monotonic()
+            self.hb_last_rx[msg.osd] = now
+            # the reply echoes our ping's send stamp: RTT = now -
+            # stamp.  Legacy stampless frames echo None — the RTT
+            # matrix stays partial instead of the daemon failing
+            if msg.stamp is not None:
+                try:
+                    self.network.note_rtt(
+                        msg.osd, now - float(msg.stamp), now)
+                except (TypeError, ValueError):
+                    pass
 
     # -- helpers -----------------------------------------------------------
 

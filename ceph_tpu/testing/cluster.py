@@ -44,6 +44,11 @@ FAST_CONF = {
     "osd_op_complaint_time": 5.0,
     "osd_beacon_report_interval": 0.25,
     "osd_op_history_size": 64,
+    # network plane at dev pacing: 40ms heartbeat RTT is slow (an
+    # injected net_degrade delay of ~80ms trips it; healthy in-proc
+    # pings run far under 1ms), well below the 600ms grace so a slow
+    # pair warns long before it is declared dead
+    "osd_slow_ping_time_ms": 40.0,
     # stats plane at dev pacing: per-PG stat rows and PGMap digests
     # must cross OSD -> mgr -> mon within a thrash round
     "osd_mgr_report_interval": 0.3,
@@ -463,9 +468,19 @@ class LocalCluster:
                 take("osd.%d" % osd.whoami, osd.ctx)
         for m in self.mons:
             take(m.msgr.entity, m.ctx)
+        # per-peer wire-throughput counter tracks from the OSDs'
+        # heartbeat-paced cumulative samples
+        net: dict[str, list[dict]] = {}
+        for osd in self.osds:
+            if osd is None:
+                continue
+            ring = getattr(getattr(osd, "network", None),
+                           "wire_ring", None)
+            if ring:
+                net["osd.%d" % osd.whoami] = [dict(r) for r in ring]
         doc = flight.chrome_trace(
             rings, offsets=self.clock_offsets(),
-            device=flight.device_records(),
+            device=flight.device_records(), net=net,
             meta={"seed": self.seed, "mesh": mesh.describe()})
         if path:
             import json
@@ -510,7 +525,12 @@ class LocalCluster:
                            osd.optracker.dump_historic_slow_ops(),
                        "ring_tail": ring_tail(osd.ctx.log.ring, 200),
                        "clog_pending": osd.clog.num_pending,
-                       "clog_counts": dict(osd.clog.counts)}
+                       "clog_counts": dict(osd.clog.counts),
+                       # the network block: per-peer wire telemetry
+                       # (WireStats dumps) + heartbeat RTT tracking
+                       "net": {
+                           "wire": osd.msgr.net_dump(),
+                           "rtt": osd.network.dump()}}
             try:
                 d["statfs"] = osd.store.statfs()
                 d["pending_crash_reports"] = [

@@ -214,6 +214,15 @@ class ClusterThrasher:
                          latency alert (the bully being throttled
                          at its dmClock limit tag is by design, not
                          a violation).
+      net_degrade      — the network-plane oracle: hold every frame
+                         between one seeded OSD pair ~80ms each way
+                         (past the slow-ping bar, under the ping
+                         period and far under the failure grace);
+                         the leader must commit OSD_SLOW_PING_TIME
+                         NAMING the pair, the raise must survive a
+                         leader change, writes keep landing, and
+                         lifting the delay clears the committed
+                         edge.
 
     Slow-op oracle: after every round's health check, no live OSD may
     still hold an op in flight past osd_op_complaint_time — a healthy
@@ -237,7 +246,7 @@ class ClusterThrasher:
                    "device_fallback", "chip_loss", "osd_crash",
                    "mixed_rmw", "corrupt_shard", "corrupt_replica",
                    "corrupt_compressed", "poison_mid_compress",
-                   "bully_tenant", "repair_compare")
+                   "bully_tenant", "repair_compare", "net_degrade")
 
     def __init__(self, cluster, seed: int = 0, rounds: int = 3,
                  actions: tuple | list | None = None,
@@ -295,7 +304,7 @@ class ClusterThrasher:
                       "corrupt_replica", "corrupt_compressed",
                       "poison_mid_compress", "bully_tenant",
                       "repair_compare", "corrupt_dedup_index",
-                      "poison_mid_chunk"):
+                      "poison_mid_chunk", "net_degrade"):
             return (action, self.rng.randrange(1 << 16))
         raise ValueError("unknown thrash action %r" % action)
 
@@ -550,6 +559,8 @@ class ClusterThrasher:
             if pid is None:
                 return              # no compression pool under thrash
             await self._poison_mid_compress_round(c, pid, arg)
+        elif action == "net_degrade":
+            await self._net_degrade_round(c, arg, workload)
         elif action == "corrupt_dedup_index":
             await self._corrupt_dedup_index_round(c, arg)
         elif action == "poison_mid_chunk":
@@ -604,6 +615,62 @@ class ClusterThrasher:
         # zero lost acked writes, bully included — throttling must
         # never become loss
         await asyncio.wait_for(gen.verify(), 120.0)
+
+    async def _net_degrade_round(self, c, seed: int,
+                                 workload) -> None:
+        """Degrade one peer link mid-round: every frame between a
+        seeded OSD pair is held ~80ms each way — past the 40ms
+        slow-ping bar, under the 100ms ping period (no send-queue
+        buildup, so the RTT stays stable and the clear is fast), and
+        far under the 600ms failure grace (the pair must degrade,
+        never die).  The leader must commit an OSD_SLOW_PING_TIME
+        raise NAMING the pair, the raise must survive a leader
+        change (it is paxos-committed), writes must keep landing,
+        and lifting the delay must clear the committed edge."""
+        osds = sorted(o.whoami for o in c.live_osds)
+        if len(osds) < 2:
+            return
+        n = len(osds)
+        ai = seed % n
+        a = osds[ai]
+        b = osds[(ai + 1 + (seed // n) % (n - 1)) % n]
+        pair = "osd.%d-osd.%d" % (min(a, b), max(a, b))
+        ea, eb = "osd.%d" % a, "osd.%d" % b
+        c.injector(ea).add_rule(src=ea, dst=eb,
+                                delay_p=1.0, delay=0.08)
+        c.injector(eb).add_rule(src=eb, dst=ea,
+                                delay_p=1.0, delay=0.08)
+        self.log.append("net_degrade: delaying %s" % pair)
+        try:
+            # the committed raise must NAME the degraded pair
+            await self._wait_health_check(
+                c, "OSD_SLOW_PING_TIME", True, timeout=45.0)
+            chk = c.leader().health_mon.checks()[
+                "OSD_SLOW_PING_TIME"]
+            assert pair in (chk.get("pairs") or ()), chk
+            # a degraded link is not an outage: writes keep landing
+            for _ in range(3):
+                assert (await workload.write_one()) is not None, \
+                    "write could not complete on the degraded link"
+            if c.n_mons >= 3:
+                # the edge is paxos-committed: losing the leader
+                # must not lose the raise (the successor re-warns
+                # from the committed pair list and its own beacon
+                # soft state)
+                old = c.leader().rank
+                c.partition_mon(old)
+                await c.client.mon_command("status", timeout=30.0)
+                await self._wait_health_check(
+                    c, "OSD_SLOW_PING_TIME", True, timeout=45.0)
+                c.heal_mon(old)
+                await c.wait_quorum()
+        finally:
+            c.injector(ea).clear_rules()
+            c.injector(eb).clear_rules()
+        # delay lifted: healthy pings resume within a period and the
+        # committed edge must clear
+        await self._wait_health_check(
+            c, "OSD_SLOW_PING_TIME", False, timeout=45.0)
 
     async def _slo_oracle(self, c, timeout: float = 45.0) -> None:
         """Post-round tenant SLO oracle: once the cluster is healthy
@@ -1371,6 +1438,18 @@ class ClusterThrasher:
                 "healthy round: %r"
                 % [(e.get("who"), e.get("message"))
                    for e in errs[:5]])
+        # network-plane oracle: a healthy round (every fault lifted,
+        # every acked write verified) must not leave a slow-ping
+        # alert raised — in-process peer pings run far under the bar,
+        # so a lingering OSD_SLOW_PING_TIME means the clear edge was
+        # lost somewhere in the counter->beacon->paxos chain
+        if leader is not None and hasattr(leader, "health_mon"):
+            from ..utils.backoff import wait_for
+            await wait_for(
+                lambda: "OSD_SLOW_PING_TIME"
+                        not in leader.health_mon.checks(),
+                30.0, what="slow-ping alert cleared after a "
+                           "healthy round")
         # stats-plane oracle (clusters running a mgr): the PGMap
         # digest — OSD stat rows -> mgr -> mon, never internal state —
         # must drain its degraded + misplaced counts to EXACTLY zero

@@ -215,6 +215,7 @@ def _lane_for(lanes: list[float], t0: float) -> int:
 def chrome_trace(rings: dict[str, list[dict]],
                  offsets: dict[str, float] | None = None,
                  device: list[dict] | None = None,
+                 net: dict[str, list[dict]] | None = None,
                  meta: dict | None = None) -> dict:
     """Merge per-daemon flight-recorder rings (+ the device ticket
     ring) into one Chrome-trace JSON document.
@@ -229,6 +230,11 @@ def chrome_trace(rings: dict[str, list[dict]],
       the cluster;
     * the device ring is its own process with one base thread per
       chip (overlapping in-flight dispatches fan onto chip lanes);
+    * `net` (daemon -> cumulative per-peer {"t","peer","tx","rx"}
+      wire samples, osd/network.py's ring) renders as per-peer
+      throughput counter tracks (`ph:"C"`) under each daemon's
+      process — rates are clamped non-negative deltas, so a
+      reconnect's counter reset shows as a zero, not a plunge;
     * `offsets` (entity -> seconds, the clock-offset solver's output)
       normalize every daemon's stamps onto one reference clock.
 
@@ -237,6 +243,7 @@ def chrome_trace(rings: dict[str, list[dict]],
     tests pin)."""
     offsets = offsets or {}
     device = device or []
+    net = net or {}
     events: list[dict] = []
     flows: list[dict] = []
 
@@ -247,6 +254,8 @@ def chrome_trace(rings: dict[str, list[dict]],
     stamps = [t_of(d, r["t0"]) for d, recs in rings.items()
               for r in recs]
     stamps += [t["t_enqueue"] for t in device]
+    stamps += [t_of(d, float(row.get("t") or 0.0))
+               for d, rows in net.items() for row in rows]
     t_base = min(stamps) if stamps else 0.0
 
     def us(t):
@@ -375,6 +384,40 @@ def chrome_trace(rings: dict[str, list[dict]],
                     "ph": "C", "name": "chip-%d %s" % (chip, key),
                     "cat": "device", "pid": dpid, "ts": us(stamp),
                     "args": {key: counts[key]}})
+
+    # per-peer wire-throughput counter tracks (ph:"C"): rates walked
+    # from the OSDs' cumulative tx/rx wire samples (osd/network.py's
+    # heartbeat-paced ring), one counter per (daemon, peer) beside
+    # the daemon's own op lanes — deltas clamped non-negative so a
+    # reconnect's counter reset reads as a zero, not a plunge
+    if net:
+        next_pid = len(pid_of) + (2 if device else 1)
+        for daemon in sorted(net):
+            pid = pid_of.get(daemon)
+            if pid is None:
+                pid = next_pid
+                next_pid += 1
+                events.append({"ph": "M", "name": "process_name",
+                               "pid": pid, "tid": 0,
+                               "args": {"name": daemon}})
+            prev: dict = {}
+            for row in net[daemon]:
+                t = t_of(daemon, float(row.get("t") or 0.0))
+                peer = str(row.get("peer"))
+                tx = int(row.get("tx") or 0)
+                rx = int(row.get("rx") or 0)
+                p = prev.get(peer)
+                prev[peer] = (t, tx, rx)
+                if p is None or t <= p[0]:
+                    continue
+                dt = t - p[0]
+                events.append({
+                    "ph": "C", "name": "net %s" % peer,
+                    "cat": "net", "pid": pid, "ts": us(t),
+                    "args": {
+                        "tx_Bps": round(max(0, tx - p[1]) / dt, 1),
+                        "rx_Bps": round(max(0, rx - p[2]) / dt, 1),
+                    }})
 
     # stable order: metadata first, then slices sorted by ts (a
     # stable sort keeps a stage slice after its enclosing op slice at

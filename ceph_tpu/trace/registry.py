@@ -151,6 +151,26 @@ HISTORY_SERIES = frozenset({
     "tenant.p99_ms", "tenant.burn_fast",        # label: tenant
     "repair.bytes_read", "repair.bytes_moved",
     "dedup.bytes_stored", "dedup.bytes_saved",
+    # network plane (label: daemon)
+    "net.rtt_ms", "net.queue_depth", "net.resend_rate",
+})
+
+# network plane: the per-peer messenger telemetry fields WireStats
+# dumps (msg/messenger.py — admin-socket `dump_osd_network`, the
+# osd_stats net rows and collect_diagnostics all serve them) and the
+# net exporter families the mgr renders.  Both directions linted.
+NET_STAGES = frozenset({
+    "queue_depth", "queue_wait_s", "resends", "replays",
+    "mark_downs", "handshake_s", "backoff_s",
+})
+
+NET_SERIES = frozenset({
+    "ceph_tpu_net_resends_total", "ceph_tpu_net_replays_total",
+    "ceph_tpu_net_mark_downs_total", "ceph_tpu_net_queue_depth",
+    "ceph_tpu_net_peer_tx_bytes_total",
+    "ceph_tpu_net_peer_rx_bytes_total",
+    "ceph_tpu_net_rtt_ms", "ceph_tpu_net_backoff_seconds",
+    "ceph_tpu_net_handshake_seconds",
 })
 
 # event bus: the committed event types the mon emits (EventMonitor
@@ -169,6 +189,22 @@ CONSUMER_HISTORY_REFS = {
     "tests/test_history.py": (
         "io.write_ops_s", "device.busy_frac", "tenant.p99_ms",
         "pg.degraded",
+    ),
+    "tests/test_net.py": (
+        "net.rtt_ms", "net.resend_rate",
+    ),
+}
+
+# consumers referencing the net plane (WireStats fields / exporter
+# families) by literal — registered AND literally present, both ways
+CONSUMER_NET_REFS = {
+    "bench.py": (
+        "ceph_tpu_net_rtt_ms", "ceph_tpu_net_peer_tx_bytes_total",
+        "resends", "queue_depth",
+    ),
+    "tests/test_net.py": (
+        "ceph_tpu_net_rtt_ms", "ceph_tpu_net_resends_total",
+        "resends", "replays", "queue_wait_s",
     ),
 }
 
@@ -539,6 +575,61 @@ def lint_history_plane(root: str | None = None) -> list[str]:
     return errors
 
 
+def lint_net_plane(root: str | None = None) -> list[str]:
+    """Network-plane drift lint: every registered WireStats field
+    must still be a literal dump key in msg/messenger.py (the single
+    emission module), every registered net exporter family must
+    literally appear in the mgr's renderer, and every consumer
+    reference must be registered AND still literally present in the
+    consumer's source — so a rename anywhere in the
+    counter->digest->exporter chain fails here."""
+    errors: list[str] = []
+    base = _repo_root(root)
+    msgr_path = os.path.join(base, "ceph_tpu", "msg",
+                             "messenger.py")
+    try:
+        with open(msgr_path) as f:
+            msgr_src = f.read()
+    except OSError:
+        errors.append("ceph_tpu/msg/messenger.py is missing")
+        msgr_src = ""
+    for name in sorted(NET_STAGES):
+        if '"%s"' % name not in msgr_src:
+            errors.append(
+                "registered net telemetry field %r is no longer"
+                " dumped by ceph_tpu/msg/messenger.py" % name)
+    mgr_path = os.path.join(base, "ceph_tpu", "mgr", "daemon.py")
+    try:
+        with open(mgr_path) as f:
+            mgr_src = f.read()
+    except OSError:
+        errors.append("ceph_tpu/mgr/daemon.py is missing")
+        mgr_src = ""
+    for fam in sorted(NET_SERIES):
+        if fam not in mgr_src:
+            errors.append(
+                "registered net series %r is not rendered by"
+                " ceph_tpu/mgr/daemon.py" % fam)
+    for relpath, names in sorted(CONSUMER_NET_REFS.items()):
+        path = os.path.join(base, relpath)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            errors.append("consumer %s is missing" % relpath)
+            continue
+        for name in names:
+            if name not in NET_SERIES and name not in NET_STAGES:
+                errors.append(
+                    "%s references unregistered net name %r"
+                    % (relpath, name))
+            if '"%s"' % name not in src:
+                errors.append(
+                    "%s no longer references net name %r (stale"
+                    " CONSUMER_NET_REFS entry?)" % (relpath, name))
+    return errors
+
+
 def lint_event_plane(root: str | None = None) -> list[str]:
     """Event-bus drift lint: every event type emitted in the mon
     package (`emit_event("...")` / the HealthMonitor's `emit("...")`
@@ -585,4 +676,4 @@ def lint_repo(root: str | None = None) -> list[str]:
     return (lint_emissions(root) + lint_device_series()
             + lint_consumers(root) + lint_tenant_plane(root)
             + lint_mgr_plane(root) + lint_history_plane(root)
-            + lint_event_plane(root))
+            + lint_net_plane(root) + lint_event_plane(root))

@@ -89,6 +89,19 @@ class AdminSocket:
                       lambda a: recorder().dump(),
                       "dump the flight-recorder span ring")
 
+        # heartbeat RTT matrix (osd.network.OsdNetwork): the
+        # reference's `ceph daemon osd.N dump_osd_network` — same
+        # lazy-backref pattern (only OSDs track peer pings)
+        def network():
+            net = getattr(ctx, "osd_network", None)
+            if net is None:
+                raise RuntimeError("this daemon tracks no peer pings")
+            return net
+
+        self.register("dump_osd_network",
+                      lambda a: network().dump(),
+                      "dump per-peer heartbeat RTT tracking")
+
     # -- server ----------------------------------------------------------
     def start(self) -> None:
         if not self.path:

@@ -21,7 +21,8 @@ class ExpBackoff:
     """One retry ramp: ``next_delay()`` yields base, ~2*base, ...
     capped at ``cap``; ``reset()`` re-arms after a success."""
 
-    __slots__ = ("base", "cap", "factor", "rng", "_interval")
+    __slots__ = ("base", "cap", "factor", "rng", "_interval",
+                 "attempts")
 
     def __init__(self, base: float = 0.05, cap: float = 2.0,
                  factor: float = 2.0,
@@ -31,17 +32,27 @@ class ExpBackoff:
         self.factor = float(factor)
         self.rng = rng or random
         self._interval = self.base
+        self.attempts = 0
 
     def reset(self) -> None:
         self._interval = self.base
+        self.attempts = 0
 
     def peek(self) -> float:
         """The un-jittered current interval (for tests/telemetry)."""
         return self._interval
 
+    def state(self) -> dict:
+        """Introspection hook for telemetry (the messenger's net
+        plane renders the active redial ramp): current un-jittered
+        interval plus how many steps the ramp has taken since the
+        last reset — 0 attempts means the ramp is idle."""
+        return {"interval_s": self._interval, "attempts": self.attempts}
+
     def next_delay(self) -> float:
         """Advance the ramp and return the jittered wait."""
         interval = self._interval
+        self.attempts += 1
         self._interval = min(self._interval * self.factor, self.cap)
         return interval / 2.0 + self.rng.random() * (interval / 2.0)
 

@@ -228,6 +228,15 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("public_addr", OPT_STR, "", "daemon bind address"),
     Option("heartbeat_interval", OPT_FLOAT, 1.0, "osd peer heartbeat period (s)"),
     Option("heartbeat_grace", OPT_FLOAT, 6.0, "failure grace before reporting (s)"),
+    Option("osd_slow_ping_time_ms", OPT_FLOAT, 0.0,
+           "heartbeat RTT above this raises OSD_SLOW_PING_TIME for"
+           " the peer pair; 0 derives 5 percent of heartbeat_grace"),
+    Option("net_peer_max", OPT_INT, 32,
+           "per-peer wire-stat rows an osd_stats net report keeps;"
+           " the tail folds into an 'other' row"),
+    Option("net_label_max", OPT_INT, 8,
+           "peer labels per daemon the net exporter families keep;"
+           " the tail folds into an 'other' label"),
     Option("mon_osd_down_out_interval", OPT_FLOAT, 30.0,
            "seconds before a down osd is auto-marked out"),
     Option("mon_osd_min_down_reporters", OPT_INT, 1,
@@ -496,7 +505,8 @@ DEFAULT_SCHEMA: list[Option] = [
            " (dropped_labels), never silently folded"),
     Option("history_anomaly_series", OPT_STR,
            "device.busy_frac,device.queue_wait_frac,"
-           "tenant.p99_ms,tenant.burn_fast",
+           "tenant.p99_ms,tenant.burn_fast,"
+           "net.rtt_ms,net.resend_rate",
            "comma-separated HISTORY_SERIES names the anomaly engine"
            " watches for sustained upward shifts"),
     Option("history_anomaly_z", OPT_FLOAT, 6.0,

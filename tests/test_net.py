@@ -1,0 +1,460 @@
+"""Network observability plane: per-connection wire accounting
+(WireStats), the heartbeat RTT matrix (OsdNetwork + dump_osd_network),
+stamped-ping / legacy-beacon wire back-compat, the paxos-committed
+OSD_SLOW_PING_TIME edge, the net.* history series, chrome-trace
+per-peer throughput counter tracks, and the net_degrade thrash round.
+
+The commit shape mirrors the event/SLO planes: counters on the hot
+path -> beacon slice -> mon soft state -> leader-committed edges, so a
+freshly elected leader that never saw a beacon still reports the slow
+pair.
+"""
+
+import asyncio
+import os
+import types
+
+from ceph_tpu.msg import Messenger, Policy, decode_message, encode_message
+from ceph_tpu.msg.messages import MOSDBeacon, MOSDOpReply, MOSDPing
+from ceph_tpu.msg.messenger import WireStats
+from ceph_tpu.osd.network import OsdNetwork
+from ceph_tpu.testing import ClusterThrasher, LocalCluster, Workload
+from ceph_tpu.utils import denc
+from ceph_tpu.utils.backoff import wait_for
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(coro, timeout=240):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def _net_ctx(**conf):
+    """Minimal ctx stand-in: OsdNetwork only reads .conf and writes
+    the .osd_network backref."""
+    return types.SimpleNamespace(conf=dict(conf))
+
+
+# -- WireStats: the per-connection accounting unit --------------------------
+
+
+def test_wirestats_accounting_and_fold():
+    st = WireStats()
+    st.note_tx("osd_op", 100)
+    st.note_tx("osd_op", 50)
+    st.note_tx("osd_ping", 10)
+    st.note_rx("osd_op_reply", 70)
+    st.note_queue_wait(0.002)
+    st.note_queue_wait(0.010)
+    st.note_handshake(0.001)
+    d = st.dump(queue_depth=3)
+    assert d["tx_msgs"] == 3 and d["tx_bytes"] == 160
+    assert d["rx_msgs"] == 1 and d["rx_bytes"] == 70
+    assert d["by_type_tx"]["osd_op"] == [2, 150]
+    assert d["by_type_rx"]["osd_op_reply"] == [1, 70]
+    assert d["queue_depth"] == 3
+    assert abs(d["queue_wait_s"] - 0.012) < 1e-9
+    assert d["queue_wait_n"] == 2
+    assert d["queue_wait_max_s"] == 0.010
+    assert d["resends"] == 0 and d["replays"] == 0
+    assert d["handshakes"] == 1
+
+    # fold (connection death -> messenger aggregate) is additive
+    other = WireStats()
+    other.note_tx("osd_op", 25)
+    other.resends = 2
+    other.replays = 1
+    st.fold(other)
+    d2 = st.dump()
+    assert d2["tx_msgs"] == 4 and d2["by_type_tx"]["osd_op"] == [3, 175]
+    assert d2["resends"] == 2 and d2["replays"] == 1
+
+
+# -- OsdNetwork: RTT rings, the two-condition slow rule ---------------------
+
+
+def test_osd_network_rtt_windows():
+    net = OsdNetwork(_net_ctx(osd_slow_ping_time_ms=40.0,
+                              heartbeat_grace=0.6))
+    t = 1000.0
+    for i in range(20):
+        net.note_rtt(1, 0.002, now=t + i * 0.1)
+    d = net.dump()
+    row = d["peers"]["osd.1"]
+    assert row["samples"] == 20
+    assert row["last_ms"] == 2.0
+    assert row["min_ms"] == 2.0 and row["max_ms"] == 2.0
+    for name in ("5s", "60s", "15m"):
+        assert abs(row["avg_ms"][name] - 2.0) < 0.01
+    assert sum(row["hist_us_pow2"]) == 20
+    assert d["threshold_ms"] == 40.0
+    assert d["slow"] == []
+    # negative deltas (clock weirdness on a legacy echo) are dropped
+    net.note_rtt(1, -0.5)
+    assert net.peers[1].samples == 20
+
+
+def test_slow_peer_two_condition_rule():
+    net = OsdNetwork(_net_ctx(osd_slow_ping_time_ms=40.0,
+                              heartbeat_grace=0.6))
+    t = 2000.0
+    # a single spiky probe over the bar must NOT flag the peer: the
+    # 5s window average is still healthy
+    for i in range(50):
+        net.note_rtt(1, 0.002, now=t + i * 0.1)
+    net.note_rtt(1, 0.300, now=t + 5.1)
+    assert net.slow_peers() == []
+    # sustained delay flips both conditions
+    for i in range(60):
+        net.note_rtt(1, 0.080, now=t + 6.0 + i * 0.1)
+    assert net.slow_peers() == [1]
+    # one healthy probe clears IMMEDIATELY (the last-probe condition;
+    # a pure EWMA would hold the alert for window constants)
+    net.note_rtt(1, 0.001, now=t + 12.1)
+    assert net.slow_peers() == []
+
+
+def test_threshold_derives_from_grace_when_unset():
+    net = OsdNetwork(_net_ctx(osd_slow_ping_time_ms=0.0,
+                              heartbeat_grace=2.0))
+    assert abs(net.slow_threshold_s() - 0.1) < 1e-9
+
+
+def test_beacon_slice_cap_and_prune():
+    net = OsdNetwork(_net_ctx(osd_slow_ping_time_ms=40.0,
+                              heartbeat_grace=0.6))
+    # no peer answered a stamped ping yet: the slice must be None so
+    # legacy beacons stay byte-stable
+    assert net.beacon_slice() is None
+    t = 3000.0
+    for peer in range(6):
+        for i in range(10):
+            net.note_rtt(peer, 0.001 * (peer + 1), now=t + i * 0.1)
+    sl = net.beacon_slice(cap=3)
+    assert set(sl) == {"rtt_ms", "slow"}
+    # worst 3 peers by 5s-window RTT keep their rows
+    assert sorted(sl["rtt_ms"]) == ["3", "4", "5"]
+    assert sl["slow"] == []
+    net.prune([0, 1])
+    assert sorted(net.peers) == [0, 1]
+    s = net.summary()
+    assert s["peers"] == 2 and s["rtt_max_ms"] > 0
+
+
+def test_dump_osd_network_admin_command(tmp_path):
+    from ceph_tpu.utils.admin import admin_command
+    from ceph_tpu.utils.context import Context
+    path = str(tmp_path / "osd.asok")
+    ctx = Context("osd.7", conf_overrides={"admin_socket": path})
+    try:
+        net = OsdNetwork(ctx)
+        net.note_rtt(2, 0.005)
+        d = admin_command(path, "dump_osd_network")
+        assert "osd.2" in d["peers"]
+        assert d["peers"]["osd.2"]["samples"] == 1
+    finally:
+        ctx.shutdown()
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+# -- wire back-compat: stamped pings, legacy beacons ------------------------
+
+
+def test_stampless_ping_backcompat():
+    # a legacy peer's ping has no stamp field at all: it must decode
+    # with stamp None (the receiver echoes None and skips the RTT
+    # feed — the matrix stays sparse, nothing crashes)
+    legacy = denc.encode_versioned(
+        ["osd_ping", 5, "osd.1", {"osd": 1, "op": "ping", "epoch": 3}],
+        1, 1)
+    p = decode_message(legacy)
+    assert isinstance(p, MOSDPing)
+    assert p.stamp is None and p.osd == 1
+    # a stamped ping round-trips its stamp exactly
+    p2 = decode_message(encode_message(
+        MOSDPing(osd=2, op="reply", stamp=123.456, epoch=9)))
+    assert p2.stamp == 123.456
+    # fields from NEWER versions are dropped, not fatal
+    p3 = MOSDPing.from_wire({"osd": 1, "op": "ping", "stamp": 1.0,
+                             "epoch": 3, "rtt_hint_2030": 42})
+    assert p3.osd == 1 and not hasattr(p3, "rtt_hint_2030")
+
+
+def test_beacon_byte_stable_without_net():
+    # a beacon with no net slice must encode BYTE-IDENTICALLY to the
+    # pre-net wire form (what an old daemon emits) — mixed-version
+    # clusters keep one canonical encoding per logical beacon
+    legacy_fields = {"osd": 3, "epoch": 9, "slow_ops": 0,
+                     "slow_tenants": {}, "device_fallback": 0,
+                     "device_chip": None}
+    legacy = denc.encode_versioned(
+        ["osd_beacon", 0, "", dict(legacy_fields)], 1, 1)
+    m = MOSDBeacon(net=None, **legacy_fields)
+    assert encode_message(m) == legacy
+    # ...and the legacy bytes decode with net None
+    old = decode_message(legacy)
+    assert isinstance(old, MOSDBeacon) and old.net is None
+    # a net-carrying beacon round-trips the slice
+    m2 = MOSDBeacon(net={"rtt_ms": {"1": 83.0}, "slow": [1]},
+                    **legacy_fields)
+    out = decode_message(encode_message(m2))
+    assert out.net == {"rtt_ms": {"1": 83.0}, "slow": [1]}
+
+
+# -- messenger: per-peer telemetry on real connections ----------------------
+
+
+def test_messenger_net_dump_counts():
+    class Sink:
+        def __init__(self):
+            self.got = []
+
+        def ms_dispatch(self, conn, msg):
+            self.got.append(msg)
+            return True
+
+    async def main():
+        server = Messenger("osd.0")
+        await server.bind()
+        sink = Sink()
+        server.add_dispatcher(sink)
+        client = Messenger("osd.1")
+        conn = client.connect_to(server.addr, entity_hint="osd.0")
+        n = 5
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        await wait_for(lambda: len(sink.got) >= n, 10.0,
+                       what="burst delivered")
+        crow = client.net_dump()["osd.0"]
+        assert crow["tx_msgs"] >= n
+        assert crow["by_type_tx"]["osd_op_reply"][0] == n
+        assert crow["queue_wait_s"] >= 0.0
+        assert crow["handshakes"] >= 1 and crow["handshake_s"] >= 0.0
+        srow = server.net_dump()["osd.1"]
+        assert srow["rx_msgs"] >= n
+        assert srow["by_type_rx"]["osd_op_reply"][0] == n
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main(), timeout=30)
+
+
+def test_messenger_resends_accounted():
+    class Sink:
+        def __init__(self):
+            self.got = []
+
+        def ms_dispatch(self, conn, msg):
+            self.got.append(msg)
+            return True
+
+        def ms_handle_reset(self, conn):
+            pass
+
+    async def main():
+        server = Messenger("osd.0")
+        server.peer_policy["osd"] = Policy.lossless_peer()
+        await server.bind()
+        sink = Sink()
+        server.add_dispatcher(sink)
+        client = Messenger("osd.1")
+        client.peer_policy["osd"] = Policy.lossless_peer()
+        client.inject_socket_failures = 5
+        conn = client.connect_to(server.addr, entity_hint="osd.0")
+        n = 40
+        for i in range(n):
+            conn.send(MOSDOpReply(tid=i, result=0, outs=[], epoch=1,
+                                  version=0))
+        await wait_for(lambda: len(sink.got) >= n, 30.0,
+                       what="lossless burst delivered")
+        assert [m.tid for m in sink.got] == list(range(n))
+        # requeued payloads are accounted on the sender; duplicate
+        # frames the receiver's seq filter absorbed count as replays
+        crow = client.net_dump()["osd.0"]
+        assert crow["resends"] > 0
+        srow = server.net_dump()["osd.1"]
+        assert srow["replays"] >= 0
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main(), timeout=60)
+
+
+# -- the committed OSD_SLOW_PING_TIME edge ----------------------------------
+
+
+def test_slow_ping_edge_committed_and_survives():
+    """A beacon net slice flagging a slow peer commits the pair list
+    through paxos: a fresh monitor over the same store (the
+    freshly-elected-leader shape) raises OSD_SLOW_PING_TIME without
+    ever seeing a beacon; a clearing beacon retires it."""
+    from ceph_tpu.mon import Monitor
+    from ceph_tpu.utils.context import Context
+
+    async def main():
+        mon = Monitor(Context("mon"))
+        await mon.start()
+        try:
+            mon.ms_dispatch(None, MOSDBeacon(
+                osd=0, epoch=1, slow_ops=0,
+                net={"rtt_ms": {"1": 83.0}, "slow": [1]}))
+            assert mon.health_mon.persisted["slowping"] == \
+                ["osd.0-osd.1"]
+            checks = mon.health_mon.checks()
+            assert "OSD_SLOW_PING_TIME" in checks
+            chk = checks["OSD_SLOW_PING_TIME"]
+            assert chk["pairs"] == ["osd.0-osd.1"]
+            assert "osd.0-osd.1" in chk["summary"]
+            # steady-state beacons commit nothing new (edges only)
+            before = mon.paxos.last_committed
+            mon.ms_dispatch(None, MOSDBeacon(
+                osd=0, epoch=1, slow_ops=0,
+                net={"rtt_ms": {"1": 85.0}, "slow": [1]}))
+            assert mon.paxos.last_committed == before
+
+            # the "fresh leader": same store, zero beacons seen
+            mon2 = Monitor(Context("mon"), store=mon.store)
+            assert not mon2.osd_net
+            checks2 = mon2.health_mon.checks()
+            assert "OSD_SLOW_PING_TIME" in checks2, checks2
+            assert checks2["OSD_SLOW_PING_TIME"]["pairs"] == \
+                ["osd.0-osd.1"]
+
+            # a healthy slice clears the committed edge
+            mon.ms_dispatch(None, MOSDBeacon(
+                osd=0, epoch=1, slow_ops=0,
+                net={"rtt_ms": {"1": 0.4}, "slow": []}))
+            assert mon.health_mon.persisted["slowping"] == []
+            assert "OSD_SLOW_PING_TIME" not in mon.health_mon.checks()
+        finally:
+            await mon.shutdown()
+
+    run(main(), timeout=60)
+
+
+# -- history series + anomaly watch -----------------------------------------
+
+
+def test_net_history_series_and_latest():
+    from ceph_tpu.mgr.history import (AnomalyEngine, HistoryStore,
+                                      extract_samples)
+
+    digest = {"net": {"osd.0": {"rtt_max_ms": 83.0, "queue_depth": 4,
+                                "resend_rate": 1.5}}}
+    samples = extract_samples(digest)
+    assert ("net.rtt_ms", "osd.0", 83.0) in samples
+    assert ("net.queue_depth", "osd.0", 4.0) in samples
+    assert ("net.resend_rate", "osd.0", 1.5) in samples
+    eng = AnomalyEngine()
+    assert "net.rtt_ms" in eng.watched
+    assert "net.resend_rate" in eng.watched
+
+    store = HistoryStore()
+    t0 = 10_000_000.0
+    for i in range(10):
+        d = {"net": {"osd.0": {"rtt_max_ms": 2.0 + i,
+                               "queue_depth": i,
+                               "resend_rate": 0.0}}}
+        store.ingest(t0 + i, d, samples=extract_samples(d))
+    got = store.latest("net.rtt_ms", "osd.0", now=t0 + 40.0)
+    assert got is not None
+    val, age = got
+    assert val == 11.0
+    assert 0.0 <= age <= 41.0
+    assert store.latest("net.rtt_ms", "osd.9", now=t0) is None
+    assert "osd.0" in store.labels_for("net.rtt_ms")
+
+
+# -- chrome-trace counter tracks --------------------------------------------
+
+
+def test_chrome_trace_net_counter_tracks():
+    from ceph_tpu.trace.recorder import (chrome_trace,
+                                         validate_chrome_trace)
+
+    doc = chrome_trace({}, net={"osd.0": [
+        {"t": 100.0, "peer": "osd.1", "tx": 0, "rx": 0},
+        {"t": 101.0, "peer": "osd.1", "tx": 1000, "rx": 500},
+        {"t": 102.0, "peer": "osd.1", "tx": 1500, "rx": 600},
+    ]})
+    assert validate_chrome_trace(doc) == []
+    counters = [e for e in doc["traceEvents"]
+                if e.get("ph") == "C" and e.get("cat") == "net"]
+    assert len(counters) == 2
+    assert counters[0]["name"] == "net osd.1"
+    assert counters[0]["args"]["tx_Bps"] == 1000.0
+    assert counters[0]["args"]["rx_Bps"] == 500.0
+    assert counters[1]["args"]["tx_Bps"] == 500.0
+
+
+# -- registry lint: the drift guard itself ----------------------------------
+
+
+def test_registry_net_lint_clean():
+    from ceph_tpu.trace.registry import (NET_SERIES, NET_STAGES,
+                                         lint_history_plane,
+                                         lint_net_plane)
+
+    assert "ceph_tpu_net_rtt_ms" in NET_SERIES
+    assert "ceph_tpu_net_resends_total" in NET_SERIES
+    assert "queue_wait_s" in NET_STAGES
+    assert lint_net_plane(REPO_ROOT) == []
+    assert lint_history_plane(REPO_ROOT) == []
+
+
+# -- acceptance: the net_degrade thrash round -------------------------------
+
+
+def test_thrash_net_degrade_round():
+    """ISSUE 20 acceptance: a seeded net_degrade round raises the
+    committed OSD_SLOW_PING_TIME naming the delayed pair, keeps
+    acked writes landing, clears after the delay lifts, and leaves
+    the netstat / exporter surfaces populated."""
+
+    async def main():
+        c = await LocalCluster(n_osds=3, with_mgr=True,
+                               seed=47).start()
+        try:
+            pid = await c.create_pool("netthrash", pg_num=8)
+            await c.wait_health(pid)
+            wl = Workload(c.client.io_ctx("netthrash"),
+                          seed=47, prefix="net").start()
+            th = ClusterThrasher(c, seed=47,
+                                 actions=[("net_degrade", 0)])
+            await th.run(pid, wl)
+            await wl.stop()
+            assert wl.acked and not wl.write_failures
+            leader = c.leader()
+            assert "OSD_SLOW_PING_TIME" not in \
+                leader.health_mon.checks()
+            # the round logged which pair it delayed
+            assert any("net_degrade" in ln for ln in th.log)
+
+            # `net status` serves the full beacon-fed RTT matrix
+            ns = await c.client.mon_command("net status")
+            rows = ns.get("rtt_ms") or {}
+            assert len(rows) == 3, ns
+            assert all(len(v) >= 2 for v in rows.values()), ns
+            assert ns["slow_pairs"] == []
+
+            # the exporter renders the net families (drift-lint
+            # consumer refs, by literal) and the exposition is clean
+            from ceph_tpu.utils.exporter import validate_exposition
+            text = c.mgr.exporter.render()
+            assert "ceph_tpu_net_rtt_ms" in text
+            assert "ceph_tpu_net_resends_total" in text
+            assert "ceph_tpu_net_peer_tx_bytes_total" in text
+            assert validate_exposition(text) == []
+
+            # the diagnostics bundle carries each daemon's wire +
+            # RTT dumps
+            diag = c.collect_diagnostics()
+            nrow = diag["daemons"]["osd.0"]["net"]
+            assert "wire" in nrow and "rtt" in nrow
+            assert nrow["rtt"]["peers"], nrow
+        finally:
+            await c.stop()
+
+    run(main(), timeout=240)
